@@ -1,0 +1,28 @@
+"""pypulsar_tpu — a TPU-native pulsar search & timing framework.
+
+A ground-up redesign of the capabilities of `pypulsar` (Patrick Lazarus'
+pure-NumPy pulsar toolkit riding on PRESTO; reference at /root/reference)
+for JAX/XLA/Pallas on TPU:
+
+- ``core``     : Spectra pytree container + physical constants (replaces the
+                 external ``psr_utils`` surface; see SURVEY.md §2.5).
+- ``ops``      : pure-JAX kernels (dedisperse / subband / downsample / smooth /
+                 scale / mask / zero-DM / detrend / fold / SNR) with NumPy
+                 golden twins for parity testing.
+- ``parallel`` : (planned) device-mesh DM-trial sweep engine (shard_map over
+                 ICI), time-axis sharding with halo exchange, streaming.
+- ``io``       : (planned) SIGPROC filterbank / PSRFITS / .dat/.inf/.fft /
+                 pulse-text / zaplist / accelcands readers & writers
+                 (replaces PRESTO's sigproc/infodata codecs).
+- ``plan``     : (planned) dedispersion planning (DDplan equivalent;
+                 reference utils/DDplan2b.py).
+- ``fourier``  : (planned) power spectra, dereddening, harmonic sums, zapping.
+- ``fold``     : (planned) polyco evaluation+generation, pulse profiles, TOAs
+                 (FFTFIT equivalent in jnp.fft).
+- ``astro``    : (planned) coordinates, time, sky temperature, radiometer SNR.
+- ``cli``      : (planned) command-line tools mirroring reference bin/ scripts.
+"""
+
+__version__ = "0.1.0"
+
+from pypulsar_tpu.core.spectra import Spectra  # noqa: F401

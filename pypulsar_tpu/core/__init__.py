@@ -1,0 +1,2 @@
+from pypulsar_tpu.core import psrmath  # noqa: F401
+from pypulsar_tpu.core.spectra import Spectra  # noqa: F401

@@ -1,0 +1,136 @@
+"""Pulsar math + physical constants.
+
+Replaces the external PRESTO ``psr_utils`` surface the reference imports
+everywhere (import census in SURVEY.md §2.5; heaviest users:
+reference formats/spectra.py, utils/DDplan2b.py, bin/dissect.py).
+
+Host-side (NumPy) implementations.  The device kernels in
+``pypulsar_tpu.ops`` re-implement ``delay_from_DM``/``rotate`` in jnp with
+identical semantics; parity is enforced by tests/test_kernels.py.
+
+Convention note: the dispersion constant follows the PRESTO convention
+``t = DM / (2.41e-4 * f^2)`` seconds (f in MHz) — i.e. k_DM ~= 4148.808 s
+rounded to 1/2.41e-4 = 4149.38 s — because the reference's numbers are all
+produced with that constant (reference formats/spectra.py:247-250 via
+psr_utils.delay_from_DM).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# --- constants (PRESTO-compatible values) ---
+SECPERDAY = 86400.0
+SECPERJULYR = 31557600.0
+TWOPI = 2.0 * np.pi
+PIBYTWO = np.pi / 2.0
+DEGTORAD = np.pi / 180.0
+RADTODEG = 180.0 / np.pi
+HRTORAD = np.pi / 12.0
+RADTOHR = 12.0 / np.pi
+ARCSECTORAD = np.pi / (180.0 * 3600.0)
+RADTOARCSEC = 1.0 / ARCSECTORAD
+#: GM_sun / c^3 in seconds
+Tsun = 4.925490947e-6
+#: dispersion constant: delay[s] = DM / (DM_CONST_INV * f_MHz^2)
+DM_CONST_INV = 2.41e-4
+KDM = 1.0 / DM_CONST_INV  # ~4149.38 s MHz^2 cm^3 / pc
+
+
+def delay_from_DM(DM, freq_emitted):
+    """Dispersion delay in seconds at frequency ``freq_emitted`` (MHz).
+
+    Zero (not inf) for non-positive frequencies, matching the reference's
+    use for masked/dummy channels.
+    """
+    f = np.asarray(freq_emitted, dtype=np.float64)
+    out = np.where(f > 0.0, DM / (DM_CONST_INV * f * f), 0.0)
+    if np.isscalar(freq_emitted) or out.ndim == 0:
+        return float(out)
+    return out
+
+
+def dm_smear(DM, BW, center_freq):
+    """Smearing (s) across bandwidth ``BW`` MHz at ``center_freq`` MHz for ``DM``."""
+    return DM * BW / (0.0001205 * center_freq ** 3.0)
+
+
+def rotate(arr, bins):
+    """Rotate an array to the LEFT by ``bins`` places (circular).
+
+    Semantics of psr_utils.rotate as used by the reference
+    (formats/spectra.py:80, bin/pfd_snr.py).
+    """
+    arr = np.asarray(arr)
+    bins = int(bins) % len(arr)
+    if bins == 0:
+        return arr.copy()
+    return np.concatenate((arr[bins:], arr[:bins]))
+
+
+def p_to_f(p, pd, pdd=None):
+    """Convert period (+derivatives) to frequency (+derivatives)."""
+    f = 1.0 / p
+    fd = -pd / (p * p)
+    if pdd is None:
+        return f, fd
+    if pdd == 0.0:
+        fdd = 0.0
+    else:
+        fdd = 2.0 * pd * pd / (p ** 3.0) - pdd / (p * p)
+    return f, fd, fdd
+
+
+# identical algebra both directions
+f_to_p = p_to_f
+
+
+def pulsar_B(p, pd):
+    """Surface magnetic field (Gauss) from P (s) and Pdot."""
+    return 3.2e19 * np.sqrt(p * pd)
+
+
+def pulsar_age(f, fdot, n=3, fo=1e99):
+    """Characteristic age (s) for braking index n."""
+    return -f / ((n - 1.0) * fdot) * (1.0 - (f / fo) ** (n - 1.0))
+
+
+def pulsar_edot(f, fdot, I=1.0e45):
+    """Spin-down luminosity (erg/s)."""
+    return -4.0 * np.pi * np.pi * I * f * fdot
+
+
+def mass_funct(pb, x):
+    """Binary mass function (Msun). pb: orbital period (s), x: a*sin(i)/c (s)."""
+    return 4.0 * np.pi ** 2 / Tsun * x ** 3.0 / pb ** 2.0
+
+
+def mass_funct2(mp, mc, i):
+    """Mass function (Msun) from component masses and inclination (rad)."""
+    return (mc * np.sin(i)) ** 3.0 / (mc + mp) ** 2.0
+
+
+def companion_mass_limits(pb, x, mpsr=1.4):
+    """Solve f(mc) = mass_funct for mc at i=90deg (minimum companion mass)."""
+    fm = mass_funct(pb, x)
+    mc = max(fm, 0.1)
+    for _ in range(200):
+        mc = (fm * (mpsr + mc) ** 2.0) ** (1.0 / 3.0)
+    return mc
+
+
+def gaussian_profile(N, phase, fwhm):
+    """Gaussian pulse profile with N bins, peak at ``phase`` (0-1), integrated
+    flux of 1; wrap-around aware."""
+    sigma = fwhm / 2.0 / np.sqrt(2.0 * np.log(2.0))
+    mean = phase % 1.0
+    phss = np.arange(N, dtype=np.float64) / N - mean
+    # wrap to [-0.5, 0.5)
+    phss = (phss + 0.5) % 1.0 - 0.5
+    return np.exp(-0.5 * (phss / sigma) ** 2.0) / (sigma * np.sqrt(2.0 * np.pi)) / N
+
+
+def span_bins(delays_sec, dt):
+    """Integer bin delays (np.round, half-even — matching the reference's
+    use of np.round at formats/spectra.py:250)."""
+    return np.round(np.asarray(delays_sec) / dt).astype(np.int64)

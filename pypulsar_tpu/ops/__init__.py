@@ -1,0 +1,4 @@
+from pypulsar_tpu.ops import kernels  # noqa: F401
+
+# numpy_ref (the scipy-dependent golden twins) is imported lazily by tests;
+# not re-exported here to keep scipy out of the production import path.

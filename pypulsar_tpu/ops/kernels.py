@@ -1,0 +1,248 @@
+"""Pure-JAX kernels for the pulsar data plane.
+
+Each kernel is a pure function on ``data[nchan, nspec]`` arrays mirroring the
+behavior of a reference Spectra method (reference formats/spectra.py) or
+preprocessing script, redesigned for XLA:
+
+- per-channel variable shifts are index-gathers with static shapes (instead of
+  the reference's Python loop of psr_utils.rotate at formats/spectra.py:76-94),
+  so they vmap over DM trials and shard over a device mesh;
+- integer bin delays may be passed in precomputed (host f64, exactly matching
+  the reference's NumPy delay math) or computed on device from a traced DM;
+- shape-changing ops (trim / downsample) take static Python ints.
+
+NumPy golden twins live in ``pypulsar_tpu.ops.numpy_ref``; parity is enforced
+in tests/test_kernels.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pypulsar_tpu.core.psrmath import DM_CONST_INV
+
+
+def delay_from_DM(dm, freqs):
+    """Dispersion delay (s) at freqs (MHz). Device version of
+    core.psrmath.delay_from_DM; 0 for non-positive frequencies."""
+    freqs = jnp.asarray(freqs)
+    return jnp.where(freqs > 0.0, dm / (DM_CONST_INV * freqs * freqs), 0.0)
+
+
+def bin_delays(dm, freqs, dt, ref_freq=None):
+    """Integer relative bin delays for dedispersion at ``dm`` (traced OK).
+
+    Matches reference formats/spectra.py:247-250: delays relative to the
+    highest frequency, rounded half-even (np.round semantics).
+    """
+    if ref_freq is None:
+        ref_freq = jnp.max(freqs)
+    rel = delay_from_DM(dm, freqs) - delay_from_DM(dm, ref_freq)
+    return jnp.round(rel / dt).astype(jnp.int32)
+
+
+def rotate_rows(data, bins):
+    """Left-rotate each row of ``data[C, T]`` by ``bins[C]`` places (circular).
+
+    Gather formulation of the reference's per-channel psr_utils.rotate loop
+    (formats/spectra.py:76-80); works under vmap/jit with traced bins.
+    """
+    T = data.shape[-1]
+    idx = (jnp.arange(T, dtype=jnp.int32)[None, :] + bins[:, None].astype(jnp.int32)) % T
+    return jnp.take_along_axis(data, idx, axis=-1)
+
+
+def shift_channels(data, bins, padval=0):
+    """Shift each channel left by bins[c]; pad vacated cells.
+
+    padval: numeric, 'mean', 'median' (of the rotated channel — the reference
+    computes pad stats after rotation, formats/spectra.py:81-94), or 'rotate'
+    (pure circular shift).
+    """
+    shifted = rotate_rows(data, bins)
+    if padval == "rotate":
+        return shifted
+    if padval == "mean":
+        pad = jnp.mean(shifted, axis=-1, keepdims=True)
+    elif padval == "median":
+        pad = jnp.median(shifted, axis=-1, keepdims=True)
+    else:
+        pad = jnp.full((data.shape[0], 1), padval, dtype=data.dtype)
+    T = data.shape[-1]
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    b = bins[:, None].astype(jnp.int32)
+    vacated = jnp.where(b > 0, t >= T - b, t < -b)
+    return jnp.where(vacated, pad.astype(data.dtype), shifted)
+
+
+def dedisperse(data, freqs, dt, dm, in_dm=0.0, padval=0):
+    """Dedisperse at ``dm`` given current dm ``in_dm`` (reference
+    formats/spectra.py:229-254, with the :37 dm-discard bug fixed)."""
+    bins = bin_delays(dm - in_dm, freqs, dt)
+    return shift_channels(data, bins, padval)
+
+
+def dedisperse_with_bins(data, bins, padval=0):
+    """Dedisperse with host-precomputed integer bin delays (exact f64 path)."""
+    return shift_channels(data, bins, padval)
+
+
+def subband(data, freqs, dt, nsub, subdm=None, in_dm=0.0, padval=0):
+    """Sum channel groups into ``nsub`` subbands, optionally dedispersing
+    within each subband at ``subdm`` first (reference formats/spectra.py:96-138).
+
+    Returns (subbanded_data[nsub, T], subband_center_freqs[nsub]).
+    """
+    C, T = data.shape
+    assert C % nsub == 0
+    per = C // nsub
+    hif = freqs[:: per]
+    lof = freqs[per - 1 :: per]
+    ctr = 0.5 * (hif + lof)
+    if subdm is not None:
+        ref = delay_from_DM(subdm - in_dm, hif)
+        delays = delay_from_DM(subdm - in_dm, freqs)
+        rel = delays - jnp.repeat(ref, per)
+        bins = jnp.round(rel / dt).astype(jnp.int32)
+        data = shift_channels(data, bins, padval)
+    out = data.reshape(nsub, per, T).sum(axis=1)
+    return out, ctr
+
+
+def downsample(data, factor):
+    """Co-add ``factor`` adjacent time bins; excess trimmed off the end
+    (reference formats/spectra.py:329-351). ``factor`` static."""
+    if factor <= 1:
+        return data
+    C, T = data.shape
+    T2 = T // factor
+    return data[:, : T2 * factor].reshape(C, T2, factor).sum(axis=-1)
+
+
+def smooth(data, width, padval=0):
+    """RMS-preserving boxcar smooth of each channel: convolve with
+    ones(width)/sqrt(width), 'same' alignment after padding ``width`` samples
+    on both sides per ``padval`` mode (reference formats/spectra.py:262-303,
+    itself from PRESTO single_pulse_search). ``width`` static."""
+    if width <= 1:
+        return data
+    C, T = data.shape
+    kernel = (jnp.ones(width, dtype=jnp.float32) / jnp.sqrt(float(width))).astype(data.dtype)
+    if padval == "wrap":
+        left, right = data[:, -width:], data[:, :width]
+    elif padval == "mean":
+        m = jnp.mean(data, axis=-1, keepdims=True)
+        left = right = jnp.broadcast_to(m, (C, width))
+    elif padval == "median":
+        m = jnp.median(data, axis=-1, keepdims=True)
+        left = right = jnp.broadcast_to(m, (C, width))
+    else:
+        left = right = jnp.full((C, width), padval, dtype=data.dtype)
+    tosmooth = jnp.concatenate([left, data, right], axis=-1)
+    # full f32 accumulation: XLA's default conv precision is bf16 on TPU
+    sm = jax.vmap(
+        lambda row: jnp.convolve(row, kernel, mode="same", precision=jax.lax.Precision.HIGHEST)
+    )(tosmooth)
+    return sm[:, width:-width]
+
+
+def scaled(data, indep=False):
+    """Subtract per-channel median; divide by global (or per-channel) std of
+    the ORIGINAL data (reference formats/spectra.py:140-163)."""
+    med = jnp.median(data, axis=-1, keepdims=True)
+    std = jnp.std(data, axis=-1, keepdims=True) if indep else jnp.std(data)
+    return (data - med) / std
+
+
+def scaled2(data, indep=False):
+    """Subtract per-channel min; divide by global (or per-channel) max of the
+    ORIGINAL data (reference formats/spectra.py:165-188)."""
+    mn = jnp.min(data, axis=-1, keepdims=True)
+    mx = jnp.max(data, axis=-1, keepdims=True) if indep else jnp.max(data)
+    return (data - mn) / mx
+
+
+def channel_maskvals(data, maskval="median-mid80"):
+    """Per-channel fill value for masking (reference formats/spectra.py:211-224).
+
+    'median-mid80': median of the channel with top & bottom 10% of sorted
+    samples removed (n = round(0.1*T); full median when n rounds to 0).
+    """
+    C, T = data.shape
+    if maskval == "mean":
+        return jnp.mean(data, axis=-1)
+    if maskval == "median":
+        return jnp.median(data, axis=-1)
+    if maskval == "median-mid80":
+        n = int(np.round(0.1 * T))
+        if n == 0:
+            return jnp.median(data, axis=-1)
+        srt = jnp.sort(data, axis=-1)[:, n:-n]
+        return jnp.median(srt, axis=-1)
+    return jnp.full((C,), maskval, dtype=data.dtype)
+
+
+def masked(data, mask, maskval="median-mid80"):
+    """Replace masked cells (mask True) with per-channel fill values
+    (reference formats/spectra.py:190-227)."""
+    vals = channel_maskvals(data, maskval)
+    return jnp.where(mask, vals[:, None].astype(data.dtype), data)
+
+
+def zero_dm(data):
+    """Zero-DM RFI filter: subtract the cross-channel mean from every time
+    sample (reference bin/zero_dm_filter.py:30-39)."""
+    return data - jnp.mean(data, axis=0, keepdims=True)
+
+
+def trim(data, bins):
+    """Drop ``bins`` spectra from the end (or start if negative); static.
+
+    Parity exception: the reference's negative branch (formats/spectra.py:324-327)
+    slices ``data[:, bins:]`` which KEEPS only the last |bins| samples and
+    grows numspectra — contradicting its own docstring. We implement the
+    documented intent: drop |bins| samples from the beginning.
+    """
+    if bins == 0:
+        return data
+    if bins > 0:
+        return data[:, :-bins]
+    return data[:, -bins:]
+
+
+# ---------------------------------------------------------------------------
+# detection / reduction kernels used by the sweep engine
+# ---------------------------------------------------------------------------
+
+
+def dedispersed_timeseries(data, bins):
+    """Fold channels into a dedispersed time series: sum over channels after
+    per-channel circular left-shift. The hot kernel of the DM sweep."""
+    return rotate_rows(data, bins).sum(axis=0)
+
+
+def boxcar_snr(ts, widths):
+    """Matched-filter boxcar SNRs of a 1-D time series.
+
+    Normalizes ts to zero median / unit std, then for each width w convolves
+    with ones(w)/sqrt(w) (the RMS-preserving kernel of reference
+    formats/spectra.py:283 / formats/pulse.py smooth) and takes the max.
+    Returns (best_snr_per_width[len(widths)], argmax_per_width[len(widths)]).
+    ``widths`` is a static tuple.
+    """
+    med = jnp.median(ts)
+    std = jnp.std(ts)
+    norm = (ts - med) / jnp.where(std == 0, 1.0, std)
+    cs = jnp.concatenate([jnp.zeros(1, norm.dtype), jnp.cumsum(norm)])
+    snrs = []
+    idxs = []
+    n = norm.shape[0]
+    for w in widths:
+        sums = (cs[w:] - cs[:-w]) / jnp.sqrt(float(w))
+        snrs.append(jnp.max(sums))
+        idxs.append(jnp.argmax(sums))
+    return jnp.stack(snrs), jnp.stack(idxs)

@@ -1,0 +1,186 @@
+"""NumPy golden twins of the JAX kernels.
+
+These mirror the REFERENCE semantics (reference formats/spectra.py,
+bin/zero_dm_filter.py) in float64 NumPy, serving as the bit-level spec for
+parity tests (SURVEY.md §4 strategy 1). They are intentionally written in the
+reference's own style (per-channel loops) so behavioral equivalence is easy to
+audit, and are never used on the hot path.
+
+Known reference defects (SURVEY.md §2.6) are FIXED here the same way they are
+in the JAX kernels, so twin == kernel by construction:
+- constructor dm discard (spectra.py:37): in_dm honored;
+- trim(bins<0) slice bug (spectra.py:324-327): documented intent implemented.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.signal
+
+from pypulsar_tpu.core.psrmath import delay_from_DM, rotate
+
+
+def bin_delays(dm, freqs, dt, ref_freq=None):
+    if ref_freq is None:
+        ref_freq = np.max(freqs)
+    rel = delay_from_DM(dm, np.asarray(freqs, dtype=np.float64)) - delay_from_DM(dm, ref_freq)
+    return np.round(rel / dt).astype(np.int64)
+
+
+def shift_channels(data, bins, padval=0):
+    data = np.array(data, dtype=np.float64)
+    C, T = data.shape
+    for ii in range(C):
+        chan = data[ii]
+        chan[:] = rotate(chan, bins[ii])
+        if padval != "rotate":
+            if padval == "mean":
+                pad = np.mean(chan)
+            elif padval == "median":
+                pad = np.median(chan)
+            else:
+                pad = padval
+            if bins[ii] > 0:
+                chan[-bins[ii]:] = pad
+            elif bins[ii] < 0:
+                chan[: -bins[ii]] = pad
+    return data
+
+
+def dedisperse(data, freqs, dt, dm, in_dm=0.0, padval=0):
+    bins = bin_delays(dm - in_dm, freqs, dt)
+    return shift_channels(data, bins, padval)
+
+
+def subband(data, freqs, dt, nsub, subdm=None, in_dm=0.0, padval=0):
+    data = np.array(data, dtype=np.float64)
+    C, T = data.shape
+    assert C % nsub == 0
+    per = C // nsub
+    hif = np.asarray(freqs)[np.arange(nsub) * per]
+    lof = np.asarray(freqs)[(1 + np.arange(nsub)) * per - 1]
+    ctr = 0.5 * (hif + lof)
+    if subdm is not None:
+        ref = delay_from_DM(subdm - in_dm, hif)
+        delays = delay_from_DM(subdm - in_dm, np.asarray(freqs, dtype=np.float64))
+        rel = delays - np.repeat(ref, per)
+        bins = np.round(rel / dt).astype(np.int64)
+        data = shift_channels(data, bins, padval)
+    out = np.array([np.sum(sub, axis=0) for sub in np.vsplit(data, nsub)])
+    return out, ctr
+
+
+def downsample(data, factor):
+    if factor <= 1:
+        return np.array(data, dtype=np.float64)
+    data = np.asarray(data, dtype=np.float64)
+    C, T = data.shape
+    T2 = T // factor
+    data = data[:, : T2 * factor]
+    return np.array(
+        np.column_stack([np.sum(s, axis=1) for s in np.hsplit(data, T2)])
+    )
+
+
+def smooth(data, width, padval=0):
+    data = np.array(data, dtype=np.float64)
+    if width <= 1:
+        return data
+    C, T = data.shape
+    kernel = np.ones(width, dtype="float32") / np.sqrt(width)
+    for ii in range(C):
+        chan = data[ii]
+        if padval == "wrap":
+            tosmooth = np.concatenate([chan[-width:], chan, chan[:width]])
+        elif padval == "mean":
+            tosmooth = np.ones(T + width * 2) * np.mean(chan)
+            tosmooth[width:-width] = chan
+        elif padval == "median":
+            tosmooth = np.ones(T + width * 2) * np.median(chan)
+            tosmooth[width:-width] = chan
+        else:
+            tosmooth = np.ones(T + width * 2) * padval
+            tosmooth[width:-width] = chan
+        smoothed = scipy.signal.convolve(tosmooth, kernel, "same")
+        chan[:] = smoothed[width:-width]
+    return data
+
+
+def scaled(data, indep=False):
+    data = np.array(data, dtype=np.float64)
+    if not indep:
+        std = data.std()
+    for ii in range(data.shape[0]):
+        chan = data[ii]
+        median = np.median(chan)
+        if indep:
+            std = chan.std()
+        chan[:] = (chan - median) / std
+    return data
+
+
+def scaled2(data, indep=False):
+    data = np.array(data, dtype=np.float64)
+    if not indep:
+        mx = data.max()
+    for ii in range(data.shape[0]):
+        chan = data[ii]
+        mn = chan.min()
+        if indep:
+            mx = chan.max()
+        chan[:] = (chan - mn) / mx
+    return data
+
+
+def masked(data, mask, maskval="median-mid80"):
+    data = np.array(data, dtype=np.float64)
+    C, T = data.shape
+    maskvals = np.ones(C)
+    for ii in range(C):
+        chan = data[ii]
+        if maskval == "mean":
+            maskvals[ii] = np.mean(chan)
+        elif maskval == "median":
+            maskvals[ii] = np.median(chan)
+        elif maskval == "median-mid80":
+            n = int(np.round(0.1 * T))
+            if n == 0:
+                maskvals[ii] = np.median(chan)
+            else:
+                maskvals[ii] = np.median(np.sort(chan)[n:-n])
+        else:
+            maskvals[ii] = maskval
+    tmp = np.ones_like(data) * maskvals[:, np.newaxis]
+    return np.where(mask, tmp, data)
+
+
+def zero_dm(data):
+    data = np.asarray(data, dtype=np.float64)
+    return data - data.mean(axis=0, keepdims=True)
+
+
+def trim(data, bins):
+    data = np.asarray(data, dtype=np.float64)
+    if bins == 0:
+        return data
+    if bins > 0:
+        return data[:, :-bins]
+    return data[:, -bins:]
+
+
+def dedispersed_timeseries(data, bins):
+    return shift_channels(data, bins, padval="rotate").sum(axis=0)
+
+
+def boxcar_snr(ts, widths):
+    ts = np.asarray(ts, dtype=np.float64)
+    med = np.median(ts)
+    std = np.std(ts)
+    norm = (ts - med) / (std if std != 0 else 1.0)
+    cs = np.concatenate([[0.0], np.cumsum(norm)])
+    snrs, idxs = [], []
+    for w in widths:
+        sums = (cs[w:] - cs[:-w]) / np.sqrt(float(w))
+        snrs.append(sums.max())
+        idxs.append(int(sums.argmax()))
+    return np.array(snrs), np.array(idxs)
