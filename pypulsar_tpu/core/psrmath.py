@@ -45,7 +45,7 @@ def delay_from_DM(DM, freq_emitted):
     """
     f = np.asarray(freq_emitted, dtype=np.float64)
     out = np.where(f > 0.0, DM / (DM_CONST_INV * f * f), 0.0)
-    if np.isscalar(freq_emitted) or out.ndim == 0:
+    if out.ndim == 0:
         return float(out)
     return out
 

@@ -1,0 +1,1 @@
+from pypulsar_tpu.fold.pulse import Pulse, SummedPulse, read_pulse_from_file  # noqa: F401

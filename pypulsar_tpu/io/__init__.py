@@ -1,0 +1,3 @@
+from pypulsar_tpu.io import sigproc  # noqa: F401
+from pypulsar_tpu.io.filterbank import FilterbankFile, write_filterbank  # noqa: F401
+from pypulsar_tpu.io.infodata import InfoData  # noqa: F401

@@ -1,0 +1,176 @@
+"""SIGPROC filterbank file reader/writer.
+
+Replaces reference formats/filterbank.py (and its external sigproc dep) with
+our own codec. The loader boundary is ``get_spectra(startsamp, N) -> Spectra``
+(reference formats/filterbank.py:143-157): data arrives on host as
+[time, chan], is transposed to [chan, time] and wrapped in a Spectra.
+
+Also provides a writer (the reference has none beyond header copies in
+bin/zero_dm_filter.py:21-27) — needed for synthetic-injection tests
+(SURVEY.md §4 strategy 2) and for CLI tools that rewrite .fil files.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from pypulsar_tpu.core.spectra import Spectra
+from pypulsar_tpu.io import sigproc
+
+
+class FilterbankFile:
+    """Random-access SIGPROC filterbank reader.
+
+    Attributes mirror the reference reader: ``header`` dict, ``frequencies``
+    (per-channel MHz, in file channel order), ``nspec`` total samples,
+    ``is_hifreq_first`` (foff < 0).
+    """
+
+    def __init__(self, filfn: str):
+        self.filename = filfn
+        if not os.path.isfile(filfn):
+            raise ValueError(f"File does not exist: {filfn}")
+        self.filfile = open(filfn, "rb")
+        self.header, self.header_params, self.header_size = sigproc.read_header(
+            self.filfile
+        )
+        nbits = int(self.header["nbits"])
+        if nbits == 32:
+            self.dtype = np.dtype("float32")
+        elif nbits in (8, 16):
+            self.dtype = np.dtype(f"uint{nbits}")
+        else:
+            raise ValueError(f"unsupported nbits={nbits} (supported: 8, 16, 32)")
+        self.nbits = nbits
+        self.data_size = os.stat(filfn).st_size - self.header_size
+        bytes_per_sample = self.nchans * (nbits // 8)
+        if self.data_size % bytes_per_sample:
+            warnings.warn("Not an integer number of samples in file.")
+        self.number_of_samples = self.data_size // bytes_per_sample
+        self.frequencies = self.fch1 + self.foff * np.arange(self.nchans)
+        self.freqs = self.frequencies
+        self.is_hifreq_first = self.foff < 0
+
+    # header fields as attributes (reference filterbank.py:36)
+    def __getattr__(self, name):
+        try:
+            return self.__dict__["header"][name]
+        except KeyError:
+            raise AttributeError(name)
+
+    @property
+    def nspec(self) -> int:
+        return self.number_of_samples
+
+    @property
+    def obs_duration(self) -> float:
+        return self.number_of_samples * self.tsamp
+
+    def close(self):
+        self.filfile.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def seek_to_sample(self, sampnum: int):
+        self.filfile.seek(self.header_size + (self.nbits // 8) * self.nchans * sampnum)
+
+    def read_Nsamples(self, N: int) -> np.ndarray:
+        return np.fromfile(self.filfile, dtype=self.dtype, count=self.nchans * N)
+
+    def read_all_samples(self) -> np.ndarray:
+        self.seek_to_sample(0)
+        return np.fromfile(self.filfile, dtype=self.dtype)
+
+    def get_samples(self, startsamp: int, N: int) -> np.ndarray:
+        """Raw [time, chan] block as float32 (no Spectra wrapper)."""
+        startsamp = int(startsamp)
+        N = int(N)
+        if startsamp < 0 or startsamp + N > self.number_of_samples:
+            raise ValueError(
+                f"requested samples [{startsamp}, {startsamp + N}) outside "
+                f"file range [0, {self.number_of_samples})"
+            )
+        self.seek_to_sample(startsamp)
+        data = self.read_Nsamples(N)
+        data.shape = (N, self.nchans)
+        return data.astype(np.float32)
+
+    def get_spectra(self, startsamp: int, N: int) -> Spectra:
+        """The loader boundary: [chan, time] Spectra of N samples."""
+        data = self.get_samples(startsamp, N)
+        return Spectra(
+            self.frequencies,
+            self.tsamp,
+            data.T,
+            starttime=self.tsamp * startsamp,
+            dm=0.0,
+        )
+
+    def iter_blocks(
+        self, block_size: int, overlap: int = 0, start: int = 0, end: Optional[int] = None
+    ) -> Iterator[Tuple[int, np.ndarray]]:
+        """Stream [time, chan] blocks with ``overlap`` samples of lookahead
+        beyond each block (overlap-save for chunked dedispersion; the TPU
+        analogue of the reference's file streaming, SURVEY.md §2.4 row 3).
+
+        Yields (startsamp, block[time, chan]) with block length
+        block_size + overlap except possibly at the tail.
+        """
+        end = self.number_of_samples if end is None else min(end, self.number_of_samples)
+        pos = start
+        while pos < end:
+            n = min(block_size + overlap, end - pos)
+            yield pos, self.get_samples(pos, n)
+            pos += block_size
+
+
+DEFAULT_HEADER = {
+    "telescope_id": 0,
+    "machine_id": 0,
+    "data_type": 1,  # filterbank
+    "source_name": "synthetic",
+    "barycentric": 0,
+    "src_raj": 0.0,
+    "src_dej": 0.0,
+    "az_start": 0.0,
+    "za_start": 0.0,
+    "nbits": 32,
+    "nifs": 1,
+    "tstart": 60000.0,
+}
+
+
+def write_filterbank(filfn: str, header: Dict[str, object], data: np.ndarray):
+    """Write a filterbank file.
+
+    ``data`` is [time, chan] (file sample order). Required header keys:
+    fch1, foff, nchans, tsamp; everything else defaults sensibly.
+    """
+    hdr = dict(DEFAULT_HEADER)
+    hdr.update(header)
+    for key in ("fch1", "foff", "nchans", "tsamp"):
+        if key not in hdr:
+            raise ValueError(f"header missing required key {key!r}")
+    nbits = int(hdr["nbits"])
+    if nbits == 32:
+        dtype = np.dtype("float32")
+    elif nbits in (8, 16):
+        dtype = np.dtype(f"uint{nbits}")
+    else:
+        raise ValueError(f"unsupported nbits={nbits}")
+    data = np.asarray(data)
+    if data.ndim != 2 or data.shape[1] != int(hdr["nchans"]):
+        raise ValueError(
+            f"data must be [time, nchans={hdr['nchans']}]; got {data.shape}"
+        )
+    with open(filfn, "wb") as f:
+        f.write(sigproc.pack_header(hdr))
+        data.astype(dtype).tofile(f)

@@ -1,0 +1,166 @@
+"""SIGPROC filterbank header codec.
+
+Clean-room implementation of the standard SIGPROC header format (the public
+spec from Lorimer's sigproc: length-prefixed keyword strings followed by typed
+binary values), replacing PRESTO's external ``sigproc.py`` used by the
+reference (reference formats/filterbank.py:53, bin/zero_dm_filter.py:26).
+
+Little-endian throughout (SIGPROC convention on all modern hardware).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import BinaryIO, Dict, List, Tuple
+
+# keyword -> struct code ('str' for length-prefixed strings)
+HEADER_TYPES: Dict[str, str] = {
+    "telescope_id": "i",
+    "machine_id": "i",
+    "data_type": "i",
+    "rawdatafile": "str",
+    "source_name": "str",
+    "barycentric": "i",
+    "pulsarcentric": "i",
+    "az_start": "d",
+    "za_start": "d",
+    "src_raj": "d",
+    "src_dej": "d",
+    "tstart": "d",
+    "tsamp": "d",
+    "nbits": "i",
+    "nsamples": "i",
+    "fch1": "d",
+    "foff": "d",
+    "fchannel": "d",
+    "nchans": "i",
+    "nifs": "i",
+    "refdm": "d",
+    "period": "d",
+    "nbeams": "i",
+    "ibeam": "i",
+    "signed": "b",
+}
+
+# SIGPROC telescope / backend id tables (public convention)
+ids_to_telescope = {
+    0: "Fake",
+    1: "Arecibo",
+    2: "Ooty",
+    3: "Nancay",
+    4: "Parkes",
+    5: "Jodrell",
+    6: "GBT",
+    7: "GMRT",
+    8: "Effelsberg",
+    9: "ATA",
+    10: "SRT",
+    11: "LOFAR",
+    12: "VLA",
+    20: "CHIME",
+    21: "FAST",
+    64: "MeerKAT",
+}
+telescope_to_ids = {v: k for k, v in ids_to_telescope.items()}
+
+ids_to_machine = {
+    0: "FAKE",
+    1: "PSPM",
+    2: "WAPP",
+    3: "AOFTM",
+    4: "BCPM1",
+    5: "OOTY",
+    6: "SCAMP",
+    7: "SPIGOT",
+    11: "BG/P",
+    12: "PDEV",
+    20: "CHIME+PSR",
+    64: "KAT+DC",
+}
+machine_to_ids = {v: k for k, v in ids_to_machine.items()}
+
+
+def _read_string(f: BinaryIO) -> str:
+    (n,) = struct.unpack("<i", f.read(4))
+    if not 0 < n < 256:
+        raise ValueError(f"invalid SIGPROC header string length {n}")
+    return f.read(n).decode("ascii", errors="replace")
+
+
+def read_hdr_val(f: BinaryIO) -> Tuple[str, object]:
+    """Read one (keyword, value) pair; value is None for START/END markers."""
+    key = _read_string(f)
+    if key in ("HEADER_START", "HEADER_END"):
+        return key, None
+    code = HEADER_TYPES.get(key)
+    if code is None:
+        raise ValueError(f"unknown SIGPROC header keyword {key!r}")
+    if code == "str":
+        return key, _read_string(f)
+    size = struct.calcsize("<" + code)
+    (val,) = struct.unpack("<" + code, f.read(size))
+    return key, val
+
+
+def read_header(f: BinaryIO) -> Tuple[Dict[str, object], List[str], int]:
+    """Read a full header from an open file positioned at 0.
+
+    Returns (header dict, keyword order, header size in bytes).
+    """
+    f.seek(0)
+    key, _ = read_hdr_val(f)
+    if key != "HEADER_START":
+        raise ValueError("not a SIGPROC filterbank file (missing HEADER_START)")
+    header: Dict[str, object] = {}
+    order: List[str] = []
+    while True:
+        key, val = read_hdr_val(f)
+        if key == "HEADER_END":
+            break
+        header[key] = val
+        order.append(key)
+    return header, order, f.tell()
+
+
+def addto_hdr(key: str, value) -> bytes:
+    """Serialize one header entry (reference bin/zero_dm_filter.py:26 API)."""
+    kb = key.encode("ascii")
+    out = struct.pack("<i", len(kb)) + kb
+    if key in ("HEADER_START", "HEADER_END"):
+        return out
+    code = HEADER_TYPES.get(key)
+    if code is None:
+        raise ValueError(f"unknown SIGPROC header keyword {key!r}")
+    if code == "str":
+        vb = str(value).encode("ascii")
+        return out + struct.pack("<i", len(vb)) + vb
+    return out + struct.pack("<" + code, value)
+
+
+def pack_header(header: Dict[str, object], order=None) -> bytes:
+    """Serialize a complete header block."""
+    keys = [k for k in (order or header.keys()) if k in header]
+    chunks = [addto_hdr("HEADER_START", None)]
+    chunks += [addto_hdr(k, header[k]) for k in keys]
+    chunks.append(addto_hdr("HEADER_END", None))
+    return b"".join(chunks)
+
+
+def ra_to_hms_string(src_raj: float) -> str:
+    """SIGPROC src_raj double (HHMMSS.S) -> 'HH:MM:SS.SSSS'."""
+    sign = "-" if src_raj < 0 else ""
+    v = abs(src_raj)
+    hh = int(v / 10000)
+    mm = int((v - hh * 10000) / 100)
+    ss = v - hh * 10000 - mm * 100
+    return f"{sign}{hh:02d}:{mm:02d}:{ss:07.4f}"
+
+
+def dec_to_dms_string(src_dej: float) -> str:
+    """SIGPROC src_dej double (DDMMSS.S) -> 'DD:MM:SS.SSSS'."""
+    sign = "-" if src_dej < 0 else ""
+    v = abs(src_dej)
+    dd = int(v / 10000)
+    mm = int((v - dd * 10000) / 100)
+    ss = v - dd * 10000 - mm * 100
+    return f"{sign}{dd:02d}:{mm:02d}:{ss:07.4f}"

@@ -56,6 +56,7 @@ def rotate_rows(data, bins):
     return jnp.take_along_axis(data, idx, axis=-1)
 
 
+@partial(jax.jit, static_argnames=("padval",))
 def shift_channels(data, bins, padval=0):
     """Shift each channel left by bins[c]; pad vacated cells.
 
@@ -79,6 +80,7 @@ def shift_channels(data, bins, padval=0):
     return jnp.where(vacated, pad.astype(data.dtype), shifted)
 
 
+@partial(jax.jit, static_argnames=("padval",))
 def dedisperse(data, freqs, dt, dm, in_dm=0.0, padval=0):
     """Dedisperse at ``dm`` given current dm ``in_dm`` (reference
     formats/spectra.py:229-254, with the :37 dm-discard bug fixed)."""
@@ -86,11 +88,13 @@ def dedisperse(data, freqs, dt, dm, in_dm=0.0, padval=0):
     return shift_channels(data, bins, padval)
 
 
+@partial(jax.jit, static_argnames=("padval",))
 def dedisperse_with_bins(data, bins, padval=0):
     """Dedisperse with host-precomputed integer bin delays (exact f64 path)."""
     return shift_channels(data, bins, padval)
 
 
+@partial(jax.jit, static_argnames=("nsub", "subdm", "in_dm", "padval"))
 def subband(data, freqs, dt, nsub, subdm=None, in_dm=0.0, padval=0):
     """Sum channel groups into ``nsub`` subbands, optionally dedispersing
     within each subband at ``subdm`` first (reference formats/spectra.py:96-138).
@@ -113,6 +117,7 @@ def subband(data, freqs, dt, nsub, subdm=None, in_dm=0.0, padval=0):
     return out, ctr
 
 
+@partial(jax.jit, static_argnames=("factor",))
 def downsample(data, factor):
     """Co-add ``factor`` adjacent time bins; excess trimmed off the end
     (reference formats/spectra.py:329-351). ``factor`` static."""
@@ -123,6 +128,7 @@ def downsample(data, factor):
     return data[:, : T2 * factor].reshape(C, T2, factor).sum(axis=-1)
 
 
+@partial(jax.jit, static_argnames=("width", "padval"))
 def smooth(data, width, padval=0):
     """RMS-preserving boxcar smooth of each channel: convolve with
     ones(width)/sqrt(width), 'same' alignment after padding ``width`` samples
@@ -150,6 +156,7 @@ def smooth(data, width, padval=0):
     return sm[:, width:-width]
 
 
+@partial(jax.jit, static_argnames=("indep",))
 def scaled(data, indep=False):
     """Subtract per-channel median; divide by global (or per-channel) std of
     the ORIGINAL data (reference formats/spectra.py:140-163)."""
@@ -158,6 +165,7 @@ def scaled(data, indep=False):
     return (data - med) / std
 
 
+@partial(jax.jit, static_argnames=("indep",))
 def scaled2(data, indep=False):
     """Subtract per-channel min; divide by global (or per-channel) max of the
     ORIGINAL data (reference formats/spectra.py:165-188)."""
@@ -186,6 +194,7 @@ def channel_maskvals(data, maskval="median-mid80"):
     return jnp.full((C,), maskval, dtype=data.dtype)
 
 
+@partial(jax.jit, static_argnames=("maskval",))
 def masked(data, mask, maskval="median-mid80"):
     """Replace masked cells (mask True) with per-channel fill values
     (reference formats/spectra.py:190-227)."""
@@ -193,6 +202,7 @@ def masked(data, mask, maskval="median-mid80"):
     return jnp.where(mask, vals[:, None].astype(data.dtype), data)
 
 
+@jax.jit
 def zero_dm(data):
     """Zero-DM RFI filter: subtract the cross-channel mean from every time
     sample (reference bin/zero_dm_filter.py:30-39)."""
@@ -219,12 +229,14 @@ def trim(data, bins):
 # ---------------------------------------------------------------------------
 
 
+@jax.jit
 def dedispersed_timeseries(data, bins):
     """Fold channels into a dedispersed time series: sum over channels after
     per-channel circular left-shift. The hot kernel of the DM sweep."""
     return rotate_rows(data, bins).sum(axis=0)
 
 
+@partial(jax.jit, static_argnames=("widths",))
 def boxcar_snr(ts, widths):
     """Matched-filter boxcar SNRs of a 1-D time series.
 

@@ -1,0 +1,7 @@
+from pypulsar_tpu.parallel.mesh import make_mesh  # noqa: F401
+from pypulsar_tpu.parallel.sweep import (  # noqa: F401
+    SweepPlan,
+    make_sweep_plan,
+    sweep_spectra,
+    SweepResult,
+)

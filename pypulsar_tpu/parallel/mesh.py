@@ -1,0 +1,37 @@
+"""Device mesh construction for the sweep engine.
+
+The scale axes of this domain (SURVEY.md §2.4): DM trials (embarrassingly
+parallel — the data-parallel analogue), the time axis (long-context analogue,
+sharded with halo exchange since dedispersion is a pure per-channel shift),
+and multi-beam/multi-file batches across hosts over DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    axis_sizes: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = ("dm", "time"),
+    devices=None,
+) -> Mesh:
+    """Build a Mesh over available devices.
+
+    Default: all devices on the 'dm' axis (1 on 'time') — DM-trial sharding
+    needs no communication until the final candidate reduction, so it rides
+    ICI most efficiently (BASELINE.json north star).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = [n] + [1] * (len(axis_names) - 1)
+    if int(np.prod(axis_sizes)) != n:
+        raise ValueError(f"axis sizes {axis_sizes} do not multiply to {n} devices")
+    dev_array = mesh_utils.create_device_mesh(tuple(axis_sizes), devices=devices)
+    return Mesh(dev_array, tuple(axis_names))

@@ -1,0 +1,190 @@
+"""File-format conformance tests (SURVEY.md §4 strategy 4): byte-level
+round-trips for the SIGPROC header codec, filterbank reader/writer, .inf
+and .dat/.inf pairs."""
+
+import numpy as np
+import pytest
+
+from pypulsar_tpu.io import sigproc
+from pypulsar_tpu.io.filterbank import FilterbankFile, write_filterbank
+from pypulsar_tpu.io.infodata import InfoData
+from pypulsar_tpu.io.datfile import Datfile, write_dat
+
+RNG = np.random.RandomState(7)
+
+
+HDR = dict(
+    telescope_id=1,
+    machine_id=2,
+    source_name="J0000+0000",
+    src_raj=123456.789,
+    src_dej=-123456.789,
+    tstart=59000.5,
+    tsamp=64e-6,
+    fch1=1500.0,
+    foff=-0.5,
+    nchans=64,
+    nbits=32,
+    nifs=1,
+)
+
+
+def test_sigproc_header_roundtrip(tmp_path):
+    fn = tmp_path / "t.fil"
+    data = RNG.randn(100, 64).astype(np.float32)
+    write_filterbank(str(fn), HDR, data)
+    with open(fn, "rb") as f:
+        hdr, order, size = sigproc.read_header(f)
+    for k, v in HDR.items():
+        if isinstance(v, float):
+            assert hdr[k] == pytest.approx(v)
+        else:
+            assert hdr[k] == v
+
+
+def test_filterbank_read_write_roundtrip(tmp_path):
+    fn = tmp_path / "t.fil"
+    data = RNG.randn(256, 64).astype(np.float32)
+    write_filterbank(str(fn), HDR, data)
+    fil = FilterbankFile(str(fn))
+    assert fil.nchans == 64
+    assert fil.number_of_samples == 256
+    assert fil.is_hifreq_first
+    np.testing.assert_allclose(
+        fil.frequencies, 1500.0 - 0.5 * np.arange(64), rtol=1e-12
+    )
+    # full read
+    got = fil.get_samples(0, 256)
+    np.testing.assert_array_equal(got, data)
+    # random window, Spectra orientation [chan, time]
+    spec = fil.get_spectra(17, 100)
+    np.testing.assert_array_equal(spec.to_numpy(), data[17:117].T)
+    assert spec.starttime == pytest.approx(17 * 64e-6)
+    assert spec.dt == pytest.approx(64e-6)
+    fil.close()
+
+
+def test_filterbank_8bit(tmp_path):
+    fn = tmp_path / "t8.fil"
+    hdr = dict(HDR, nbits=8)
+    data = RNG.randint(0, 255, size=(50, 64)).astype(np.uint8)
+    write_filterbank(str(fn), hdr, data)
+    fil = FilterbankFile(str(fn))
+    np.testing.assert_array_equal(fil.get_samples(0, 50), data.astype(np.float32))
+    fil.close()
+
+
+def test_filterbank_iter_blocks(tmp_path):
+    fn = tmp_path / "t.fil"
+    data = RNG.randn(1000, 64).astype(np.float32)
+    write_filterbank(str(fn), HDR, data)
+    fil = FilterbankFile(str(fn))
+    seen = []
+    for start, block in fil.iter_blocks(256, overlap=32):
+        assert block.shape[0] <= 256 + 32
+        np.testing.assert_array_equal(block, data[start : start + block.shape[0]])
+        seen.append(start)
+    assert seen == [0, 256, 512, 768]
+    fil.close()
+
+
+def test_filterbank_out_of_range(tmp_path):
+    fn = tmp_path / "t.fil"
+    write_filterbank(str(fn), HDR, RNG.randn(10, 64).astype(np.float32))
+    fil = FilterbankFile(str(fn))
+    with pytest.raises(ValueError):
+        fil.get_samples(5, 10)
+    fil.close()
+
+
+def test_infodata_roundtrip(tmp_path):
+    inf = InfoData()
+    inf.basenm = "testobs"
+    inf.telescope = "Parkes"
+    inf.instrument = "WAPP"
+    inf.object = "J1234+5678"
+    inf.RA = "12:34:56.7000"
+    inf.DEC = "-56:07:08.9000"
+    inf.observer = "Nobody"
+    inf.epoch = 59123.456789012345
+    inf.bary = 0
+    inf.N = 123456
+    inf.dt = 64e-6
+    inf.breaks = 0
+    inf.DM = 42.42
+    inf.lofreq = 1182.0
+    inf.BW = 320.0
+    inf.numchan = 1024
+    inf.chan_width = 0.3125
+    inf.notes.append("    a note line")
+    fn = tmp_path / "testobs.inf"
+    inf.to_file(str(fn))
+    back = InfoData(str(fn))
+    assert back.basenm == "testobs"
+    assert back.telescope == "Parkes"
+    assert back.epoch == pytest.approx(59123.456789012345, abs=1e-12)
+    assert back.N == 123456
+    assert back.dt == pytest.approx(64e-6)
+    assert back.DM == pytest.approx(42.42)
+    assert back.numchan == 1024
+    assert back.mjd_i == 59123
+    assert any("a note line" in n for n in back.notes)
+
+
+def _write_dat_pair(tmp_path, N=10000, dt=1e-3, epoch=59000.0):
+    data = RNG.randn(N).astype(np.float32)
+    inf = InfoData()
+    inf.telescope = "Parkes"
+    inf.instrument = "FAKE"
+    inf.epoch = epoch
+    inf.dt = dt
+    inf.DM = 10.0
+    inf.lofreq = 1400.0
+    inf.BW = 256.0
+    inf.numchan = 1
+    inf.chan_width = 256.0
+    base = str(tmp_path / "series")
+    write_dat(base, data, inf)
+    return base, data
+
+
+def test_datfile_read(tmp_path):
+    base, data = _write_dat_pair(tmp_path)
+    df = Datfile(base + ".dat")
+    assert df.inf.N == 10000
+    np.testing.assert_array_equal(df.read_all(), data)
+    df.rewind()
+    np.testing.assert_array_equal(df.read_Nsamples(100), data[:100])
+    np.testing.assert_array_equal(df.read_Nsamples(50), data[100:150])
+    # dual clocks: desired time accumulates requests, actual integer samples
+    df.rewind()
+    df.read_Tseconds(0.0015)  # 1.5 samples -> reads 2, desired=0.0015
+    assert df.currsample == 2
+    assert df.currtime_desired == pytest.approx(0.0015)
+    assert df.currtime_actual == pytest.approx(0.002)
+    # next request accounts for the fraction already consumed
+    df.read_Tseconds(0.0015)  # desired end 0.003 -> sample 3 -> reads 1
+    assert df.currsample == 3
+    df.close()
+
+
+def test_datfile_pulses_generator(tmp_path):
+    base, data = _write_dat_pair(tmp_path, N=1000, dt=1e-3)
+    df = Datfile(base + ".dat")
+    period = 0.0237  # seconds, non-integer number of samples
+    pulses = list(df.pulses(lambda mjd: period))
+    # ~1000*0.001/0.0237 = 42 full pulses
+    assert len(pulses) == 42
+    assert pulses[0].number == 1
+    total = sum(len(p.profile) for p in pulses)
+    assert abs(total - 42 * period / 1e-3) <= len(pulses)  # rounding only
+    # profiles tile the series in order
+    np.testing.assert_array_equal(
+        np.concatenate([p.profile for p in pulses]), data[:total]
+    )
+    df.close()
+
+
+def test_datfile_rejects_bad_name(tmp_path):
+    with pytest.raises(ValueError):
+        Datfile(str(tmp_path / "nope.txt"))

@@ -1,0 +1,165 @@
+"""Sweep-engine tests: NumPy twin parity, chunked-streaming consistency,
+multi-device sharding on the virtual CPU mesh, and end-to-end pulse recovery
+(SURVEY.md §4 strategies 1-3)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pypulsar_tpu.core.spectra import Spectra
+from pypulsar_tpu.ops import numpy_ref
+from pypulsar_tpu.parallel import make_mesh, make_sweep_plan, sweep_spectra
+
+
+def make_obs(C=64, T=4096, dt=1e-3, dm=80.0, seed=1, amp=6.0, t0=700):
+    rng = np.random.RandomState(seed)
+    freqs = (1500.0 - 2.0 * np.arange(C)).astype(np.float64)
+    data = rng.randn(C, T).astype(np.float32)
+    bins = numpy_ref.bin_delays(dm, freqs, dt)
+    for c in range(C):
+        idx = t0 + bins[c]
+        if idx < T:
+            data[c, idx] += amp
+            if idx + 1 < T:
+                data[c, idx + 1] += amp * 0.5
+    return freqs, data
+
+
+def twin_sweep_stats(data, plan, chunk_is_whole_T):
+    """Float64 twin of _sweep_chunk_impl for a single whole-series chunk."""
+    C, T = data.shape
+    W = max(plan.widths)
+    out_len = T + W
+    slack2 = plan.max_shift2
+    need = out_len + slack2 + plan.max_shift1
+    padded = np.zeros((C, need))
+    padded[:, :T] = data
+    per = C // plan.nsub
+    D = plan.n_trials
+    L1 = out_len + slack2
+    s = np.zeros(D)
+    ss = np.zeros(D)
+    mb = np.zeros((D, len(plan.widths)))
+    ab = np.zeros((D, len(plan.widths)), dtype=int)
+    for gi in range(plan.n_groups):
+        sliced = np.stack(
+            [padded[c, plan.stage1_bins[gi, c] : plan.stage1_bins[gi, c] + L1] for c in range(C)]
+        )
+        sub = sliced.reshape(plan.nsub, per, L1).sum(axis=1)
+        for ti in range(plan.group_size):
+            d = gi * plan.group_size + ti
+            ts = np.zeros(out_len)
+            for si in range(plan.nsub):
+                st = plan.stage2_bins[gi, ti, si]
+                ts += sub[si, st : st + out_len]
+            payload = ts[:T]
+            s[d] = payload.sum()
+            ss[d] = (payload ** 2).sum()
+            cs = np.concatenate([[0.0], np.cumsum(ts)])
+            for wi, w in enumerate(plan.widths):
+                box = cs[w : w + T] - cs[:T]
+                mb[d, wi] = box.max()
+                ab[d, wi] = box.argmax()
+    mean = s / T
+    std = np.sqrt(np.maximum(ss / T - mean ** 2, 0.0))
+    ws = np.array(plan.widths, dtype=np.float64)
+    snr = (mb - ws[None, :] * mean[:, None]) / (
+        np.sqrt(ws)[None, :] * np.where(std > 0, std, 1.0)[:, None]
+    )
+    return snr, ab
+
+
+def test_sweep_matches_numpy_twin():
+    freqs, data = make_obs()
+    dms = np.linspace(0.0, 160.0, 48)
+    spec = Spectra(freqs, 1e-3, data)
+    res = sweep_spectra(spec, dms, nsub=16, group_size=8)
+    plan = make_sweep_plan(dms, freqs, 1e-3, nsub=16, group_size=8)
+    ref_snr, ref_ab = twin_sweep_stats(data, plan, True)
+    np.testing.assert_allclose(res.snr, ref_snr[: len(dms)], rtol=2e-3, atol=2e-3)
+    np.testing.assert_array_equal(res.peak_sample, ref_ab[: len(dms)])
+
+
+def test_sweep_recovers_injection():
+    dm_true, t0 = 80.0, 700
+    freqs, data = make_obs(dm=dm_true, t0=t0)
+    dms = np.linspace(0.0, 160.0, 81)  # 2 pc/cm^3 steps
+    res = sweep_spectra(Spectra(freqs, 1e-3, data), dms, nsub=16, group_size=8)
+    best = res.best(1)[0]
+    assert abs(best["dm"] - dm_true) <= 4.0
+    assert abs(best["sample"] - t0) <= 2
+    assert best["snr"] > 15.0
+
+
+def test_chunked_equals_unchunked():
+    freqs, data = make_obs(T=4096)
+    dms = np.linspace(0.0, 120.0, 32)
+    spec = Spectra(freqs, 1e-3, data)
+    full = sweep_spectra(spec, dms, nsub=16, group_size=8)
+    chunked = sweep_spectra(spec, dms, nsub=16, group_size=8, chunk_payload=1024)
+    np.testing.assert_allclose(chunked.snr, full.snr, rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(chunked.peak_sample, full.peak_sample)
+
+
+def test_sharded_sweep_matches_single_device():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    freqs, data = make_obs()
+    dms = np.linspace(0.0, 120.0, 64)
+    spec = Spectra(freqs, 1e-3, data)
+    single = sweep_spectra(spec, dms, nsub=16, group_size=8)
+    mesh = make_mesh(axis_names=("dm",))
+    sharded = sweep_spectra(spec, dms, nsub=16, group_size=8, mesh=mesh)
+    np.testing.assert_allclose(sharded.snr, single.snr, rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(sharded.peak_sample, single.peak_sample)
+
+
+def test_plan_geometry():
+    freqs = 1400.0 - 0.5 * np.arange(128)
+    plan = make_sweep_plan(np.arange(100, dtype=float), freqs, 64e-6, nsub=32,
+                           group_size=16, pad_groups_to=8)
+    assert plan.n_groups == 8
+    assert plan.n_trials == 128
+    assert plan.n_real_trials == 100
+    assert plan.stage1_bins.shape == (8, 128)
+    assert plan.stage2_bins.shape == (8, 16, 32)
+    assert (plan.stage1_bins >= 0).all() and (plan.stage2_bins >= 0).all()
+    # higher DM -> larger max shift
+    assert plan.stage2_bins[-1].max() >= plan.stage2_bins[0].max()
+
+
+def test_sharded_2d_matches_single_device():
+    """dm x time mesh with ppermute halo exchange == single-device result."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from pypulsar_tpu.parallel.sweep import make_sharded_sweep_chunk_2d, sweep_chunk
+
+    freqs, data = make_obs(C=32, T=2048, dt=1e-3, dm=60.0)
+    dms = np.linspace(0.0, 120.0, 32)
+    plan = make_sweep_plan(dms, freqs, 1e-3, nsub=8, group_size=8, pad_groups_to=4)
+    mesh = make_mesh([4, 2], ("dm", "time"))
+    T = data.shape[1]
+    nt = 2
+    local_payload = T // nt
+    W = max(plan.widths)
+    overlap = plan.min_overlap
+    assert overlap < local_payload
+
+    fn2d = make_sharded_sweep_chunk_2d(mesh, plan.nsub, local_payload, overlap,
+                                       plan.max_shift2, plan.widths)
+    darr = jax.device_put(jnp.asarray(data), NamedSharding(mesh, P(None, "time")))
+    s1 = jax.device_put(jnp.asarray(plan.stage1_bins), NamedSharding(mesh, P("dm")))
+    s2 = jax.device_put(jnp.asarray(plan.stage2_bins), NamedSharding(mesh, P("dm")))
+    s, ss, mb, ab = fn2d(darr, s1, s2)
+
+    # single-device reference on the zero-padded whole series
+    out_len = T + W
+    need = out_len + plan.max_shift2 + plan.max_shift1
+    padded = jnp.pad(jnp.asarray(data), ((0, 0), (0, need - T)))
+    s0, ss0, mb0, ab0 = sweep_chunk(
+        padded, jnp.asarray(plan.stage1_bins), jnp.asarray(plan.stage2_bins),
+        plan.nsub, out_len, plan.max_shift2, plan.widths, T)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(s0), rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(ss), np.asarray(ss0), rtol=1e-4, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(mb), np.asarray(mb0), rtol=1e-4, atol=1e-3)
+    np.testing.assert_array_equal(np.asarray(ab), np.asarray(ab0))
