@@ -1,0 +1,28 @@
+"""Astrometry / time utilities (host-side NumPy; no TPU need).
+
+Behavioral parity targets (reference files):
+- utils/astro/protractor.py — angle conversions
+- utils/astro/calendar.py   — JD/MJD/date arithmetic
+- utils/astro/clock.py      — sidereal time
+- utils/astro/sextant.py    — coordinate transforms
+- utils/coordconv.py        — compact RA/DEC string formats
+- utils/telescopes.py       — telescope/TEMPO-site tables
+"""
+
+from pypulsar_tpu.astro import protractor, calendar, clock, sextant, coordconv
+from pypulsar_tpu.astro.telescopes import (
+    telescope_to_id,
+    id_to_telescope,
+    telescope_to_maxha,
+)
+
+__all__ = [
+    "protractor",
+    "calendar",
+    "clock",
+    "sextant",
+    "coordconv",
+    "telescope_to_id",
+    "id_to_telescope",
+    "telescope_to_maxha",
+]

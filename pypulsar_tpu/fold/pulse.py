@@ -260,22 +260,21 @@ class SummedPulse(Pulse):
             raise PulseIncompatibleError(
                 f"Incompatible bin widths: {self.dt} vs {other.dt}"
             )
+        # validate the whole merge before mutating anything, so a conflict
+        # raised mid-merge can't leave the registry out of sync with the profile
         if hasattr(other, "pulse_registry"):
-            for fn, nums in other.pulse_registry.items():
-                mine = self.pulse_registry.setdefault(fn, [])
-                for num in nums:
-                    if num in mine:
-                        raise PulseConflictError(f"Pulse {fn}:{num} already summed")
-                    mine.append(num)
+            incoming = other.pulse_registry
             ocount = other.count
         else:
-            mine = self.pulse_registry.setdefault(other.origfn, [])
-            if other.number in mine:
-                raise PulseConflictError(
-                    f"Pulse {other.origfn}:{other.number} already summed"
-                )
-            mine.append(other.number)
+            incoming = {other.origfn: [other.number]}
             ocount = 1
+        for fn, nums in incoming.items():
+            mine = self.pulse_registry.get(fn, [])
+            for num in nums:
+                if num in mine:
+                    raise PulseConflictError(f"Pulse {fn}:{num} already summed")
+        for fn, nums in incoming.items():
+            self.pulse_registry.setdefault(fn, []).extend(nums)
 
         self.N = int(np.min([self.N, other.N]))
         self.duration = float(np.min([self.duration, other.duration]))

@@ -71,7 +71,9 @@ class InfoData:
                     continue
                 if "=" not in line:
                     continue
-                key, _, val = line.partition("=")
+                # split at the LAST '=': labels themselves contain '='
+                # (e.g. " Barycentered?           (1=yes, 0=no)  =  1")
+                key, _, val = line.rpartition("=")
                 key = key.strip()
                 val = val.strip()
                 for prefix, attr, conv in self._FIELDS:

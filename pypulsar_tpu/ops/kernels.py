@@ -94,25 +94,43 @@ def dedisperse_with_bins(data, bins, padval=0):
     return shift_channels(data, bins, padval)
 
 
-@partial(jax.jit, static_argnames=("nsub", "subdm", "in_dm", "padval"))
 def subband(data, freqs, dt, nsub, subdm=None, in_dm=0.0, padval=0):
     """Sum channel groups into ``nsub`` subbands, optionally dedispersing
     within each subband at ``subdm`` first (reference formats/spectra.py:96-138).
 
     Returns (subbanded_data[nsub, T], subband_center_freqs[nsub]).
+    ``subdm``/``in_dm`` are traced (no per-DM recompile); only nsub/padval and
+    the presence of subdm are static.
     """
+    if subdm is None:
+        return _subband_nodm(data, freqs, nsub)
+    return _subband_dm(data, freqs, dt, nsub, subdm, in_dm, padval)
+
+
+@partial(jax.jit, static_argnames=("nsub",))
+def _subband_nodm(data, freqs, nsub):
+    C, T = data.shape
+    assert C % nsub == 0
+    per = C // nsub
+    hif = freqs[::per]
+    lof = freqs[per - 1 :: per]
+    ctr = 0.5 * (hif + lof)
+    return data.reshape(nsub, per, T).sum(axis=1), ctr
+
+
+@partial(jax.jit, static_argnames=("nsub", "padval"))
+def _subband_dm(data, freqs, dt, nsub, subdm, in_dm, padval):
     C, T = data.shape
     assert C % nsub == 0
     per = C // nsub
     hif = freqs[:: per]
     lof = freqs[per - 1 :: per]
     ctr = 0.5 * (hif + lof)
-    if subdm is not None:
-        ref = delay_from_DM(subdm - in_dm, hif)
-        delays = delay_from_DM(subdm - in_dm, freqs)
-        rel = delays - jnp.repeat(ref, per)
-        bins = jnp.round(rel / dt).astype(jnp.int32)
-        data = shift_channels(data, bins, padval)
+    ref = delay_from_DM(subdm - in_dm, hif)
+    delays = delay_from_DM(subdm - in_dm, freqs)
+    rel = delays - jnp.repeat(ref, per)
+    bins = jnp.round(rel / dt).astype(jnp.int32)
+    data = shift_channels(data, bins, padval)
     out = data.reshape(nsub, per, T).sum(axis=1)
     return out, ctr
 

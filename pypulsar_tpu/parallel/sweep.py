@@ -421,20 +421,33 @@ def sweep_stream(
             start, stat_len, (s, ss, mb, ab) = pending.pop(0)
             acc.update(start, stat_len, s, ss, mb, ab)
 
+    need = out_len + slack2 + plan.max_shift1
+    prev = None  # detect short *interior* blocks: only the final one may pad
     for start, block in blocks:
+        if prev is not None:
+            pstart, pdata, pL = prev
+            if pL < need:
+                raise ValueError(
+                    f"interior block at sample {pstart} has {pL} samples but the "
+                    f"sweep needs {need} (payload {chunk_payload} + overlap "
+                    f">= {plan.min_overlap + W}); stream blocks with "
+                    f"block_size={chunk_payload} and overlap >= plan.min_overlap"
+                )
+            pending.append((pstart, chunk_payload, run_chunk(pdata, chunk_payload)))
+            drain(MAX_PENDING)
         if chan_major:
             data = jnp.asarray(block, dtype=jnp.float32)
         else:
             data = jnp.asarray(np.ascontiguousarray(block.T), dtype=jnp.float32)
-        C, L = data.shape
-        need = out_len + slack2 + plan.max_shift1
+        prev = (start, data, data.shape[1])
+    if prev is not None:
+        start, data, L = prev
         if L < need:  # tail: pad with zeros (reference pads with padval=0)
             data = jnp.pad(data, ((0, 0), (0, need - L)))
             stat_len = min(chunk_payload, L)
         else:
             stat_len = chunk_payload
         pending.append((start, stat_len, run_chunk(data, stat_len)))
-        drain(MAX_PENDING)
     drain(0)
 
     mean = acc.s / max(acc.n, 1)

@@ -1,0 +1,246 @@
+"""Tests for pypulsar_tpu.astro: angles, calendar, sidereal time, transforms.
+
+Golden values from standard references (Meeus worked examples, Duffett-Smith
+section 12 example, known pulsar positions) — independent of the reference
+implementation.
+"""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from pypulsar_tpu.astro import calendar, clock, coordconv, protractor, sextant
+from pypulsar_tpu.astro import telescope_to_id, id_to_telescope, telescope_to_maxha
+
+
+class TestProtractor:
+    def test_roundtrip_deg(self):
+        vals = np.array([0.0, 12.5, 180.0, 359.9])
+        assert np.allclose(
+            protractor.convert(protractor.convert(vals, "deg", "rad"), "rad", "deg"),
+            vals,
+        )
+
+    def test_hmsstr_to_rad(self):
+        # 06:00:00 hours = 90 deg = pi/2
+        assert np.allclose(protractor.hmsstr_to_rad("06:00:00"), np.pi / 2)
+        # negative sign
+        assert np.allclose(protractor.hmsstr_to_rad("-06:00:00"), -np.pi / 2)
+
+    def test_dmsstr_to_rad(self):
+        assert np.allclose(protractor.dmsstr_to_rad("90:00:00"), np.pi / 2)
+        assert np.allclose(
+            protractor.dmsstr_to_rad("-45:30:00"), -45.5 * np.pi / 180.0
+        )
+
+    def test_rad_to_hmsstr_format(self):
+        (s,) = protractor.rad_to_hmsstr(np.pi / 2)
+        assert s == "06:00:00.0000"
+        # seconds < 10 are zero-padded ("0x.xxxx")
+        (s,) = protractor.rad_to_hmsstr(protractor.hmsstr_to_rad("01:02:03.5")[0])
+        assert s == "01:02:03.5000"
+
+    def test_rad_to_dmsstr_negative(self):
+        (s,) = protractor.rad_to_dmsstr(-np.pi / 4)
+        assert s == "-45:00:00.0000"
+
+    def test_invalid_string_warns_nan(self):
+        with pytest.warns(UserWarning):
+            out = protractor.hmsstr_to_rad("garbage")
+        assert np.isnan(out[0])
+
+    def test_hms_dms_triples(self):
+        assert np.allclose(protractor.hms_to_rad(6, 0, 0), np.pi / 2)
+        assert np.allclose(protractor.dms_to_rad(-45, 30, 0), -45.5 * np.pi / 180)
+
+    def test_convert_unknown_raises(self):
+        with pytest.raises(ValueError):
+            protractor.convert(1.0, "parsec", "rad")
+
+
+class TestCalendar:
+    def test_meeus_sputnik(self):
+        # Meeus example 7.a: 1957 Oct 4.81 -> JD 2436116.31
+        assert np.allclose(calendar.date_to_JD(1957, 10, 4.81), 2436116.31)
+
+    def test_meeus_333(self):
+        # Meeus example 7.b: 333 Jan 27.5 (Julian calendar) -> JD 1842713.0
+        assert np.allclose(
+            calendar.date_to_JD(333, 1, 27.5, gregorian=False), 1842713.0
+        )
+
+    def test_jd_to_date_inverse(self):
+        y, m, d = calendar.JD_to_date(2436116.31)
+        assert (y, m) == (1957, 10)
+        assert np.allclose(d, 4.81)
+
+    def test_mjd_roundtrip(self):
+        mjd = 55000.123
+        assert np.allclose(calendar.JD_to_MJD(calendar.MJD_to_JD(mjd)), mjd)
+
+    def test_j2000_epoch(self):
+        # J2000.0 = 2000 Jan 1.5 = JD 2451545.0 = MJD 51544.5
+        assert np.allclose(calendar.date_to_MJD(2000, 1, 1.5), 51544.5)
+
+    def test_leap_years(self):
+        assert calendar.is_leap_year(2000)
+        assert not calendar.is_leap_year(1900)
+        assert calendar.is_leap_year(2004)
+        assert calendar.is_leap_year(1900, gregorian=False)
+
+    def test_day_of_year(self):
+        assert calendar.day_of_year(2023, 1, 1) == 1
+        assert calendar.day_of_year(2023, 12, 31) == 365
+        assert calendar.day_of_year(2024, 12, 31) == 366
+
+    def test_fraction_and_year_roundtrip(self):
+        mjd = calendar.date_to_MJD(2010, 7, 2.0)
+        year = calendar.MJD_to_year(mjd)
+        assert 2010.0 < year < 2010.6
+        assert np.allclose(calendar.year_to_MJD(year), mjd)
+
+    def test_month_names(self):
+        assert calendar.month_to_num("Feb") == 2
+        assert calendar.num_to_month(2) == "February"
+        with pytest.raises(ValueError):
+            calendar.month_to_num("J")  # ambiguous
+
+    def test_datetime_roundtrip(self):
+        dt = datetime.datetime(2015, 6, 1, 12, 30, 15)
+        mjd = calendar.datetime_to_MJD(dt)
+        back = calendar.MJD_to_datetime(mjd)
+        assert abs((back - dt).total_seconds()) < 1e-3
+
+    def test_interval(self):
+        assert calendar.interval_in_days(2000, 1, 1, 2000, 1, 31) == 30
+
+
+class TestClock:
+    def test_duffett_smith_example(self):
+        # Duffett-Smith sec. 12: 1980 April 22 at 14:36:51.67 UT
+        # -> GST 4h 40m 5.17s = 4.668103 h
+        jd = calendar.date_to_JD(1980, 4, 22 + (14 + 36 / 60.0 + 51.67 / 3600.0) / 24.0)
+        gst = clock.JD_to_GST(jd)
+        assert np.allclose(gst, 4.668103, atol=2e-4)
+
+    def test_lst_longitude(self):
+        mjd = 55000.0
+        gst = clock.MJD_to_GST(mjd)
+        lst = clock.MJD_lon_to_LST(mjd, -75.0)  # 75 deg West = -5 h
+        assert np.allclose(lst, (gst - 5.0) % 24.0)
+
+
+class TestSextant:
+    def test_precess_roundtrip(self):
+        ra, dec = 1.2, 0.3  # rad
+        ra2, dec2 = sextant.precess_B1950_to_J2000(ra, dec, input="rad", output="rad")
+        ra3, dec3 = sextant.precess_J2000_to_B1950(ra2, dec2, input="rad", output="rad")
+        assert np.allclose([ra3, dec3], [ra, dec], atol=1e-9)
+
+    def test_galactic_center(self):
+        # Galactic center J2000: RA 17:45:37.2, Dec -28:56:10 -> l~0, b~0
+        l, b = sextant.equatorial_to_galactic(
+            "17:45:37.2", "-28:56:10", input="sexigesimal", output="deg"
+        )
+        assert abs(float(b)) < 0.2
+        assert min(float(l), 360 - float(l)) < 0.2
+
+    def test_galactic_pole(self):
+        # North galactic pole J2000: RA 12:51:26.28, Dec +27:07:41.7 -> b=90
+        _l, b = sextant.equatorial_to_galactic(
+            "12:51:26.28", "+27:07:41.7", input="sexigesimal", output="deg"
+        )
+        assert abs(float(b) - 90.0) < 0.1
+
+    def test_ecliptic_roundtrip(self):
+        ra, dec = 2.0, -0.5
+        lon, lat = sextant.equatorial_to_ecliptic(ra, dec, input="rad", output="rad")
+        ra2, dec2 = sextant.ecliptic_to_equatorial(lon, lat, input="rad", output="rad")
+        assert np.allclose(np.mod([ra2, dec2], 2 * np.pi), np.mod([ra, dec], 2 * np.pi), atol=1e-9)
+
+    def test_ecliptic_pole(self):
+        # Ecliptic north pole: lat = +90 - obliquity at ra=18h... simpler:
+        # a point on the ecliptic (the vernal equinox) has lat 0
+        lon, lat = sextant.equatorial_to_ecliptic(0.0, 0.0, input="rad", output="rad")
+        assert np.allclose([lon, lat], [0.0, 0.0], atol=1e-12)
+
+    def test_angsep(self):
+        assert np.allclose(sextant.angsep(0.0, 0.0, np.pi, 0.0, input="rad", output="deg"), 180.0)
+        assert np.allclose(
+            sextant.angsep(0.0, np.pi / 2, 1.0, np.pi / 2, input="rad", output="deg"),
+            0.0,
+            atol=1e-6,
+        )
+
+    def test_hadec_altaz_roundtrip(self):
+        # The two functions use different azimuth conventions (from-north with
+        # arccos fold vs from-south; reference parity) so they compose to
+        # az -> pi - az, while altitude roundtrips exactly.
+        obslat = 0.6  # rad
+        alt0, az0 = 0.8, 2.1
+        ha, dec = sextant.altaz_to_hadec(alt0, az0, obslat, input="rad", output="rad")
+        alt, az = sextant.hadec_to_altaz(ha, dec, obslat, input="rad", output="rad")
+        assert np.allclose(np.mod(alt, 2 * np.pi), alt0, atol=1e-9)
+        assert np.allclose(np.mod(az, 2 * np.pi), np.pi - az0, atol=1e-9)
+        # forward spherical-triangle identity holds for the inverse transform
+        lhs = np.sin(alt0)
+        rhs = np.sin(obslat) * np.sin(dec) + np.cos(obslat) * np.cos(dec) * np.cos(ha)
+        assert np.allclose(lhs, rhs, atol=1e-12)
+
+    def test_hadec_to_altaz_duffett_smith(self):
+        # Duffett-Smith sec. 25 worked example: ha = 5h51m44s, dec = 23d13'10",
+        # lat = 52N -> alt = 19d20'04", az = 283d16'16" (arccos folds to 360-az)
+        ha = protractor.hmsstr_to_rad("05:51:44")[0]
+        dec = protractor.dmsstr_to_rad("23:13:10")[0]
+        alt, az = sextant.hadec_to_altaz(
+            ha, dec, np.deg2rad(52.0), input="rad", output="deg"
+        )
+        assert np.allclose(alt, 19.0 + 20.0 / 60 + 4.0 / 3600, atol=1e-3)
+        assert np.allclose(az, 360.0 - (283.0 + 16.0 / 60 + 16.0 / 3600), atol=1e-3)
+
+    def test_zenith(self):
+        # source at dec=obslat, ha=0 is at zenith
+        obslat = 0.7
+        alt, _az = sextant.hadec_to_altaz(0.0, obslat, obslat, input="rad", output="deg")
+        assert np.allclose(alt, 90.0, atol=1e-8)
+
+
+class TestCoordconv:
+    def test_parse_decstr(self):
+        assert coordconv.parse_decstr("-123456.78") == ("-", "12", "34", "56.78")
+        # float stringification keeps a trailing .0 (reference parity)
+        assert coordconv.parse_decstr("123456") == ("+", "12", "34", "56.0")
+        assert coordconv.parse_decstr("0") == ("+", "00", "00", "00")
+        assert coordconv.parse_decstr("-1234") == ("-", "00", "12", "34.0")
+
+    def test_decstr_to_rad(self):
+        assert np.allclose(
+            coordconv.decstr_to_rad("900000"), np.pi / 2
+        )
+        assert np.allclose(coordconv.decstr_to_deg("-453000"), -45.5)
+
+    def test_rastr(self):
+        assert coordconv.parse_rastr("063015.5") == ("06", "30", "15.5")
+        assert np.allclose(coordconv.rastr_to_deg("060000"), 90.0)
+        assert coordconv.rastr_to_fmrastr("063015.5") == "06:30:15.5"
+        assert coordconv.fmrastr_to_rastr("06:30:15.5") == "63015.5"
+
+    def test_fm_roundtrip(self):
+        assert coordconv.decstr_to_fmdecstr("-123456.78") == "-12:34:56.78"
+        assert coordconv.fmdecstr_to_decstr("-12:34:56.78") == "-123456.78"
+
+    def test_galactic_degrees(self):
+        l, b = coordconv.eqdeg_to_galdeg(266.405, -28.936)  # galactic center
+        assert min(abs(l), abs(360 - l)) < 0.2
+        assert abs(b) < 0.2
+
+
+class TestTelescopes:
+    def test_tables(self):
+        assert telescope_to_id["Arecibo"] == "3"
+        assert id_to_telescope["1"] == "GBT"
+        assert telescope_to_maxha["Arecibo"] == 3
+        # every telescope with an id has a maxha
+        for name in telescope_to_id:
+            assert name in telescope_to_maxha

@@ -127,6 +127,9 @@ def test_infodata_roundtrip(tmp_path):
     assert back.dt == pytest.approx(64e-6)
     assert back.DM == pytest.approx(42.42)
     assert back.numchan == 1024
+    # labels containing '=' (e.g. "(1=yes, 0=no)") must parse to ints
+    assert back.bary == 0 and isinstance(back.bary, int)
+    assert back.breaks == 0 and isinstance(back.breaks, int)
     assert back.mjd_i == 59123
     assert any("a note line" in n for n in back.notes)
 

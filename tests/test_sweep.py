@@ -163,3 +163,21 @@ def test_sharded_2d_matches_single_device():
     np.testing.assert_allclose(np.asarray(ss), np.asarray(ss0), rtol=1e-4, atol=1e-2)
     np.testing.assert_allclose(np.asarray(mb), np.asarray(mb0), rtol=1e-4, atol=1e-3)
     np.testing.assert_array_equal(np.asarray(ab), np.asarray(ab0))
+
+
+def test_stream_rejects_short_interior_block():
+    # interior blocks lacking the required overlap must raise, not silently
+    # zero-pad (seam SNRs would be depressed with no error)
+    from pypulsar_tpu.parallel.sweep import make_sweep_plan, sweep_stream
+
+    freqs, data = make_obs(T=4096)
+    dms = np.linspace(0.0, 120.0, 16)
+    plan = make_sweep_plan(dms, freqs, 1e-3, nsub=16, group_size=8)
+    chunk = 1024
+
+    def bad_blocks():  # no overlap at all
+        for pos in range(0, 4096, chunk):
+            yield pos, data[:, pos : pos + chunk].T
+
+    with pytest.raises(ValueError, match="interior block"):
+        sweep_stream(plan, bad_blocks(), chunk)
