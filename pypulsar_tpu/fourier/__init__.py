@@ -1,0 +1,31 @@
+"""Fourier-domain layer: power spectra, interpolation, harmonic sums,
+red-noise removal, spectrograms (parity: reference formats/prestofft.py and
+bin/spectrogram.py, redesigned for XLA)."""
+
+from pypulsar_tpu.fourier.prestofft import PrestoFFT, power_law, write_fft
+from pypulsar_tpu.fourier import kernels, numpy_ref
+from pypulsar_tpu.fourier.kernels import (
+    fourier_interpolate,
+    harmonic_sum,
+    deredden_schedule,
+    deredden,
+    estimate_power_errors,
+    spectrogram,
+)
+from pypulsar_tpu.fourier.prestofft import get_smear_response, smearing_function
+
+__all__ = [
+    "PrestoFFT",
+    "power_law",
+    "write_fft",
+    "kernels",
+    "numpy_ref",
+    "fourier_interpolate",
+    "harmonic_sum",
+    "deredden_schedule",
+    "deredden",
+    "estimate_power_errors",
+    "spectrogram",
+    "get_smear_response",
+    "smearing_function",
+]

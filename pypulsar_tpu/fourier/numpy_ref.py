@@ -1,0 +1,107 @@
+"""NumPy golden twins of the Fourier kernels, following the reference's
+sequential loops exactly (formats/prestofft.py) for parity testing."""
+
+import numpy as np
+
+
+def harmonic_sum(powers, nharm=8):
+    """Decimated harmonic sum (reference prestofft.py:98-113)."""
+    nn = powers.size
+    out_len = nn // nharm
+    harmsummed = np.copy(powers[:out_len])
+    for nh in range(2, nharm + 1):
+        harmsummed += np.reshape(powers[: nn // nh * nh], (-1, nh))[:, 0][:out_len]
+    return harmsummed
+
+
+def fourier_interpolate(fft, r, m=32):
+    """Finite-window Fourier interpolation with the CORRECT sinc kernel
+    (see kernels.fourier_interpolate parity note)."""
+    nn = fft.size
+    r = np.atleast_1d(np.asarray(r, dtype=float))
+    round_r = np.round(r).astype(int)
+    k = round_r[:, None] + np.arange(-m // 2, m // 2 + 1)
+    valid = (k >= 0) & (k < nn)
+    coefs = np.where(valid, fft[np.clip(k, 0, nn - 1)], 0.0)
+    x = r[:, None] - k
+    return np.sum(coefs * np.exp(-1.0j * np.pi * x) * np.sinc(x), axis=1)
+
+
+def deredden(fft, initialbuflen=6, maxbuflen=200):
+    """Sequential PRESTO-style deredden (reference prestofft.py:151-195)."""
+    powers = np.abs(fft) ** 2
+    dered = np.copy(fft)
+    dered[0] = 1 + 0j
+
+    newoffset = 1
+    fixedoffset = 1
+    mean_old = np.median(powers[newoffset : newoffset + initialbuflen]) / np.log(2.0)
+    newoffset += initialbuflen
+    lastbuflen = initialbuflen
+    newbuflen = int(initialbuflen * np.log(newoffset))
+    if newoffset > maxbuflen:
+        newbuflen = maxbuflen
+
+    scaleval = np.ones(1)
+    while (newoffset + newbuflen) < len(dered):
+        mean_new = np.median(powers[newoffset : newoffset + newbuflen]) / np.log(2.0)
+        slope = (mean_new - mean_old) / (newbuflen + lastbuflen)
+        ioffs = np.arange(lastbuflen)
+        lineoffset = 0.5 * (newbuflen + lastbuflen)
+        lineval = mean_old + slope * (lineoffset - ioffs)
+        scaleval = 1.0 / np.sqrt(lineval)
+        dered[fixedoffset + ioffs] *= scaleval
+        fixedoffset += lastbuflen
+        lastbuflen = newbuflen
+        mean_old = mean_new
+        newoffset += lastbuflen
+        newbuflen = int(initialbuflen * np.log(newoffset))
+        if newbuflen > maxbuflen:
+            newbuflen = maxbuflen
+
+    dered[fixedoffset:] *= scaleval[-1]
+    return dered
+
+
+def estimate_power_errors(powers, initialbuflen=6, maxbuflen=200):
+    """Sequential per-bin power error estimation (prestofft.py:197-236)."""
+    errs = np.zeros(len(powers))
+    newoffset = 1
+    fixedoffset = 1
+    rms_old = np.std(powers[newoffset : newoffset + initialbuflen])
+    newoffset += initialbuflen
+    lastbuflen = initialbuflen
+    newbuflen = int(initialbuflen * np.log(newoffset))
+    if newoffset > maxbuflen:
+        newbuflen = maxbuflen
+
+    lineval = np.zeros(1)
+    while (newoffset + newbuflen) < len(errs):
+        rms_new = np.std(powers[newoffset : newoffset + newbuflen])
+        slope = (rms_new - rms_old) / (newbuflen + lastbuflen)
+        ioffs = np.arange(lastbuflen)
+        lineoffset = 0.5 * (newbuflen + lastbuflen)
+        lineval = rms_old + slope * (lineoffset - ioffs)
+        errs[fixedoffset + ioffs] = lineval
+        fixedoffset += lastbuflen
+        lastbuflen = newbuflen
+        rms_old = rms_new
+        newoffset += lastbuflen
+        newbuflen = int(initialbuflen * np.log(newoffset))
+        if newbuflen > maxbuflen:
+            newbuflen = maxbuflen
+
+    errs[fixedoffset:] = lineval[-1]
+    return errs
+
+
+def spectrogram(timeseries, samp_per_block):
+    """Block power spectra via a Python loop (bin/spectrogram.py:17-37)."""
+    n = timeseries.size
+    numspec = n // samp_per_block
+    numcoeffs = samp_per_block // 2 + 1
+    spectra = np.empty((numspec, numcoeffs))
+    for ii in range(numspec):
+        block = timeseries[ii * samp_per_block : (ii + 1) * samp_per_block]
+        spectra[ii, :] = np.abs(np.fft.rfft(block)) ** 2
+    return spectra

@@ -422,32 +422,40 @@ def sweep_stream(
             acc.update(start, stat_len, s, ss, mb, ab)
 
     need = out_len + slack2 + plan.max_shift1
-    prev = None  # detect short *interior* blocks: only the final one may pad
+
+    def process(start, data, L):
+        if L < need:  # end-of-data: pad with zeros (reference pads padval=0)
+            data = jnp.pad(data, ((0, 0), (0, need - L)))
+        stat_len = min(chunk_payload, L)
+        pending.append((start, stat_len, run_chunk(data, stat_len)))
+
+    # A short block is only legal at end-of-data: hold one block back so we
+    # can tell whether the stream continues past its end. A block that is
+    # short while later data exists would silently zero-pad real samples and
+    # depress every seam SNR — raise instead.
+    prev = None
     for start, block in blocks:
-        if prev is not None:
-            pstart, pdata, pL = prev
-            if pL < need:
-                raise ValueError(
-                    f"interior block at sample {pstart} has {pL} samples but the "
-                    f"sweep needs {need} (payload {chunk_payload} + overlap "
-                    f">= {plan.min_overlap + W}); stream blocks with "
-                    f"block_size={chunk_payload} and overlap >= plan.min_overlap"
-                )
-            pending.append((pstart, chunk_payload, run_chunk(pdata, chunk_payload)))
-            drain(MAX_PENDING)
         if chan_major:
             data = jnp.asarray(block, dtype=jnp.float32)
         else:
             data = jnp.asarray(np.ascontiguousarray(block.T), dtype=jnp.float32)
-        prev = (start, data, data.shape[1])
+        L = data.shape[1]
+        if prev is not None:
+            pstart, pdata, pL = prev
+            if pL < need and pstart + pL < start + L:
+                raise ValueError(
+                    f"interior block at sample {pstart} has {pL} samples but "
+                    f"data continues to sample {start + L}; the sweep needs "
+                    f"{need} per block (payload {chunk_payload} + overlap >= "
+                    f"plan.min_overlap = {plan.min_overlap}); stream blocks "
+                    f"with block_size={chunk_payload} and overlap >= "
+                    f"plan.min_overlap"
+                )
+            process(pstart, pdata, pL)
+            drain(MAX_PENDING)
+        prev = (start, data, L)
     if prev is not None:
-        start, data, L = prev
-        if L < need:  # tail: pad with zeros (reference pads with padval=0)
-            data = jnp.pad(data, ((0, 0), (0, need - L)))
-            stat_len = min(chunk_payload, L)
-        else:
-            stat_len = chunk_payload
-        pending.append((start, stat_len, run_chunk(data, stat_len)))
+        process(*prev)
     drain(0)
 
     mean = acc.s / max(acc.n, 1)

@@ -1,0 +1,23 @@
+"""Dedispersion planning (host-side metadata; execution is parallel.sweep)."""
+
+from pypulsar_tpu.plan.ddplan import (
+    ALLOW_DMSTEPS,
+    MAX_DOWNFACTOR,
+    FF,
+    SMEARFACT,
+    Observation,
+    DDstep,
+    DDplan,
+    guess_DMstep,
+)
+
+__all__ = [
+    "ALLOW_DMSTEPS",
+    "MAX_DOWNFACTOR",
+    "FF",
+    "SMEARFACT",
+    "Observation",
+    "DDstep",
+    "DDplan",
+    "guess_DMstep",
+]

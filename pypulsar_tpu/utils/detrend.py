@@ -1,0 +1,82 @@
+"""Masked piecewise polynomial detrending (parity: reference utils/mydetrend.py).
+
+Like scipy.signal.detrend but masked-array aware: masked samples are omitted
+from the fit while the polynomial is still subtracted everywhere. Used by the
+zaplist pipeline's iterative masked log-log honing (bin/autozap.py:196-244).
+"""
+
+import numpy as np
+import scipy.linalg
+
+
+def old_detrend(ydata, xdata=None, mask=None, order=1):
+    """Detrend with an explicit boolean omit-mask (True = omit from fit;
+    reference utils/mydetrend.py:19-62)."""
+    if xdata is None:
+        xdata = np.arange(ydata.size)
+    powers = np.arange(order + 1)
+    A = np.repeat(xdata, order + 1).reshape(xdata.size, order + 1) ** powers
+
+    if mask is None:
+        unmasked = np.ones(ydata.size, dtype="bool")
+    else:
+        unmasked = ~np.asarray(mask, dtype=bool)
+    coeffs, _resids, _rank, _s = scipy.linalg.lstsq(A[unmasked], ydata[unmasked])
+    return ydata - np.dot(A, coeffs)
+
+
+def detrend(ydata, xdata=None, order=1, bp=[], numpieces=None):
+    """Piecewise polynomial detrend of a (possibly masked) 1D array.
+
+    ``bp`` lists indices where new independently-detrended segments start
+    (len(bp)+1 segments); ``numpieces`` instead splits into roughly equal
+    parts and overrides ``bp``. Masked input yields masked output
+    (reference utils/mydetrend.py:65-107).
+    """
+    ymasked = np.ma.masked_array(ydata, mask=np.ma.getmaskarray(ydata))
+    if xdata is None:
+        xdata = np.ma.masked_array(
+            np.arange(ydata.size), mask=np.ma.getmaskarray(ydata)
+        )
+    detrended = ymasked.copy()
+
+    if numpieces is None:
+        edges = [0] + list(bp) + [len(ydata)]
+    else:
+        edges = np.round(np.linspace(0, len(ydata), numpieces + 1, endpoint=1)).astype(int)
+    for start, stop in zip(edges[:-1], edges[1:]):
+        if not np.ma.count(ymasked[start:stop]):
+            continue  # fully masked segment stays masked in the output
+        _coeffs, poly_ydata = fit_poly(ymasked[start:stop], xdata[start:stop], order)
+        detrended.data[start:stop] -= poly_ydata
+    if np.ma.isMaskedArray(ydata):
+        return detrended
+    return detrended.data
+
+
+def fit_poly(ydata, xdata, order=1):
+    """Least-squares polynomial fit honoring masks.
+
+    Returns (coeffs[order+1], polynomial evaluated at ALL xdata incl. masked).
+    """
+    xmasked = np.ma.asarray(xdata)
+    ymasked = np.ma.asarray(ydata)
+    if not np.ma.count(ymasked):
+        raise ValueError(
+            "Cannot fit polynomial to data. There are no unmasked values!"
+        )
+    ycomp = ymasked.compressed()
+    xcomp = xmasked.compressed()
+
+    powers = np.arange(order + 1)
+    A = np.repeat(xcomp, order + 1).reshape(xcomp.size, order + 1) ** powers
+    coeffs, _resids, _rank, _s = scipy.linalg.lstsq(A, ycomp)
+
+    Afull = (
+        np.repeat(np.asarray(xmasked.data, dtype=float), order + 1).reshape(
+            len(xmasked.data), order + 1
+        )
+        ** powers
+    )
+    poly_ydata = np.dot(Afull, coeffs).squeeze()
+    return coeffs, poly_ydata

@@ -1,0 +1,238 @@
+"""Fourier-layer tests: JAX-vs-NumPy-twin parity (deredden, errors, harmonic
+sums, interpolation, spectrogram) and end-to-end .fft pipeline checks."""
+
+import numpy as np
+import pytest
+
+from pypulsar_tpu.fourier import (
+    PrestoFFT,
+    kernels,
+    numpy_ref,
+    power_law,
+    write_fft,
+    get_smear_response,
+    smearing_function,
+)
+from pypulsar_tpu.io.infodata import InfoData
+
+
+def make_series(n=1 << 15, f0=37.0, dt=1e-3, amp=1.0, seed=0, redamp=0.0):
+    rng = np.random.RandomState(seed)
+    t = np.arange(n) * dt
+    x = rng.standard_normal(n) + amp * np.sin(2 * np.pi * f0 * t)
+    if redamp:
+        # integrated noise = steep red spectrum
+        x = x + redamp * np.cumsum(rng.standard_normal(n)) / np.sqrt(n)
+    return x.astype(np.float32)
+
+
+def make_fft(n=1 << 15, **kw):
+    x = make_series(n, **kw)
+    return np.fft.rfft(x).astype(np.complex64)
+
+
+def make_inf(tmp_path, n, dt=1e-3, DM=0.0):
+    inf = InfoData()
+    inf.basenm = "synth"
+    inf.telescope = "GBT"
+    inf.N = n
+    inf.dt = dt
+    inf.DM = DM
+    inf.lofreq = 1400.0
+    inf.BW = 300.0
+    inf.numchan = 1024
+    inf.chan_width = 300.0 / 1024
+    inf.epoch = 55000.0
+    return inf
+
+
+class TestInterpolate:
+    def test_exact_at_integer_bins(self):
+        fft = make_fft(1 << 12)
+        r = np.arange(100, 200, dtype=float)
+        out = np.asarray(kernels.fourier_interpolate(fft, r, m=32))
+        np.testing.assert_allclose(out, fft[100:200], rtol=1e-5, atol=1e-3)
+
+    def test_matches_numpy_twin(self):
+        fft = make_fft(1 << 12)
+        r = np.linspace(10.25, 1000.75, 64)
+        jax_out = np.asarray(kernels.fourier_interpolate(fft, r, m=16))
+        np_out = numpy_ref.fourier_interpolate(fft.astype(np.complex128), r, m=16)
+        np.testing.assert_allclose(jax_out, np_out, rtol=1e-4, atol=1e-2)
+
+    def test_half_bin_signal_recovery(self):
+        # a tone exactly between bins: interpolation at the true (fractional)
+        # bin recovers more power than either neighboring integer bin
+        n = 1 << 12
+        dt = 1e-3
+        freqs = np.fft.rfftfreq(n, dt)
+        df = freqs[1] - freqs[0]
+        f0 = freqs[500] + 0.5 * df
+        t = np.arange(n) * dt
+        fft = np.fft.rfft(np.sin(2 * np.pi * f0 * t))
+        interp = np.asarray(
+            kernels.fourier_interpolate(fft.astype(np.complex64),
+                                        np.array([500.5]), m=32)
+        )
+        assert np.abs(interp[0]) ** 2 > np.abs(fft[500]) ** 2
+        assert np.abs(interp[0]) ** 2 > np.abs(fft[501]) ** 2
+
+    def test_odd_m_raises(self):
+        with pytest.raises(ValueError):
+            kernels.fourier_interpolate(make_fft(256), np.array([1.0]), m=3)
+
+
+class TestHarmonicSum:
+    def test_matches_twin(self):
+        powers = np.abs(make_fft(1 << 13)) ** 2
+        for nharm in (2, 4, 8):
+            jax_out = np.asarray(kernels.harmonic_sum(powers.astype(np.float32), nharm))
+            np_out = numpy_ref.harmonic_sum(powers, nharm)
+            np.testing.assert_allclose(jax_out, np_out, rtol=1e-5)
+
+    def test_boosts_harmonic_rich_signal(self):
+        # narrow pulse train has many strong harmonics; harmonic summing must
+        # raise its significance vs the noise floor
+        n = 1 << 14
+        dt = 1e-3
+        rng = np.random.RandomState(2)
+        x = rng.standard_normal(n)
+        period_bins = 128  # divides n: fundamental lands on an exact bin
+        x[::period_bins] += 8.0  # sharp pulses: power in many harmonics
+        powers = np.abs(np.fft.rfft(x)) ** 2
+        fund_bin = n // period_bins  # fundamental
+        hs = np.asarray(kernels.harmonic_sum(powers.astype(np.float32), 8))
+
+        # robust (MAD-based) significance: harmonic summing also boosts
+        # sub-harmonic alias bins, so a plain std would overestimate noise
+        def z(arr, bin_):
+            med = np.median(arr)
+            mad = np.median(np.abs(arr - med)) * 1.4826
+            return (arr[bin_] - med) / mad
+
+        assert z(hs, fund_bin) > z(powers, fund_bin)
+
+    def test_incoherent_and_coherent_run(self):
+        fft = make_fft(1 << 10)
+        powers = np.abs(fft) ** 2
+        inc = np.asarray(kernels.incoherent_harmonic_sum(fft, powers.astype(np.float32), 4))
+        coh = np.asarray(kernels.coherent_harmonic_sum(fft, 4))
+        assert inc.shape == powers.shape
+        assert coh.shape == powers.shape
+        assert np.all(np.isfinite(inc))
+        assert np.all(np.isfinite(coh))
+
+
+class TestDeredden:
+    @pytest.mark.parametrize("n", [5000, 1 << 15])
+    def test_matches_sequential_reference(self, n):
+        fft = make_fft(n, redamp=5.0)
+        jax_out = np.asarray(kernels.deredden(fft))
+        np_out = numpy_ref.deredden(fft.astype(np.complex128))
+        np.testing.assert_allclose(jax_out, np_out, rtol=1e-4, atol=1e-4)
+
+    def test_flattens_red_noise(self):
+        n = 1 << 15
+        fft = make_fft(n, amp=0.0, redamp=20.0, seed=3)
+        dered = np.asarray(kernels.deredden(fft))
+        p = np.abs(dered) ** 2
+        lo = np.median(p[10:1000])
+        hi = np.median(p[n // 4 :])
+        praw = np.abs(fft) ** 2
+        lo_raw = np.median(praw[10:1000])
+        hi_raw = np.median(praw[n // 4 :])
+        assert lo_raw / hi_raw > 5  # red input
+        assert lo / hi < 2  # whitened output
+
+    def test_errors_match_sequential(self):
+        powers = (np.abs(make_fft(20000, redamp=3.0)) ** 2).astype(np.float64)
+        jax_out = np.asarray(kernels.estimate_power_errors(powers))
+        np_out = numpy_ref.estimate_power_errors(powers)
+        np.testing.assert_allclose(jax_out, np_out, rtol=1e-4, atol=1e-6)
+
+
+class TestSpectrogram:
+    def test_matches_twin(self):
+        x = make_series(1 << 12)
+        jax_out = np.asarray(kernels.spectrogram(x, 512))
+        np_out = numpy_ref.spectrogram(x.astype(np.float64), 512)
+        np.testing.assert_allclose(jax_out, np_out, rtol=1e-3, atol=1e-2)
+
+    def test_tone_localized(self):
+        x = make_series(1 << 14, f0=100.0, dt=1e-3, amp=5.0)
+        spec = np.asarray(kernels.spectrogram(x, 1024))
+        freqs = np.fft.rfftfreq(1024, 1e-3)
+        peak_bins = spec[:, 1:].argmax(axis=1) + 1
+        assert np.all(np.abs(freqs[peak_bins] - 100.0) < 2.0)
+
+
+class TestPrestoFFTFile:
+    def test_read_write_roundtrip(self, tmp_path):
+        n = 1 << 12
+        fft = make_fft(n)
+        inf = make_inf(tmp_path, n)
+        fftfn = str(tmp_path / "synth.fft")
+        write_fft(fftfn, fft, inf)
+        pfft = PrestoFFT(fftfn)
+        np.testing.assert_allclose(pfft.fft, fft)
+        assert len(pfft.freqs) == len(pfft.fft)
+        assert pfft.freqs[0] == 0.0
+        np.testing.assert_allclose(pfft.powers, np.abs(fft) ** 2, rtol=1e-5)
+        pfft.close()
+
+    def test_maxfreq_truncation(self, tmp_path):
+        n = 1 << 12
+        fft = make_fft(n)
+        inf = make_inf(tmp_path, n)
+        fftfn = str(tmp_path / "synth.fft")
+        write_fft(fftfn, fft, inf)
+        pfft = PrestoFFT(fftfn, maxfreq=100.0)
+        assert np.all(pfft.freqs < 100.0)
+        assert len(pfft.fft) == len(pfft.freqs)
+        pfft.close()
+
+    def test_white_level_and_fit(self, tmp_path):
+        n = 1 << 15
+        dt = 1e-4  # Nyquist 5000 Hz so >1000 Hz white band exists
+        x = make_series(n, dt=dt, amp=0.0, redamp=30.0, seed=5)
+        fft = np.fft.rfft(x).astype(np.complex64)
+        inf = make_inf(tmp_path, n, dt=dt)
+        fftfn = str(tmp_path / "synth.fft")
+        write_fft(fftfn, fft, inf)
+        pfft = PrestoFFT(fftfn)
+        white = pfft.estimate_white_power_level(1000)
+        assert white > 0
+        fit = pfft.fit_powers(freqlim=50.0)
+        assert fit["index"] < -0.5  # steep red noise detected
+        assert fit["amp"] > 0
+        pfft.close()
+
+    def test_missing_file_raises(self, tmp_path):
+        with pytest.raises(ValueError):
+            PrestoFFT(str(tmp_path / "nope.fft"))
+
+
+class TestSmearResponse:
+    def test_zero_ddm_is_unity(self):
+        resp = get_smear_response(0.0)
+        assert resp(1.0) == 1
+
+    def test_response_lowpass(self):
+        # wrong-DM smearing suppresses high fluctuation frequencies
+        obs = dict(chan_width=0.3, numchan=1024, lofreq=1200.0, N=1 << 14, dt=1e-3)
+        resp = get_smear_response(1.0, **obs)
+        assert resp(0.5) > resp(100.0)
+
+    def test_smearing_kernel_support(self):
+        flo, fhi, ddm = 1200.0, 1500.0, 1.0
+        smear = smearing_function(flo, fhi, ddm)
+        tmax = 4.15e3 * ddm * (flo**-2 - fhi**-2)
+        times = np.linspace(-tmax, 2 * tmax, 1000)
+        w = smear(times.copy())
+        assert np.all(w[(times < 0) | (times > tmax)] == 0)
+        assert np.any(w[(times > 0) & (times < tmax)] > 0)
+
+
+def test_power_law():
+    f = np.array([1.0, 10.0])
+    np.testing.assert_allclose(power_law(f, 2.0, -1.0, 3.0), [5.0, 3.2])

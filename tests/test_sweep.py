@@ -181,3 +181,14 @@ def test_stream_rejects_short_interior_block():
 
     with pytest.raises(ValueError, match="interior block"):
         sweep_stream(plan, bad_blocks(), chunk)
+
+
+def test_chunked_short_remainder():
+    # T % chunk smaller than min_overlap: the penultimate block is short but
+    # contains all remaining data, which is legal (end-of-data padding)
+    freqs, data = make_obs(T=3 * 1024 + 32)
+    dms = np.linspace(0.0, 120.0, 16)
+    spec = Spectra(freqs, 1e-3, data)
+    full = sweep_spectra(spec, dms, nsub=16, group_size=8)
+    chunked = sweep_spectra(spec, dms, nsub=16, group_size=8, chunk_payload=1024)
+    np.testing.assert_allclose(chunked.snr, full.snr, rtol=1e-4, atol=1e-4)
