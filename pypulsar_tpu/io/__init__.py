@@ -1,3 +1,12 @@
 from pypulsar_tpu.io import sigproc  # noqa: F401
 from pypulsar_tpu.io.filterbank import FilterbankFile, write_filterbank  # noqa: F401
 from pypulsar_tpu.io.infodata import InfoData  # noqa: F401
+from pypulsar_tpu.io.psrfits import (  # noqa: F401
+    PsrfitsFile,
+    SpectraInfo,
+    is_PSRFITS,
+    DATEOBS_to_MJD,
+    write_psrfits,
+    unpack_4bit,
+)
+from pypulsar_tpu.io.rfimask import RfifindMask, write_mask  # noqa: F401

@@ -1,0 +1,118 @@
+"""PRESTO rfifind ``.mask`` file reader/writer + per-sample mask expansion.
+
+Replaces the external PRESTO ``rfifind`` module used by the reference
+(bin/waterfaller.py:21,28-48; imported 3x per SURVEY.md §2.5).  The binary
+layout is PRESTO's rfifind mask format:
+
+    6 float64: time_sigma, freq_sigma, MJD, dtint, lofreq, df
+    3 int32:   nchan, nint, ptsperint
+    int32 nzap_chans, then that many int32 channel indices
+    int32 nzap_ints,  then that many int32 interval indices
+    nint int32: per-interval zap counts, then the concatenated int32
+                channel lists, one per interval
+
+Channel indices are in *file order* (lowest frequency = channel 0 for the
+usual PSRFITS/SIGPROC lo->hi layout); ``get_chan_mask`` can flip to the
+high-frequency-first orientation our Spectra uses (the reference flips with
+``mask[::-1]`` at bin/waterfaller.py:atomic use sites).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Sequence
+
+import numpy as np
+
+
+class RfifindMask:
+    """Parsed rfifind mask.  Attributes mirror PRESTO's ``rfifind`` object:
+    time_sigma, freq_sigma, MJD, dtint, lofreq, df, nchan, nint, ptsperint,
+    mask_zap_chans, mask_zap_ints, mask_zap_chans_per_int."""
+
+    def __init__(self, maskfn: str):
+        self.basefn = maskfn[: -len(".mask")] if maskfn.endswith(".mask") else maskfn
+        with open(maskfn, "rb") as f:
+            (
+                self.time_sigma,
+                self.freq_sigma,
+                self.MJD,
+                self.dtint,
+                self.lofreq,
+                self.df,
+            ) = struct.unpack("<6d", f.read(48))
+            self.nchan, self.nint, self.ptsperint = struct.unpack("<3i", f.read(12))
+            nzap = struct.unpack("<i", f.read(4))[0]
+            self.mask_zap_chans = np.fromfile(f, "<i4", nzap)
+            nzap = struct.unpack("<i", f.read(4))[0]
+            self.mask_zap_ints = np.fromfile(f, "<i4", nzap)
+            nzap_per_int = np.fromfile(f, "<i4", self.nint)
+            self.mask_zap_chans_per_int: List[np.ndarray] = []
+            for n in nzap_per_int:
+                self.mask_zap_chans_per_int.append(np.fromfile(f, "<i4", n))
+        self.mask_zap_chans_set = set(int(c) for c in self.mask_zap_chans)
+        # per-interval boolean table [nint, nchan]: union of the per-interval
+        # lists, the globally zapped channels, and fully zapped intervals
+        table = np.zeros((self.nint, self.nchan), dtype=bool)
+        for i, chans in enumerate(self.mask_zap_chans_per_int):
+            if chans.size:
+                table[i, chans] = True
+        if self.mask_zap_chans.size:
+            table[:, self.mask_zap_chans] = True
+        if self.mask_zap_ints.size:
+            table[np.asarray(self.mask_zap_ints, dtype=int), :] = True
+        self._zap_table = table
+
+    def get_sample_mask(self, startsamp: int, N: int) -> np.ndarray:
+        """Boolean [nchan, N] mask (True = zapped) for samples
+        [startsamp, startsamp+N), in file channel order — the vectorized
+        equivalent of the reference's get_mask (bin/waterfaller.py:28-48).
+        Intervals past the end of the mask reuse the last interval."""
+        sampnums = np.arange(startsamp, startsamp + N)
+        blocknums = np.minimum(sampnums // self.ptsperint, self.nint - 1)
+        mask = self._zap_table[blocknums]  # [N, nchan]
+        return mask.T
+
+    def get_chan_mask(self, startsamp: int, N: int, hifreq_first: bool = True
+                      ) -> np.ndarray:
+        """Like get_sample_mask but optionally flipped to the
+        high-frequency-first channel order of our Spectra."""
+        m = self.get_sample_mask(startsamp, N)
+        return m[::-1] if hifreq_first else m
+
+
+def write_mask(
+    maskfn: str,
+    *,
+    time_sigma: float = 10.0,
+    freq_sigma: float = 4.0,
+    mjd: float = 56000.0,
+    dtint: float = 1.0,
+    lofreq: float = 1400.0,
+    df: float = 1.0,
+    nchan: int,
+    nint: int,
+    ptsperint: int,
+    zap_chans: Sequence[int] = (),
+    zap_ints: Sequence[int] = (),
+    zap_chans_per_int: Sequence[Sequence[int]] = (),
+) -> str:
+    """Write a PRESTO-layout rfifind mask (the reference ecosystem has no
+    writer; needed for round-trip tests and synthetic pipelines)."""
+    zap_chans_per_int = list(zap_chans_per_int) or [[] for _ in range(nint)]
+    if len(zap_chans_per_int) != nint:
+        raise ValueError("need one zap list per interval")
+    with open(maskfn, "wb") as f:
+        f.write(struct.pack("<6d", time_sigma, freq_sigma, mjd, dtint, lofreq, df))
+        f.write(struct.pack("<3i", nchan, nint, ptsperint))
+        zc = np.asarray(sorted(zap_chans), dtype="<i4")
+        f.write(struct.pack("<i", zc.size))
+        zc.tofile(f)
+        zi = np.asarray(sorted(zap_ints), dtype="<i4")
+        f.write(struct.pack("<i", zi.size))
+        zi.tofile(f)
+        counts = np.asarray([len(c) for c in zap_chans_per_int], dtype="<i4")
+        counts.tofile(f)
+        for chans in zap_chans_per_int:
+            np.asarray(sorted(chans), dtype="<i4").tofile(f)
+    return maskfn

@@ -167,10 +167,14 @@ class TestApproxHarm:
         assert out.startswith("2/1 + ")
 
     def test_irrational(self):
-        # ratios needing large m,n print the plain float
+        # pi/1 is approximated by 22/7 (within tol, k<=9), with a residue term
         out = output_harm(np.pi, 1.0)
-        assert "/" not in out or out.count("/") == 0 or True  # no crash
-        assert float(out.split()[0].split("/")[0]) > 0
+        assert out.startswith("22/7 ")
+        # a ratio needing m>9 AND n>9 (here exactly 10/11) falls back to
+        # printing the plain float
+        out = output_harm(10.0, 11.0)
+        assert "/" not in out
+        assert float(out) == pytest.approx(10.0 / 11.0, abs=1e-6)
 
 
 class TestShowProgress:
