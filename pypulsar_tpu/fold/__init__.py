@@ -1,1 +1,26 @@
 from pypulsar_tpu.fold.pulse import Pulse, SummedPulse, read_pulse_from_file  # noqa: F401
+from pypulsar_tpu.fold.polycos import (  # noqa: F401
+    Polyco,
+    Polycos,
+    PolycoError,
+    create_polycos,
+    create_polycos_from_inf,
+    create_polycos_from_spindown,
+)
+from pypulsar_tpu.fold.toa import (  # noqa: F401
+    FFTFitError,
+    cprof,
+    fftfit,
+    measure_phase,
+    format_princeton_toa,
+    write_princeton_toa,
+)
+from pypulsar_tpu.fold.engine import (  # noqa: F401
+    fold_bins,
+    fold_numpy,
+    fold_timeseries,
+    fold_spectra,
+    phases_from_polycos,
+    phases_constant_period,
+    phase_to_bins,
+)

@@ -10,3 +10,4 @@ from pypulsar_tpu.io.psrfits import (  # noqa: F401
     unpack_4bit,
 )
 from pypulsar_tpu.io.rfimask import RfifindMask, write_mask  # noqa: F401
+from pypulsar_tpu.io.parfile import PsrPar, psr_par, write_par  # noqa: F401
