@@ -10,6 +10,7 @@ Behavioral parity targets (reference files):
 """
 
 from pypulsar_tpu.astro import protractor, calendar, clock, sextant, coordconv
+from pypulsar_tpu.astro import healpix, skytemp, estimate_snr
 from pypulsar_tpu.astro.telescopes import (
     telescope_to_id,
     id_to_telescope,
@@ -22,6 +23,9 @@ __all__ = [
     "clock",
     "sextant",
     "coordconv",
+    "healpix",
+    "skytemp",
+    "estimate_snr",
     "telescope_to_id",
     "id_to_telescope",
     "telescope_to_maxha",

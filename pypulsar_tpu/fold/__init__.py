@@ -15,6 +15,7 @@ from pypulsar_tpu.fold.toa import (  # noqa: F401
     format_princeton_toa,
     write_princeton_toa,
 )
+from pypulsar_tpu.fold import profile_snr  # noqa: F401
 from pypulsar_tpu.fold.engine import (  # noqa: F401
     fold_bins,
     fold_numpy,

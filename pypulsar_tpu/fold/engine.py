@@ -41,8 +41,9 @@ def fold_bins(data, bin_idx, nbins: int):
     phase bins given per-sample bin indices.  Returns (profile, counts)."""
     data = jnp.asarray(data)
     bin_idx = jnp.asarray(bin_idx, jnp.int32)
+    # integer accumulation: float32 counts would saturate at 2^24/bin
     counts = jax.ops.segment_sum(
-        jnp.ones(bin_idx.shape, jnp.float32), bin_idx, num_segments=nbins
+        jnp.ones(bin_idx.shape, jnp.int32), bin_idx, num_segments=nbins
     )
     if data.ndim == 1:
         prof = jax.ops.segment_sum(data, bin_idx, num_segments=nbins)

@@ -328,6 +328,16 @@ def create_polycos(
                 "TEMPO binary not found and ephemeris has binary terms; "
                 "cannot generate polycos natively."
             )
+        if telescope_id not in ("@", "0"):
+            # topocentric data needs Earth-motion corrections only TEMPO
+            # provides; a pure spin-down polyco would smear the fold by
+            # up to v/c ~ 1e-4 in apparent frequency
+            raise PolycoError(
+                "TEMPO binary not found; the native spin-down generator is "
+                "only valid for barycentred/geocentric data (telescope_id "
+                f"'@' or '0', got {telescope_id!r}).  Call "
+                "create_polycos_from_spindown directly to override."
+            )
         return create_polycos_from_spindown(
             par, float(start_mjd), float(end_mjd), obs=telescope_id,
             obsfreq=center_freq, span=span, numcoeffs=numcoeffs,

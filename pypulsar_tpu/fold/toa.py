@@ -146,7 +146,8 @@ def format_princeton_toa(toa_MJDi: int, toa_MJDf: float, toaerr: float,
     toastr = f"{toa_MJDi:5d}{fracstr}"
     line = f"{obs}{name:13s} {freq:8.3f} {toastr} {toaerr:8.2f}"
     if dm != 0.0:
-        line += f"{'':14s}{dm:10.4f}"
+        # line is 52 chars here; 16 spaces put the F10.4 DM at cols 69-78
+        line += f"{'':16s}{dm:10.4f}"
     return line
 
 

@@ -11,3 +11,4 @@ from pypulsar_tpu.io.psrfits import (  # noqa: F401
 )
 from pypulsar_tpu.io.rfimask import RfifindMask, write_mask  # noqa: F401
 from pypulsar_tpu.io.parfile import PsrPar, psr_par, write_par  # noqa: F401
+from pypulsar_tpu.io.prestopfd import PfdFile, make_pfd, fft_rotate  # noqa: F401

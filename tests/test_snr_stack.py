@@ -1,0 +1,273 @@
+"""Tests: HEALPix interp, skytemp, radiometer SNR, .pfd round trip,
+profile SNR (parity targets: healpy.get_interp_val, reference
+utils/{skytemp,estimate_snr}.py, external prepfold.pfd, bin/pfd_snr.py)."""
+
+import numpy as np
+import pytest
+
+from pypulsar_tpu.astro import estimate_snr, healpix, skytemp
+from pypulsar_tpu.core import psrmath
+from pypulsar_tpu.fold import profile_snr
+from pypulsar_tpu.io.prestopfd import PfdFile, fft_rotate, make_pfd
+
+
+class TestHealpix:
+    def test_npix_nside(self):
+        assert healpix.npix(8) == 768
+        assert healpix.nside_from_npix(768) == 8
+        with pytest.raises(ValueError):
+            healpix.nside_from_npix(1000)
+
+    def test_pix2ang_ang2pix_roundtrip(self):
+        nside = 16
+        pix = np.arange(healpix.npix(nside))
+        theta, phi = healpix.pix2ang(nside, pix)
+        back = healpix.ang2pix(nside, theta, phi)
+        np.testing.assert_array_equal(back, pix)
+
+    def test_ring_structure(self):
+        # ring z values must be strictly decreasing over all rings
+        nside = 8
+        i = np.arange(1, 4 * nside)
+        _, _, z, _ = healpix._ring_info(nside, i)
+        assert (np.diff(z) < 0).all()
+        # pixel counts sum to npix
+        _, rp, _, _ = healpix._ring_info(nside, i)
+        assert rp.sum() == healpix.npix(nside)
+
+    def test_interp_smooth_function(self):
+        # interpolation of a smooth function sampled on pixel centers
+        # should reproduce the function well away from the poles
+        nside = 64
+        pix = np.arange(healpix.npix(nside))
+        theta, phi = healpix.pix2ang(nside, pix)
+        f = lambda th, ph: np.cos(th) + 0.3 * np.sin(th) * np.cos(ph)
+        m = f(theta, phi)
+        rng = np.random.RandomState(0)
+        th_test = rng.uniform(0.3, np.pi - 0.3, 500)
+        ph_test = rng.uniform(0, 2 * np.pi, 500)
+        got = healpix.get_interp_val(m, th_test, ph_test)
+        np.testing.assert_allclose(got, f(th_test, ph_test), atol=2e-3)
+
+    def test_interp_at_pixel_centers_exact(self):
+        nside = 16
+        pix = np.arange(healpix.npix(nside))
+        theta, phi = healpix.pix2ang(nside, pix)
+        m = np.arange(healpix.npix(nside), dtype=float)
+        # at exact centers of the equatorial belt the interp is dominated
+        # by the pixel itself
+        sel = (theta > 1.0) & (theta < np.pi - 1.0)
+        got = healpix.get_interp_val(m, theta[sel], phi[sel])
+        # neighbors are close in value only for smooth maps; use a smooth map
+        m2 = np.cos(theta)
+        got2 = healpix.get_interp_val(m2, theta[sel], phi[sel])
+        np.testing.assert_allclose(got2, np.cos(theta[sel]), atol=5e-3)
+        assert np.isfinite(got).all()
+
+
+class TestSkytemp:
+    def test_get_skytemp_from_synthetic_map(self, tmp_path):
+        nside = 32
+        pix = np.arange(healpix.npix(nside))
+        theta, phi = healpix.pix2ang(nside, pix)
+        # temperature pattern: hot galactic plane (theta ~ pi/2)
+        m = 10.0 + 40.0 * np.exp(-((theta - np.pi / 2) / 0.2) ** 2)
+        fn = str(tmp_path / "haslam.fits")
+        skytemp.write_healpix_map(fn, m)
+        t_plane = skytemp.get_skytemp(0.0, 0.0, freq=408.0, mapfn=fn)
+        t_pole = skytemp.get_skytemp(0.0, 85.0, freq=408.0, mapfn=fn)
+        assert t_plane == pytest.approx(50.0, rel=0.05)
+        assert t_pole == pytest.approx(10.0, rel=0.05)
+
+    def test_freq_scaling_honors_index(self, tmp_path):
+        nside = 8
+        m = np.full(healpix.npix(nside), 20.0)
+        fn = str(tmp_path / "flat.fits")
+        skytemp.write_healpix_map(fn, m)
+        t408 = skytemp.get_skytemp(10.0, 10.0, freq=408.0, mapfn=fn)
+        t1400 = skytemp.get_skytemp(10.0, 10.0, freq=1400.0, mapfn=fn)
+        assert t1400 / t408 == pytest.approx((1400.0 / 408.0) ** -2.7)
+        # unlike the reference (SURVEY.md §2.6), index is honored
+        t_flat = skytemp.get_skytemp(10.0, 10.0, freq=1400.0, index=0.0,
+                                     mapfn=fn)
+        assert t_flat == pytest.approx(t408)
+
+
+class TestEstimateSnr:
+    def test_airy_pattern(self):
+        assert estimate_snr.airy_pattern(10.0, 0.0) == pytest.approx(1.0)
+        assert estimate_snr.airy_pattern(10.0, 5.0) == pytest.approx(0.5, abs=0.01)
+        assert estimate_snr.airy_pattern(10.0, 20.0) < 0.05
+
+    def test_change_freq(self):
+        S, e = estimate_snr.change_freq(10.0, 1.0, 400.0, 1400.0, -1.8)
+        k = (1400.0 / 400.0) ** -1.8
+        assert S == pytest.approx(10.0 * k)
+        assert e == pytest.approx(1.0 * k)
+
+    def test_radiometer_scalings(self):
+        est = estimate_snr.SnrEstimator(freq=1400.0, bw=100.0, numpol=2,
+                                        gain=10.0, systemp=30.0, fwhm=3.5)
+        snr1, err1 = est.estimate_snr(za=5, az=0, Smean=1.0, Sfreq=1400.0,
+                                      time=600.0, angsep=0.0, period=0.5)
+        snr2, _ = est.estimate_snr(za=5, az=0, Smean=2.0, Sfreq=1400.0,
+                                   time=600.0, angsep=0.0, period=0.5)
+        assert snr2 == pytest.approx(2 * snr1)  # linear in flux
+        snr4t, _ = est.estimate_snr(za=5, az=0, Smean=1.0, Sfreq=1400.0,
+                                    time=2400.0, angsep=0.0, period=0.5)
+        assert snr4t == pytest.approx(2 * snr1)  # sqrt(t)
+        assert np.isnan(err1).all()  # no flux error given
+        # off-axis reduces SNR
+        snr_off, _ = est.estimate_snr(za=5, az=0, Smean=1.0, Sfreq=1400.0,
+                                      time=600.0, angsep=2.0, period=0.5)
+        assert snr_off < snr1
+
+    def test_gain_curve_callable(self):
+        gain = lambda za=0, az=0: 11.0 - 0.1 * za
+        est = estimate_snr.SnrEstimator(1400.0, 100.0, 2, gain, 25.0, 3.5)
+        s_low, _ = est.estimate_snr(0, 0, 1.0, 1400.0, 600.0, 0.0, 0.5)
+        s_high, _ = est.estimate_snr(15, 0, 1.0, 1400.0, 600.0, 0.0, 0.5)
+        assert s_low > s_high
+
+
+class TestPfd:
+    def _fake(self, proflen=64, npart=8, nsub=4, pulse_phase=0.3):
+        rng = np.random.RandomState(0)
+        template = psrmath.gaussian_profile(proflen, pulse_phase, 0.06)
+        profs = (1000.0 + 50.0 * template[None, None, :]
+                 + rng.randn(npart, nsub, proflen) * 1.0)
+        return make_pfd(profs, dt=1e-3, lofreq=1400.0, chan_wid=25.0,
+                        numchan=4, fold_p1=0.5, bestdm=0.0)
+
+    def test_roundtrip(self, tmp_path):
+        p = self._fake()
+        fn = str(tmp_path / "fake.pfd")
+        p.write(fn)
+        q = PfdFile(fn)
+        assert q.proflen == p.proflen and q.npart == p.npart
+        assert q.candnm == p.candnm
+        assert q.curr_p1 == p.curr_p1
+        np.testing.assert_allclose(q.profs, p.profs)
+        np.testing.assert_allclose(q.stats, p.stats)
+        assert q.rastr == "00:00:00.00"
+
+    def test_fft_rotate(self):
+        x = np.zeros(32)
+        x[4] = 1.0
+        y = fft_rotate(x, 3.0)
+        assert np.argmax(y) == 7
+        # fractional rotation conserves total flux
+        z = fft_rotate(x, 2.5)
+        assert z.sum() == pytest.approx(x.sum())
+
+    def test_dedisperse_aligns_subbands(self):
+        proflen, npart, nsub = 64, 4, 8
+        dm, p1 = 50.0, 0.5
+        lofreq, chan_wid, numchan = 1300.0, 1.0, 64
+        chan_per_sub = numchan // nsub
+        subfreqs = lofreq + (np.arange(nsub) * chan_per_sub
+                             + 0.5 * (chan_per_sub - 1)) * chan_wid
+        delays = psrmath.delay_from_DM(dm, subfreqs)
+        delays -= delays[-1]
+        template = psrmath.gaussian_profile(proflen, 0.5, 0.05)
+        profs = np.zeros((npart, nsub, proflen))
+        for j in range(nsub):
+            shift = delays[j] / p1 * proflen
+            profs[:, j, :] = fft_rotate(template, shift)[None, :] * 10 + 100
+        p = make_pfd(profs, dt=1e-3, lofreq=lofreq, chan_wid=chan_wid,
+                     numchan=numchan, fold_p1=p1, bestdm=dm)
+        smeared_peak = p.sumprof.max()
+        p.dedisperse()
+        assert p.currdm == dm
+        aligned_peak = p.sumprof.max()
+        assert aligned_peak > smeared_peak
+        # after dedispersion all subbands peak at the template phase
+        prof = p.sumprof - p.sumprof.min()
+        assert abs(int(np.argmax(prof)) - 32) <= 1
+
+    def test_adjust_period_aligns_parts(self):
+        proflen, npart, nsub = 64, 16, 1
+        p1 = 0.5
+        p_wrong = p1 * (1 + 2e-4)  # folded at slightly wrong period
+        T_part = 10.0
+        template = psrmath.gaussian_profile(proflen, 0.5, 0.05)
+        profs = np.zeros((npart, nsub, proflen))
+        for i in range(npart):
+            t = i * T_part
+            dphi = (1.0 / p1 - 1.0 / p_wrong) * t
+            profs[i, 0, :] = fft_rotate(template, dphi * proflen) * 10 + 100
+        p = make_pfd(profs, dt=1e-3, lofreq=1400.0, chan_wid=1.0,
+                     numchan=1, fold_p1=p_wrong)
+        p.T = npart * T_part  # override synthesized T for the test
+        drift_peak = p.sumprof.max()
+        p.adjust_period(p=p1)
+        assert p.sumprof.max() > drift_peak
+        assert p.curr_p1 == p1
+
+    def test_dof_corr_limits(self):
+        p = self._fake()
+        # many samples per bin -> correction ~1; <1 sample per bin -> ~dt_per_bin
+        p.dt_per_bin = 100.0
+        assert p.DOF_corr() == pytest.approx(1.0, rel=0.01)
+        p.dt_per_bin = 0.01
+        assert p.DOF_corr() == pytest.approx(0.01, rel=0.01)
+
+
+class TestProfileSnr:
+    def test_calc_snr_known_signal(self):
+        proflen = 128
+        rng = np.random.RandomState(1)
+        std_true = 2.0
+        template = np.zeros(proflen)
+        template[60:68] = 50.0
+        prof = template + rng.randn(proflen) * std_true + 10.0
+        onpulse = profile_snr.onpulse_from_regions(proflen, [(58, 70)])
+        snr, weq, area, offmean = profile_snr.calc_snr(prof, onpulse, std_true)
+        # analytic: area ~ 400, weq ~ 8, snr ~ 400/2/sqrt(8) ~ 70
+        assert snr == pytest.approx(400.0 / 2.0 / np.sqrt(8.0), rel=0.15)
+        assert offmean == pytest.approx(10.0, abs=0.5)
+
+    def test_onpulse_auto(self):
+        prof = np.ones(64)
+        prof[30:34] = 30.0
+        mask = profile_snr.onpulse_auto(prof)
+        assert mask[30:34].all()
+        assert mask.sum() == 4
+
+    def test_pfd_snr_end_to_end(self):
+        proflen, npart, nsub = 64, 8, 4
+        rng = np.random.RandomState(2)
+        template = psrmath.gaussian_profile(proflen, 0.5, 0.08)
+        template /= template.max()
+        profs = (1000.0 + rng.randn(npart, nsub, proflen) * 5.0
+                 + 30.0 * template[None, None, :])
+        p = make_pfd(profs, dt=1e-3, lofreq=1400.0, chan_wid=25.0,
+                     numchan=4, fold_p1=0.5)
+        out = profile_snr.pfd_snr(p, regions=[(24, 40)], dedisperse=False)
+        assert out["snr"] > 5
+        assert out["smean"] is None
+        out2 = profile_snr.pfd_snr(p, regions=[(24, 40)], dedisperse=False,
+                                   sefd=3.0)
+        assert out2["smean"] is not None and out2["smean"] > 0
+
+    def test_gaussfitfile(self, tmp_path):
+        fn = str(tmp_path / "g.gaussians")
+        with open(fn, "w") as f:
+            f.write("const = 1.0 +/- 0\n")
+            f.write("phas1 = 0.25 +/- 0\nampl1 = 5.0 +/- 0\nfwhm1 = 0.05 +/- 0\n")
+            f.write("phas2 = 0.60 +/- 0\nampl2 = 2.0 +/- 0\nfwhm2 = 0.10 +/- 0\n")
+        comps, const = profile_snr.read_gaussfitfile(fn, 128)
+        assert comps.shape == (2, 128)
+        assert np.argmax(comps[0]) == 32
+        assert np.argmax(comps[1]) == pytest.approx(77, abs=1)
+
+    def test_model_alignment(self):
+        proflen = 64
+        model = psrmath.gaussian_profile(proflen, 0.2, 0.06)
+        prof = np.roll(model, 10) * 3 + 1
+        rot = profile_snr.get_rotation(prof, model)
+        # transform() rotates LEFT (PRESTO rotate convention): a profile
+        # np.roll'ed right by 10 needs a left rotation of n-10
+        assert rot == pytest.approx(54.0 / 64.0, abs=1.0 / 64)
+        mask = profile_snr.onpulse_from_model(prof, model)
+        assert mask[np.argmax(prof)]
