@@ -1,0 +1,129 @@
+"""Multi-file SIGPROC filterbank observations.
+
+Behavioral spec: reference ``formats/fbobs.py`` — sort member files by start
+MJD, build a cumulative sample index (:21-45), and read sample intervals
+across file boundaries (:66-105).  Fixes the reference's
+``get_time_interval`` NameError (:62-64, undefined ``endsamp``) and replaces
+the linear file-search loop with ``np.searchsorted`` on the cumulative index.
+
+Adds what the TPU pipeline actually needs at this boundary:
+``get_spectra`` (the ``<reader>.get_spectra -> Spectra`` loader contract) and
+``iter_blocks`` for overlap-save streaming of host->device chunks.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+from pypulsar_tpu.core.spectra import Spectra
+from pypulsar_tpu.io.filterbank import FilterbankFile
+
+__all__ = ["FilterbankObs", "fbobs"]
+
+
+class FilterbankObs:
+    """An observation made of multiple contiguous .fil files.
+
+    Sample ``i`` of the observation lives in the member file whose
+    ``[startsamp, endsamp)`` interval contains it; member files are sorted
+    by header start MJD.  Sample time and channelization are taken from the
+    first file and assumed uniform.
+    """
+
+    def __init__(self, filfns: Sequence[str]):
+        if not filfns:
+            raise ValueError("need at least one filterbank file")
+        fbs = [FilterbankFile(fn) for fn in filfns]
+        order = np.argsort([fb.header["tstart"] for fb in fbs], kind="stable")
+        self.fbs: List[FilterbankFile] = [fbs[i] for i in order]
+        self.filenames = [fb.filename for fb in self.fbs]
+        self.numfiles = len(self.fbs)
+        self.startmjds = np.array([fb.header["tstart"] for fb in self.fbs])
+
+        self.tsamp = float(self.fbs[0].header["tsamp"])
+        self.nchans = int(self.fbs[0].header["nchans"])
+        self.frequencies = self.fbs[0].frequencies
+        self.nsamps = np.array([fb.nspec for fb in self.fbs], dtype=np.int64)
+        self.lengths = self.nsamps * self.tsamp
+
+        self.endsamps = np.cumsum(self.nsamps)
+        self.startsamps = np.concatenate(([0], self.endsamps[:-1]))
+        self.endtimes = self.endsamps * self.tsamp
+        self.starttimes = self.startsamps * self.tsamp
+        self.number_of_samples = int(self.endsamps[-1])
+        self.obslen = float(self.endtimes[-1])
+
+    # -- lifecycle ---------------------------------------------------------
+    def close_all(self):
+        for fb in self.fbs:
+            fb.close()
+
+    close = close_all
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close_all()
+
+    # -- reading -----------------------------------------------------------
+    def _file_of(self, samp: int) -> int:
+        """Index of the member file containing global sample ``samp``."""
+        return int(np.searchsorted(self.endsamps, samp, side="right"))
+
+    def get_time_interval(self, starttime: float, endtime: float) -> np.ndarray:
+        """Read samples in ``[starttime, endtime)`` seconds (fixes the
+        reference's undefined-name bug at fbobs.py:62-64).  Times are
+        rounded to the nearest sample so float representation error
+        cannot shift the window by one sample."""
+        return self.get_sample_interval(int(round(starttime / self.tsamp)),
+                                        int(round(endtime / self.tsamp)))
+
+    def get_sample_interval(self, startsamp: int, endsamp: int) -> np.ndarray:
+        """Read global samples ``[startsamp, endsamp)`` spanning member
+        files; returns (nsamples, nchans) float32."""
+        if startsamp > endsamp:
+            raise ValueError("Start of interval must precede end of interval!")
+        startsamp = max(int(startsamp), 0)
+        endsamp = min(int(endsamp), self.number_of_samples)
+        if endsamp <= startsamp:
+            return np.empty((0, self.nchans), dtype=np.float32)
+
+        first = self._file_of(startsamp)
+        last = self._file_of(endsamp - 1)
+        chunks = []
+        for ii in range(first, last + 1):
+            lo = max(startsamp, int(self.startsamps[ii])) - int(self.startsamps[ii])
+            hi = min(endsamp, int(self.endsamps[ii])) - int(self.startsamps[ii])
+            chunks.append(self.fbs[ii].get_samples(lo, hi - lo))
+        return np.concatenate(chunks) if len(chunks) > 1 else chunks[0]
+
+    def get_spectra(self, startsamp: int, N: int) -> Spectra:
+        """Loader-boundary contract: (chan, time) Spectra of N samples."""
+        data = self.get_sample_interval(startsamp, startsamp + N).T
+        starttime = startsamp * self.tsamp
+        return Spectra(self.frequencies, self.tsamp, data,
+                       starttime=starttime, dm=0.0)
+
+    def iter_blocks(self, block_len: int, overlap: int = 0,
+                    start: int = 0, end: int = None,
+                    ) -> Iterator[Tuple[int, Spectra]]:
+        """Stream ``(start_sample, Spectra)`` blocks with ``overlap``
+        trailing samples re-read at each seam (overlap-save for chunked
+        dedispersion)."""
+        if end is None:
+            end = self.number_of_samples
+        step = block_len - overlap
+        if step <= 0:
+            raise ValueError("block_len must exceed overlap")
+        pos = start
+        while pos < end:
+            n = min(block_len, end - pos)
+            yield pos, self.get_spectra(pos, n)
+            pos += step
+
+
+# Reference-compatible alias (reference class name is lowercase `fbobs`).
+fbobs = FilterbankObs

@@ -351,6 +351,35 @@ class HDUList:
                     f.write(b"\x00" * ((-len(raw)) % BLOCK))
 
 
+def update_primary_header(fn: str, updates: Dict[str, object]) -> None:
+    """Rewrite the values of existing cards in a file's primary header in
+    place (card slots are fixed 80 bytes, so file layout is unchanged).
+    Keys that are absent from the header raise KeyError."""
+    remaining = {k.upper(): v for k, v in updates.items()}
+    with builtins.open(fn, "r+b") as f:
+        offset = 0
+        while remaining:
+            block = f.read(BLOCK)
+            if len(block) < BLOCK:
+                raise ValueError("truncated FITS header")
+            for i in range(0, BLOCK, CARDLEN):
+                card = block[i : i + CARDLEN].decode("ascii", errors="replace")
+                key = card[:8].strip()
+                if key == "END":
+                    if remaining:
+                        raise KeyError(
+                            f"cards not found in primary header: "
+                            f"{sorted(remaining)}")
+                    return
+                if key in remaining and card[8:10] == "= ":
+                    newcard = (f"{key:<8}= "
+                               f"{_fmt_value(remaining.pop(key))}")
+                    f.seek(offset + i)
+                    f.write(newcard[:CARDLEN].ljust(CARDLEN).encode("ascii"))
+                    f.seek(offset + BLOCK)
+            offset += BLOCK
+
+
 def open(fn: str, mode: str = "readonly", memmap: bool = True) -> HDUList:  # noqa: A001
     """Open a FITS file read-only; BINTABLE data are memmapped."""
     f = builtins.open(fn, "rb")

@@ -23,7 +23,7 @@ from __future__ import annotations
 import os
 import re
 import warnings
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -208,6 +208,9 @@ class SpectraInfo:
         self.orig_df = primary.get("OBSBW", 0.0)
         self.beam_FWHM = primary.get("BMIN", 0.0)
         self.chan_dm = primary.get("CHAN_DM", 0.0)
+        self.start_lst = primary.get("STT_LST", 0.0)
+        ibeam = primary.get("IBEAM")
+        self.beam_id = None if ibeam in (None, "") else int(ibeam)
 
         self.start_MJD[ii] = primary.get("STT_IMJD", 0) + (
             primary.get("STT_SMJD", 0) + primary.get("STT_OFFS", 0.0)
@@ -233,6 +236,7 @@ class SpectraInfo:
                 self.user_poln = 1
 
         self.poln_order = subint["POL_TYPE"]
+        self.num_ifs = subint.get("NUMIFS", 1)  # Mock spectrometer extension
         if subint.get("NCHNOFFS", 0) > 0:
             warnings.warn(f"first freq channel is not 0 in file {ii}")
         self.spectra_per_subint = subint["NSBLK"]
@@ -469,6 +473,7 @@ def write_psrfits(
     offsets: Optional[np.ndarray] = None,
     weights: Optional[np.ndarray] = None,
     nsuboffs: int = 0,
+    extra_primary: Optional[Dict[str, object]] = None,
 ) -> str:
     """Write ``data`` [chan, time] (channel 0 = freqs[0]; stored on disk
     low-frequency-first as real PSRFITS search files are) to a minimal
@@ -525,6 +530,9 @@ def write_psrfits(
     ph["STT_IMJD"] = imjd
     ph["STT_SMJD"] = smjd
     ph["STT_OFFS"] = offs
+    ph["STT_LST"] = 0.0
+    for key, val in (extra_primary or {}).items():
+        ph[key] = val
 
     nrows = nsub
     if nbits == 32:

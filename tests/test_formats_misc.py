@@ -1,0 +1,291 @@
+"""Tests for accelcands, fbobs, wapp, and datafile format modules."""
+
+import io
+import struct
+
+import numpy as np
+import pytest
+
+from pypulsar_tpu.io import accelcands
+from pypulsar_tpu.io.fbobs import FilterbankObs
+from pypulsar_tpu.io.filterbank import write_filterbank
+from pypulsar_tpu.io.psrfits import write_psrfits
+
+
+# ---------------------------------------------------------------------------
+# accelcands
+# ---------------------------------------------------------------------------
+
+def _make_cand(i):
+    c = accelcands.Candidate(
+        accelfile="obs_DM%05.2f_ACCEL_50" % (i * 1.5), candnum=i + 1,
+        dm=i * 1.5, snr=10.0 + i, sigma=5.0 + i, numharm=1 << (i % 4),
+        ipow=100.0 + i, cpow=110.0 + i, period=0.033 * (i + 1),
+        r=1234.5 + i, z=-2.0 * i)
+    c.add_dmhit(i * 1.5, 10.0 + i, 5.0 + i)
+    c.add_dmhit(i * 1.5 + 0.5, 8.0 + i)
+    return c
+
+
+def test_accelcands_roundtrip():
+    cands = [_make_cand(i) for i in range(5)]
+    buf = io.StringIO()
+    accelcands.write_candlist(cands, buf)
+    text = buf.getvalue()
+    back = accelcands.parse_candlist(io.StringIO(text))
+    assert len(back) == 5
+    # writer sorts by sigma descending
+    sigmas = [c.sigma for c in back]
+    assert sigmas == sorted(sigmas, reverse=True)
+    orig = {c.candnum: c for c in cands}
+    for c in back:
+        o = orig[c.candnum]
+        assert c.accelfile == o.accelfile
+        assert c.dm == pytest.approx(o.dm)
+        assert c.snr == pytest.approx(o.snr)
+        assert c.numharm == o.numharm
+        assert c.period == pytest.approx(o.period, rel=1e-6)
+        assert len(c.dmhits) == len(o.dmhits)
+        assert c.dmhits[0].sigma is not None
+        assert c.dmhits[1].sigma is None
+
+    # second write of the parsed list is byte-identical (format is stable)
+    buf2 = io.StringIO()
+    accelcands.write_candlist(back, buf2)
+    assert buf2.getvalue() == text
+
+
+def test_accelcands_file_roundtrip(tmp_path):
+    fn = str(tmp_path / "test.accelcands")
+    accelcands.write_candlist([_make_cand(0)], fn)
+    back = accelcands.parse_candlist(fn)
+    assert len(back) == 1 and back[0].candnum == 1
+
+
+def test_accelcands_bad_line():
+    with pytest.raises(accelcands.AccelcandsError):
+        accelcands.parse_candlist(io.StringIO("utter nonsense\n"))
+
+
+# ---------------------------------------------------------------------------
+# fbobs
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fil_pair(tmp_path):
+    """Two contiguous filterbank files of 100 + 60 samples, 4 channels."""
+    rng = np.random.RandomState(42)
+    nchan, tsamp = 4, 1e-3
+    hdr = dict(fch1=1500.0, foff=-1.0, nchans=nchan, tsamp=tsamp, nbits=32)
+    d1 = rng.rand(100, nchan).astype(np.float32)
+    d2 = rng.rand(60, nchan).astype(np.float32)
+    fn1 = str(tmp_path / "part1.fil")
+    fn2 = str(tmp_path / "part2.fil")
+    write_filterbank(fn1, dict(hdr, tstart=55000.0), d1)
+    write_filterbank(fn2, dict(hdr, tstart=55000.0 + 100 * tsamp / 86400.0), d2)
+    # deliberately pass out of order; fbobs must sort by tstart
+    return [fn2, fn1], np.concatenate([d1, d2])
+
+
+def test_fbobs_index_and_read(fil_pair):
+    fns, full = fil_pair
+    with FilterbankObs(fns) as obs:
+        assert obs.numfiles == 2
+        assert obs.number_of_samples == 160
+        assert obs.filenames[0].endswith("part1.fil")
+        # interval within first file
+        np.testing.assert_allclose(obs.get_sample_interval(10, 50), full[10:50])
+        # interval spanning the boundary
+        np.testing.assert_allclose(obs.get_sample_interval(90, 130), full[90:130])
+        # interval in second file
+        np.testing.assert_allclose(obs.get_sample_interval(110, 160), full[110:160])
+        # clipping
+        np.testing.assert_allclose(obs.get_sample_interval(-5, 1000), full)
+        with pytest.raises(ValueError):
+            obs.get_sample_interval(50, 10)
+
+
+def test_fbobs_time_interval_and_spectra(fil_pair):
+    fns, full = fil_pair
+    with FilterbankObs(fns) as obs:
+        d = obs.get_time_interval(0.09, 0.13)  # samples 90..130
+        np.testing.assert_allclose(d, full[90:130])
+        spec = obs.get_spectra(95, 20)
+        assert spec.data.shape == (4, 20)
+        np.testing.assert_allclose(np.asarray(spec.data), full[95:115].T)
+        assert spec.starttime == pytest.approx(95 * obs.tsamp)
+
+
+def test_fbobs_iter_blocks(fil_pair):
+    fns, full = fil_pair
+    with FilterbankObs(fns) as obs:
+        blocks = list(obs.iter_blocks(block_len=64, overlap=16))
+        assert blocks[0][0] == 0 and blocks[1][0] == 48
+        # overlap region is re-read
+        np.testing.assert_allclose(
+            np.asarray(blocks[0][1].data)[:, 48:64],
+            np.asarray(blocks[1][1].data)[:, :16])
+        # full coverage
+        last_start, last_spec = blocks[-1]
+        assert last_start + last_spec.data.shape[1] == 160
+
+
+# ---------------------------------------------------------------------------
+# wapp
+# ---------------------------------------------------------------------------
+
+WAPP_HDR_SRC = """
+#define NAMELEN 12
+struct WAPP_HEADER {
+    char src_name[NAMELEN];
+    char obs_date[12];
+    char start_time[12];
+    double samp_time;
+    double bandwidth;
+    double cent_freq;
+    int num_lags;
+    int lagformat;
+    int nifs;
+    long timeoff;
+    double alfa_az[7];
+};
+"""
+
+
+def _write_wapp(fn, nsamp=16, num_lags=8, lagformat=0, timeoff=0):
+    packed = b"".join([
+        struct.pack("12s", b"J0000+0000"),
+        struct.pack("12s", b"20100910"),
+        struct.pack("12s", b"12:34:56"),
+        struct.pack("d", 64.0),       # samp_time (us)
+        struct.pack("d", 100.0),      # bandwidth
+        struct.pack("d", 1420.0),     # cent_freq
+        struct.pack("i", num_lags),
+        struct.pack("i", lagformat),
+        struct.pack("i", 1),
+        struct.pack("l", timeoff),
+        struct.pack("7d", *np.linspace(100.0, 106.0, 7)),
+    ])
+    dtype = np.int16 if lagformat == 0 else np.int32
+    lags = np.arange(nsamp * num_lags, dtype=dtype)
+    with open(fn, "wb") as f:
+        f.write(WAPP_HDR_SRC.encode("ascii") + b"\0")
+        f.write(packed)
+        lags.tofile(f)
+    return lags
+
+
+def test_wapp_header_parse(tmp_path):
+    from pypulsar_tpu.io.wapp import WappFile
+
+    fn = str(tmp_path / "test.wapp")
+    lags = _write_wapp(fn)
+    with WappFile(fn) as w:
+        assert w.header["src_name"] == "J0000+0000"
+        assert w.header["samp_time"] == 64.0
+        assert w.header["num_lags"] == 8
+        assert w.header["nifs"] == 1
+        assert len(w.header["alfa_az"]) == 7
+        assert w.header["alfa_az"][0] == pytest.approx(100.0)
+        assert w.bytes_per_lag == 2
+        assert w.number_of_samples == 16
+        assert w.obs_time == pytest.approx(64e-6 * 16)
+        got = w.read_lags(2, 3)
+        np.testing.assert_array_equal(got, lags.reshape(16, 8)[2:5])
+
+
+def test_wapp_32bit_lags(tmp_path):
+    """lagformat=1 works (reference wapp.py:86 typo made this path raise)."""
+    from pypulsar_tpu.io.wapp import WappFile
+
+    fn = str(tmp_path / "test32.wapp")
+    _write_wapp(fn, lagformat=1)
+    with WappFile(fn) as w:
+        assert w.bytes_per_lag == 4
+        assert w.number_of_samples == 16
+
+
+def test_wapp_preprocessor():
+    from pypulsar_tpu.io.wapp import preprocess_c
+
+    out = preprocess_c("#define N 4\n/* c */ struct S { int a[N]; }; // x\n")
+    assert "4" in out and "#" not in out and "/*" not in out and "//" not in out
+
+
+# ---------------------------------------------------------------------------
+# datafile
+# ---------------------------------------------------------------------------
+
+def _write_mock_fits(tmp_path, name):
+    rng = np.random.RandomState(0)
+    nchan = 8
+    freqs = 1400.0 + np.arange(nchan)
+    data = rng.randint(0, 255, size=(nchan, 128)).astype(np.float32)
+    fn = str(tmp_path / name)
+    write_psrfits(fn, data, freqs, tsamp=6.4e-5, nsamp_per_subint=64,
+                  nbits=8, start_mjd=55500.25, src_name="FAKE",
+                  extra_primary={"IBEAM": 3})
+    return fn
+
+
+def test_datafile_autogen_mock(tmp_path):
+    from pypulsar_tpu.io import datafile
+
+    fn = _write_mock_fits(
+        tmp_path, "4bit-p2030.20101105.FAKE.b3s1g0.00100.fits")
+    data = datafile.autogen_dataobj([fn])
+    assert isinstance(data, datafile.MockPsrfitsData)
+    assert data.beam_id == 3
+    assert data.scan_num == "00100"
+    assert data.num_channels_per_record == 8
+    assert data.sample_time == pytest.approx(64.0)  # microseconds
+    assert data.obs_name.startswith("TEST.FAKE.55500")
+    # header coords fall through (no coords table, MJD > 54651)
+    assert data.ra_deg == pytest.approx(data.orig_ra_deg)
+
+
+def test_datafile_autogen_merged(tmp_path):
+    from pypulsar_tpu.io import datafile
+
+    fn = _write_mock_fits(
+        tmp_path, "4bit-p2030.20101105.FAKE.b5g0.merged.00100_0001.fits")
+    data = datafile.autogen_dataobj([fn])
+    assert isinstance(data, datafile.MergedMockPsrfitsData)
+    assert data.beam_id == 5  # from filename, not IBEAM
+    assert data.num_ifs == 2
+
+
+def test_datafile_rejects_unknown(tmp_path):
+    from pypulsar_tpu.io import datafile
+
+    with pytest.raises(ValueError):
+        datafile.autogen_dataobj(["garbage.xyz"])
+
+
+def test_accelcands_write_does_not_mutate():
+    c = _make_cand(0)
+    c.dmhits = c.dmhits[::-1]  # deliberately out of DM order
+    before = list(c.dmhits)
+    accelcands.write_candlist([c], io.StringIO())
+    assert c.dmhits == before
+
+
+def test_datafile_regex_anchored():
+    from pypulsar_tpu.io import datafile
+
+    assert datafile.MockPsrfitsData.fnmatch(
+        "4bit-p2030.20101105.FAKE.b3s1g0X00100.fits") is None
+    assert datafile.MockPsrfitsData.fnmatch(
+        "4bit-p2030.20101105.FAKE.b3s1g0.00100.fitsJUNK") is None
+
+
+def test_datafile_filename_dispatch():
+    from pypulsar_tpu.io import datafile
+
+    assert datafile.MultiplexedWappData.is_correct_filetype(
+        ["p2030.FAKE.wapp1.55000.0003"])
+    assert datafile.DumpOfWappData.is_correct_filetype(
+        ["p2030_55000_00010_0003_FAKE_1.w4bit.wapp_hdr"])
+    assert datafile.WappPsrfitsData.is_correct_filetype(
+        ["p2030_55000_00010_0003_FAKE_1.w4bit.fits"])
+    assert not datafile.MockPsrfitsData.is_correct_filetype(["x.fil"])
