@@ -83,17 +83,20 @@ class Candidate:
     def add_dmhit(self, dm, snr, sigma=None):
         self.dmhits.append(DMHit(dm, snr, sigma))
 
-    def to_lines(self) -> str:
+    def to_lines(self, sort_hits: bool = False) -> str:
         """Render the candidate row + its DM-hit rows (reference layout,
-        formats/accelcands.py:46-56)."""
+        formats/accelcands.py:46-56; the numharm cell is the 6-char
+        ``"  %2d  "`` the reference's pre-substitution center(7) yields)."""
         cand = "%s:%d" % (self.accelfile, self.candnum)
         row = ("%-65s   %7.2f  %6.2f  %6.2f  %s   %7.1f  "
                "%7.1f  %12.6f  %10.2f  %8.2f  (%d)\n") % (
             cand, self.dm, self.snr, self.sigma,
-            ("%2d" % self.numharm).center(7), self.ipow,
+            "  %2d  " % self.numharm, self.ipow,
             self.cpow, self.period * 1000.0, self.r, self.z,
             len(self.dmhits))
-        return row + "".join(h.to_line() for h in self.dmhits)
+        hits = sorted(self.dmhits, key=lambda h: h.dm) if sort_hits \
+            else self.dmhits
+        return row + "".join(h.to_line() for h in hits)
 
     __str__ = to_lines
 
@@ -124,11 +127,7 @@ def write_candlist(candlist: Sequence[Candidate],
 def _write(candlist: Sequence[Candidate], f: IO) -> None:
     f.write(_HEADER)
     for cand in sorted(candlist, key=lambda c: c.sigma, reverse=True):
-        # render DM hits sorted by DM without mutating the caller's list
-        rendered = Candidate.__new__(Candidate)
-        rendered.__dict__ = dict(cand.__dict__)
-        rendered.dmhits = sorted(cand.dmhits, key=lambda h: h.dm)
-        f.write(rendered.to_lines())
+        f.write(cand.to_lines(sort_hits=True))
 
 
 def parse_candlist(candlistfn: Union[str, IO]) -> List[Candidate]:

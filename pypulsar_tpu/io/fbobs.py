@@ -123,6 +123,10 @@ class FilterbankObs:
             n = min(block_len, end - pos)
             yield pos, self.get_spectra(pos, n)
             pos += step
+            if pos + overlap >= end:
+                # remaining samples were all delivered in this block's tail;
+                # a further block would contain only re-read overlap
+                break
 
 
 # Reference-compatible alias (reference class name is lowercase `fbobs`).

@@ -1,29 +1,11 @@
 """Small host-side helpers: progress meter, detrending, harmonic ratios,
-terminal colour (parity: reference utils/__init__.py and friends)."""
+terminal colour, receiver gain curves, external-tool wrappers
+(parity: reference utils/__init__.py and friends)."""
 
-import sys
-
-
-def show_progress(iterator, width=0, tot=None, fmt="%d", show_number=False):
-    """Wrap an iterator, printing a percent counter (and optional bar) as it
-    is consumed (reference utils/__init__.py:6-44)."""
-    if tot is None:
-        tot = len(iterator)
-    old = -1
-    curr = 1
-    for toreturn in iterator:
-        progfrac = curr / float(tot)
-        progpcnt = int(100 * progfrac)
-        if progpcnt > old:
-            neq = int(width * progfrac + 0.5)
-            nsp = width - neq
-            bar = "[" * bool(width) + "=" * neq + " " * nsp + "]" * bool(width)
-            old = progpcnt
-            sys.stdout.write("     " + bar + " %s %% " % (fmt % progpcnt))
-            if show_number:
-                sys.stdout.write("(%d of %d)" % (curr, tot))
-            sys.stdout.write("\r")
-            sys.stdout.flush()
-        curr += 1
-        yield toreturn
-    print("Done")
+from pypulsar_tpu.utils.progress import show_progress  # noqa: F401
+from pypulsar_tpu.utils.freq_at_epoch import freq_at_epoch  # noqa: F401
+from pypulsar_tpu.utils.ne2001 import (  # noqa: F401
+    get_pulse_broadening,
+    bhat_pulse_broadening,
+)
+from pypulsar_tpu.utils import receivers  # noqa: F401

@@ -1,0 +1,111 @@
+"""Tests for progress, receivers, ne2001 fallback, freq_at_epoch,
+parfile_diff, and the tempo2 wrapper gating."""
+
+import io
+
+import numpy as np
+import pytest
+
+from pypulsar_tpu.io.parfile import write_par
+from pypulsar_tpu.utils import (
+    bhat_pulse_broadening,
+    freq_at_epoch,
+    get_pulse_broadening,
+    receivers,
+    show_progress,
+)
+
+
+def test_show_progress_yields_all_and_reports():
+    buf = io.StringIO()
+    out = list(show_progress(range(10), width=20, file=buf))
+    assert out == list(range(10))
+    text = buf.getvalue()
+    assert "100 %" in text and text.endswith("Done\n")
+    assert "[====================]" in text
+
+
+def test_show_progress_generator_with_tot():
+    buf = io.StringIO()
+    gen = (x * x for x in range(5))
+    assert list(show_progress(gen, tot=5, file=buf)) == [0, 1, 4, 9, 16]
+
+
+def test_alfa_receiver_curves():
+    # spot values from the NAIC beam-0 fits: gain ~ 11 K/Jy at low ZA,
+    # dropping past za=14; tsys rises toward the ZA limit
+    g = receivers.alfa.gain(np.array([5.0, 10.0, 19.0]))
+    assert g[0] > g[2]          # gain falls off at high ZA
+    assert 8.0 < g[1] < 12.0
+    t = receivers.alfa.tsys(np.array([5.0, 19.0]))
+    assert t[1] > t[0]
+    s = receivers.alfa.sefd(10.0)
+    assert np.ndim(s) == 0 and 1.0 < float(s) < 6.0
+    # clipping: below start_za the value equals the start_za value
+    assert receivers.alfa.gain(0.0) == pytest.approx(
+        float(receivers.alfa.gain(5.0)))
+
+
+def test_lwide_receiver_curves():
+    assert receivers.lwide.gain(0.0) == pytest.approx(10.14891)
+    # cubic falloff beyond 14 deg
+    assert receivers.lwide.gain(18.0) < receivers.lwide.gain(10.0)
+    assert receivers.lwide.tsys(12.0) == 30.0
+
+
+def test_bhat_broadening_scalings():
+    # higher DM -> more scattering; higher freq -> less
+    assert bhat_pulse_broadening(300.0) > bhat_pulse_broadening(30.0)
+    t1 = bhat_pulse_broadening(100.0, freq=1.0)
+    t2 = bhat_pulse_broadening(100.0, freq=2.0)
+    assert t1 / t2 == pytest.approx(2.0 ** 3.86, rel=1e-6)
+    # fallback path of get_pulse_broadening (no NE2001 installed)
+    assert get_pulse_broadening(30.0, 5.0, 100.0) == pytest.approx(
+        bhat_pulse_broadening(100.0))
+
+
+def test_freq_at_epoch(tmp_path):
+    parfn = str(tmp_path / "test.par")
+    write_par(parfn, dict(PSR="J0000+0000", F0=10.0, F1=-1e-14,
+                          PEPOCH=55000.0, F0_ERR=1e-8, F1_ERR=1e-16))
+    f, ferr = freq_at_epoch(parfn, 55100.0)
+    dt = 100.0 * 86400.0
+    assert f == pytest.approx(10.0 - 1e-14 * dt)
+    assert ferr == pytest.approx(np.sqrt(1e-16 + dt ** 2 * 1e-32))
+
+
+def test_parfile_diff_same_par_is_zero(tmp_path):
+    from pypulsar_tpu.utils.parfile_diff import rotation_diffs
+
+    parfn = str(tmp_path / "a.par")
+    write_par(parfn, dict(PSR="J0001+0001", F0=2.0, F1=0.0, PEPOCH=55000.0,
+                          DM=10.0))
+    mjds, diffs = rotation_diffs(parfn, [parfn], mjd_start=55000.0,
+                                 mjd_end=55002.0, num=12)
+    # identical ephemeris: zero rotation offset (up to the fractional-turn
+    # snap residual which is exactly 0 here since both use the same polycos)
+    np.testing.assert_allclose(diffs, 0.0, atol=1e-6)
+    assert mjds.shape == (12,)
+
+
+def test_parfile_diff_offset_f0(tmp_path):
+    from pypulsar_tpu.utils.parfile_diff import rotation_diffs
+
+    ref = str(tmp_path / "ref.par")
+    cmp_ = str(tmp_path / "cmp.par")
+    write_par(ref, dict(PSR="J1", F0=2.0, F1=0.0, PEPOCH=55000.0, DM=10.0))
+    # df = 1e-6 Hz -> after 1 day, offset ~ 0.0864 rotations
+    write_par(cmp_, dict(PSR="J1", F0=2.0 + 1e-6, F1=0.0, PEPOCH=55000.0,
+                         DM=10.0))
+    mjds, diffs = rotation_diffs(ref, [cmp_], mjd_start=55000.0,
+                                 mjd_end=55001.0, num=5)
+    expect = (mjds - 55000.0) * 86400.0 * 1e-6
+    np.testing.assert_allclose(diffs[:, 0], expect, atol=2e-3)
+
+
+def test_tempo2_gated():
+    from pypulsar_tpu.utils import tempo2
+
+    if not tempo2.have_tempo2():
+        with pytest.raises(FileNotFoundError):
+            tempo2.get_resids("x.par", "x.tim")
