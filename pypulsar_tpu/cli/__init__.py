@@ -1,0 +1,44 @@
+"""Command-line tools mirroring the reference ``bin/`` scripts.
+
+Each tool is a module with a ``main(argv=None) -> int`` entry point and is
+runnable as ``python -m pypulsar_tpu.cli.<tool>``.  Flag names follow the
+reference scripts (they are part of the observable surface); compute runs
+through the JAX/TPU backend.  Interactive matplotlib fronts are kept, but
+every tool also supports ``--outfile`` for headless use.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def open_data_file(fn: str):
+    """Open a .fil or .fits raw-data file with the matching reader
+    (reference bin/waterfaller.py:51-64, with the psrfits import bug
+    fixed)."""
+    if fn.endswith(".fil"):
+        from pypulsar_tpu.io.filterbank import FilterbankFile
+        return FilterbankFile(fn)
+    elif fn.endswith(".fits"):
+        from pypulsar_tpu.io.psrfits import PsrfitsFile
+        return PsrfitsFile(fn)
+    raise ValueError(
+        "Cannot recognize data file type from extension. "
+        "(Only '.fits' and '.fil' are supported.)")
+
+
+def use_headless_backend_if_needed(outfile):
+    """Switch matplotlib to Agg when writing to a file or no display."""
+    import matplotlib
+    if outfile or not os.environ.get("DISPLAY"):
+        matplotlib.use("Agg", force=False)
+
+
+def show_or_save(outfile):
+    """plt.show(), or savefig(outfile) when given (headless mode)."""
+    import matplotlib.pyplot as plt
+    if outfile:
+        plt.savefig(outfile, dpi=120, bbox_inches="tight")
+        print("Wrote %s" % outfile)
+    else:
+        plt.show()

@@ -1,0 +1,193 @@
+"""Frequency-vs-time image of a (multi-file) filterbank observation with
+an optional dispersion trace and dedispersed summed profile.
+
+Behavioral spec: reference ``bin/freq_time.py`` — sample-window rounding
+to downsample multiples with smoothing margins (:50-61), channel masking
+(:212-221), downsample/smooth/scale pipeline (:224-279), dispersion-trace
+overlay and zero-padded dedispersed profile (:134-151, :194-209).  Fixes
+the reference's ``maxsamps``-undefined-without-``--dm`` bug (:118) and the
+min-max scaling mutating its input.
+
+The per-channel downsample/smooth/shift ops run on device via the Spectra
+kernels.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from pypulsar_tpu.cli import show_or_save, use_headless_backend_if_needed
+from pypulsar_tpu.core import psrmath
+from pypulsar_tpu.core.spectra import Spectra
+
+
+def dedisperse_profile(data: np.ndarray, delays: np.ndarray) -> np.ndarray:
+    """Zero-padded shift-and-sum dedispersed profile from [time, chan]
+    data and per-channel integer delays (reference freq_time.py:194-209)."""
+    prof = np.zeros_like(data[:, 0])
+    for ii, delay in enumerate(np.asarray(delays, dtype=int)):
+        shifted = data[delay:, ii]
+        prof[:shifted.size] += shifted
+    return prof
+
+
+def scale_minmax(data: np.ndarray, indep: bool = False) -> np.ndarray:
+    """Min-subtract each channel; normalize per channel (``indep``) or by
+    the global max (reference freq_time.py:261-279; non-mutating here)."""
+    out = data - data.min(axis=0, keepdims=True)
+    if indep:
+        mx = out.max(axis=0, keepdims=True)
+        np.divide(out, mx, out=out, where=mx != 0)
+    else:
+        if out.max() != 0:
+            out /= out.max()
+    return out
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="freq_time.py",
+        description="Plot frequency vs. time (non-dedispersed) for a "
+                    "filterbank observation to verify single-pulse "
+                    "dispersion delays (TPU backend).")
+    parser.add_argument("filfns", nargs="+", help="filterbank file(s)")
+    parser.add_argument("--debug", action="store_true",
+                        help="Display debugging information")
+    parser.add_argument("--downsamp", type=int, default=1,
+                        help="Downsample factor (default: 1)")
+    parser.add_argument("-w", "--width", type=int, default=1,
+                        help="Boxcar width in samples (default: 1)")
+    parser.add_argument("--dm", type=float, default=None,
+                        help="DM for the dispersion-delay trace "
+                             "(default: no trace)")
+    parser.add_argument("-s", "--start", type=float, default=0.0,
+                        help="Interval start in seconds (default: 0)")
+    parser.add_argument("-e", "--end", type=float, default=None,
+                        help="Interval end in seconds (default: EOF)")
+    parser.add_argument("--mask", default=None,
+                        help="rfifind mask for channel zapping")
+    parser.add_argument("--scaleindep", action="store_true",
+                        help="Scale each channel independently")
+    parser.add_argument("-o", "--outfile", default=None,
+                        help="Write plot to file instead of showing")
+    return parser
+
+
+def main(argv=None):
+    options = build_parser().parse_args(argv)
+    use_headless_backend_if_needed(options.outfile)
+    import matplotlib.pyplot as plt
+    from pypulsar_tpu.io.fbobs import FilterbankObs
+
+    obs = FilterbankObs(options.filfns)
+    obslen = obs.obslen
+    start = max(options.start, 0.0)
+    end = obslen if options.end is None or options.end > obslen \
+        else options.end
+
+    downsamp = max(options.downsamp, 1)
+    width = max(options.width, 1)
+    reqstartsamp = int(start / obs.tsamp)
+    reqstartsamp -= reqstartsamp % downsamp
+    startsamp = max(0, reqstartsamp - width * downsamp)
+    reqendsamp = int(end / obs.tsamp)
+    reqendsamp += -reqendsamp % downsamp
+
+    delay_samples = np.zeros(obs.nchans)
+    maxsamps = 0
+    if options.dm:
+        delay_seconds = psrmath.delay_from_DM(options.dm, obs.frequencies)
+        delay_seconds = delay_seconds - delay_seconds.min()
+        delay_samples = delay_seconds / (downsamp * obs.tsamp)
+        maxsamps = int(np.round(
+            float(np.max(delay_samples * downsamp)) / downsamp)) * downsamp
+    endsamp = min(obs.number_of_samples,
+                  reqendsamp + width * downsamp + maxsamps)
+
+    if options.debug:
+        print("Input filterbank files:", options.filfns)
+        print("Requested interval: samples [%d, %d)" %
+              (reqstartsamp, reqendsamp))
+        print("Read interval: samples [%d, %d)" % (startsamp, endsamp))
+
+    data = obs.get_sample_interval(startsamp, endsamp)  # [time, chan]
+    obs.close_all()
+
+    if options.mask is not None:
+        from pypulsar_tpu.io.rfimask import RfifindMask
+        mask = RfifindMask(options.mask)
+        # rfifind channel indices are low-frequency-first; the .fil data
+        # is high-frequency-first
+        maskchans = obs.nchans - 1 - np.asarray(
+            sorted(mask.mask_zap_chans), dtype=int)
+        data[:, maskchans] = 0.0
+
+    # device pipeline on [chan, time]
+    spec = Spectra(obs.frequencies, obs.tsamp, data.T,
+                   starttime=startsamp * obs.tsamp)
+    if downsamp > 1:
+        spec = spec.downsample(downsamp)
+    if width > 1:
+        spec = spec.smooth(width, padval=0)
+        # drop only the smoothing margins that were actually read
+        # (reference :108-111 always trimmed `width`, losing the first/last
+        # requested samples when the margin was clamped at a file edge)
+        lead_raw = reqstartsamp - startsamp
+        trail_raw = max(endsamp - (reqendsamp + maxsamps), 0)
+        lead = lead_raw // downsamp
+        trail = trail_raw // downsamp
+        data2 = np.asarray(spec.data).T[lead:-trail or None]
+        startsamp += lead_raw
+        endsamp -= trail_raw
+    else:
+        data2 = np.asarray(spec.data).T
+
+    fig = plt.figure()
+    try:
+        fig.canvas.manager.set_window_title("Frequency vs. Time")
+    except AttributeError:
+        pass
+    ax = plt.axes((0.15, 0.15, 0.8, 0.7))
+    data_scaled = scale_minmax(data2, indep=options.scaleindep)
+    ntrim = maxsamps // downsamp
+    if ntrim:
+        data_scaled = data_scaled[:-ntrim]
+        endsamp -= maxsamps
+    plt.imshow(data_scaled.T, aspect="auto", cmap="binary",
+               interpolation="nearest",
+               extent=(startsamp / downsamp, endsamp / downsamp,
+                       obs.frequencies[-1], obs.frequencies[0]))
+    plt.xlabel("Sample")
+    plt.ylabel("Observing frequency (MHz)")
+    plt.suptitle("Frequency vs. Time")
+    fig.text(0.05, 0.02,
+             r"Start time: $\sim$ %s s, End time: $\sim$ %s s; "
+             "Downsampled: %d bins, Smoothed: %d bins; "
+             "DM trace: %s $cm^{-3}pc$" %
+             (start, end, downsamp, width, options.dm),
+             ha="left", va="center", size="x-small")
+    if options.dm:
+        xlim, ylim = plt.xlim(), plt.ylim()
+        plt.plot(startsamp / downsamp + delay_samples, obs.frequencies,
+                 "r-", lw=5, alpha=0.25)
+        plt.xlim(xlim)
+        plt.ylim(ylim)
+        profax = plt.axes((0.15, 0.85, 0.8, 0.1), sharex=ax)
+        prof = dedisperse_profile(data2, delay_samples)
+        if ntrim:
+            prof = prof[:-ntrim]
+        plt.plot(np.linspace(xlim[0], xlim[1], prof.size), prof, "k-")
+        plt.setp(profax.xaxis.get_ticklabels(), visible=False)
+        plt.setp(profax.yaxis.get_ticklabels(), visible=False)
+        plt.xlim(xlim)
+    fig.canvas.mpl_connect(
+        "key_press_event",
+        lambda ev: ev.key in ("q", "Q") and plt.close(fig))
+    show_or_save(options.outfile)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

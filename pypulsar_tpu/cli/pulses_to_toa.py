@@ -1,0 +1,94 @@
+"""Generate TOAs from saved single-pulse profile text files.
+
+Behavioral spec: reference ``bin/pulses_to_toa.py`` — read ``.prof`` pulse
+files, sum consecutive pulses until an SNR threshold is passed (:46-97 main
+loop, same machinery as dissect), then a Princeton TOA per summed profile.
+Without polycos, the period is the profile duration (:148-149) and the
+start-of-pulse MJD is the reference epoch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Tuple
+
+import numpy as np
+
+from pypulsar_tpu.astro import telescopes
+from pypulsar_tpu.cli.dissect import get_snr, plot_toa
+from pypulsar_tpu.core import psrmath
+from pypulsar_tpu.fold.pulse import read_pulse_from_file
+from pypulsar_tpu.fold.toa import emit_princeton_toa, presto_freq_offsets
+
+
+def write_toa(summed_pulse, template_profile,
+              debug: bool = False) -> Tuple[float, float]:
+    """One Princeton TOA from a summed pulse without an ephemeris: period
+    = profile duration, reference epoch = pulse-start MJD (reference
+    pulses_to_toa.py:136-195); the template matching and DM bookkeeping
+    are shared with dissect via fold.toa."""
+    mjdi = int(summed_pulse.mjd)
+    mjdf = summed_pulse.mjd - mjdi
+    period = summed_pulse.dt * len(summed_pulse.profile)
+    midfreq, dmdelay = presto_freq_offsets(
+        summed_pulse.lofreq, summed_pulse.bw, summed_pulse.chan_width,
+        summed_pulse.dm)
+    t0f = mjdf + dmdelay / psrmath.SECPERDAY
+    obs_code = telescopes.telescope_to_id.get(summed_pulse.telescope, "@")
+    return emit_princeton_toa(summed_pulse, template_profile, mjdi, t0f,
+                              period, midfreq, summed_pulse.dm, obs_code)
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="pulses_to_toa.py",
+        description="Write TOAs to stdout from saved pulse profile files. "
+                    "Consecutive pulses are summed until the summed "
+                    "profile's SNR surpasses --toa-threshold.")
+    parser.add_argument("proffiles", nargs="+", help="pulse .prof files")
+    parser.add_argument("--debug", action="store_true")
+    parser.add_argument("--template", required=True,
+                        help="Template profile (text; 2nd column used)")
+    parser.add_argument("--toa-threshold", type=float, default=0.0)
+    parser.add_argument("--min-pulses", type=int, default=1)
+    parser.add_argument("--write-toa-files", action="store_true")
+    return parser
+
+
+def main(argv=None):
+    options = build_parser().parse_args(argv)
+    template = np.loadtxt(options.template, usecols=(1,))
+    pulses = [read_pulse_from_file(fn) for fn in options.proffiles]
+    pulses.sort(key=lambda p: p.mjd)
+
+    numtoas = 0
+    current = None
+    numsummed = 0
+    for pulse in pulses:
+        if current is None:
+            current = pulse.to_summed_pulse()
+            numsummed = 1
+        else:
+            current += pulse
+            numsummed += 1
+        if numsummed < options.min_pulses:
+            continue
+        if get_snr(current) > options.toa_threshold:
+            current.interp_and_downsamp(template.size)
+            current.scale()
+            pulseshift, templateshift = write_toa(current, template,
+                                                  options.debug)
+            numtoas += 1
+            if options.write_toa_files:
+                plot_toa(numtoas, current, template, pulseshift,
+                         templateshift)
+                current.write_to_file("TOA%d" % numtoas)
+            current = None
+            numsummed = 0
+    print("Number of TOAs: %d" % numtoas, file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

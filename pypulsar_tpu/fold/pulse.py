@@ -195,6 +195,44 @@ class Pulse:
         c.scale()
         return float(np.max(c.get_on_pulse() if c.on_pulse is not None else c.profile))
 
+    def plot(self, basefn: Optional[str] = None, downfactor: int = 1,
+             smoothfactor: int = 1, shownotes: bool = False,
+             decorate: bool = False):
+        """Plot the scaled profile to ``<basefn>.prof<number>.ps``
+        (reference formats/pulse.py:296-337).  ``decorate`` adds off-pulse
+        mean and +1-sigma lines; ``shownotes`` annotates the smoothing."""
+        import matplotlib.pyplot as plt
+
+        if basefn is None:
+            basefn, _ = os.path.splitext(self.origfn)
+        copy = self.make_copy()
+        if smoothfactor > 1:
+            copy.smooth(smoothfactor)
+        copy.scale()
+        plt.figure()
+        if decorate and copy.on_pulse is not None:
+            off = copy.get_off_pulse()
+            avg, std = float(np.mean(off)), float(np.std(off))
+            plt.axhline(avg, color="k", linestyle="--")
+            plt.axhline(avg + std, color="k", linestyle=":")
+        if shownotes:
+            snrmax = float(np.max(copy.get_on_pulse()
+                                  if copy.on_pulse is not None
+                                  else copy.profile))
+            plt.figtext(0.05, 0.025,
+                        "Smooth factor: %d, Downsample factor: %d, "
+                        "Max SNR: %f" % (smoothfactor, downfactor, snrmax),
+                        size="xx-small")
+        if downfactor > 1:
+            copy.downsample(downfactor)
+        plt.plot(copy.profile, "k-", lw=0.5)
+        plt.xlabel("Profile bin")
+        plt.title("Pulse #%d" % self.number)
+        outfn = "%s.prof%d.ps" % (basefn, self.number)
+        plt.savefig(outfn, orientation="landscape")
+        plt.close()
+        return outfn
+
     # --- text format (reference :339-374) ---
     def _header_lines(self) -> List[str]:
         lines = [

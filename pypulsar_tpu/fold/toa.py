@@ -132,6 +132,49 @@ def measure_phase(profile: np.ndarray, template: np.ndarray):
     return shift, eshift, snr, esnr, b, errb, ngood, pha1
 
 
+def presto_freq_offsets(lofreq: float, bw: float, chan_width: float,
+                        dm: float):
+    """(midfreq, dmdelay_seconds) with PRESTO get_TOAs.py's channel-edge
+    conventions: hifreq has no half-channel offset and is one channel below
+    the band top (reference bin/dissect.py:290-300)."""
+    from pypulsar_tpu.core import psrmath
+
+    hifreq = lofreq + bw - chan_width
+    midfreq = lofreq - 0.5 * chan_width + 0.5 * bw
+    dmdelay = (psrmath.delay_from_DM(dm, midfreq) -
+               psrmath.delay_from_DM(dm, hifreq))
+    return midfreq, dmdelay
+
+
+def emit_princeton_toa(summed_pulse, template_profile, t0i: int, t0f: float,
+                       period: float, midfreq: float, dm: float,
+                       obs_code: str = "@"):
+    """Template-match ``summed_pulse`` and print one Princeton TOA.
+
+    Shared tail of the TOA pipelines (reference bin/dissect.py:308-336 and
+    bin/pulses_to_toa.py:167-195): FFTFIT the profile against the
+    template, validate the fit, convert the bin shift to time, and write
+    the line.  Returns (tau, tphs) — the pulse shift and template
+    rotation, both in rotational phase.
+    """
+    from pypulsar_tpu.core import psrmath
+
+    if template_profile is None:
+        raise ValueError("A template profile MUST be provided.")
+    shift, eshift, snr, esnr, b, errb, ngood, tphs = measure_phase(
+        summed_pulse.profile, template_profile)
+    tphs = tphs / TWOPI % 1.0
+    tau, tau_err = shift / summed_pulse.N, eshift / summed_pulse.N
+    # fftfit's bad-fit sentinel
+    if np.fabs(shift) < 1e-7 and np.fabs(eshift - 999.0) < 1e-7:
+        raise FFTFitError("Error in FFTFIT. Bad return values.")
+    toaf = t0f + tau * period / psrmath.SECPERDAY
+    newdays = int(np.floor(toaf))
+    write_princeton_toa(t0i + newdays, toaf - newdays,
+                        tau_err * period * 1e6, midfreq, dm, obs=obs_code)
+    return tau, tphs
+
+
 def format_princeton_toa(toa_MJDi: int, toa_MJDf: float, toaerr: float,
                          freq: float, dm: float, obs: str = "@",
                          name: str = " " * 13) -> str:

@@ -1,0 +1,184 @@
+"""Smoke + behavior tests for the CLI tools (batch 1: data-plane tools)."""
+
+import os
+
+import matplotlib
+import numpy as np
+import pytest
+
+matplotlib.use("Agg", force=True)
+
+from pypulsar_tpu.io.datfile import Datfile, write_dat
+from pypulsar_tpu.io.filterbank import FilterbankFile, write_filterbank
+from pypulsar_tpu.io.infodata import InfoData
+from pypulsar_tpu.ops import numpy_ref
+
+
+def _make_fil(tmp_path, name="test.fil", C=16, T=512, dt=1e-3, dm=None,
+              tstart=55000.0, fch1=1500.0, foff=-2.0, seed=0, offset=100.0):
+    rng = np.random.RandomState(seed)
+    data = (rng.randn(T, C) + offset).astype(np.float32)
+    if dm:
+        freqs = fch1 + foff * np.arange(C)
+        bins = numpy_ref.bin_delays(dm, freqs, dt)
+        for c in range(C):
+            data[(T // 3 + bins[c]) % T, c] += 40.0
+    fn = str(tmp_path / name)
+    write_filterbank(fn, dict(fch1=fch1, foff=foff, nchans=C, tsamp=dt,
+                              nbits=32, tstart=tstart), data)
+    return fn, data
+
+
+def _make_dat(tmp_path, name="test", N=4096, dt=1e-3, epoch=55000.0,
+              freq=20.0, seed=0):
+    rng = np.random.RandomState(seed)
+    t = np.arange(N) * dt
+    data = (rng.randn(N) + 3 * np.sin(2 * np.pi * freq * t)).astype(np.float32)
+    inf = InfoData()
+    inf.epoch = epoch
+    inf.dt = dt
+    inf.N = N
+    inf.telescope = "Fake"
+    inf.lofreq = 1400.0
+    inf.BW = 100.0
+    inf.numchan = 1
+    inf.chan_width = 100.0
+    inf.object = "FAKE"
+    basefn = str(tmp_path / name)
+    write_dat(basefn, data, inf)
+    return basefn + ".dat", data
+
+
+def test_waterfaller(tmp_path):
+    from pypulsar_tpu.cli import waterfaller
+
+    fn, _ = _make_fil(tmp_path, dm=30.0)
+    out = str(tmp_path / "wf.png")
+    rc = waterfaller.main([fn, "-T", "0.05", "-t", "0.3", "-d", "30.0",
+                           "-s", "8", "--downsamp", "2", "--width-bins", "2",
+                           "--sweep-dm", "30.0", "-o", out])
+    assert rc == 0 and os.path.getsize(out) > 1000
+
+
+def test_waterfaller_requires_duration(tmp_path):
+    from pypulsar_tpu.cli import waterfaller
+
+    fn, _ = _make_fil(tmp_path)
+    assert waterfaller.main([fn, "-T", "0"]) == 1
+
+
+def test_zero_dm_filter(tmp_path):
+    from pypulsar_tpu.cli import zero_dm_filter
+
+    fn, data = _make_fil(tmp_path)
+    out = str(tmp_path / "zdm.fil")
+    rc = zero_dm_filter.main([fn, "-o", out])
+    assert rc == 0
+    with FilterbankFile(out) as fb:
+        got = fb.get_samples(0, fb.nspec)
+        assert fb.header["nchans"] == 16
+    expect = data - data.mean(axis=1, keepdims=True)
+    np.testing.assert_allclose(got, expect, atol=2e-4)
+
+
+def test_spectrogram_cli(tmp_path):
+    from pypulsar_tpu.cli import spectrogram
+
+    datfn, _ = _make_dat(tmp_path)
+    out = str(tmp_path / "sg.png")
+    rc = spectrogram.main([datfn, "-t", "0.512", "-l", "-o", out])
+    assert rc == 0 and os.path.getsize(out) > 1000
+
+
+def test_spectrogram_get_spectra_matches_numpy(tmp_path):
+    from pypulsar_tpu.cli.spectrogram import get_spectra
+
+    datfn, data = _make_dat(tmp_path, N=2048)
+    spectra, times, freqs = get_spectra(Datfile(datfn), time=0.256)
+    spb = 256
+    expect = np.abs(np.fft.rfft(data[:2048 // spb * spb]
+                                .reshape(-1, spb), axis=1)) ** 2
+    np.testing.assert_allclose(spectra, expect, rtol=2e-4)
+    assert freqs[0] == 0.0 and times[0] == 0.0
+
+
+def test_freq_time(tmp_path):
+    from pypulsar_tpu.cli import freq_time
+
+    fn, _ = _make_fil(tmp_path, dm=30.0, T=1024)
+    out = str(tmp_path / "ft.png")
+    rc = freq_time.main([fn, "--dm", "30.0", "--downsamp", "2", "-w", "2",
+                         "-s", "0.0", "-e", "0.9", "-o", out])
+    assert rc == 0 and os.path.getsize(out) > 1000
+
+
+def test_freq_time_no_dm(tmp_path):
+    """Reference bin/freq_time.py:118 crashed without --dm; ours must not."""
+    from pypulsar_tpu.cli import freq_time
+
+    fn, _ = _make_fil(tmp_path, T=512)
+    out = str(tmp_path / "ft2.png")
+    assert freq_time.main([fn, "-o", out]) == 0
+
+
+def test_combinefil(tmp_path):
+    from pypulsar_tpu.cli import combinefil
+
+    # two adjacent 8-channel bands: 1500..1486 and 1484..1470 (foff=-2)
+    fn_hi, d_hi = _make_fil(tmp_path, "hi.fil", C=8, T=300, fch1=1500.0)
+    fn_lo, d_lo = _make_fil(tmp_path, "lo.fil", C=8, T=300, fch1=1484.0,
+                            seed=1)
+    out = str(tmp_path / "comb.fil")
+    rc = combinefil.main([fn_lo, fn_hi, "-o", out])
+    assert rc == 0
+    with FilterbankFile(out) as fb:
+        assert fb.header["nchans"] == 16
+        assert fb.header["fch1"] == 1500.0
+        got = fb.get_samples(0, 300)
+    np.testing.assert_allclose(got, np.hstack([d_hi, d_lo]))
+
+
+def test_combinefil_rejects_overlap(tmp_path):
+    from pypulsar_tpu.cli.combinefil import combine_fil
+
+    fn1, _ = _make_fil(tmp_path, "a.fil", C=8, fch1=1500.0)
+    fn2, _ = _make_fil(tmp_path, "b.fil", C=8, fch1=1499.0)
+    with pytest.raises(ValueError):
+        combine_fil([fn1, fn2], str(tmp_path / "x.fil"))
+
+
+def test_stitchdat(tmp_path):
+    from pypulsar_tpu.cli import stitchdat
+
+    dt = 1e-3
+    fn1, d1 = _make_dat(tmp_path, "a", N=1000, epoch=55000.0)
+    # second file starts 1.5 s after the first begins -> 500-sample gap
+    fn2, d2 = _make_dat(tmp_path, "b", N=800,
+                        epoch=55000.0 + 1.5 / 86400.0, seed=1)
+    out = str(tmp_path / "stitched")
+    rc = stitchdat.main([fn1, fn2, "-o", out])
+    assert rc == 0
+    combined = np.fromfile(out + ".dat", dtype=np.float32)
+    assert combined.size == 1000 + 500 + 800
+    np.testing.assert_allclose(combined[:1000], d1)
+    np.testing.assert_allclose(combined[1500:], d2)
+    np.testing.assert_allclose(combined[1000:1500], np.median(d1))
+    inf = InfoData(out + ".inf")
+    assert inf.N == 2300
+
+
+def test_mockspecfil2subbands(tmp_path):
+    from pypulsar_tpu.cli import mockspecfil2subbands
+
+    fn, data = _make_fil(tmp_path, C=4, T=200)
+    out = str(tmp_path / "subbands")
+    rc = mockspecfil2subbands.main([fn, "-o", out])
+    assert rc == 0
+    # foff < 0: sub0000 is the lowest-frequency channel = last data column
+    sub0 = np.fromfile(out + ".sub0000", dtype=np.float32)
+    np.testing.assert_allclose(sub0, data[:, 3])
+    sub3 = np.fromfile(out + ".sub0003", dtype=np.float32)
+    np.testing.assert_allclose(sub3, data[:, 0])
+    inf = InfoData(out + ".sub.inf")
+    assert inf.numchan == 4
+    assert inf.lofreq == pytest.approx(1500.0 - 8.0)

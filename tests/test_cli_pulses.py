@@ -1,0 +1,194 @@
+"""Tests for the single-pulse / TOA CLI tools (dissect, pulses_to_toa,
+sum_profs, pulse_energy_distribution)."""
+
+import glob
+import os
+
+import matplotlib
+import numpy as np
+import pytest
+
+matplotlib.use("Agg", force=True)
+
+from pypulsar_tpu.core.psrmath import SECPERDAY
+from pypulsar_tpu.io.datfile import write_dat
+from pypulsar_tpu.io.infodata import InfoData
+
+
+PERIOD = 0.25   # s
+DT = 1e-3       # s
+
+
+def _make_pulsar_dat(tmp_path, N=8000, snr=30.0, seed=0):
+    """A .dat with a strong pulse at phase 0.3 of a 0.25 s period."""
+    rng = np.random.RandomState(seed)
+    data = rng.randn(N).astype(np.float32)
+    t = np.arange(N) * DT
+    phase = (t / PERIOD) % 1.0
+    data[np.abs(phase - 0.3) < 0.02] += snr
+    inf = InfoData()
+    inf.epoch = 55000.0
+    inf.dt = DT
+    inf.N = N
+    inf.telescope = "Arecibo"
+    inf.bary = 1  # synthetic data: no topocentric corrections needed
+    inf.lofreq = 1400.0
+    inf.BW = 100.0
+    inf.numchan = 256
+    inf.chan_width = 100.0 / 256
+    inf.DM = 10.0
+    inf.object = "FAKE"
+    basefn = str(tmp_path / "pulsar")
+    write_dat(basefn, data, inf)
+    return basefn + ".dat"
+
+
+@pytest.fixture
+def pulsar_dat(tmp_path):
+    return _make_pulsar_dat(tmp_path)
+
+
+def _write_parfile(tmp_path):
+    from pypulsar_tpu.io.parfile import write_par
+
+    parfn = str(tmp_path / "fake.par")
+    write_par(parfn, dict(PSR="J0000+0000", F0=1.0 / PERIOD, F1=0.0,
+                          PEPOCH=55000.0, DM=10.0))
+    return parfn
+
+
+def _write_template(tmp_path, nbins=64):
+    phases = np.arange(nbins) / nbins
+    template = np.exp(-0.5 * ((phases - 0.3) / 0.02) ** 2)
+    fn = str(tmp_path / "template.txt")
+    np.savetxt(fn, np.column_stack([np.arange(nbins), template]))
+    return fn
+
+
+def test_dissect_constant_period(pulsar_dat, tmp_path, monkeypatch, capsys):
+    from pypulsar_tpu.cli import dissect
+
+    monkeypatch.chdir(tmp_path)
+    rc = dissect.main([pulsar_dat, "-p", str(PERIOD), "-r", "0.2:0.4",
+                       "-t", "5", "--no-joydiv-plot", "--no-pulse-plots"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Autopsy report:" in out
+    # 8000 samples / 250 per period = 32 pulses, all with injected signal
+    assert "Total number of pulses searched: 32" in out
+    profs = glob.glob(str(tmp_path / "pulsar.prof*"))
+    assert len(profs) > 25  # nearly every rotation has the strong pulse
+
+
+def test_dissect_requires_period_source(pulsar_dat):
+    from pypulsar_tpu.cli import dissect
+
+    assert dissect.main([pulsar_dat]) == 1
+    assert dissect.main([pulsar_dat, "-p", "0.25", "--use-parfile",
+                         "x.par"]) == 1
+
+
+def test_dissect_parfile_toas(pulsar_dat, tmp_path, monkeypatch, capsys):
+    from pypulsar_tpu.cli import dissect
+
+    monkeypatch.chdir(tmp_path)
+    parfn = _write_parfile(tmp_path)
+    template = _write_template(tmp_path)
+    rc = dissect.main([pulsar_dat, "--use-parfile", parfn, "-t", "5",
+                       "-r", "0.2:0.4",
+                       "--toas", "--template", template, "--min-pulses", "4",
+                       "--no-joydiv-plot", "--no-pulse-plots",
+                       "--no-text-files"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    toa_lines = [ln for ln in out.splitlines()
+                 if ln.strip().startswith("FAKE") or "55000" in ln]
+    # princeton TOA lines carry the observing freq and MJD ~55000
+    assert any("55000" in ln for ln in toa_lines)
+    assert "Number of TOAs:" in out
+    ntoas = int(out.split("Number of TOAs:")[1].split()[0])
+    assert ntoas >= 4
+
+
+def test_dissect_joydiv_plot(pulsar_dat, tmp_path, monkeypatch):
+    from pypulsar_tpu.cli import dissect
+
+    monkeypatch.chdir(tmp_path)
+    rc = dissect.main([pulsar_dat, "-p", str(PERIOD), "-r", "0.2:0.4",
+                       "-t", "5", "--no-pulse-plots", "--no-text-files"])
+    assert rc == 0
+    assert os.path.exists(str(tmp_path / "pulsar.joydiv.ps"))
+
+
+def test_toa_accuracy_constant_period(pulsar_dat, tmp_path, monkeypatch,
+                                      capsys):
+    """TOA MJDs should land near the injected pulse peaks (phase 0.3)."""
+    from pypulsar_tpu.cli import dissect
+
+    monkeypatch.chdir(tmp_path)
+    parfn = _write_parfile(tmp_path)
+    template = _write_template(tmp_path)
+    rc = dissect.main([pulsar_dat, "--use-parfile", parfn, "-t", "5",
+                       "-r", "0.2:0.4",
+                       "--toas", "--template", template, "--min-pulses", "1",
+                       "--no-joydiv-plot", "--no-pulse-plots",
+                       "--no-text-files"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    mjds = []
+    for ln in out.splitlines():
+        for p in ln.split():
+            # princeton TOA MJDs carry >= 10 decimal digits; the report
+            # table's "%5.4f" MJD column does not
+            if p.startswith("55000.") and len(p.split(".")[1]) >= 10:
+                mjds.append(float(p))
+    assert len(mjds) >= 4
+    # each TOA should land at the injected pulse phase (0.3) mod period
+    secs = (np.array(mjds) - 55000.0) * SECPERDAY
+    phases = (secs / PERIOD) % 1.0
+    assert np.ptp(phases) < 0.05
+    assert abs(np.median(phases) - 0.3) < 0.05
+
+
+def test_sum_profs_and_energy_distribution(pulsar_dat, tmp_path,
+                                           monkeypatch, capsys):
+    from pypulsar_tpu.cli import dissect, pulse_energy_distribution, sum_profs
+
+    monkeypatch.chdir(tmp_path)
+    rc = dissect.main([pulsar_dat, "-p", str(PERIOD), "-r", "0.2:0.4",
+                       "-t", "5", "--no-joydiv-plot", "--no-pulse-plots"])
+    assert rc == 0
+    profs = sorted(glob.glob(str(tmp_path / "pulsar.prof*")))
+    profs = [p for p in profs if not p.endswith(".ps")]
+    assert len(profs) >= 4
+
+    rc = sum_profs.main(profs[:4] + ["--scale", "-o",
+                                     str(tmp_path / "summed")])
+    assert rc == 0
+    summed_fns = glob.glob(str(tmp_path / "summed.summedprof"))
+    assert len(summed_fns) == 1
+    from pypulsar_tpu.fold.pulse import read_pulse_from_file
+    summed = read_pulse_from_file(summed_fns[0])
+    assert summed.N > 0
+
+    out = str(tmp_path / "energies.png")
+    rc = pulse_energy_distribution.main(profs + ["-s", out, "-a"])
+    assert rc == 0 and os.path.getsize(out) > 1000
+
+
+def test_pulses_to_toa(pulsar_dat, tmp_path, monkeypatch, capsys):
+    from pypulsar_tpu.cli import dissect, pulses_to_toa
+
+    monkeypatch.chdir(tmp_path)
+    rc = dissect.main([pulsar_dat, "-p", str(PERIOD), "-r", "0.2:0.4",
+                       "-t", "5", "--no-joydiv-plot", "--no-pulse-plots"])
+    assert rc == 0
+    capsys.readouterr()
+    profs = sorted(glob.glob(str(tmp_path / "pulsar.prof*")))
+    profs = [p for p in profs if not p.endswith(".ps")][:6]
+    template = _write_template(tmp_path, nbins=50)
+    rc = pulses_to_toa.main(profs + ["--template", template,
+                                     "--min-pulses", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert any("55000." in ln for ln in out.splitlines())
