@@ -98,10 +98,32 @@ def change_obsfreq(temp, oldfreq, newfreq, index=SYNCHROTRON_INDEX):
     return temp * (newfreq / oldfreq) ** index
 
 
+def approx_skytemp_408(gal_long, gal_lat):
+    """Analytic approximation of the 408 MHz sky temperature (K): an
+    isotropic ~25 K floor plus a galactic-plane/centre component falling
+    off in longitude and latitude.  A coarse stand-in (tens of percent on
+    the plane) for when the Haslam map file is unavailable."""
+    l = np.mod(np.asarray(gal_long, dtype=np.float64) + 180.0, 360.0) - 180.0
+    b = np.asarray(gal_lat, dtype=np.float64)
+    return 25.0 + 275.0 / ((1.0 + (l / 42.0) ** 2) * (1.0 + (b / 3.0) ** 2))
+
+
 def get_skytemp(gal_long, gal_lat, freq=HASLAM_FREQ,
                 index=SYNCHROTRON_INDEX, mapfn: Optional[str] = None):
     """Sky temperature (K) at galactic (l, b) degrees, scaled to ``freq``
-    MHz (reference :55-78)."""
+    MHz (reference :55-78).  Falls back to :func:`approx_skytemp_408`
+    (with a warning) only when NO map was configured anywhere; an
+    explicitly requested ``mapfn`` or $PYPULSAR_TPU_HASLAM path that is
+    missing still raises, so a typo cannot silently degrade fluxes."""
+    configured = mapfn or any(p and os.path.isfile(p)
+                              for p in _default_paths())
+    if not configured:
+        import warnings
+        warnings.warn(
+            "Haslam map unavailable; using the analytic plane-model "
+            "approximation for the sky temperature.")
+        temp_408 = approx_skytemp_408(gal_long, gal_lat)
+        return change_obsfreq(temp_408, HASLAM_FREQ, freq, index)
     m = read_map(mapfn)
     theta = (90.0 - np.asarray(gal_lat, dtype=np.float64)) * DEGTORAD
     phi = np.asarray(gal_long, dtype=np.float64) * DEGTORAD

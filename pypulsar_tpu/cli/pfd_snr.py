@@ -1,0 +1,147 @@
+"""Profile SNR (and mean flux) from prepfold ``.pfd`` archives.
+
+Behavioral spec: reference ``bin/pfd_snr.py`` — SNR = area/(std*sqrt(weq))
+with DOF correction (L&K eq. 7.1; :674-718), on-pulse selection manually,
+from a paas ``.m`` von-Mises model (:113-160), or from a pygaussfit
+Gaussians file (:73-110, :356-403); SEFD either given or derived from
+Tsys/gain + Haslam sky temperature at the pointing (:738-753), with an
+Airy-pattern correction for off-centre pulsars (:747-752).
+
+The reference's interactive matplotlib region picker is replaced by the
+``--on-pulse`` flag plus an automatic 3-sigma selection fallback; compute
+goes through ``pypulsar_tpu.fold.profile_snr``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from pypulsar_tpu.astro import estimate_snr, sextant, skytemp
+from pypulsar_tpu.fold import profile_snr
+from pypulsar_tpu.io.prestopfd import PfdFile
+
+
+def parse_model_file(modelfn: str) -> List[Tuple[float, float, float]]:
+    """Parse a paas-style ``.m`` component file: one von-Mises component
+    per line as ``phase concentration amplitude`` (comments with '#')."""
+    comps = []
+    with open(modelfn) as f:
+        for line in f:
+            line = line.partition("#")[0].strip()
+            if not line:
+                continue
+            phs, conc, amp = [float(x) for x in line.split()[:3]]
+            comps.append((phs, conc, amp))
+    return comps
+
+
+def model_from_components(comps, proflen: int) -> np.ndarray:
+    """Sum of von-Mises components evaluated over ``proflen`` bins."""
+    model = np.zeros(proflen)
+    for phs, conc, amp in comps:
+        model += amp * np.asarray(
+            profile_snr.vonmises_profile(proflen, phs, conc))
+    return model
+
+
+def effective_sefd(args, pfd) -> Optional[float]:
+    """SEFD from --sefd, or Tsys/gain + sky temperature at the pfd's
+    coordinates; reduced by the Airy factor for off-centre pointings."""
+    sefd = None
+    if args.sefd is not None:
+        sefd = args.sefd
+    elif args.gain is not None and args.tsys is not None:
+        fctr = 0.5 * (pfd.hifreq + pfd.lofreq)
+        glon, glat = sextant.equatorial_to_galactic(
+            pfd.rastr, pfd.decstr, input="sexigesimal", output="deg")
+        glon = float(np.atleast_1d(glon)[0])
+        glat = float(np.atleast_1d(glat)[0])
+        print("Galactic Coords: l=%g deg, b=%g deg" % (glon, glat))
+        tsky = float(np.atleast_1d(
+            skytemp.get_skytemp(glon, glat, freq=fctr))[0])
+        print("Sky temp at %g MHz: %g K" % (fctr, tsky))
+        sefd = (args.tsys + tsky) / args.gain
+    if sefd is not None and args.fwhm is not None and args.sep is not None:
+        factor = float(estimate_snr.airy_pattern(args.fwhm, args.sep))
+        print("Pulsar is off-centre")
+        print("Reducing SEFD by factor of %g (SEFD: %g->%g)"
+              % (factor, sefd, sefd / factor))
+        sefd /= factor
+    return sefd
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="pfd_snr.py",
+        description="Calculate SNR from .pfd files (TPU backend; "
+                    "non-interactive).")
+    parser.add_argument("files", nargs="+", help=".pfd files")
+    parser.add_argument("--on-pulse", dest="on_pulse", nargs=2, type=float,
+                        default=None,
+                        help="On-pulse region: start and end phase "
+                             "(0-1 floats)")
+    parser.add_argument("--sefd", type=float, default=None,
+                        help="SEFD in Jy (Tsys/Gain); sky temperature is "
+                             "not added")
+    parser.add_argument("--tsys", type=float, default=None,
+                        help="System temperature in K (sky temperature is "
+                             "added from the Haslam map)")
+    parser.add_argument("--gain", type=float, default=None,
+                        help="Gain in K/Jy")
+    parser.add_argument("--sep", type=float, default=None,
+                        help="Offset of pulsar from beam centre in arcmin "
+                             "(requires --fwhm)")
+    parser.add_argument("--fwhm", type=float, default=None,
+                        help="Beam FWHM in arcmin")
+    parser.add_argument("-m", "--model-file", default=None,
+                        help="paas-created .m file of von-Mises "
+                             "components")
+    parser.add_argument("-g", "--gaussian-file", dest="gauss_file",
+                        default=None,
+                        help="pygaussfit-created Gaussians file")
+    return parser
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.sefd is not None and (args.tsys is not None or
+                                  args.gain is not None):
+        print("Gain and/or system temperature should not be provided if "
+              "SEFD is given.", file=sys.stderr)
+        return 1
+    if (args.tsys is None) != (args.gain is None):
+        print("Both gain and system temperature must be provided "
+              "together.", file=sys.stderr)
+        return 1
+
+    for pfdfn in args.files:
+        print(pfdfn)
+        pfd = PfdFile(pfdfn)
+        sefd = effective_sefd(args, pfd)
+
+        regions = None
+        model = None
+        if args.on_pulse is not None:
+            lo, hi = args.on_pulse
+            regions = [(int(lo * pfd.proflen), int(hi * pfd.proflen))]
+        elif args.model_file is not None:
+            model = model_from_components(
+                parse_model_file(args.model_file), pfd.proflen)
+        elif args.gauss_file is not None:
+            model = profile_snr.read_gaussfitfile(args.gauss_file,
+                                                  pfd.proflen)
+
+        result = profile_snr.pfd_snr(pfd, regions=regions, model=model,
+                                     sefd=sefd, verbose=True)
+        print("SNR: %.3f" % result["snr"])
+        if result["smean"] is not None:
+            print("Mean flux density (mJy): %.4f" % result["smean"])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

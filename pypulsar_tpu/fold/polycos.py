@@ -269,16 +269,23 @@ def create_polycos_from_spindown(
     # similarly over-covers the requested window)
     tmid = float(start_mjd)
     while tmid - 0.5 * span_days <= end_mjd:
+        # snap TMID to its serialized split (TMIDi + full-precision
+        # fraction) before computing RPHASE so evaluation, which parses
+        # tmid_str the same way, is consistent
+        tmid_str = f"{tmid:.11f}"
+        ipart, _, fpart = tmid_str.partition(".")
+        tmid_eval = np.longdouble(int(ipart)) + np.longdouble(
+            float("0." + fpart))
         coeffs = np.zeros(numcoeffs)
         # DT is minutes: dt_sec = 60*DT.  The dt^2 coefficient uses the
         # frequency DERIVATIVE AT TMID, f'(TMID) = F1 + F2*(TMID-PEPOCH):
-        fdot_tmid = f1 + f2 * (tmid - pepoch) * psrmath.SECPERDAY
+        fdot_tmid = f1 + f2 * (tmid_eval - pepoch) * psrmath.SECPERDAY
         if numcoeffs > 2:
             coeffs[2] = 0.5 * fdot_tmid * 3600.0
         if numcoeffs > 3:
             coeffs[3] = f2 / 6.0 * 216000.0
-        mjdi = int(tmid)
-        frac_h = (tmid - mjdi) * 24.0
+        mjdi = int(tmid_eval)
+        frac_h = (tmid_eval - mjdi) * 24.0
         hh = int(frac_h)
         mm = int((frac_h - hh) * 60)
         ss = (frac_h - hh) * 3600 - mm * 60
@@ -287,12 +294,12 @@ def create_polycos_from_spindown(
                 psr=psrname,
                 date="DD-MMM-YY",
                 utc=f"{hh:02d}{mm:02d}{ss:05.2f}".replace(".", ""),
-                tmid_str=f"{tmid:.11f}",
+                tmid_str=tmid_str,
                 dm=dm,
                 doppler=0.0,
                 log10rms=-10.0,
-                rphase=n_at(tmid),
-                f0=f_at(tmid),
+                rphase=float(n_at(tmid_eval)),
+                f0=float(f_at(tmid_eval)),
                 obs=obs,
                 dataspan=span,
                 numcoeff=numcoeffs,
@@ -302,6 +309,154 @@ def create_polycos_from_spindown(
         )
         tmid += span_days
     return Polycos(filenm="<generated>", blocks=blocks)
+
+
+def _bt_roemer_delay(mjds: np.ndarray, pb_days: float, a1: float,
+                     ecc: float, om_deg: float, t0: float) -> np.ndarray:
+    """Blandford-Teukolsky Roemer delay (s) of the pulsar's orbit at the
+    given barycentric MJDs: x[sin w (cos E - e) + sqrt(1-e^2) cos w sin E]
+    with E from Kepler's equation by Newton iteration."""
+    mjds = np.asarray(mjds, dtype=np.longdouble)
+    ma = 2.0 * np.pi * np.asarray((mjds - t0) / pb_days, dtype=np.float64)
+    ma = np.mod(ma, 2.0 * np.pi)
+    E = ma + ecc * np.sin(ma)  # good starting guess for e < 0.8
+    for _ in range(25):
+        dE = (E - ecc * np.sin(E) - ma) / (1.0 - ecc * np.cos(E))
+        E = E - dE
+        if np.max(np.abs(dE)) < 1e-14:
+            break
+    om = np.deg2rad(om_deg)
+    return a1 * (np.sin(om) * (np.cos(E) - ecc)
+                 + np.sqrt(1.0 - ecc ** 2) * np.cos(om) * np.sin(E))
+
+
+def create_polycos_from_binary(
+    par: Union[str, PsrPar],
+    start_mjd: float,
+    end_mjd: float,
+    obs: str = "@",
+    obsfreq: float = 0.0,
+    span: int = SPAN_DEFAULT,
+    numcoeffs: int = NUMCOEFFS_DEFAULT,
+    max_resid_phase: float = 1e-6,
+) -> Polycos:
+    """Native polyco generation for binary pulsars (BT/ELL1-style Keplerian
+    orbits) on barycentred data — the capability the reference delegated to
+    the TEMPO binary.
+
+    Per block, the exact rotation count N(t) = f(tau) integrated over the
+    orbit-retarded proper time tau = t - Roemer(t) is sampled on Chebyshev
+    nodes and least-squares fitted with the polyco polynomial in
+    DT = (t - TMID) minutes.  The block span is shrunk (and the fit
+    re-done) until the max fit residual is below ``max_resid_phase``
+    rotations, so short-period orbits are handled correctly.
+    """
+    if isinstance(par, str):
+        par = PsrPar(par)
+    f0 = float(par.F0)
+    f1 = float(getattr(par, "F1", 0.0) or 0.0)
+    f2 = float(getattr(par, "F2", 0.0) or 0.0)
+    pepoch = float(getattr(par, "PEPOCH", start_mjd))
+    dm = float(getattr(par, "DM", 0.0) or 0.0)
+    pb = float(par.PB)           # days
+    a1 = float(par.A1)           # lt-s
+    if hasattr(par, "EPS1") or hasattr(par, "EPS2"):
+        # ELL1 parameterization: eps1 = e sin w, eps2 = e cos w, epoch is
+        # the ascending node; T0 = TASC + (w/2pi) Pb (exact to O(e^2),
+        # consistent with the ELL1 small-e regime)
+        eps1 = float(getattr(par, "EPS1", 0.0) or 0.0)
+        eps2 = float(getattr(par, "EPS2", 0.0) or 0.0)
+        ecc = float(np.hypot(eps1, eps2))
+        om_rad = float(np.arctan2(eps1, eps2))
+        om = np.rad2deg(om_rad)
+        t0 = float(par.TASC) + (om_rad % (2 * np.pi)) / (2 * np.pi) * pb
+    elif hasattr(par, "T0"):
+        ecc = float(getattr(par, "ECC", getattr(par, "E", 0.0)) or 0.0)
+        om = float(getattr(par, "OM", 0.0) or 0.0)
+        t0 = float(par.T0)
+    else:
+        raise PolycoError(
+            "Binary ephemeris has neither T0/ECC/OM (BT/DD-style) nor "
+            "TASC/EPS1/EPS2 (ELL1-style) parameters; cannot generate "
+            "native polycos for this model.")
+    psrname = par.name.lstrip("BJ")
+
+    def n_at(mjds):
+        """Exact rotation count at barycentric MJDs (longdouble)."""
+        mjds = np.atleast_1d(np.asarray(mjds, dtype=np.longdouble))
+        delay = _bt_roemer_delay(mjds, pb, a1, ecc, om, t0)
+        tau = (mjds - pepoch) * psrmath.SECPERDAY - delay
+        return f0 * tau + 0.5 * f1 * tau ** 2 + f2 * tau ** 3 / 6.0
+
+    def fit_block(tmid, cur_span):
+        """(coeffs, rphase, max_resid) of the polyco polynomial fit on
+        Chebyshev nodes over [tmid - span/2, tmid + span/2]."""
+        half_min = cur_span / 2.0
+        k = np.arange(4 * numcoeffs)
+        dts = half_min * np.cos(np.pi * (k + 0.5) / k.size)
+        mjds = tmid + np.asarray(dts, dtype=np.longdouble) / 1440.0
+        n_tmid = n_at(tmid)[0]
+        y = np.asarray(n_at(mjds) - n_tmid, dtype=np.float64)
+        # fit in the scaled variable s = DT/half (condition number ~1),
+        # then rescale coefficients to the polyco's DT-minutes monomials
+        s = dts / half_min
+        A = np.vander(s, numcoeffs, increasing=True)
+        coeffs_s, *_ = np.linalg.lstsq(A, y, rcond=None)
+        resid = float(np.max(np.abs(A @ coeffs_s - y)))
+        coeffs = coeffs_s / half_min ** np.arange(numcoeffs)
+        return coeffs, float(n_tmid), resid
+
+    # TEMPO polycos require one uniform dataspan; pick the largest span —
+    # starting well inside one orbit — whose fit converges at every
+    # orbital phase (probe 8 phases across the orbit)
+    span = int(min(span, max(4, pb * 1440.0 / 16.0)))
+    probes = float(start_mjd) + pb * np.arange(8) / 8.0
+    while span > 4:
+        if all(fit_block(t, span)[2] <= max_resid_phase for t in probes):
+            break
+        span = max(4, span // 2)
+
+    blocks = []
+    tmid = float(start_mjd)
+    while tmid - 0.5 * (span / 1440.0) <= end_mjd:
+        # Fit around TMID exactly as evaluation will see it: Polyco splits
+        # tmid_str into TMIDi + TMIDf (the fraction parsed at full float64
+        # precision, which differs from frac(float(tmid_str)) by ~1e-12
+        # days ~ 1e-4 rotations at 200 Hz), so reconstruct that split in
+        # longdouble here.
+        tmid_str = f"{tmid:.11f}"
+        ipart, _, fpart = tmid_str.partition(".")
+        tmid_eval = np.longdouble(int(ipart)) + np.longdouble(
+            float("0." + fpart))
+        coeffs, n_tmid, _ = fit_block(tmid_eval, span)
+        f0_block = coeffs[1] / 60.0
+        pcoeffs = coeffs.copy()
+        pcoeffs[1] = 0.0  # linear term lives in F0_block
+        mjdi = int(tmid_eval)
+        frac_h = (tmid_eval - mjdi) * 24.0
+        hh = int(frac_h)
+        mm = int((frac_h - hh) * 60)
+        ss = (frac_h - hh) * 3600 - mm * 60
+        blocks.append(
+            Polyco(
+                psr=psrname,
+                date="DD-MMM-YY",
+                utc=f"{hh:02d}{mm:02d}{ss:05.2f}".replace(".", ""),
+                tmid_str=tmid_str,
+                dm=dm,
+                doppler=0.0,
+                log10rms=-10.0,
+                rphase=float(n_tmid),
+                f0=f0_block,
+                obs=obs,
+                dataspan=span,
+                numcoeff=numcoeffs,
+                obsfreq=obsfreq,
+                coeffs=pcoeffs,
+            )
+        )
+        tmid += span / 1440.0
+    return Polycos(filenm="<generated-binary>", blocks=blocks)
 
 
 def create_polycos(
@@ -317,16 +472,22 @@ def create_polycos(
 ) -> Polycos:
     """Create polycos from a parfile via ``tempo -z`` (reference
     mypolycos.py:213-276).  Falls back to the native spin-down generator
-    when the TEMPO binary is unavailable and the ephemeris has no binary
-    terms (raises PolycoError for binary pulsars without TEMPO)."""
+    (or the native Keplerian generator for binary ephemerides) when the
+    TEMPO binary is unavailable; topocentric data without TEMPO raises."""
     if isinstance(par, str):
         par = PsrPar(par)
 
     if shutil.which("tempo") is None:
         if hasattr(par, "BINARY"):
-            raise PolycoError(
-                "TEMPO binary not found and ephemeris has binary terms; "
-                "cannot generate polycos natively."
+            if telescope_id not in ("@", "0"):
+                raise PolycoError(
+                    "TEMPO binary not found; native binary polycos are "
+                    "only valid for barycentred data (telescope_id '@' "
+                    f"or '0', got {telescope_id!r})."
+                )
+            return create_polycos_from_binary(
+                par, float(start_mjd), float(end_mjd), obs=telescope_id,
+                obsfreq=center_freq, span=span, numcoeffs=numcoeffs,
             )
         if telescope_id not in ("@", "0"):
             # topocentric data needs Earth-motion corrections only TEMPO

@@ -23,7 +23,7 @@ class PrestoFFT:
     """A PRESTO .fft file (complex64 rfft of a .dat time series) plus its
     .inf metadata (reference prestofft.py:33-71)."""
 
-    def __init__(self, fftfn, inffn=None, maxfreq=None):
+    def __init__(self, fftfn, inffn=None, maxfreq=None, lazy=False):
         if not fftfn.endswith(".fft"):
             raise ValueError("FFT filename must end with '.fft'! (%s)" % fftfn)
         if not os.path.isfile(fftfn):
@@ -38,23 +38,31 @@ class PrestoFFT:
         self.inffn = inffn
         self.inf = InfoData(inffn)
 
-        self.freqs = np.fft.rfftfreq(self.inf.N, self.inf.dt)
+        # number of coefficients actually on disk (PRESTO realffts hold
+        # N/2; our write_fft holds N/2+1)
+        self.numcoeffs = os.path.getsize(fftfn) // 8
+        self.freqs = np.fft.rfftfreq(self.inf.N, self.inf.dt)[: self.numcoeffs]
+
+        self.normalisation = "raw"
+        self.errs = None
+        self._schedule = None
+        if lazy:
+            # streaming mode (the reference's delayread=True): metadata
+            # only; use read_fft/seek_to_bin for block access
+            self.fft = None
+            self.phases = None
+            self.powers = None
+            return
         if maxfreq is not None:
             ntoread = int(np.sum(self.freqs < maxfreq))
             self.freqs = self.freqs[:ntoread]
         else:
             ntoread = -1
         self.fft = self.read_fft(count=ntoread)
-        # PRESTO realffts hold N/2 coefficients; our writer holds N/2+1 —
-        # align freqs to whatever the file actually contains
         self.freqs = self.freqs[: len(self.fft)]
         self.fft = self.fft[: len(self.freqs)]
         self.phases = np.angle(self.fft)
-
-        self.normalisation = "raw"
         self.powers = np.abs(self.fft) ** 2
-        self.errs = None
-        self._schedule = None
 
     def close(self):
         self.fftfile.close()
@@ -62,6 +70,11 @@ class PrestoFFT:
     def read_fft(self, count=-1):
         """Read ``count`` complex64 coefficients from the .fft file."""
         return np.fromfile(self.fftfile, dtype=np.dtype("c8"), count=count)
+
+    def seek_to_bin(self, binnum: int):
+        """Position the file at frequency bin ``binnum`` for streamed
+        block reads (8 bytes per complex64 coefficient)."""
+        self.fftfile.seek(8 * int(binnum))
 
     # ---- spectral ops (device) -------------------------------------------
 

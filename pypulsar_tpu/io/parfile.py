@@ -113,10 +113,13 @@ psr_par = PsrPar
 def write_par(parfn: str, params: dict) -> str:
     """Write a simple .par file from a {KEY: value} dict (used by tests and
     by bin/demodulate-style tools that synthesize ephemerides)."""
+    import numbers
+
     with open(parfn, "w") as f:
         for k, v in params.items():
-            if isinstance(v, float):
-                f.write(f"{k:<12s} {v!r}\n")
+            if isinstance(v, numbers.Real) and not isinstance(v, bool) \
+                    and not isinstance(v, numbers.Integral):
+                f.write(f"{k:<12s} {float(v)!r}\n")
             else:
                 f.write(f"{k:<12s} {v}\n")
     return parfn

@@ -1,0 +1,201 @@
+"""Tests for the analysis CLI tools: autozap, plot_accelcands, shapiro,
+pbdot, massfunc, pfdinfo, coordconv, prestocand IO."""
+
+import os
+
+import matplotlib
+import numpy as np
+import pytest
+
+matplotlib.use("Agg", force=True)
+
+from pypulsar_tpu.core.psrmath import Tsun
+from pypulsar_tpu.io.infodata import InfoData
+from pypulsar_tpu.io.prestocand import (FOURIERPROPS_DTYPE, read_rzwcands,
+                                        write_rzwcands)
+
+
+def _make_inf(N=32768, dt=1e-3):
+    inf = InfoData()
+    inf.epoch = 55000.0
+    inf.dt = dt
+    inf.N = N
+    inf.telescope = "Fake"
+    inf.lofreq = 1400.0
+    inf.BW = 100.0
+    inf.numchan = 1
+    inf.chan_width = 100.0
+    inf.object = "FAKE"
+    return inf
+
+
+def _make_ffts(tmp_path, nfiles=3, N=32768, dt=1e-3, rfi_freq=60.0):
+    """Write .fft files of white noise + a strong persistent RFI tone."""
+    from pypulsar_tpu.fourier.prestofft import write_fft
+
+    fns = []
+    for ii in range(nfiles):
+        rng = np.random.RandomState(ii)
+        data = rng.randn(N).astype(np.float32)
+        t = np.arange(N) * dt
+        data += 20.0 * np.sin(2 * np.pi * rfi_freq * t)
+        # full rfft: N/2+1 coefficients (our write_fft layout); autozap
+        # must size by the on-disk count, not inf.N//2
+        fft = np.fft.rfft(data).astype(np.complex64)
+        fn = str(tmp_path / ("beam%d.fft" % ii))
+        inf = _make_inf(N, dt)
+        inf.basenm = "beam%d" % ii
+        write_fft(fn, fft, inf)
+        fns.append(fn)
+    return fns
+
+
+def test_autozap_finds_rfi_tone(tmp_path, monkeypatch):
+    from pypulsar_tpu.cli import autozap
+
+    monkeypatch.chdir(tmp_path)
+    fns = _make_ffts(tmp_path, rfi_freq=60.0)
+    rc = autozap.main(fns + ["-o", str(tmp_path / "zap"), "--no-plot"])
+    assert rc == 0
+    zap = np.atleast_2d(np.loadtxt(str(tmp_path / "zap.zaplist")))
+    assert zap.shape[0] >= 1
+    # the 60 Hz tone must be inside one of the zapped intervals
+    hit = any(lo - w <= 60.0 <= lo + w for lo, w in zap)
+    assert hit, f"60 Hz tone not zapped: {zap}"
+
+
+def test_rzwcands_roundtrip(tmp_path):
+    fn = str(tmp_path / "test_ACCEL_0.cand")
+    cands = [dict(r=1234.5, rerr=0.1, z=-3.0, zerr=0.5, sig=12.0,
+                  pow=50.0),
+             dict(r=888.0, rerr=0.2, z=0.0, zerr=0.1, sig=8.0, pow=25.0)]
+    write_rzwcands(fn, cands)
+    assert os.path.getsize(fn) == 2 * FOURIERPROPS_DTYPE.itemsize
+    back = read_rzwcands(fn)
+    assert len(back) == 2
+    assert back[0].r == pytest.approx(1234.5)
+    assert back[0].zerr == pytest.approx(0.5)
+    assert back[1].sig == pytest.approx(8.0)
+
+
+def test_plot_accelcands(tmp_path, monkeypatch, capsys):
+    from pypulsar_tpu.cli import plot_accelcands
+
+    monkeypatch.chdir(tmp_path)
+    N, dt = 32768, 1e-3
+    T = N * dt
+    # 10 files, all containing a candidate at the same frequency (60 Hz)
+    inffns = []
+    for ii in range(10):
+        base = str(tmp_path / ("file%02d" % ii))
+        inf = _make_inf(N, dt)
+        inf.basenm = os.path.basename(base)
+        inf.to_file(base + ".inf")
+        # jitter the 60 Hz candidate slightly per file so the intervals
+        # overlap (strict-inequality merge, reference :24-31)
+        write_rzwcands(base + "_ACCEL_0.cand",
+                       [dict(r=(60.0 + 0.001 * ii) * T, rerr=0.5 + 0.1 * ii,
+                             z=0, zerr=0.1, sig=10.0),
+                        dict(r=(20.0 + ii) * T, rerr=0.5, z=0, zerr=0.1,
+                             sig=6.0)])
+        inffns.append(base + ".inf")
+    out = str(tmp_path / "cands.png")
+    rc = plot_accelcands.main(inffns + ["-o", out])
+    assert rc == 0
+    printed = capsys.readouterr().out
+    # the persistent 60 Hz interval (10 hits) is reported; scattered ones not
+    rows = [ln for ln in printed.splitlines() if ln.startswith("\t")]
+    assert len(rows) == 1
+    assert float(rows[0].split()[0]) == pytest.approx(60.0, abs=0.1)
+    assert os.path.getsize(out) > 1000
+
+
+def test_shapiro_math():
+    from pypulsar_tpu.cli.shapiro import measurable_shapiro_delay, sini
+
+    # edge-on equal-mass system: sini = (f(2m)^2)^(1/3)/m
+    mf, mp, mc = 0.15, 1.4, 1.4
+    s = sini(mp, mc, mf)
+    assert s == pytest.approx((mf * (mp + mc) ** 2) ** (1 / 3) / mc)
+    # measurable delay is finite
+    d = measurable_shapiro_delay(1.4, 1.4, mf, phi=np.pi / 2)
+    assert np.isfinite(d)
+    # higher mass function (at fixed masses) -> higher inclination ->
+    # larger measurable harmonic content
+    d2 = measurable_shapiro_delay(1.4, 1.4, 0.05, phi=np.pi / 2)
+    assert abs(d) > abs(d2)
+
+
+def test_shapiro_cli(tmp_path):
+    from pypulsar_tpu.cli import shapiro
+
+    out = str(tmp_path / "shapiro.png")
+    assert shapiro.main(["-o", out]) == 0
+    assert os.path.getsize(out) > 1000
+
+
+def test_pbdot_hulse_taylor():
+    from pypulsar_tpu.cli.pbdot import pbdot
+
+    # PSR B1913+16: Pb=0.322997 d, e=0.6171, mp=1.441, mc=1.387
+    # GR prediction: Pb-dot = -2.40e-12 s/s
+    pb = 0.322997448918 * 86400
+    val = pbdot(1.4398, 1.3886, pb, 0.6171340)
+    assert val == pytest.approx(-2.402e-12, rel=0.01)
+
+
+def test_pbdot_cli(tmp_path):
+    from pypulsar_tpu.cli import pbdot
+
+    out = str(tmp_path / "pbdot.png")
+    assert pbdot.main(["-o", out]) == 0
+    assert os.path.getsize(out) > 1000
+
+
+def test_massfunc():
+    from pypulsar_tpu.cli.massfunc import min_companion_mass
+    from pypulsar_tpu.core.psrmath import mass_funct
+
+    # consistency: mass function of the returned minimum mass reproduces f
+    mp, inc = 1.4, 90.0
+    for mf in (0.001, 0.15, 1.0):
+        roots = min_companion_mass(mf, mp, inc)
+        assert roots.size >= 1
+        mc = roots.max()
+        f_back = mc ** 3 / (mp + mc) ** 2
+        assert f_back == pytest.approx(mf, rel=1e-8)
+
+
+def test_massfunc_cli(capsys):
+    from pypulsar_tpu.cli import massfunc
+
+    assert massfunc.main(["-f", "0.15"]) == 0
+    out = capsys.readouterr().out
+    assert "Minimum companion mass" in out
+
+
+def test_pfdinfo(tmp_path, capsys):
+    from pypulsar_tpu.cli import pfdinfo
+    from pypulsar_tpu.io.prestopfd import make_pfd
+
+    profs = np.random.RandomState(0).rand(4, 8, 32)
+    pfd = make_pfd(profs, dt=1e-3, lofreq=1400.0, chan_wid=1.0,
+                   fold_p1=0.033, bestdm=25.0, candnm="TESTCAND")
+    fn = str(tmp_path / "test.pfd")
+    pfd.write(fn)
+    rc = pfdinfo.main([fn, "-a", "candnm,bestdm", "--header",
+                       "name,dm"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "TESTCAND\t25.0" in out
+    assert "# name\tdm" in out
+
+
+def test_coordconv_cli(capsys):
+    from pypulsar_tpu.cli import coordconv
+
+    assert coordconv.main(["192.25", "27.4"]) == 0
+    out = capsys.readouterr().out
+    # (192.25, 27.4) deg is close to the galactic north pole definition
+    assert out.strip()
+    assert coordconv.main(["1"]) == 1

@@ -1,0 +1,261 @@
+"""Tests for pfd_snr, gridding, fitkepler, and demodulate CLIs."""
+
+import os
+
+import matplotlib
+import numpy as np
+import pytest
+
+matplotlib.use("Agg", force=True)
+
+from pypulsar_tpu.core.psrmath import SECPERDAY
+from pypulsar_tpu.io.datfile import Datfile, write_dat
+from pypulsar_tpu.io.infodata import InfoData
+from pypulsar_tpu.io.parfile import write_par
+from pypulsar_tpu.io.prestopfd import make_pfd
+
+
+def _gauss_profs(npart=8, nsub=4, proflen=64, amp=50.0, phase=0.3,
+                 width=0.03, noise=1.0, seed=0):
+    rng = np.random.RandomState(seed)
+    phases = np.arange(proflen) / proflen
+    shape = amp * np.exp(-0.5 * ((phases - phase) / width) ** 2)
+    profs = rng.randn(npart, nsub, proflen) * noise + shape / nsub
+    return profs
+
+
+def _make_pfd_file(tmp_path, name="cand.pfd", amp=50.0, rastr=None,
+                   decstr=None):
+    profs = _gauss_profs(amp=amp)
+    pfd = make_pfd(profs, dt=1e-3, lofreq=1400.0, chan_wid=25.0,
+                   fold_p1=0.064, bestdm=0.0, candnm="TEST")
+    if rastr:
+        pfd.rastr = rastr
+    if decstr:
+        pfd.decstr = decstr
+    fn = str(tmp_path / name)
+    pfd.write(fn)
+    return fn
+
+
+def test_pfd_snr_cli(tmp_path, capsys):
+    from pypulsar_tpu.cli import pfd_snr
+
+    fn = _make_pfd_file(tmp_path)
+    rc = pfd_snr.main([fn, "--on-pulse", "0.2", "0.4", "--sefd", "3.0"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    snr_line = [ln for ln in out.splitlines() if ln.startswith("SNR:")][-1]
+    snr = float(snr_line.split()[1])
+    assert snr > 10.0
+    assert "Mean flux density" in out
+
+
+def test_pfd_snr_rejects_conflicting_flags(tmp_path):
+    from pypulsar_tpu.cli import pfd_snr
+
+    fn = _make_pfd_file(tmp_path)
+    assert pfd_snr.main([fn, "--sefd", "3", "--gain", "10"]) == 1
+    assert pfd_snr.main([fn, "--gain", "10"]) == 1
+
+
+def test_pfd_snr_model_file(tmp_path, capsys):
+    from pypulsar_tpu.cli import pfd_snr
+
+    fn = _make_pfd_file(tmp_path)
+    mfn = str(tmp_path / "comps.m")
+    with open(mfn, "w") as f:
+        f.write("# phase concentration amplitude\n")
+        f.write("0.3 300.0 1.0\n")
+    rc = pfd_snr.main([fn, "-m", mfn])
+    assert rc == 0
+    out = capsys.readouterr().out
+    snr = float([ln for ln in out.splitlines()
+                 if ln.startswith("SNR:")][-1].split()[1])
+    assert snr > 10.0
+
+
+def test_gridding_recovers_position(tmp_path, capsys):
+    from pypulsar_tpu.cli import gridding
+    from pypulsar_tpu.astro.estimate_snr import airy_pattern
+
+    # pulsar at RA 12:00:02, Dec 30:00:30; 5 pointings around 12:00:00
+    # +30:00:00 with SNRs set by the Airy beam
+    fwhm = 3.35
+    true_ra_am = (12 + 0 / 60 + 2.0 / 3600) * 15 * 60
+    true_dec_am = (30 + 0 / 60 + 30.0 / 3600) * 60
+    true_snr = 40.0
+    pfdfns = []
+    offsets = [(0, 0), (1.0, 0), (-1.0, 0), (0, 1.0), (0, -1.0)]
+    from pypulsar_tpu.cli.gridding import angsep_arcmin
+    for ii, (dra, ddec) in enumerate(offsets):
+        ra_am = (12 * 15 * 60) + dra  # pointing RA in arcmin
+        dec_am = (30 * 60) + ddec
+        sep = angsep_arcmin(true_ra_am, true_dec_am, ra_am, dec_am)
+        snr = true_snr * float(np.atleast_1d(airy_pattern(fwhm, sep))[0])
+        # profile amplitude tuned so measured SNR ~ target snr
+        h, rem = divmod(ra_am / 60 / 15, 1)
+        m, rem = divmod(rem * 60, 1)
+        s = rem * 60
+        rastr = "%02d:%02d:%07.4f" % (h, m, s)
+        dh, drem = divmod(dec_am / 60, 1)
+        dm_, drem = divmod(drem * 60, 1)
+        ds = drem * 60
+        decstr = "%02d:%02d:%07.4f" % (dh, dm_, ds)
+        fn = _make_pfd_file(tmp_path, "point%d.pfd" % ii,
+                            amp=snr * 1.17, rastr=rastr, decstr=decstr)
+        pfdfns.append(fn)
+    rc = gridding.main(pfdfns + ["--fwhm", str(fwhm), "--no-plot"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    res_line = [ln for ln in out.splitlines() if "RA:" in ln and
+                "results" not in ln][-1]
+    # crude: fitted RA/Dec within ~1 arcmin of truth
+    parts = res_line.split()
+    fit_ra = float(parts[parts.index("RA:") + 1])
+    fit_dec = float(parts[parts.index("Dec:") + 1])
+    assert abs(fit_ra - true_ra_am) < 2.0
+    assert abs(fit_dec - true_dec_am) < 2.0
+
+
+def test_fitkepler_recovers_orbit(tmp_path, capsys):
+    from pypulsar_tpu.cli import fitkepler
+    from pypulsar_tpu.cli.fitkepler import kepler_period
+
+    # circular orbit: asini=2 lt-s, Porb=0.5 d, Ppsr=5 ms
+    true = (2.0, 0.5, 0.005, 55000.1, 0.0, 0.0)
+    rng = np.random.RandomState(1)
+    mjds = 55000.0 + np.linspace(0, 1.0, 40)
+    ps = kepler_period(mjds, *true)
+    perr = 2e-9
+    ps = ps + rng.randn(ps.size) * perr
+    fn = str(tmp_path / "periods.txt")
+    np.savetxt(fn, np.column_stack([mjds, ps * 1000,
+                                    np.full(ps.size, perr * 1000)]))
+    rc = fitkepler.main([fn, "--init", "1.5", "0.45", "0.005", "55000.05",
+                         "0.001", "0.0", "--no-plot"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    asini = float([ln for ln in out.splitlines()
+                   if "Asini" in ln][0].split(":")[1])
+    porb = float([ln for ln in out.splitlines()
+                  if "Porb" in ln][0].split(":")[1])
+    assert asini == pytest.approx(2.0, rel=0.01)
+    assert porb == pytest.approx(0.5, rel=0.001)
+    assert "Min companion mass" in out
+
+
+def test_eccentric_anomaly_solves_kepler():
+    from pypulsar_tpu.cli.fitkepler import eccentric_anomaly
+
+    for ecc in (0.0, 0.3, 0.9):
+        ma = np.linspace(0.01, 2 * np.pi - 0.01, 50)
+        E = eccentric_anomaly(ecc, ma)
+        # Kepler's equation: M = E - e sin E (mod 2pi)
+        back = np.mod(E - ecc * np.sin(E), 2 * np.pi)
+        np.testing.assert_allclose(back, np.mod(ma, 2 * np.pi), atol=1e-9)
+
+
+def test_binary_polycos_match_exact_phase(tmp_path):
+    """Native Keplerian polycos reproduce the exact BT-orbit rotation
+    count to < 1e-5 rotations across several orbits."""
+    from pypulsar_tpu.fold.polycos import (_bt_roemer_delay,
+                                           create_polycos_from_binary)
+
+    parfn = str(tmp_path / "bin.par")
+    write_par(parfn, dict(PSR="J0001+0001", F0=200.0, F1=-1e-14,
+                          PEPOCH=55000.0, DM=5.0, BINARY="BT", A1=5.0,
+                          PB=0.2, T0=55000.05, OM=45.0, E=0.1))
+    pcos = create_polycos_from_binary(parfn, 55000.0, 55001.0)
+    rng = np.random.RandomState(0)
+    for mjd in 55000.0 + rng.rand(25):
+        mjdi, mjdf = int(mjd), mjd - int(mjd)
+        got = pcos.get_rotation(mjdi, mjdf)
+        delay = float(_bt_roemer_delay(np.array([mjd]), 0.2, 5.0, 0.1,
+                                       45.0, 55000.05)[0])
+        tau = (mjd - 55000.0) * SECPERDAY - delay
+        exact = 200.0 * tau + 0.5 * (-1e-14) * tau ** 2
+        assert abs(got - exact) < 1e-5, (mjd, got, exact)
+    # apparent frequency is modulated around F0 by ~ 2 pi a1 / Pb_s * F0
+    freqs = [pcos.get_freq(55000, f) for f in np.linspace(0.1, 0.9, 20)]
+    vmax = 2 * np.pi * 5.0 / (0.2 * SECPERDAY)
+    assert max(freqs) > 200.0 * (1 + 0.3 * vmax)
+    assert min(freqs) < 200.0 * (1 - 0.3 * vmax)
+
+
+def test_binary_polycos_ell1(tmp_path):
+    """ELL1 ephemerides (TASC/EPS1/EPS2) produce the same polycos as the
+    equivalent BT parameterization."""
+    from pypulsar_tpu.fold.polycos import create_polycos_from_binary
+
+    ecc, om_deg, pb, tasc = 0.01, 30.0, 0.3, 55000.02
+    om = np.deg2rad(om_deg)
+    t0 = tasc + om / (2 * np.pi) * pb
+    bt_fn = str(tmp_path / "bt.par")
+    ell1_fn = str(tmp_path / "ell1.par")
+    common = dict(PSR="J2", F0=150.0, F1=0.0, PEPOCH=55000.0, DM=1.0,
+                  A1=3.0, PB=pb)
+    write_par(bt_fn, dict(common, BINARY="BT", T0=t0, OM=om_deg, E=ecc))
+    write_par(ell1_fn, dict(common, BINARY="ELL1", TASC=tasc,
+                            EPS1=ecc * np.sin(om), EPS2=ecc * np.cos(om)))
+    p_bt = create_polycos_from_binary(bt_fn, 55000.0, 55000.5)
+    p_ell = create_polycos_from_binary(ell1_fn, 55000.0, 55000.5)
+    for f in np.linspace(0.05, 0.45, 9):
+        r1 = p_bt.get_rotation(55000, f)
+        r2 = p_ell.get_rotation(55000, f)
+        assert abs(r1 - r2) < 1e-4, (f, r1, r2)
+
+
+def test_binary_polycos_rejects_unknown_model(tmp_path):
+    from pypulsar_tpu.fold.polycos import (PolycoError,
+                                           create_polycos_from_binary)
+
+    parfn = str(tmp_path / "weird.par")
+    write_par(parfn, dict(PSR="J3", F0=100.0, PEPOCH=55000.0, DM=1.0,
+                          BINARY="DDK", A1=3.0, PB=0.3))
+    with pytest.raises(PolycoError):
+        create_polycos_from_binary(parfn, 55000.0, 55000.5)
+
+
+def test_demodulate(tmp_path, monkeypatch, capsys):
+    from pypulsar_tpu.cli import demodulate
+
+    monkeypatch.chdir(tmp_path)
+    # Build a .dat whose samples encode their own index, with a binary
+    # pulsar parfile; demodulation should add/drop samples
+    N, dt = 200000, 1e-3
+    inf = InfoData()
+    inf.epoch = 55000.0
+    inf.dt = dt
+    inf.N = N
+    inf.telescope = "Arecibo"
+    inf.bary = 1
+    inf.lofreq = 1400.0
+    inf.BW = 100.0
+    inf.numchan = 1
+    inf.chan_width = 100.0
+    inf.DM = 0.0
+    inf.RA = "12:00:00.0000"
+    inf.DEC = "30:00:00.0000"
+    inf.object = "FAKE"
+    data = np.arange(N, dtype=np.float32)
+    basefn = str(tmp_path / "binpsr")
+    write_dat(basefn, data, inf)
+    parfn = str(tmp_path / "bin.par")
+    # strong orbit: asini=10 lt-s, Pb=0.05 d -> drift of many samples
+    write_par(parfn, dict(PSR="J0000+0000", F0=100.0, F1=0.0,
+                          PEPOCH=55000.0, DM=0.0, RAJ="12:00:00",
+                          DECJ="30:00:00", BINARY="BT", A1=10.0,
+                          PB=0.05, T0=55000.0, OM=0.0, E=0.0))
+    rc = demodulate.main([basefn + ".dat", "-f", parfn])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert os.path.exists(basefn + "_demod.dat")
+    newinf = InfoData(basefn + "_demod.inf")
+    demod = np.fromfile(basefn + "_demod.dat", dtype=np.float32)
+    assert newinf.N == demod.size
+    assert demod.size % 2 == 0
+    nrem = int(out.split("removed:")[1].split()[0])
+    nadd = int(out.split("added:")[1].split()[0])
+    assert nrem + nadd > 0
+    assert demod.size == N + nadd - nrem - ((N + nadd - nrem) % 2)
