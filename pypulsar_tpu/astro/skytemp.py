@@ -115,8 +115,11 @@ def get_skytemp(gal_long, gal_lat, freq=HASLAM_FREQ,
     (with a warning) only when NO map was configured anywhere; an
     explicitly requested ``mapfn`` or $PYPULSAR_TPU_HASLAM path that is
     missing still raises, so a typo cannot silently degrade fluxes."""
-    configured = mapfn or any(p and os.path.isfile(p)
-                              for p in _default_paths())
+    # configured = caller passed a path, the env var is SET (even if its
+    # target is missing — a typo should raise, not degrade), or the
+    # bundled default file exists
+    envpath, libpath = _default_paths()
+    configured = bool(mapfn) or bool(envpath) or os.path.isfile(libpath)
     if not configured:
         import warnings
         warnings.warn(

@@ -1,0 +1,36 @@
+"""``python -m pypulsar_tpu.cli <tool> [args...]`` — tool dispatcher."""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+TOOLS = [
+    "waterfaller", "zero_dm_filter", "freq_time", "spectrogram",
+    "dissect", "pulses_to_toa", "sum_profs", "pulse_energy_distribution",
+    "autozap", "plot_accelcands", "combinefil", "stitchdat",
+    "mockspecfil2subbands", "demodulate", "pfd_snr", "pfdinfo",
+    "gridding", "fitkepler", "shapiro", "pbdot", "massfunc",
+    "pyppdot", "pyplotres", "coordconv",
+]
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m pypulsar_tpu.cli <tool> [args...]\n")
+        print("available tools:")
+        for tool in TOOLS:
+            print("  %s" % tool)
+        return 0 if argv else 1
+    tool = argv[0]
+    if tool not in TOOLS:
+        print("unknown tool %r; run with --help for the list" % tool,
+              file=sys.stderr)
+        return 1
+    mod = importlib.import_module("pypulsar_tpu.cli.%s" % tool)
+    return mod.main(argv[1:])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

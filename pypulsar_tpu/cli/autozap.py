@@ -111,6 +111,11 @@ def hone_mask(freqs, powerspec, inmask, nsig) -> np.ndarray:
         spec_block = powerspec[block - lo:blockend + hi]
         freq_block = freqs[block - lo:blockend + hi]
         mask_block = inmask[block - lo:blockend + hi]
+        if mask_block.all():
+            # fully masked block: keep it masked (an empty unmasked
+            # selection would give a NaN std and silently clear it)
+            outmask[block:blockend] = True
+            continue
         detrended = old_detrend(np.log10(spec_block),
                                 xdata=np.log10(freq_block),
                                 mask=mask_block, order=2)

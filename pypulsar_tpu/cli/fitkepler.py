@@ -21,8 +21,8 @@ import numpy as np
 import scipy.optimize as opt
 
 from pypulsar_tpu.cli import show_or_save, use_headless_backend_if_needed
-from pypulsar_tpu.core.psrmath import (PIBYTWO, SECPERDAY, TWOPI,
-                                       mass_funct, mass_funct2)
+from pypulsar_tpu.core import psrmath
+from pypulsar_tpu.core.psrmath import PIBYTWO, SECPERDAY, TWOPI
 
 PARAMNAMES = ["Asini (lt-s)", "Porb (days)", "Ppsr (s)", "T0 (MJD)",
               "Ecc", "Omega (rad)"]
@@ -81,11 +81,8 @@ def fit_orbit(params: Sequence[float], ps, perrs, mjds, maxfev=10000):
 def min_comp_mass(Pb: float, x: float, mp: float = 1.4) -> float:
     """Minimum companion mass (edge-on) matching the fitted mass
     function; Pb in days, asini ``x`` in lt-s."""
-    def f(mc):
-        return (mass_funct(Pb * SECPERDAY, np.fabs(x))
-                - mass_funct2(mp, mc, PIBYTWO))
-
-    return float(opt.newton(f, 0.1))
+    return float(psrmath.companion_mass_limits(
+        Pb * SECPERDAY, np.fabs(x), mpsr=mp))
 
 
 def read_textfiles(fns: List[str], efac: float = 1.0):
@@ -172,7 +169,8 @@ def main(argv=None):
     print("\tMin companion mass: ", min_comp_mass(result[1], result[0]))
 
     for mjd in options.predict_mjds:
-        print("\t%.12f: %.15g s" % (mjd, float(kepler_period(mjd, *result))))
+        print("\t%.12f: %.15g s"
+              % (mjd, float(np.atleast_1d(kepler_period(mjd, *result))[0])))
 
     if not options.no_plot:
         use_headless_backend_if_needed(options.outfile)

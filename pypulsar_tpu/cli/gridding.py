@@ -16,22 +16,21 @@ from typing import List, Tuple
 import numpy as np
 import scipy.optimize as opt
 
-from pypulsar_tpu.astro import protractor
+from pypulsar_tpu.astro import protractor, sextant
 from pypulsar_tpu.astro.estimate_snr import airy_pattern
 from pypulsar_tpu.cli import show_or_save, use_headless_backend_if_needed
-from pypulsar_tpu.core.psrmath import DEGTORAD, RADTODEG
+from pypulsar_tpu.core.psrmath import DEGTORAD
 from pypulsar_tpu.fold import profile_snr
 from pypulsar_tpu.io.prestopfd import PfdFile
 
 
 def angsep_arcmin(ra1, dec1, ra2, dec2):
     """Angular separation in arcmin of positions given in arcmin
-    (reference gridding.py:52-67)."""
-    ra1, dec1, ra2, dec2 = [np.asarray(x) / 60.0 * DEGTORAD
-                            for x in (ra1, dec1, ra2, dec2)]
-    cossep = (np.sin(dec1) * np.sin(dec2) +
-              np.cos(dec1) * np.cos(dec2) * np.cos(ra1 - ra2))
-    return np.arccos(np.clip(cossep, -1.0, 1.0)) * RADTODEG * 60.0
+    (reference gridding.py:52-67; delegates to sextant.angsep)."""
+    sep_deg = sextant.angsep(np.asarray(ra1) / 60.0, np.asarray(dec1) / 60.0,
+                             np.asarray(ra2) / 60.0, np.asarray(dec2) / 60.0,
+                             input="deg", output="deg")
+    return np.asarray(sep_deg) * 60.0
 
 
 def fit_position(data: np.ndarray, fwhm: float,

@@ -1,0 +1,125 @@
+"""Plot TEMPO timing residuals.
+
+Behavioral spec: reference ``bin/pyplotres.py`` — run TEMPO on a
+par/tim pair (or reuse an existing ``resid2.tmp``), read the residual
+records, and plot pre/post-fit residuals against MJD, orbital phase, or
+TOA number in phase/seconds/microsecond units (TempoResults :58-198, axis
+options in the interactive UI).  The always-interactive reference UI is
+replaced by flags + ``-o`` headless output; TEMPO execution is gated on
+the binary's availability (an existing resid2.tmp works without it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+
+from pypulsar_tpu.cli import show_or_save, use_headless_backend_if_needed
+from pypulsar_tpu.io.residuals import read_residuals
+
+XAXIS_CHOICES = ("mjd", "orbitphase", "numtoa")
+YAXIS_CHOICES = ("phase", "usec", "sec")
+
+
+def run_tempo(parfn: str, timfn: str, cwd: str = ".") -> None:
+    """Run the TEMPO binary to (re)generate resid2.tmp."""
+    if shutil.which("tempo") is None:
+        raise FileNotFoundError(
+            "tempo binary not found on PATH; pass --resid-file with an "
+            "existing resid2.tmp instead")
+    subprocess.run(["tempo", "-f", parfn, timfn], cwd=cwd,
+                   capture_output=True, check=True)
+
+
+def get_xdata(resids, key: str):
+    if key == "mjd":
+        return resids.bary_TOA, "MJD"
+    if key == "orbitphase":
+        return resids.orbit_phs, "Orbital Phase"
+    if key == "numtoa":
+        return np.arange(resids.numTOAs), "TOA Number"
+    raise ValueError("unknown x axis %r" % key)
+
+
+def get_ydata(resids, key: str, postfit: bool = True):
+    phs = resids.postfit_phs if postfit else resids.prefit_phs
+    sec = resids.postfit_sec if postfit else resids.prefit_sec
+    if key == "phase":
+        with np.errstate(divide="ignore", invalid="ignore"):
+            freq = np.where(sec != 0, phs / sec, 0.0)
+        return phs, resids.uncertainty * freq, "Residuals (Phase)"
+    if key == "usec":
+        return sec * 1e6, resids.uncertainty * 1e6, r"Residuals ($\mu$s)"
+    if key == "sec":
+        return sec, resids.uncertainty, "Residuals (s)"
+    raise ValueError("unknown y axis %r" % key)
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="pyplotres.py",
+        description="Plot TEMPO timing residuals.")
+    parser.add_argument("-f", "--parfile", default=None,
+                        help="Parfile (with --timfile, runs TEMPO first)")
+    parser.add_argument("-t", "--timfile", default=None,
+                        help="TOA file")
+    parser.add_argument("--resid-file", default="resid2.tmp",
+                        help="Residual file to read "
+                             "(default: resid2.tmp)")
+    parser.add_argument("-x", "--xaxis", choices=XAXIS_CHOICES,
+                        default="mjd")
+    parser.add_argument("-y", "--yaxis", choices=YAXIS_CHOICES,
+                        default="usec")
+    parser.add_argument("--prefit", action="store_true",
+                        help="Plot prefit residuals (default: postfit)")
+    parser.add_argument("--both", action="store_true",
+                        help="Plot prefit and postfit panels")
+    parser.add_argument("-o", "--outfile", default=None,
+                        help="Write plot to file instead of showing")
+    return parser
+
+
+def main(argv=None):
+    options = build_parser().parse_args(argv)
+    if options.parfile and options.timfile:
+        run_tempo(options.parfile, options.timfile,
+                  cwd=os.path.dirname(os.path.abspath(options.parfile))
+                  or ".")
+    if not os.path.exists(options.resid_file):
+        print("No residual file (%s); run TEMPO first or pass "
+              "--resid-file." % options.resid_file, file=sys.stderr)
+        return 1
+    resids = read_residuals(options.resid_file)
+
+    use_headless_backend_if_needed(options.outfile)
+    import matplotlib.pyplot as plt
+
+    panels = [(False, "Prefit"), (True, "Postfit")] if options.both \
+        else [(not options.prefit, "Prefit" if options.prefit
+               else "Postfit")]
+    fig, axes = plt.subplots(len(panels), 1, sharex=True,
+                             figsize=(10, 4 * len(panels)), squeeze=False)
+    xdata, xlabel = get_xdata(resids, options.xaxis)
+    for ax_row, (postfit, title) in zip(axes, panels):
+        ax = ax_row[0]
+        ydata, yerr, ylabel = get_ydata(resids, options.yaxis, postfit)
+        ax.errorbar(xdata, ydata, yerr=yerr, fmt="k.", capsize=0)
+        ax.axhline(0, ls="--", c="0.6", lw=0.5)
+        ax.set_ylabel(ylabel)
+        ax.set_title("%s residuals (RMS: %.3g %s)"
+                     % (title, float(np.sqrt(np.mean(ydata ** 2))),
+                        {"phase": "turns", "usec": "us",
+                         "sec": "s"}[options.yaxis]))
+    axes[-1][0].set_xlabel(xlabel)
+    fig.tight_layout()
+    show_or_save(options.outfile)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

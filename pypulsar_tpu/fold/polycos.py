@@ -416,47 +416,57 @@ def create_polycos_from_binary(
             break
         span = max(4, span // 2)
 
-    blocks = []
-    tmid = float(start_mjd)
-    while tmid - 0.5 * (span / 1440.0) <= end_mjd:
-        # Fit around TMID exactly as evaluation will see it: Polyco splits
-        # tmid_str into TMIDi + TMIDf (the fraction parsed at full float64
-        # precision, which differs from frac(float(tmid_str)) by ~1e-12
-        # days ~ 1e-4 rotations at 200 Hz), so reconstruct that split in
-        # longdouble here.
-        tmid_str = f"{tmid:.11f}"
-        ipart, _, fpart = tmid_str.partition(".")
-        tmid_eval = np.longdouble(int(ipart)) + np.longdouble(
-            float("0." + fpart))
-        coeffs, n_tmid, _ = fit_block(tmid_eval, span)
-        f0_block = coeffs[1] / 60.0
-        pcoeffs = coeffs.copy()
-        pcoeffs[1] = 0.0  # linear term lives in F0_block
-        mjdi = int(tmid_eval)
-        frac_h = (tmid_eval - mjdi) * 24.0
-        hh = int(frac_h)
-        mm = int((frac_h - hh) * 60)
-        ss = (frac_h - hh) * 3600 - mm * 60
-        blocks.append(
-            Polyco(
-                psr=psrname,
-                date="DD-MMM-YY",
-                utc=f"{hh:02d}{mm:02d}{ss:05.2f}".replace(".", ""),
-                tmid_str=tmid_str,
-                dm=dm,
-                doppler=0.0,
-                log10rms=-10.0,
-                rphase=float(n_tmid),
-                f0=f0_block,
-                obs=obs,
-                dataspan=span,
-                numcoeff=numcoeffs,
-                obsfreq=obsfreq,
-                coeffs=pcoeffs,
+    while True:
+        blocks = []
+        span_ok = True
+        tmid = float(start_mjd)
+        while tmid - 0.5 * (span / 1440.0) <= end_mjd:
+            # Fit around TMID exactly as evaluation will see it: Polyco
+            # splits tmid_str into TMIDi + TMIDf (the fraction parsed at
+            # full float64 precision, which differs from
+            # frac(float(tmid_str)) by ~1e-12 days ~ 1e-4 rotations at
+            # 200 Hz), so reconstruct that split in longdouble here.
+            tmid_str = f"{tmid:.11f}"
+            ipart, _, fpart = tmid_str.partition(".")
+            tmid_eval = np.longdouble(int(ipart)) + np.longdouble(
+                float("0." + fpart))
+            coeffs, n_tmid, resid = fit_block(tmid_eval, span)
+            if resid > max_resid_phase and span > 4:
+                # a production block (e.g. a fast periastron sweep the
+                # start-epoch probes missed) needs a finer span; polycos
+                # must share one dataspan, so restart smaller
+                span_ok = False
+                break
+            f0_block = coeffs[1] / 60.0
+            pcoeffs = coeffs.copy()
+            pcoeffs[1] = 0.0  # linear term lives in F0_block
+            mjdi = int(tmid_eval)
+            frac_h = (tmid_eval - mjdi) * 24.0
+            hh = int(frac_h)
+            mm = int((frac_h - hh) * 60)
+            ss = (frac_h - hh) * 3600 - mm * 60
+            blocks.append(
+                Polyco(
+                    psr=psrname,
+                    date="DD-MMM-YY",
+                    utc=f"{hh:02d}{mm:02d}{ss:05.2f}".replace(".", ""),
+                    tmid_str=tmid_str,
+                    dm=dm,
+                    doppler=0.0,
+                    log10rms=-10.0,
+                    rphase=float(n_tmid),
+                    f0=f0_block,
+                    obs=obs,
+                    dataspan=span,
+                    numcoeff=numcoeffs,
+                    obsfreq=obsfreq,
+                    coeffs=pcoeffs,
+                )
             )
-        )
-        tmid += span / 1440.0
-    return Polycos(filenm="<generated-binary>", blocks=blocks)
+            tmid += span / 1440.0
+        if span_ok:
+            return Polycos(filenm="<generated-binary>", blocks=blocks)
+        span = max(4, span // 2)
 
 
 def create_polycos(

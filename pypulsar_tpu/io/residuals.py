@@ -1,0 +1,91 @@
+"""TEMPO ``resid2.tmp`` residual files: reader + writer.
+
+Replaces the external ``residuals.read_residuals`` import (reference
+bin/pyplotres.py:37-50).  ``resid2.tmp`` is a Fortran unformatted
+sequential file: every TOA is one record of nine float64s framed by
+4-byte record-length markers (72 bytes each):
+
+    bary_TOA      barycentric TOA (MJD)
+    postfit_phs   postfit residual (pulse periods)
+    postfit_sec   postfit residual (seconds)
+    orbit_phs     orbital phase at the TOA (turns)
+    bary_freq     barycentric observing frequency (MHz)
+    weight        TOA weight in the fit
+    uncertainty   TOA uncertainty (seconds)
+    prefit_sec    prefit residual (seconds)
+    ddm           (unused / DM correction slot)
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["Residuals", "read_residuals", "write_residuals"]
+
+_RECLEN = 72  # 9 float64s
+_FIELDS = ["bary_TOA", "postfit_phs", "postfit_sec", "orbit_phs",
+           "bary_freq", "weight", "uncertainty", "prefit_sec", "ddm"]
+
+
+class Residuals:
+    """Parsed residual set; arrays named after the record fields, plus
+    ``prefit_phs`` derived via the spin frequency implied by
+    postfit_phs/postfit_sec."""
+
+    def __init__(self, arrays):
+        self.numTOAs = len(arrays["bary_TOA"])
+        for name in _FIELDS:
+            setattr(self, name, arrays[name])
+        # derive prefit residual in periods where the phase/sec ratio of
+        # the postfit columns defines the folding frequency
+        with np.errstate(divide="ignore", invalid="ignore"):
+            freq = np.where(self.postfit_sec != 0,
+                            self.postfit_phs / self.postfit_sec, 0.0)
+        self.prefit_phs = self.prefit_sec * freq
+
+
+def read_residuals(filenm: str = "resid2.tmp") -> Residuals:
+    """Read a TEMPO resid2.tmp file."""
+    arrays = {name: [] for name in _FIELDS}
+    with open(filenm, "rb") as f:
+        while True:
+            head = f.read(4)
+            if len(head) < 4:
+                break
+            (reclen,) = struct.unpack("<i", head)
+            rec = f.read(reclen)
+            tail = f.read(4)
+            if len(rec) < reclen or len(tail) < 4:
+                raise ValueError(f"truncated record in {filenm}")
+            if reclen != _RECLEN:
+                raise ValueError(
+                    f"unexpected record length {reclen} (want {_RECLEN}) "
+                    f"in {filenm}")
+            vals = struct.unpack("<9d", rec)
+            for name, val in zip(_FIELDS, vals):
+                arrays[name].append(val)
+    return Residuals({k: np.asarray(v) for k, v in arrays.items()})
+
+
+def write_residuals(filenm: str, *, bary_TOA, postfit_phs, postfit_sec,
+                    orbit_phs=None, bary_freq=None, weight=None,
+                    uncertainty=None, prefit_sec=None) -> str:
+    """Write a resid2.tmp (test/interchange counterpart of the reader)."""
+    n = len(bary_TOA)
+
+    def arr(x, fill=0.0):
+        return (np.full(n, fill) if x is None
+                else np.asarray(x, dtype=np.float64))
+
+    cols = [arr(bary_TOA), arr(postfit_phs), arr(postfit_sec),
+            arr(orbit_phs), arr(bary_freq, 1400.0), arr(weight, 1.0),
+            arr(uncertainty, 1e-6), arr(prefit_sec), arr(None)]
+    with open(filenm, "wb") as f:
+        for i in range(n):
+            f.write(struct.pack("<i", _RECLEN))
+            f.write(struct.pack("<9d", *(c[i] for c in cols)))
+            f.write(struct.pack("<i", _RECLEN))
+    return filenm

@@ -1,0 +1,113 @@
+"""Tests for pyppdot (catalog + P-Pdot), pyplotres, and residuals IO."""
+
+import os
+
+import matplotlib
+import numpy as np
+import pytest
+
+matplotlib.use("Agg", force=True)
+
+from pypulsar_tpu.io.residuals import read_residuals, write_residuals
+
+
+def test_parse_bundled_catalog(capsys):
+    from pypulsar_tpu.cli.pyppdot import DEFAULT_CATALOG, parse_pulsar_file
+
+    pulsars = parse_pulsar_file(DEFAULT_CATALOG)
+    names = {p.name for p in pulsars}
+    # INCLUDE pulls in magnetars and RRATs
+    assert "B0531+21" in names          # Crab
+    assert "J1809-1943" in names        # magnetar include
+    assert "J1819-1458" in names        # RRAT include
+    crab = next(p for p in pulsars if p.name == "B0531+21")
+    assert crab.snr and not crab.binary
+    rrat = next(p for p in pulsars if p.name == "J1819-1458")
+    assert rrat.rrat
+    mag = next(p for p in pulsars if p.name == "J1808-2024")
+    assert mag.magnetar
+    hulse = next(p for p in pulsars if p.name == "B1913+16")
+    assert hulse.binary
+    ter5 = next(p for p in pulsars if p.name == "J1748-2446ad")
+    assert ter5.pdot_uplim
+
+
+def test_derived_parameters_crab():
+    from pypulsar_tpu.cli.pyppdot import params_from_ppdot
+
+    b, age, edot = params_from_ppdot(0.0334, 4.21e-13)
+    # Crab: B ~ 3.8e12 G, tau_c ~ 1250 yr, Edot ~ 4.5e38 erg/s
+    assert b == pytest.approx(3.8e12, rel=0.1)
+    assert age == pytest.approx(1.26e3, rel=0.1)
+    assert edot == pytest.approx(4.5e38, rel=0.15)
+    assert params_from_ppdot(None, 1e-15) == (None, None, None)
+
+
+def test_line_families_are_inverses():
+    from pypulsar_tpu.cli import pyppdot
+
+    p = 0.1
+    for pdot_f, p_f, val in [
+            (pyppdot.pdot_from_edot, pyppdot.p_from_edot, 1e33),
+            (pyppdot.pdot_from_bfield, pyppdot.p_from_bfield, 1e12),
+            (pyppdot.pdot_from_age, pyppdot.p_from_age, 1e6)]:
+        pdot = float(pdot_f(p, val))
+        assert float(p_f(pdot, val)) == pytest.approx(p, rel=1e-9)
+
+
+def test_pyppdot_cli(tmp_path, capsys):
+    from pypulsar_tpu.cli import pyppdot
+
+    out = str(tmp_path / "ppdot.png")
+    rc = pyppdot.main(["--def-lines", "--binaries", "--rrats",
+                       "--magnetars", "--snrs", "-o", out])
+    assert rc == 0 and os.path.getsize(out) > 1000
+
+
+def test_pyppdot_info(capsys):
+    from pypulsar_tpu.cli import pyppdot
+
+    assert pyppdot.main(["--info", "B0531+21"]) == 0
+    out = capsys.readouterr().out
+    assert "PSR B0531+21" in out and "B-field" in out
+    assert pyppdot.main(["--info", "NOSUCH"]) == 1
+
+
+def test_residuals_roundtrip(tmp_path):
+    fn = str(tmp_path / "resid2.tmp")
+    n = 25
+    rng = np.random.RandomState(0)
+    mjds = 55000.0 + np.sort(rng.rand(n) * 100)
+    phs = rng.randn(n) * 1e-3
+    freq_hz = 10.0
+    write_residuals(fn, bary_TOA=mjds, postfit_phs=phs,
+                    postfit_sec=phs / freq_hz,
+                    orbit_phs=np.linspace(0, 1, n),
+                    uncertainty=np.full(n, 5e-6),
+                    prefit_sec=phs / freq_hz + 1e-4)
+    r = read_residuals(fn)
+    assert r.numTOAs == n
+    np.testing.assert_allclose(r.bary_TOA, mjds)
+    np.testing.assert_allclose(r.postfit_phs, phs)
+    np.testing.assert_allclose(r.uncertainty, 5e-6)
+    # derived prefit phase: prefit_sec * (postfit_phs/postfit_sec)
+    np.testing.assert_allclose(r.prefit_phs,
+                               (phs / freq_hz + 1e-4) * freq_hz)
+
+
+def test_pyplotres_cli(tmp_path):
+    from pypulsar_tpu.cli import pyplotres
+
+    fn = str(tmp_path / "resid2.tmp")
+    n = 30
+    rng = np.random.RandomState(1)
+    write_residuals(fn, bary_TOA=55000 + np.arange(n, dtype=float),
+                    postfit_phs=rng.randn(n) * 1e-3,
+                    postfit_sec=rng.randn(n) * 1e-4,
+                    prefit_sec=rng.randn(n) * 1e-3)
+    out = str(tmp_path / "res.png")
+    rc = pyplotres.main(["--resid-file", fn, "--both", "-y", "usec",
+                         "-x", "mjd", "-o", out])
+    assert rc == 0 and os.path.getsize(out) > 1000
+    assert pyplotres.main(["--resid-file",
+                           str(tmp_path / "missing.tmp")]) == 1
