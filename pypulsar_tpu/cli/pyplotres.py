@@ -26,14 +26,19 @@ XAXIS_CHOICES = ("mjd", "orbitphase", "numtoa")
 YAXIS_CHOICES = ("phase", "usec", "sec")
 
 
-def run_tempo(parfn: str, timfn: str, cwd: str = ".") -> None:
-    """Run the TEMPO binary to (re)generate resid2.tmp."""
+def run_tempo(parfn: str, timfn: str) -> None:
+    """Run the TEMPO binary in the current directory (where it writes
+    resid2.tmp, which is also where --resid-file defaults to looking)."""
     if shutil.which("tempo") is None:
         raise FileNotFoundError(
             "tempo binary not found on PATH; pass --resid-file with an "
             "existing resid2.tmp instead")
-    subprocess.run(["tempo", "-f", parfn, timfn], cwd=cwd,
-                   capture_output=True, check=True)
+    proc = subprocess.run(["tempo", "-f", parfn, timfn],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            "tempo failed (exit %d):\n%s\n%s"
+            % (proc.returncode, proc.stdout[-2000:], proc.stderr[-2000:]))
 
 
 def get_xdata(resids, key: str):
@@ -87,9 +92,7 @@ def build_parser():
 def main(argv=None):
     options = build_parser().parse_args(argv)
     if options.parfile and options.timfile:
-        run_tempo(options.parfile, options.timfile,
-                  cwd=os.path.dirname(os.path.abspath(options.parfile))
-                  or ".")
+        run_tempo(options.parfile, options.timfile)
     if not os.path.exists(options.resid_file):
         print("No residual file (%s); run TEMPO first or pass "
               "--resid-file." % options.resid_file, file=sys.stderr)

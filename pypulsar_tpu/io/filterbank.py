@@ -89,28 +89,39 @@ class FilterbankFile:
         self.seek_to_sample(0)
         return np.fromfile(self.filfile, dtype=self.dtype)
 
-    def get_samples(self, startsamp: int, N: int) -> np.ndarray:
-        """Raw [time, chan] block as float32 (no Spectra wrapper)."""
-        startsamp = int(startsamp)
-        N = int(N)
+    def _read_raw_block(self, startsamp: int, N: int) -> np.ndarray:
+        """Validated seek+read of N samples in the file's native dtype
+        (flat array of N*nchans values)."""
+        startsamp, N = int(startsamp), int(N)
         if startsamp < 0 or startsamp + N > self.number_of_samples:
             raise ValueError(
                 f"requested samples [{startsamp}, {startsamp + N}) outside "
                 f"file range [0, {self.number_of_samples})"
             )
         self.seek_to_sample(startsamp)
-        data = self.read_Nsamples(N)
-        data.shape = (N, self.nchans)
+        return self.read_Nsamples(N)
+
+    def get_samples(self, startsamp: int, N: int) -> np.ndarray:
+        """Raw [time, chan] block as float32 (no Spectra wrapper)."""
+        data = self._read_raw_block(startsamp, N)
+        data.shape = (int(N), self.nchans)
         return data.astype(np.float32)
 
     def get_spectra(self, startsamp: int, N: int) -> Spectra:
-        """The loader boundary: [chan, time] Spectra of N samples."""
-        data = self.get_samples(startsamp, N)
+        """The loader boundary: [chan, time] Spectra of N samples.  Uses
+        the native fused widen+transpose when available."""
+        from pypulsar_tpu import native
+
+        if native.available():
+            raw = self._read_raw_block(startsamp, N)
+            data = native.transpose_to_chan_major(raw, int(N), self.nchans)
+        else:
+            data = self.get_samples(startsamp, N).T
         return Spectra(
             self.frequencies,
             self.tsamp,
-            data.T,
-            starttime=self.tsamp * startsamp,
+            data,
+            starttime=self.tsamp * int(startsamp),
             dm=0.0,
         )
 

@@ -393,9 +393,15 @@ class PsrfitsFile:
         (reference :70-108).  Multi-poln data keeps poln
         ``specinfo.default_poln`` (PRESTO-style; summed polns pass
         through)."""
+        from pypulsar_tpu import native
+
         subintdata = np.asarray(self.fits["SUBINT"].data[isub]["DATA"])
         if self.nbits in _UNPACKERS:
-            data = _UNPACKERS[self.nbits](subintdata.ravel()).astype(np.float32)
+            if native.available():
+                data = native.unpack_bits(subintdata.ravel(), self.nbits)
+            else:
+                data = _UNPACKERS[self.nbits](
+                    subintdata.ravel()).astype(np.float32)
         else:
             data = subintdata.astype(np.float32).ravel()
         offsets = self.get_offsets(isub) if apply_offsets else 0
@@ -411,6 +417,12 @@ class PsrfitsFile:
             offsets = np.asarray(offsets).reshape(-1)[sl]
         else:
             data = data.reshape((self.nsamp_per_subint, self.nchan))
+        if (native.available()
+                and np.ndim(scales) and np.ndim(offsets)
+                and np.ndim(weights)
+                and np.asarray(scales).size == self.nchan):
+            return native.scale_offset_weight(
+                np.ascontiguousarray(data), scales, offsets, weights)
         return ((data * scales) + offsets) * weights
 
     def get_weights(self, isub: int) -> np.ndarray:
