@@ -418,9 +418,8 @@ class PsrfitsFile:
         else:
             data = data.reshape((self.nsamp_per_subint, self.nchan))
         if (native.available()
-                and np.ndim(scales) and np.ndim(offsets)
-                and np.ndim(weights)
-                and np.asarray(scales).size == self.nchan):
+                and all(np.ndim(a) and np.asarray(a).size == self.nchan
+                        for a in (scales, offsets, weights))):
             return native.scale_offset_weight(
                 np.ascontiguousarray(data), scales, offsets, weights)
         return ((data * scales) + offsets) * weights

@@ -36,7 +36,10 @@ _tried = False
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB]
+    # compile to a temp path and rename atomically so concurrent
+    # importers never dlopen a half-written .so
+    tmp = _LIB + ".tmp.%d" % os.getpid()
+    cmd = ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", tmp]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)
@@ -45,6 +48,11 @@ def _build() -> bool:
     if proc.returncode != 0:
         warnings.warn("native codec build failed:\n" + proc.stderr[-2000:])
         return False
+    try:
+        os.replace(tmp, _LIB)
+    except OSError:
+        os.unlink(tmp)
+        return os.path.isfile(_LIB)
     return True
 
 
@@ -109,11 +117,10 @@ def unpack_bits(raw: np.ndarray, nbits: int) -> np.ndarray:
             raw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             _f32ptr(out), raw.size, nbits)
         return out
-    # NumPy fallback: shift out each field
-    shifts = np.arange(per, dtype=np.uint8) * nbits
-    mask = (1 << nbits) - 1
-    vals = (raw[:, None] >> shifts[None, :]) & mask
-    return vals.reshape(-1).astype(np.float32)
+    # NumPy fallback: delegate to the canonical unpackers (psrfits only
+    # imports this module lazily, so no cycle)
+    from pypulsar_tpu.io.psrfits import _UNPACKERS
+    return _UNPACKERS[nbits](raw).astype(np.float32)
 
 
 def widen(raw: np.ndarray) -> np.ndarray:
@@ -142,13 +149,23 @@ def scale_offset_weight(data: np.ndarray, scales, offsets,
     scales = np.ascontiguousarray(scales, dtype=np.float32)
     offsets = np.ascontiguousarray(offsets, dtype=np.float32)
     weights = np.ascontiguousarray(weights, dtype=np.float32)
+    if not (scales.size == nchan and offsets.size == nchan
+            and weights.size == nchan):
+        raise ValueError(
+            f"per-channel arrays must have size nchan={nchan}; got "
+            f"scales {scales.size}, offsets {offsets.size}, "
+            f"weights {weights.size}")
     lib = _load()
     if lib is not None:
         lib.scale_offset_weight(_f32ptr(data), _f32ptr(scales),
                                 _f32ptr(offsets), _f32ptr(weights),
                                 nspec, nchan)
         return data
-    return (data * scales + offsets) * weights
+    # match the native path's in-place semantics
+    np.multiply(data, scales, out=data)
+    np.add(data, offsets, out=data)
+    np.multiply(data, weights, out=data)
+    return data
 
 
 def zero_dm(data: np.ndarray) -> np.ndarray:
@@ -160,7 +177,9 @@ def zero_dm(data: np.ndarray) -> np.ndarray:
     if lib is not None:
         lib.zero_dm(_f32ptr(data), nspec, nchan)
         return data
-    return data - data.mean(axis=1, keepdims=True).astype(np.float32)
+    # match the native path's in-place semantics
+    data -= data.mean(axis=1, keepdims=True).astype(np.float32)
+    return data
 
 
 def transpose_to_chan_major(raw: np.ndarray, nspec: int, nchan: int

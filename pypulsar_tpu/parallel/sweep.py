@@ -44,6 +44,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pypulsar_tpu.core import psrmath
+from pypulsar_tpu.ops.pallas_kernels import boxcar_stats
 
 DEFAULT_WIDTHS = (1, 2, 4, 8, 16, 32)
 
@@ -200,19 +201,10 @@ def _sweep_chunk_impl(
         ts = jax.vmap(lambda sh: _slice_rows(sub, sh, out_len).sum(axis=0))(
             shift2
         )  # [g, out_len]
-        payload = ts[:, :stat_len]
-        s = payload.sum(axis=-1)
-        ss = (payload * payload).sum(axis=-1)
-        cs = jnp.concatenate(
-            [jnp.zeros((g, 1), ts.dtype), jnp.cumsum(ts, axis=-1)], axis=-1
-        )
-        maxs, args = [], []
-        for w in widths:
-            # windows starting within the payload region
-            box = cs[:, w : w + stat_len] - cs[:, :stat_len]
-            maxs.append(box.max(axis=-1))
-            args.append(box.argmax(axis=-1))
-        return carry, (s, ss, jnp.stack(maxs, -1), jnp.stack(args, -1).astype(jnp.int32))
+        # fused detection stats: Pallas kernel on TPU, lax elsewhere
+        # (windows start within the payload region)
+        s, ss, mb_g, ab_g = boxcar_stats(ts, widths, stat_len)
+        return carry, (s, ss, mb_g, ab_g)
 
     _, (s, ss, mb, ab) = jax.lax.scan(per_group, 0, (stage1_bins, stage2_bins))
     D = G * g
