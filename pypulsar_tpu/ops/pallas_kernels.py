@@ -119,7 +119,11 @@ def _pallas_boxcar_stats(ts, widths: Tuple[int, ...], stat_len: int,
     t_block = max(halo, (t_block // halo) * halo)
     n_t = -(-stat_len // t_block)
     pad_d = (-D) % D_BLOCK
-    # pad the time axis so every tile's halo read stays in bounds
+    # pad the time axis so every tile's halo read stays in bounds.  With
+    # the default widths (maxw=32 < halo=128) this fires on every call;
+    # the copy is of the [D, T] detection series only (a few percent of
+    # the dedispersion stage's traffic), the price of a lane-aligned
+    # halo block.
     pad_t = max(n_t * t_block + halo - T, 0)
     if pad_d or pad_t:
         ts = jnp.pad(ts, ((0, pad_d), (0, pad_t)))
