@@ -8,6 +8,13 @@ doing the reference's brute-force per-channel-roll dedispersion
 (reference formats/spectra.py:229-260 semantics) with the same detection step,
 measured on a slice and scaled linearly (NumPy cost is linear in trials).
 
+Robustness contract (round-1 postmortem): this script ALWAYS prints exactly one
+JSON line of the required shape and exits 0, whatever the TPU tunnel does.
+Backend acquisition retries with bounded backoff; if the accelerator backend
+cannot initialize, the benchmark re-execs itself on the CPU backend (reduced
+shapes) so the round still records a measured number, with the fallback noted
+in ``unit``.
+
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
@@ -16,13 +23,15 @@ Usage: python bench.py [--quick] [--trials D] [--nsamp T] [--nchan C]
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 
-def main():
+def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small shapes for smoke tests")
     ap.add_argument("--trials", type=int, default=None, help="number of DM trials")
@@ -31,9 +40,47 @@ def main():
     ap.add_argument("--dm-max", type=float, default=500.0)
     ap.add_argument("--baseline-trials", type=int, default=None,
                     help="NumPy trials to actually run before extrapolating")
-    args = ap.parse_args()
+    ap.add_argument("--profile", action="store_true",
+                    help="print a per-stage timing breakdown to stderr")
+    ap.add_argument("--cpu-fallback", action="store_true",
+                    help="(internal) run on the CPU backend with reduced shapes")
+    ap.add_argument("--child", action="store_true",
+                    help="(internal) run the measurement in this process")
+    return ap.parse_args(argv)
 
-    if args.quick:
+
+def acquire_backend(retries=3, backoff=20.0):
+    """jax.devices() with bounded retry; returns the device list or raises."""
+    last = None
+    for attempt in range(retries):
+        try:
+            import jax
+
+            devs = jax.devices()
+            # a device list can exist while the tunnel is wedged; prove
+            # liveness with a tiny round-trip before committing to the run
+            import jax.numpy as jnp
+
+            val = float(jnp.ones((8, 8)).sum())
+            assert val == 64.0
+            return devs
+        except Exception as e:  # noqa: BLE001 - any backend failure retries
+            last = e
+            print(f"# backend attempt {attempt + 1}/{retries} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            if attempt + 1 < retries:
+                time.sleep(backoff)
+                try:
+                    import jax.extend.backend
+
+                    jax.extend.backend.clear_backends()
+                except Exception:
+                    pass
+    raise RuntimeError(f"backend unavailable after {retries} attempts: {last}")
+
+
+def run_benchmark(args):
+    if args.cpu_fallback or args.quick:
         C = args.nchan or 128
         T = args.nsamp or 1 << 15
         D = args.trials or 64
@@ -48,15 +95,16 @@ def main():
         nsub, group = 64, 32
         chunk = 1 << 18
 
+    devs = acquire_backend()
+
     import jax
     import jax.numpy as jnp
     from pypulsar_tpu.core.spectra import Spectra
     from pypulsar_tpu.ops import numpy_ref
     from pypulsar_tpu.parallel import make_sweep_plan, sweep_spectra
-    from pypulsar_tpu.parallel.sweep import sweep_chunk
 
     dt = 64e-6
-    dev = jax.devices()[0]
+    dev = devs[0]
     print(f"# device: {dev}, C={C} chans, T={T} samples ({T*dt:.0f}s), "
           f"D={D} DM trials 0-{args.dm_max}", file=sys.stderr)
 
@@ -86,9 +134,19 @@ def main():
         warm = Spectra(freqs, dt, data[:, :wl])
         sweep_spectra(warm, dms, nsub=nsub, group_size=group, chunk_payload=chunk)
 
-    t0 = time.perf_counter()
-    res = sweep_spectra(spec, dms, nsub=nsub, group_size=group, chunk_payload=chunk)
-    jax_time = time.perf_counter() - t0
+    if args.profile:
+        from pypulsar_tpu.utils.profiling import stage_report
+
+        profile_ctx = stage_report(file=sys.stderr)
+    else:
+        import contextlib
+
+        profile_ctx = contextlib.nullcontext()
+    with profile_ctx:
+        t0 = time.perf_counter()
+        res = sweep_spectra(spec, dms, nsub=nsub, group_size=group,
+                            chunk_payload=chunk)
+        jax_time = time.perf_counter() - t0
     trials_per_sec = D / jax_time
 
     # --- NumPy single-core baseline: reference-style brute force, nb trials ---
@@ -106,12 +164,78 @@ def main():
 
     print(f"# jax: {jax_time:.3f}s for {D} trials; numpy: {bl_time:.3f}s for {nb} "
           f"trials on {bl_T/T:.3f} of data; best cand: {res.best(1)[0]}", file=sys.stderr)
-    print(json.dumps({
+    unit = f"DM-trials/s ({C}-chan, {T*dt:.0f}s @ 64us, nsub={nsub})"
+    if args.cpu_fallback:
+        unit += " [CPU FALLBACK: accelerator backend unavailable]"
+    return {
         "metric": "dm_trials_per_sec",
         "value": round(trials_per_sec, 2),
-        "unit": f"DM-trials/s ({C}-chan, {T*dt:.0f}s @ 64us, nsub={nsub})",
+        "unit": unit,
         "vs_baseline": round(speedup, 2),
-    }))
+    }
+
+
+def run_child(args, cpu: bool, timeout: float):
+    """Run the measurement in a child interpreter; return its JSON record.
+
+    The accelerator attempt keeps the full environment; the CPU attempt pins
+    ``JAX_PLATFORMS=cpu`` and strips the axon sitecustomize trigger vars so
+    the child cannot touch (or hang on) the TPU tunnel at interpreter start.
+    A child is the only way to bound a backend that hangs instead of raising
+    — ``jax.devices()`` on a wedged tunnel blocks in native code."""
+    env = dict(os.environ)
+    argv = [sys.executable, os.path.abspath(__file__), "--child"]
+    if cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        for var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"):
+            env.pop(var, None)
+        argv.append("--cpu-fallback")
+    for flag, val in (("--trials", args.trials), ("--nchan", args.nchan),
+                      ("--nsamp", args.nsamp),
+                      ("--baseline-trials", args.baseline_trials)):
+        if val is not None:
+            argv += [flag, str(val)]
+    argv += ["--dm-max", str(args.dm_max)]
+    if args.quick:
+        argv.append("--quick")
+    if args.profile:
+        argv.append("--profile")
+    proc = subprocess.run(argv, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+    sys.stderr.write(proc.stderr[-6000:])
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise RuntimeError(f"bench child produced no JSON (rc={proc.returncode})")
+
+
+def main():
+    args = parse_args()
+    if args.child:
+        # measurement mode: run in this interpreter, print JSON, propagate rc
+        print(json.dumps(run_benchmark(args)))
+        return
+    record = None
+    try:
+        record = run_child(args, cpu=False, timeout=2400)
+    except Exception as e:  # noqa: BLE001 - the JSON line must happen
+        print(f"# benchmark failed on primary backend: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        try:
+            record = run_child(args, cpu=True, timeout=1800)
+        except Exception as e2:  # noqa: BLE001
+            print(f"# cpu fallback failed too: {type(e2).__name__}: {e2}",
+                  file=sys.stderr)
+    if record is None:
+        record = {
+            "metric": "dm_trials_per_sec",
+            "value": 0.0,
+            "unit": "DM-trials/s [FAILED: no backend produced a measurement]",
+            "vs_baseline": 0.0,
+        }
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -46,6 +46,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pypulsar_tpu.core import psrmath
 from pypulsar_tpu.ops.pallas_kernels import boxcar_stats
+from pypulsar_tpu.utils import profiling
 
 DEFAULT_WIDTHS = (1, 2, 4, 8, 16, 32)
 
@@ -447,7 +448,8 @@ def sweep_stream(
     def drain(limit):
         while len(pending) > limit:
             start, stat_len, (s, ss, mb, ab) = pending.pop(0)
-            acc.update(start, stat_len, s, ss, mb, ab)
+            with profiling.stage("device_wait+accumulate"):
+                acc.update(start, stat_len, s, ss, mb, ab)
 
     need = out_len + slack2 + plan.max_shift1
 
@@ -455,7 +457,8 @@ def sweep_stream(
         if L < need:  # end-of-data: pad with zeros (reference pads padval=0)
             data = jnp.pad(data, ((0, 0), (0, need - L)))
         stat_len = min(chunk_payload, L)
-        pending.append((start, stat_len, run_chunk(data, stat_len)))
+        with profiling.stage("dispatch_sweep_chunk"):
+            pending.append((start, stat_len, run_chunk(data, stat_len)))
 
     # A short block is only legal at end-of-data: hold one block back so we
     # can tell whether the stream continues past its end. A block that is
@@ -463,10 +466,11 @@ def sweep_stream(
     # depress every seam SNR — raise instead.
     prev = None
     for start, block in blocks:
-        if chan_major:
-            data = jnp.asarray(block, dtype=jnp.float32)
-        else:
-            data = jnp.asarray(np.ascontiguousarray(block.T), dtype=jnp.float32)
+        with profiling.stage("host_to_device"):
+            if chan_major:
+                data = jnp.asarray(block, dtype=jnp.float32)
+            else:
+                data = jnp.asarray(np.ascontiguousarray(block.T), dtype=jnp.float32)
         L = data.shape[1]
         if prev is not None:
             pstart, pdata, pL = prev

@@ -1,0 +1,112 @@
+"""Per-stage timing + optional ``jax.profiler`` tracing.
+
+The reference has no profiling subsystem (SURVEY.md §5: only the
+``show_progress`` percent bar, reference utils/__init__.py:6-44); TPU perf
+work needs attribution, so this is new surface. Design goals: zero overhead
+when inactive (one module-global check), no hard jax dependency at import
+time, and usable both as a library API and from ``bench.py --profile``.
+
+Usage::
+
+    from pypulsar_tpu.utils import profiling
+
+    with profiling.stage_report():          # activates collection; prints
+        run_sweep(...)                      # breakdown on exit
+
+    # inside instrumented code:
+    with profiling.stage("dedisperse"):
+        out = kernel(x)
+
+    # optional XLA-level trace viewable in TensorBoard/Perfetto:
+    with profiling.trace("/tmp/jax-trace"):
+        run_sweep(...)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import time
+from typing import Dict, Optional, TextIO
+
+_active: Optional[Dict[str, list]] = None  # name -> [total_seconds, count]
+
+
+def is_active() -> bool:
+    return _active is not None
+
+
+def record(name: str, seconds: float) -> None:
+    """Add ``seconds`` to stage ``name`` (no-op unless a report is active)."""
+    if _active is None:
+        return
+    ent = _active.setdefault(name, [0.0, 0])
+    ent[0] += seconds
+    ent[1] += 1
+
+
+@contextlib.contextmanager
+def stage(name: str):
+    """Time a block under ``name``. Near-zero cost when no report is active."""
+    if _active is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record(name, time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def stage_report(file: TextIO = None):
+    """Collect stage timings inside the block; print a breakdown on exit.
+
+    Nesting reuses the outer collector (one report is printed, by the
+    outermost context)."""
+    global _active
+    outer = _active
+    if outer is None:
+        _active = {}
+    t0 = time.perf_counter()
+    try:
+        yield _Report(_active)
+    finally:
+        total = time.perf_counter() - t0
+        stages, _active = _active, outer
+        if outer is None:
+            _print_report(stages, total, file or sys.stderr)
+
+
+class _Report:
+    def __init__(self, stages):
+        self.stages = stages
+
+    def totals(self) -> Dict[str, float]:
+        return {k: v[0] for k, v in self.stages.items()}
+
+
+def _print_report(stages: Dict[str, list], total: float, file: TextIO) -> None:
+    print(f"# stage breakdown (wall {total:.3f}s):", file=file)
+    accounted = 0.0
+    for name, (secs, count) in sorted(stages.items(), key=lambda kv: -kv[1][0]):
+        accounted += secs
+        print(f"#   {name:<24s} {secs:9.3f}s  {100.0 * secs / max(total, 1e-12):5.1f}%"
+              f"  ({count} calls)", file=file)
+    other = total - accounted
+    if stages:
+        print(f"#   {'(untracked)':<24s} {other:9.3f}s  "
+              f"{100.0 * other / max(total, 1e-12):5.1f}%", file=file)
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Wrap a block in a ``jax.profiler`` trace (XLA op-level timeline).
+
+    View with TensorBoard's profile plugin or Perfetto. Separate from
+    :func:`stage_report` so CPU-side attribution works without the (large)
+    trace machinery."""
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield

@@ -15,6 +15,9 @@ def test_parse_bundled_catalog(capsys):
     from pypulsar_tpu.cli.pyppdot import DEFAULT_CATALOG, parse_pulsar_file
 
     pulsars = parse_pulsar_file(DEFAULT_CATALOG)
+    # full catalog: 1830 reference rows (minus '*'-period entries and
+    # commented duplicates) + magnetar/RRAT includes
+    assert len(pulsars) > 1700
     names = {p.name for p in pulsars}
     # INCLUDE pulls in magnetars and RRATs
     assert "B0531+21" in names          # Crab
@@ -29,7 +32,9 @@ def test_parse_bundled_catalog(capsys):
     hulse = next(p for p in pulsars if p.name == "B1913+16")
     assert hulse.binary
     ter5 = next(p for p in pulsars if p.name == "J1748-2446ad")
-    assert ter5.pdot_uplim
+    assert ter5.pdot == 0.0 and ter5.binary  # catalog lists no Pdot for Ter5ad
+    uplims = [p for p in pulsars if p.pdot_uplim]
+    assert uplims, "catalog should contain '<' Pdot upper limits"
 
 
 def test_derived_parameters_crab():

@@ -1,0 +1,63 @@
+"""Tests for the per-stage profiling subsystem (new surface; reference has
+none — SURVEY.md §5 tracing row)."""
+
+import io
+import time
+
+from pypulsar_tpu.utils import profiling
+
+
+def test_inactive_is_noop():
+    assert not profiling.is_active()
+    with profiling.stage("x"):
+        pass
+    profiling.record("x", 1.0)  # must not raise or leak state
+    assert not profiling.is_active()
+
+
+def test_stage_report_collects_and_prints():
+    buf = io.StringIO()
+    with profiling.stage_report(file=buf) as rep:
+        assert profiling.is_active()
+        with profiling.stage("alpha"):
+            time.sleep(0.01)
+        with profiling.stage("alpha"):
+            pass
+        with profiling.stage("beta"):
+            pass
+        totals = rep.totals()
+    assert not profiling.is_active()
+    assert totals["alpha"] >= 0.01
+    assert set(totals) == {"alpha", "beta"}
+    out = buf.getvalue()
+    assert "stage breakdown" in out
+    assert "alpha" in out and "(2 calls)" in out
+
+
+def test_nested_report_uses_outer_collector():
+    buf = io.StringIO()
+    with profiling.stage_report(file=buf) as outer:
+        with profiling.stage("before"):
+            pass
+        with profiling.stage_report(file=buf):
+            with profiling.stage("inner"):
+                pass
+        assert set(outer.totals()) == {"before", "inner"}
+    # only the outermost context prints
+    assert buf.getvalue().count("stage breakdown") == 1
+
+
+def test_sweep_emits_stages():
+    import numpy as np
+
+    from pypulsar_tpu.core.spectra import Spectra
+    from pypulsar_tpu.parallel import sweep_spectra
+
+    rng = np.random.RandomState(0)
+    freqs = 1500.0 - 2.0 * np.arange(32)
+    spec = Spectra(freqs, 1e-3, rng.randn(32, 2048).astype(np.float32))
+    buf = io.StringIO()
+    with profiling.stage_report(file=buf) as rep:
+        sweep_spectra(spec, np.linspace(0, 50, 8), nsub=8, group_size=4)
+    assert "dispatch_sweep_chunk" in rep.totals()
+    assert "device_wait+accumulate" in rep.totals()
