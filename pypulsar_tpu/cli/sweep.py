@@ -1,0 +1,177 @@
+"""DM sweep from the command line — the framework's prepsubband-equivalent.
+
+Reads a SIGPROC filterbank or PSRFITS file, runs the sharded TPU sweep
+engine over a DM range (flat grid or a DDplan2b staged plan executed
+per-step at its own downsample factor), and writes a single-pulse
+candidate list; optionally per-DM dedispersed .dat/.inf time series.
+
+This is the user-facing workload BASELINE.md configs[2] names: the
+reference generates the plan (utils/DDplan2b.py:202-273) and hands
+execution to PRESTO's prepsubband/single_pulse_search; here the whole
+pipeline runs inside the framework on device.
+
+Candidate file format (``{outbase}.cands``)::
+
+    # DM      SNR    time_s     sample  width_bins  downsamp
+    80.0000   12.31  0.700000   700     2           1
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def _open_reader(fn: str):
+    from pypulsar_tpu.io import filterbank, psrfits
+
+    if psrfits.is_PSRFITS(fn):
+        return psrfits.PsrfitsFile(fn)
+    return filterbank.FilterbankFile(fn)
+
+
+def _write_cands(path, cands):
+    with open(path, "w") as f:
+        f.write("# DM      SNR      time_s       sample    width_bins  downsamp\n")
+        for c in cands:
+            f.write(f"{c['dm']:<9.4f} {c['snr']:<8.3f} {c['time_sec']:<12.6f} "
+                    f"{c['sample']:<9d} {c['width_bins']:<11d} {c['downsamp']}\n")
+
+
+def _write_dats(outbase, reader, dms, downsamp):
+    """Write per-DM dedispersed time series (.dat + .inf), flat mode only."""
+    from pypulsar_tpu.io.datfile import write_dat
+    from pypulsar_tpu.io.infodata import InfoData
+    from pypulsar_tpu.parallel.staged import _make_source
+
+    spec = reader.get_spectra(0, _make_source(reader).nsamples)
+    if downsamp > 1:
+        spec = spec.downsample(downsamp)
+    freqs = np.asarray(spec.freqs)
+    for dm in dms:
+        ts = np.asarray(spec.dedispersed_timeseries(float(dm)),
+                        dtype=np.float32)
+        inf = InfoData()
+        inf.basenm = f"{outbase}_DM{dm:.2f}"
+        inf.telescope = getattr(reader, "telescope", "unknown") or "unknown"
+        inf.object = getattr(reader, "source_name", "synthetic") or "synthetic"
+        inf.epoch = float(getattr(reader, "tstart", 0.0) or 0.0)
+        inf.N = len(ts)
+        inf.dt = float(spec.dt)
+        inf.DM = float(dm)
+        inf.numchan = len(freqs)
+        inf.lofreq = float(freqs.min())
+        inf.BW = float(abs(freqs.max() - freqs.min()))
+        inf.chan_width = float(inf.BW / max(inf.numchan - 1, 1))
+        inf.bary = 0
+        inf.analyzer = "pypulsar_tpu"
+        write_dat(f"{outbase}_DM{dm:.2f}", ts, inf)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="sweep",
+        description="DM-trial sweep of a .fil/.fits file on the TPU engine")
+    ap.add_argument("infile", help=".fil or PSRFITS input")
+    ap.add_argument("-o", "--outbase", default=None,
+                    help="output basename (default: input sans extension)")
+    ap.add_argument("--lodm", type=float, default=0.0, help="lowest trial DM")
+    ap.add_argument("--dmstep", type=float, default=1.0,
+                    help="flat-mode DM step (pc/cm^3)")
+    ap.add_argument("--numdms", type=int, default=None,
+                    help="flat-mode number of DM trials")
+    ap.add_argument("--ddplan", action="store_true",
+                    help="derive a staged DDplan2b plan from --lodm/--hidm "
+                         "and execute each step at its own downsampling")
+    ap.add_argument("--hidm", type=float, default=None,
+                    help="highest DM (required with --ddplan)")
+    ap.add_argument("--plan-numsub", type=int, default=0,
+                    help="DDplan subband count hint (prepsubband staging)")
+    ap.add_argument("--resolution", type=float, default=0.0,
+                    help="DDplan acceptable time resolution (ms)")
+    ap.add_argument("-s", "--nsub", type=int, default=64,
+                    help="sweep-engine subbands (two-stage dedispersion)")
+    ap.add_argument("--group-size", type=int, default=32,
+                    help="DM trials per stage-1 group")
+    ap.add_argument("--downsamp", type=int, default=1,
+                    help="flat-mode downsample factor")
+    ap.add_argument("--chunk", type=int, default=None,
+                    help="streaming chunk payload in (downsampled) samples")
+    ap.add_argument("--widths", default="1,2,4,8,16,32",
+                    help="comma-separated boxcar widths in bins")
+    ap.add_argument("--threshold", type=float, default=6.0,
+                    help="SNR threshold for the .cands file")
+    ap.add_argument("-k", "--topk", type=int, default=10,
+                    help="candidates to print")
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="shard DM trials over this many devices")
+    ap.add_argument("--write-dats", action="store_true",
+                    help="flat mode: also write per-DM .dat/.inf series")
+    args = ap.parse_args(argv)
+
+    from pypulsar_tpu.parallel import make_mesh
+    from pypulsar_tpu.parallel.staged import sweep_ddplan, sweep_flat
+
+    if args.ddplan and args.write_dats:
+        ap.error("--write-dats is a flat-mode option (DDplan steps use "
+                 "varying time resolutions)")
+    if args.ddplan and args.downsamp != 1:
+        ap.error("--downsamp is a flat-mode option (DDplan sets per-step "
+                 "downsampling itself)")
+    widths = tuple(int(w) for w in args.widths.split(","))
+    outbase = args.outbase or os.path.splitext(args.infile)[0]
+    reader = _open_reader(args.infile)
+    mesh = None
+    if args.mesh:
+        import jax
+
+        mesh = make_mesh([args.mesh], ("dm",),
+                         devices=jax.devices()[: args.mesh])
+
+    if args.ddplan:
+        if args.hidm is None:
+            ap.error("--ddplan requires --hidm")
+        from pypulsar_tpu.plan.ddplan import Observation
+
+        freqs = np.asarray(reader.frequencies, dtype=np.float64)
+        bw = abs(freqs.max() - freqs.min()) + abs(
+            freqs[1] - freqs[0] if len(freqs) > 1 else 0.0)
+        obs = Observation(dt=float(reader.tsamp),
+                          fctr=float(freqs.mean()),
+                          BW=float(bw), numchan=len(freqs))
+        plan = obs.gen_ddplan(args.lodm, args.hidm,
+                              numsub=args.plan_numsub,
+                              resolution=args.resolution)
+        print(f"# DDplan: {len(plan.DDsteps)} steps, "
+              f"{sum(s.numDMs for s in plan.DDsteps)} total DM trials")
+        staged = sweep_ddplan(reader, plan, nsub=args.nsub,
+                              group_size=args.group_size, widths=widths,
+                              chunk_payload=args.chunk, mesh=mesh,
+                              verbose=True)
+    else:
+        if args.numdms is None:
+            ap.error("flat mode requires --numdms (or use --ddplan)")
+        dms = args.lodm + args.dmstep * np.arange(args.numdms)
+        staged = sweep_flat(reader, dms, downsamp=args.downsamp,
+                            nsub=args.nsub, group_size=args.group_size,
+                            widths=widths, chunk_payload=args.chunk,
+                            mesh=mesh)
+        if args.write_dats:
+            _write_dats(outbase, reader, dms, args.downsamp)
+
+    hits = staged.above_threshold(args.threshold)
+    _write_cands(outbase + ".cands", hits)
+    print(f"# {staged.n_trials} DM trials swept; {len(hits)} detections "
+          f">= {args.threshold} sigma -> {outbase}.cands")
+    for c in staged.best(args.topk):
+        print(f"DM {c['dm']:8.3f}  SNR {c['snr']:7.2f}  t {c['time_sec']:10.4f}s"
+              f"  width {c['width_bins']:3d} bins ({c['width_sec']*1e3:.2f} ms)"
+              f"  ds {c['downsamp']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

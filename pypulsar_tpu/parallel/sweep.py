@@ -396,6 +396,7 @@ def sweep_stream(
     chunk_payload: int,
     mesh: Optional[Mesh] = None,
     chan_major: bool = False,
+    baseline=None,
 ) -> SweepResult:
     """Run the sweep over a stream of (startsamp, block) chunks.
 
@@ -407,6 +408,33 @@ def sweep_stream(
     When ``mesh`` is given, trial groups are sharded over its 'dm' axis via
     shard_map — zero cross-device communication until the final (host-side)
     top-k, the layout the north star prescribes.
+
+    SNR accumulation-order contract (the "bit-exact SNR" policy, BASELINE.md):
+
+    1. A single per-channel baseline — ``baseline`` if given (sweep_spectra
+       passes the whole-series per-channel mean so results are independent
+       of chunking), else the f32 per-channel mean of the first streamed
+       block — is subtracted from every block before dedispersion.
+       The SNR is exactly invariant under per-channel constant shifts (every
+       window sum of trial d loses ``w * B`` and the series mean loses ``B``
+       where ``B = sum_c baseline_c``), so this changes no result in exact
+       arithmetic; numerically it removes the DC term so all f32 rounding is
+       relative to the *fluctuation* scale, not the offset (8-bit PSRFITS
+       data has offsets ~100x sigma, which otherwise costs ~3 decimal digits
+       of SNR through catastrophic cancellation in ``maxbox - w*mean``).
+    2. On device (f32): stage-1 channel-group sums and stage-2 subband sums
+       in XLA reduction order; per-chunk payload sum/sumsq; per-width window
+       sums (cumsum-difference in the lax path, dyadic doubling in the
+       Pallas kernel) and their running max.
+    3. On host (f64): cross-chunk accumulation of the moments, the
+       cross-chunk max of the f32 window sums, and the final SNR formula
+       ``(maxbox - w*mean) / (sqrt(w)*std)``.
+
+    Guaranteed (and tested, tests/test_sweep.py) bound vs the float64 NumPy
+    twin: |dSNR| <= 1e-4 absolute with relative error at f32-ulp scale
+    (measured ~1e-6), independent of per-channel DC offsets. End-of-data is
+    zero-padded *after* baseline subtraction, i.e. padded samples sit at the
+    channel baseline level in original units.
     """
     W = max(plan.widths)
     out_len = chunk_payload + W
@@ -465,12 +493,19 @@ def sweep_stream(
     # short while later data exists would silently zero-pad real samples and
     # depress every seam SNR — raise instead.
     prev = None
+    if baseline is not None:
+        baseline = jnp.asarray(baseline, dtype=jnp.float32).reshape(-1, 1)
     for start, block in blocks:
         with profiling.stage("host_to_device"):
             if chan_major:
                 data = jnp.asarray(block, dtype=jnp.float32)
             else:
                 data = jnp.asarray(np.ascontiguousarray(block.T), dtype=jnp.float32)
+        if baseline is None:
+            # per-channel baseline from the first block (see the SNR
+            # accumulation-order contract in the docstring)
+            baseline = jnp.mean(data, axis=1, keepdims=True)
+        data = data - baseline
         L = data.shape[1]
         if prev is not None:
             pstart, pdata, pL = prev
@@ -497,12 +532,15 @@ def sweep_stream(
     snr = (acc.mb - ws[None, :] * mean[:, None]) / (
         np.sqrt(ws)[None, :] * np.where(std > 0, std, 1.0)[:, None]
     )
+    # report mean in original (pre-baseline-subtraction) units; snr and std
+    # are invariant under the per-channel shift
+    B = float(np.asarray(baseline, dtype=np.float64).sum()) if baseline is not None else 0.0
     return SweepResult(
         dms=plan.dms[: plan.n_real_trials],
         widths=plan.widths,
         snr=snr[: plan.n_real_trials],
         peak_sample=acc.ab[: plan.n_real_trials],
-        mean=mean[: plan.n_real_trials],
+        mean=mean[: plan.n_real_trials] + B,
         std=std[: plan.n_real_trials],
     )
 
@@ -531,4 +569,14 @@ def sweep_spectra(spectra, dms, nsub=64, group_size=32, widths=DEFAULT_WIDTHS,
             yield pos, data[:, pos : pos + n]
             pos += chunk_payload
 
-    return sweep_stream(plan, blocks(), chunk_payload, mesh=mesh, chan_major=True)
+    # whole-series per-channel baseline: makes the result (incl. the padded
+    # end-of-data windows) independent of chunk_payload — see the contract.
+    # Host arrays stay on host for this (a device round-trip of the full
+    # series would defeat chunked streaming's memory bound).
+    if isinstance(data, np.ndarray):
+        baseline = np.mean(data, axis=1, keepdims=True,
+                           dtype=np.float64).astype(np.float32)
+    else:
+        baseline = jnp.mean(data.astype(jnp.float32), axis=1, keepdims=True)
+    return sweep_stream(plan, blocks(), chunk_payload, mesh=mesh, chan_major=True,
+                        baseline=baseline)

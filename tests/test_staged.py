@@ -1,0 +1,158 @@
+"""Staged DDplan execution + sweep CLI tests (VERDICT round-1 item 4:
+configs[2] end-to-end from the command line)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from pypulsar_tpu.core.spectra import Spectra
+from pypulsar_tpu.io import filterbank
+from pypulsar_tpu.ops import numpy_ref
+from pypulsar_tpu.plan.ddplan import Observation
+
+
+def synth_fil(tmp_path, C=64, T=8192, dt=1e-3, dm=60.0, t0=900, amp=7.0,
+              seed=2, name="synth.fil"):
+    rng = np.random.RandomState(seed)
+    freqs = (1500.0 - 2.0 * np.arange(C)).astype(np.float64)
+    data = rng.randn(T, C).astype(np.float32) + 50.0  # DC offset on purpose
+    bins = numpy_ref.bin_delays(dm, freqs, dt)
+    for c in range(C):
+        idx = t0 + bins[c]
+        for k, a in ((0, amp), (1, amp * 0.6)):
+            if idx + k < T:
+                data[idx + k, c] += a
+    fn = str(tmp_path / name)
+    hdr = dict(filterbank.DEFAULT_HEADER)
+    hdr.update(nchans=C, fch1=freqs[0], foff=freqs[1] - freqs[0], tsamp=dt,
+               nbits=32)
+    filterbank.write_filterbank(fn, hdr, data)
+    return fn, freqs, data
+
+
+def test_sweep_ddplan_staged_recovers_injection(tmp_path):
+    from pypulsar_tpu.parallel.staged import sweep_ddplan
+
+    dm_true, t0, dt = 60.0, 900, 1e-3
+    fn, freqs, _ = synth_fil(tmp_path, dm=dm_true, t0=t0, dt=dt)
+    fil = filterbank.FilterbankFile(fn)
+    bw = abs(freqs[0] - freqs[-1]) + 2.0
+    obs = Observation(dt=dt, fctr=float(freqs.mean()), BW=bw,
+                      numchan=len(freqs))
+    plan = obs.gen_ddplan(0.0, 120.0)
+    assert len(plan.DDsteps) >= 1
+    staged = sweep_ddplan(fil, plan, nsub=16, group_size=8)
+    # every step ran with its own downsampling
+    assert [s.downsamp for s in staged.steps] == \
+        [st.downsamp for st in plan.DDsteps][: len(staged.steps)]
+    best = staged.best(1)[0]
+    assert abs(best["dm"] - dm_true) <= 2 * plan.DDsteps[0].dDM + 1.0
+    assert abs(best["time_sec"] - t0 * dt) <= 0.005
+    assert best["snr"] > 10.0
+
+
+def test_staged_step_equals_flat_sweep(tmp_path):
+    """A one-step staged run must equal sweep_spectra on the same DMs."""
+    from pypulsar_tpu.parallel import sweep_spectra
+    from pypulsar_tpu.parallel.staged import sweep_ddplan
+
+    fn, freqs, data = synth_fil(tmp_path, T=4096)
+    fil = filterbank.FilterbankFile(fn)
+    obs = Observation(dt=1e-3, fctr=float(freqs.mean()),
+                      BW=abs(freqs[0] - freqs[-1]) + 2.0, numchan=len(freqs))
+    plan = obs.gen_ddplan(0.0, 30.0)
+    step0 = plan.DDsteps[0]
+    staged = sweep_ddplan(fil, plan, nsub=16, group_size=8)
+    if step0.downsamp == 1:
+        spec = Spectra(freqs, 1e-3, np.ascontiguousarray(data.T))
+        flat = sweep_spectra(spec, step0.DMs, nsub=16, group_size=8)
+        np.testing.assert_allclose(staged.steps[0].result.snr, flat.snr,
+                                   rtol=5e-6, atol=1e-4)
+
+
+def test_staged_chunked_consistency(tmp_path):
+    from pypulsar_tpu.parallel.staged import sweep_ddplan
+
+    fn, freqs, _ = synth_fil(tmp_path, T=8192)
+    fil = filterbank.FilterbankFile(fn)
+    obs = Observation(dt=1e-3, fctr=float(freqs.mean()),
+                      BW=abs(freqs[0] - freqs[-1]) + 2.0, numchan=len(freqs))
+    plan = obs.gen_ddplan(0.0, 80.0)
+    whole = sweep_ddplan(fil, plan, nsub=16, group_size=8)
+    chunked = sweep_ddplan(fil, plan, nsub=16, group_size=8,
+                           chunk_payload=2048)
+    for a, b in zip(whole.steps, chunked.steps):
+        # baseline comes from the first block (chunk-dependent), so the
+        # guarantee here is detection-level consistency, not ulp parity
+        np.testing.assert_allclose(a.result.snr, b.result.snr,
+                                   rtol=1e-3, atol=5e-3)
+
+
+def test_sweep_cli_flat_writes_cands(tmp_path, capsys):
+    from pypulsar_tpu.cli import sweep as sweep_cli
+
+    dm_true, t0, dt = 60.0, 900, 1e-3
+    fn, _, _ = synth_fil(tmp_path, dm=dm_true, t0=t0, dt=dt)
+    out = str(tmp_path / "out")
+    rc = sweep_cli.main([fn, "-o", out, "--lodm", "0", "--dmstep", "2.5",
+                         "--numdms", "48", "-s", "16", "--group-size", "8",
+                         "--threshold", "8"])
+    assert rc == 0
+    cands = out + ".cands"
+    assert os.path.exists(cands)
+    rows = [ln.split() for ln in open(cands) if not ln.startswith("#")]
+    assert rows, "threshold crossings expected for a 7-sigma injection"
+    stdout = capsys.readouterr().out
+    assert "DM" in stdout
+    dms = [float(r[0]) for r in rows]
+    snrs = [float(r[1]) for r in rows]
+    assert any(abs(d - dm_true) <= 5.0 for d in dms)
+    assert max(snrs) > 10.0
+
+
+def test_sweep_cli_ddplan_mode(tmp_path):
+    from pypulsar_tpu.cli import sweep as sweep_cli
+
+    fn, _, _ = synth_fil(tmp_path, T=8192)
+    out = str(tmp_path / "plan_out")
+    rc = sweep_cli.main([fn, "-o", out, "--ddplan", "--lodm", "0",
+                         "--hidm", "100", "-s", "16", "--group-size", "8"])
+    assert rc == 0
+    assert os.path.exists(out + ".cands")
+
+
+def test_sweep_cli_write_dats(tmp_path):
+    from pypulsar_tpu.cli import sweep as sweep_cli
+    from pypulsar_tpu.io.datfile import Datfile
+
+    fn, freqs, data = synth_fil(tmp_path, T=4096)
+    out = str(tmp_path / "dats")
+    rc = sweep_cli.main([fn, "-o", out, "--lodm", "0", "--dmstep", "30",
+                         "--numdms", "2", "-s", "16", "--group-size", "8",
+                         "--write-dats"])
+    assert rc == 0
+    for dm in (0.0, 30.0):
+        base = f"{out}_DM{dm:.2f}"
+        assert os.path.exists(base + ".dat") and os.path.exists(base + ".inf")
+        df = Datfile(base + ".dat")
+        ts = df.read_all()
+        assert len(ts) == 4096
+        if dm == 0.0:
+            # DM 0: series is the plain channel sum
+            np.testing.assert_allclose(ts, data.sum(axis=1), rtol=1e-5)
+
+
+def test_sweep_cli_sharded_mesh(tmp_path):
+    import jax
+
+    from pypulsar_tpu.cli import sweep as sweep_cli
+
+    assert len(jax.devices()) == 8
+    fn, _, _ = synth_fil(tmp_path)
+    out = str(tmp_path / "mesh_out")
+    rc = sweep_cli.main([fn, "-o", out, "--lodm", "0", "--dmstep", "2.5",
+                         "--numdms", "48", "-s", "16", "--group-size", "8",
+                         "--mesh", "4"])
+    assert rc == 0
+    assert os.path.exists(out + ".cands")
