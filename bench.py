@@ -6,19 +6,29 @@ sampling; one "DM trial" = dedispersing + boxcar-detecting the full segment at
 one DM. ``vs_baseline`` is the speedup over a single-core NumPy implementation
 doing the reference's brute-force per-channel-roll dedispersion
 (reference formats/spectra.py:229-260 semantics) with the same detection step,
-measured on a slice and scaled linearly (NumPy cost is linear in trials).
+measured on a time slice and a trial subset and scaled linearly (NumPy cost is
+linear in both; the scaling is stated in the JSON).
 
-Robustness contract (round-1 postmortem): this script ALWAYS prints exactly one
-JSON line of the required shape and exits 0, whatever the TPU tunnel does.
-Backend acquisition retries with bounded backoff; if the accelerator backend
-cannot initialize, the benchmark re-execs itself on the CPU backend (reduced
-shapes) so the round still records a measured number, with the fallback noted
-in ``unit``.
+HBM budgeting (round-3 fix: BENCH_r02 OOM'd the chip): the dataset is
+device-resident only up to a byte budget derived from the accelerator's HBM
+(16 GB on v5e, override PYPULSAR_TPU_HBM_GB); the chunk payload is sized for
+a power-of-two FFT length, the streaming dispatch depth (max_pending) is
+computed from the leftover budget, and an in-child RESOURCE_EXHAUSTED retry
+halves the dataset until the run fits. The measured configuration is always
+recorded in the JSON.
+
+Robustness contract (round-1 postmortem): this script ALWAYS prints exactly
+one JSON line of the required shape and exits 0, whatever the TPU tunnel
+does. Backend acquisition retries with bounded backoff; if the accelerator
+backend cannot initialize, the benchmark re-execs itself on the CPU backend
+(reduced shapes) so the round still records a measured number, with the
+fallback noted in ``unit``.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
 Usage: python bench.py [--quick] [--trials D] [--nsamp T] [--nchan C]
+                       [--engine auto|gather|scan|fourier] [--ab]
 """
 
 import argparse
@@ -30,6 +40,9 @@ import time
 
 import numpy as np
 
+V5E_HBM_BYTES = 16e9
+V5E_HBM_BW = 819e9  # HBM roofline, bytes/s
+
 
 def parse_args(argv=None):
     ap = argparse.ArgumentParser()
@@ -38,10 +51,15 @@ def parse_args(argv=None):
     ap.add_argument("--nchan", type=int, default=None)
     ap.add_argument("--nsamp", type=int, default=None)
     ap.add_argument("--dm-max", type=float, default=500.0)
+    ap.add_argument("--engine", default="auto",
+                    help="sweep chunk engine: auto|gather|scan|fourier")
     ap.add_argument("--baseline-trials", type=int, default=None,
                     help="NumPy trials to actually run before extrapolating")
     ap.add_argument("--profile", action="store_true",
                     help="print a per-stage timing breakdown to stderr")
+    ap.add_argument("--ab", action="store_true",
+                    help="run the kernel A/B comparison table instead of the "
+                         "headline benchmark")
     ap.add_argument("--cpu-fallback", action="store_true",
                     help="(internal) run on the CPU backend with reduced shapes")
     ap.add_argument("--child", action="store_true",
@@ -79,21 +97,64 @@ def acquire_backend(retries=3, backoff=20.0):
     raise RuntimeError(f"backend unavailable after {retries} attempts: {last}")
 
 
+def budget_shapes(C, T_req, plan, hbm_bytes):
+    """(T, chunk_payload, n_fft, max_pending) fitting the HBM budget.
+
+    Accounting: device dataset C*T*4; each in-flight chunk buffer C*n*4
+    (padded to the FFT length); one executable workspace ~3 chunk buffers
+    (rfft output + fused intermediates); 25% headroom for the allocator.
+    """
+    n = 1 << 17
+    while plan.min_overlap >= n // 2:
+        n <<= 1
+    payload = n - plan.min_overlap
+    budget = 0.75 * hbm_bytes
+    chunk_bytes = 4 * C * n
+    workspace = 3 * chunk_bytes
+    avail = budget - workspace - 2 * chunk_bytes  # >= 2 chunks in flight
+    T = int(min(T_req, avail // (4 * C)))
+    T = max(T, payload)
+    max_pending = int((budget - workspace - 4 * C * T) // chunk_bytes)
+    max_pending = max(1, min(4, max_pending))
+    return T, payload, n, max_pending
+
+
+def sweep_bytes(plan, C, T, payload, n, engine):
+    """Analytic HBM traffic of the full sweep (dominant streams only)."""
+    G, g, S = plan.n_groups, plan.group_size, plan.nsub
+    D = G * g
+    W = max(plan.widths)
+    nchunks = -(-T // payload)
+    F = n // 2 + 1
+    out_len = payload + W
+    if engine == "fourier":
+        per_chunk = (
+            4 * C * n + 8 * C * F  # rfft read + write
+            + G * (8 * C * F + 8 * S * F)  # stage1 read X per group + write
+            + 8 * D * S * F + 8 * D * F  # stage2 read + write
+            + 8 * D * F + 4 * D * n  # irfft read + write
+            + 2 * 4 * D * out_len  # boxcar read + stats
+        )
+    else:
+        L1 = out_len + plan.max_shift2
+        per_chunk = 4 * (G * C * L1 + G * S * L1 + D * S * out_len
+                         + 2 * D * out_len)
+    return per_chunk * nchunks
+
+
 def run_benchmark(args):
     if args.cpu_fallback or args.quick:
         C = args.nchan or 128
-        T = args.nsamp or 1 << 15
+        T_req = args.nsamp or 1 << 15
         D = args.trials or 64
         nb = args.baseline_trials or 2
         nsub, group = 32, 16
-        chunk = 1 << 14
     else:
         C = args.nchan or 1024
-        T = args.nsamp or 1 << 21  # ~134 s at 64 us
+        T_req = args.nsamp or 1 << 21  # ~134 s at 64 us
         D = args.trials or 1024
         nb = args.baseline_trials or 4
         nsub, group = 64, 32
-        chunk = 1 << 18
 
     devs = acquire_backend()
 
@@ -102,54 +163,88 @@ def run_benchmark(args):
     from pypulsar_tpu.core.spectra import Spectra
     from pypulsar_tpu.ops import numpy_ref
     from pypulsar_tpu.parallel import make_sweep_plan, sweep_spectra
+    from pypulsar_tpu.parallel.sweep import resolve_engine
 
     dt = 64e-6
     dev = devs[0]
-    print(f"# device: {dev}, C={C} chans, T={T} samples ({T*dt:.0f}s), "
-          f"D={D} DM trials 0-{args.dm_max}", file=sys.stderr)
+    engine = resolve_engine(args.engine)
+    on_tpu = getattr(dev, "platform", "cpu") == "tpu"
+    hbm = float(os.environ.get("PYPULSAR_TPU_HBM_GB", V5E_HBM_BYTES / 1e9)) * 1e9
 
     freqs = (1500.0 - 300.0 / C * np.arange(C)).astype(np.float64)
-    # generate the dataset directly on device: the measured quantity is the
-    # sweep engine, not the axon tunnel's host->device transfer rate
-    key = jax.random.PRNGKey(0)
-    data = jax.random.normal(key, (C, T), dtype=jnp.float32)
-    data.block_until_ready()
     dms = np.linspace(0.0, args.dm_max, D)
-    spec = Spectra(freqs, dt, data)
-
-    # --- JAX sweep: warm up compile on one chunk, then time the full run ---
     plan = make_sweep_plan(dms, freqs, dt, nsub=nsub, group_size=group)
-    if plan.min_overlap >= chunk:
-        chunk = int(2 ** np.ceil(np.log2(plan.min_overlap * 2)))
-        print(f"# chunk raised to {chunk} (overlap {plan.min_overlap})", file=sys.stderr)
+    if args.cpu_fallback or args.quick:
+        from pypulsar_tpu.ops.fourier_dedisperse import fourier_chunk_len
 
-    # warmup: compile exactly the stat_len variants the timed run will hit.
-    # A single block of length L takes the tail path with stat_len=min(chunk,L)
-    # and is padded to the same shape as interior blocks, so warming on slices
-    # of length chunk and T%chunk covers both jit cache entries.
-    warm_lens = {min(T, chunk)}
-    if T > chunk and T % chunk:
-        warm_lens.add(T % chunk)
-    for wl in warm_lens:
-        warm = Spectra(freqs, dt, data[:, :wl])
-        sweep_spectra(warm, dms, nsub=nsub, group_size=group, chunk_payload=chunk)
-
-    if args.profile:
-        from pypulsar_tpu.utils.profiling import stage_report
-
-        profile_ctx = stage_report(file=sys.stderr)
+        T, chunk, max_pending = T_req, min(T_req, 1 << 14), 2
+        if plan.min_overlap >= chunk:
+            chunk = fourier_chunk_len(plan.min_overlap * 2)
+        n_fft = fourier_chunk_len(chunk + plan.min_overlap)
     else:
-        import contextlib
+        T, chunk, n_fft, max_pending = budget_shapes(C, T_req, plan, hbm)
+    print(f"# device: {dev}, engine={engine}, C={C} chans, T={T} samples "
+          f"({T*dt:.0f}s), D={D} trials 0-{args.dm_max}, chunk={chunk}, "
+          f"max_pending={max_pending}", file=sys.stderr)
 
-        profile_ctx = contextlib.nullcontext()
-    with profile_ctx:
-        t0 = time.perf_counter()
-        res = sweep_spectra(spec, dms, nsub=nsub, group_size=group,
-                            chunk_payload=chunk)
-        jax_time = time.perf_counter() - t0
+    def measure(T):
+        # generate the dataset directly on device: the measured quantity is
+        # the sweep engine, not the axon tunnel's host->device transfer rate
+        key = jax.random.PRNGKey(0)
+        data = jax.random.normal(key, (C, T), dtype=jnp.float32)
+        float(jnp.sum(data[0, :8]))  # force materialization
+        spec = Spectra(freqs, dt, data)
+        # warmup: compile exactly the stat_len variants the timed run hits
+        warm_lens = {min(T, chunk)}
+        if T > chunk and T % chunk:
+            warm_lens.add(T % chunk)
+        for wl in warm_lens:
+            warm = Spectra(freqs, dt, data[:, :wl])
+            sweep_spectra(warm, dms, nsub=nsub, group_size=group,
+                          chunk_payload=chunk, engine=engine,
+                          max_pending=max_pending)
+        if args.profile:
+            from pypulsar_tpu.utils.profiling import stage_report
+
+            profile_ctx = stage_report(file=sys.stderr)
+        else:
+            import contextlib
+
+            profile_ctx = contextlib.nullcontext()
+        with profile_ctx:
+            t0 = time.perf_counter()
+            res = sweep_spectra(spec, dms, nsub=nsub, group_size=group,
+                                chunk_payload=chunk, engine=engine,
+                                max_pending=max_pending)
+            jax_time = time.perf_counter() - t0
+        return res, jax_time
+
+    res = None
+    for attempt in range(6):
+        try:
+            res, jax_time = measure(T)
+            break
+        except Exception as e:  # noqa: BLE001 - OOM shrinks and retries
+            if "RESOURCE_EXHAUSTED" not in str(e):
+                raise
+            if T // 2 >= chunk:
+                T //= 2
+                print(f"# RESOURCE_EXHAUSTED; halving dataset to T={T}",
+                      file=sys.stderr)
+            elif n_fft // 2 > plan.min_overlap:
+                # dataset is already one chunk: shrink the chunk itself
+                n_fft //= 2
+                chunk = n_fft - plan.min_overlap
+                T = min(T, max(chunk, T // 2))
+                print(f"# RESOURCE_EXHAUSTED; shrinking chunk to {chunk} "
+                      f"(n_fft={n_fft})", file=sys.stderr)
+            else:
+                raise
+    if res is None:
+        raise RuntimeError("dataset would not fit on device at any size")
     trials_per_sec = D / jax_time
 
-    # --- NumPy single-core baseline: reference-style brute force, nb trials ---
+    # --- NumPy single-core baseline: reference-style brute force ---
     bl_T = min(T, 1 << 17)  # slice; scale linearly
     rng = np.random.RandomState(1)
     bl_data = rng.standard_normal((C, bl_T))  # same distribution; cost is data-independent
@@ -162,9 +257,24 @@ def run_benchmark(args):
     bl_trials_per_sec = nb / (bl_time * (T / bl_T))
     speedup = trials_per_sec / bl_trials_per_sec
 
-    print(f"# jax: {jax_time:.3f}s for {D} trials; numpy: {bl_time:.3f}s for {nb} "
-          f"trials on {bl_T/T:.3f} of data; best cand: {res.best(1)[0]}", file=sys.stderr)
-    unit = f"DM-trials/s ({C}-chan, {T*dt:.0f}s @ 64us, nsub={nsub})"
+    # --- bandwidth accounting vs the HBM roofline ---
+    nbytes = sweep_bytes(plan, C, T, chunk, n_fft, engine)
+    hbm_frac = nbytes / jax_time / V5E_HBM_BW if on_tpu else 0.0
+
+    # --- north-star extrapolation: same trials/s formula at 1 hr ---
+    T_1hr = int(3600.0 / dt)
+    trials_1hr = trials_per_sec * T / T_1hr
+
+    print(f"# jax: {jax_time:.3f}s for {D} trials; numpy: {bl_time:.3f}s for "
+          f"{nb} trials on {bl_T/T:.3f} of data; best cand: {res.best(1)[0]}",
+          file=sys.stderr)
+    print(f"# analytic HBM traffic {nbytes/1e9:.0f} GB -> "
+          f"{nbytes/jax_time/1e9:.0f} GB/s ({hbm_frac*100:.0f}% of v5e "
+          f"roofline); 1-hr extrapolation {trials_1hr:.1f} trials/s",
+          file=sys.stderr)
+    unit = (f"DM-trials/s ({C}-chan, {T*dt:.0f}s @ 64us, nsub={nsub}, "
+            f"engine={engine}; numpy baseline measured on {bl_T/T:.2f} of "
+            f"the data x {nb}/{D} trials, scaled linearly)")
     if args.cpu_fallback:
         unit += " [CPU FALLBACK: accelerator backend unavailable]"
     return {
@@ -172,6 +282,83 @@ def run_benchmark(args):
         "value": round(trials_per_sec, 2),
         "unit": unit,
         "vs_baseline": round(speedup, 2),
+        "jax_seconds": round(jax_time, 3),
+        "numpy_seconds_measured": round(bl_time, 3),
+        "numpy_trials_measured": nb,
+        "numpy_slice_frac": round(bl_T / T, 4),
+        "hbm_frac": round(hbm_frac, 4),
+        "hbm_gbps": round(nbytes / jax_time / 1e9, 1),
+        "trials_per_sec_1hr_extrapolated": round(trials_1hr, 2),
+        "nsamp": T,
+        "engine": engine,
+    }
+
+
+def run_ab(args):
+    """Kernel A/B table (VERDICT r2 item 3): full-chunk engines + boxcar
+    backends, timed on the live backend. Results land in BENCHNOTES.md."""
+    acquire_backend()
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from pypulsar_tpu.ops.pallas_kernels import boxcar_stats
+    from pypulsar_tpu.parallel import make_sweep_plan
+    from pypulsar_tpu.parallel.sweep import sweep_chunk
+
+    C, D = args.nchan or 1024, args.trials or 1024
+    nsub, group = 64, 32
+    dt = 64e-6
+    freqs = (1500.0 - 300.0 / C * np.arange(C)).astype(np.float64)
+    dms = np.linspace(0.0, args.dm_max, D)
+    plan = make_sweep_plan(dms, freqs, dt, nsub=nsub, group_size=group)
+    n = 1 << 17
+    W = max(plan.widths)
+    chunk = n - plan.min_overlap
+    out_len = chunk + W
+    need = out_len + plan.max_shift2 + plan.max_shift1
+    key = jax.random.PRNGKey(0)
+    data = jax.random.normal(key, (C, need), dtype=jnp.float32)
+    s1 = jnp.asarray(plan.stage1_bins)
+    s2 = jnp.asarray(plan.stage2_bins)
+    float(jnp.sum(data[0, :8]))
+
+    def force(out):
+        return float(jnp.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0])
+
+    results = {}
+    for engine in ("fourier", "gather", "scan"):
+        try:
+            fn = lambda: sweep_chunk(data, s1, s2, plan.nsub, out_len,
+                                     plan.max_shift2, plan.widths, chunk,
+                                     engine=engine)
+            force(fn())
+            t0 = time.perf_counter()
+            force(fn())
+            el = time.perf_counter() - t0
+            results[f"chunk-{engine}"] = round(el, 4)
+            print(f"# chunk-{engine:8s} {el*1e3:9.1f} ms "
+                  f"({D / el:.1f} trials/s per chunk)", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - record and keep going
+            results[f"chunk-{engine}"] = f"FAILED: {type(e).__name__}"
+            print(f"# chunk-{engine} FAILED: {type(e).__name__}: "
+                  f"{str(e)[:200]}", file=sys.stderr)
+    ts = jax.random.normal(key, (256, out_len), dtype=jnp.float32)
+    float(ts[0, 0])
+    for be in ("pallas", "lax"):
+        try:
+            fn = partial(boxcar_stats, ts, plan.widths, chunk, backend=be)
+            force(fn())
+            t0 = time.perf_counter()
+            force(fn())
+            results[f"boxcar-{be}"] = round(time.perf_counter() - t0, 4)
+        except Exception as e:  # noqa: BLE001
+            results[f"boxcar-{be}"] = f"FAILED: {type(e).__name__}"
+    return {
+        "metric": "kernel_ab_seconds",
+        "value": results.get("chunk-fourier", 0.0),
+        "unit": "s per 1024-trial chunk (see extras)",
+        "vs_baseline": 0.0,
+        **results,
     }
 
 
@@ -195,11 +382,10 @@ def run_child(args, cpu: bool, timeout: float):
                       ("--baseline-trials", args.baseline_trials)):
         if val is not None:
             argv += [flag, str(val)]
-    argv += ["--dm-max", str(args.dm_max)]
-    if args.quick:
-        argv.append("--quick")
-    if args.profile:
-        argv.append("--profile")
+    argv += ["--dm-max", str(args.dm_max), "--engine", args.engine]
+    for flag in ("quick", "profile", "ab"):
+        if getattr(args, flag):
+            argv.append("--" + flag)
     proc = subprocess.run(argv, env=env, capture_output=True, text=True,
                           timeout=timeout)
     sys.stderr.write(proc.stderr[-6000:])
@@ -215,7 +401,8 @@ def main():
     args = parse_args()
     if args.child:
         # measurement mode: run in this interpreter, print JSON, propagate rc
-        print(json.dumps(run_benchmark(args)))
+        record = run_ab(args) if args.ab else run_benchmark(args)
+        print(json.dumps(record))
         return
     record = None
     try:

@@ -1,0 +1,144 @@
+"""Fourier-domain acceleration search over a .dat / .fft file.
+
+Fills the reference pipeline's missing stage (the reference shells out to
+PRESTO's ``accelsearch`` and only consumes its ``*_ACCEL_*.cand`` output —
+``bin/plot_accelcands.py:50-71``, ``formats/accelcands.py``).  Pipeline:
+
+  .dat (or pre-computed .fft) -> rfft -> deredden (red-noise normalize)
+  -> optional zaplist masking -> (r, z) matched-template search with
+  harmonic summing (fourier/accelsearch.py) -> ``<base>_ACCEL_<zmax>.cand``
+  (PRESTO fourierprops records readable by cli/plot_accelcands) +
+  ``<base>_ACCEL_<zmax>.txtcand`` human-readable summary.
+
+Flag names follow PRESTO's accelsearch where they exist (-zmax, -numharm,
+-sigma, -flo, -fhi).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+from pypulsar_tpu.fourier.accelsearch import AccelSearchConfig, accel_search
+from pypulsar_tpu.fourier.kernels import deredden, deredden_schedule
+from pypulsar_tpu.io.infodata import InfoData
+
+
+def load_spectrum(fn: str):
+    """(complex spectrum, T seconds, base filename) from a .dat or .fft."""
+    base, ext = os.path.splitext(fn)
+    inf = InfoData(base + ".inf")
+    if ext == ".dat":
+        from pypulsar_tpu.io.datfile import Datfile
+
+        dat = Datfile(fn)
+        series = dat.read_all()
+        fft = np.fft.rfft(series)
+        n = len(series)
+    elif ext == ".fft":
+        from pypulsar_tpu.fourier.prestofft import PrestoFFT
+
+        pf = PrestoFFT(fn, inffn=base + ".inf")
+        fft = pf.fft
+        n = int(inf.N)
+    else:
+        raise ValueError(f"expected a .dat or .fft file, got {fn!r}")
+    T = n * float(inf.dt)
+    return np.asarray(fft), T, base
+
+
+def zap_spectrum(fft: np.ndarray, T: float, zapfile: str) -> np.ndarray:
+    """Replace zaplist intervals (centre/width Hz rows, reference
+    bin/autozap.py:262-287 format) with unit-power noise-free zeros."""
+    fft = fft.copy()
+    for line in open(zapfile):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        fc, w = float(parts[0]), float(parts[1])
+        lo = max(int(np.floor((fc - w / 2) * T)), 0)
+        hi = min(int(np.ceil((fc + w / 2) * T)) + 1, len(fft))
+        if hi > lo:
+            fft[lo:hi] = 0.0
+    return fft
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="accelsearch.py",
+        description="Search an FFT or time series for accelerated periodic "
+                    "signals (TPU backend).")
+    p.add_argument("infile", help=".dat or .fft file (with matching .inf)")
+    p.add_argument("-z", "--zmax", type=float, default=200.0,
+                   help="max drift in Fourier bins over the observation "
+                        "(default 200)")
+    p.add_argument("--dz", type=float, default=2.0,
+                   help="drift step in bins (default 2)")
+    p.add_argument("-n", "--numharm", type=int, default=8,
+                   choices=(1, 2, 4, 8),
+                   help="max harmonics summed (default 8)")
+    p.add_argument("-s", "--sigma", type=float, default=2.0,
+                   help="candidate significance threshold (default 2)")
+    p.add_argument("--flo", type=float, default=1.0,
+                   help="lowest searched frequency, Hz (default 1)")
+    p.add_argument("--fhi", type=float, default=None,
+                   help="highest searched frequency, Hz (default Nyquist)")
+    p.add_argument("--zapfile", default=None,
+                   help="zaplist of RFI intervals to blank before searching")
+    p.add_argument("--no-deredden", action="store_true",
+                   help="input spectrum is already normalized")
+    p.add_argument("--max-cands", type=int, default=200,
+                   help="cap on written candidates (default 200)")
+    p.add_argument("-o", "--outbase", default=None,
+                   help="output base name (default: input base)")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    fft, T, base = load_spectrum(args.infile)
+    outbase = args.outbase or base
+    N = len(fft)
+    print(f"# {args.infile}: {N} bins, T = {T:.1f} s", file=sys.stderr)
+
+    if args.no_deredden:
+        norm = fft.astype(np.complex64)
+    else:
+        sched = deredden_schedule(N)
+        norm = np.asarray(deredden(fft.astype(np.complex64), schedule=sched))
+    if args.zapfile:
+        norm = zap_spectrum(norm, T, args.zapfile)
+
+    cfg = AccelSearchConfig(
+        zmax=args.zmax, dz=args.dz, numharm=args.numharm,
+        sigma_min=args.sigma, flo=args.flo, fhi=args.fhi,
+    )
+    cands = accel_search(norm, T, cfg)[: args.max_cands]
+
+    from pypulsar_tpu.io.prestocand import write_rzwcands
+
+    ztag = int(round(args.zmax))
+    candfn = f"{outbase}_ACCEL_{ztag}.cand"
+    write_rzwcands(candfn, [c.as_fourierprops() for c in cands])
+    txtfn = f"{outbase}_ACCEL_{ztag}.txtcand"
+    with open(txtfn, "w") as f:
+        f.write("# cand   sigma    power  numharm          r          z"
+                "        freq(Hz)       fdot(Hz/s)      period(s)\n")
+        for i, c in enumerate(cands):
+            freq = c.freq(T)
+            f.write(
+                f"{i + 1:6d} {c.sigma:7.2f} {c.power:8.2f} {c.numharm:8d} "
+                f"{c.r:10.2f} {c.z:10.2f} {freq:15.8f} "
+                f"{c.fdot(T):16.6e} {1.0 / freq:14.10f}\n"
+            )
+    print(f"# wrote {len(cands)} candidates to {candfn} and {txtfn}",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -110,6 +110,13 @@ def main(argv=None):
                     help="shard DM trials over this many devices")
     ap.add_argument("--write-dats", action="store_true",
                     help="flat mode: also write per-DM .dat/.inf series")
+    ap.add_argument("--checkpoint", default=None, metavar="PATH",
+                    help="persist in-sweep state to PATH for --resume")
+    ap.add_argument("--checkpoint-every", type=int, default=16,
+                    help="chunks between checkpoint writes (default 16)")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from an existing --checkpoint file "
+                         "(without this flag stale checkpoints are removed)")
     args = ap.parse_args(argv)
 
     from pypulsar_tpu.parallel import make_mesh
@@ -121,8 +128,23 @@ def main(argv=None):
     if args.ddplan and args.downsamp != 1:
         ap.error("--downsamp is a flat-mode option (DDplan sets per-step "
                  "downsampling itself)")
+    if args.resume and not args.checkpoint:
+        ap.error("--resume requires --checkpoint PATH")
     widths = tuple(int(w) for w in args.widths.split(","))
     outbase = args.outbase or os.path.splitext(args.infile)[0]
+    if args.checkpoint and not args.resume:
+        # remove exactly the files this run's checkpointing could have
+        # written (never a glob: a prefix pattern could match unrelated
+        # user files living next to the checkpoint)
+        stale = [args.checkpoint, args.checkpoint + ".tmp.npz"]
+        for i in range(256):
+            stale += [f"{args.checkpoint}.step{i}.npz",
+                      f"{args.checkpoint}.step{i}.npz.tmp.npz",
+                      f"{args.checkpoint}.step{i}.done.npz",
+                      f"{args.checkpoint}.step{i}.done.npz.tmp.npz"]
+        for fn in stale:
+            if os.path.exists(fn):
+                os.remove(fn)
     reader = _open_reader(args.infile)
     mesh = None
     if args.mesh:
@@ -150,7 +172,9 @@ def main(argv=None):
         staged = sweep_ddplan(reader, plan, nsub=args.nsub,
                               group_size=args.group_size, widths=widths,
                               chunk_payload=args.chunk, mesh=mesh,
-                              verbose=True)
+                              verbose=True,
+                              checkpoint_path=args.checkpoint,
+                              checkpoint_every=args.checkpoint_every)
     else:
         if args.numdms is None:
             ap.error("flat mode requires --numdms (or use --ddplan)")
@@ -158,7 +182,9 @@ def main(argv=None):
         staged = sweep_flat(reader, dms, downsamp=args.downsamp,
                             nsub=args.nsub, group_size=args.group_size,
                             widths=widths, chunk_payload=args.chunk,
-                            mesh=mesh)
+                            mesh=mesh,
+                            checkpoint_path=args.checkpoint,
+                            checkpoint_every=args.checkpoint_every)
         if args.write_dats:
             _write_dats(outbase, reader, dms, args.downsamp)
 

@@ -1,0 +1,430 @@
+"""Fourier-domain acceleration search: the (r, z) = (frequency, drift) plane.
+
+Fills the reference pipeline's gap between ``.fft`` files and
+``*_ACCEL*.cand`` candidate files (the reference defers this stage to
+PRESTO's ``accelsearch`` and only consumes its output —
+``bin/plot_accelcands.py:50-104``, ``formats/accelcands.py``; BASELINE.md
+configs[4] names the workload: 4096 DM x ~200 z-trials).
+
+TPU-native design
+-----------------
+The search correlates the normalized FFT with a bank of constant-
+:math:`\\dot f` templates (fourier/zresponse.py) for every drift ``z`` in
+``[-zmax, zmax]`` and sums harmonics — all as *batched power-of-two FFT
+convolutions*:
+
+- The template bank for one harmonic stage is a single ``[2*Z, L]``
+  complex64 array (interleaved integer/half-bin phase rows, PRESTO's
+  ``numbetween=2`` resolution); its FFT is precomputed once per search.
+- The spectrum streams through in fundamental-bin segments (overlap-save,
+  exactly the sweep engine's chunking pattern); each segment x harmonic is
+  one batched ``fft -> multiply -> ifft`` over the z axis, a shape XLA
+  tiles well on TPU (power-of-two lengths only: XLA lowers other sizes
+  through a dense DFT matmul that allocates O(L^2)).
+- Harmonic summing searches the grid of the *highest* summed harmonic and
+  adds subharmonics by stretch-gather (see accel_search's docstring for
+  the geometry). Each stage H in (1, 2, 4, 8) builds its own plane from
+  scratch — a full ladder costs sum(H) = 15 correlation+stretch passes
+  per span (stages have different grids, so partial sums cannot be
+  reused across them).
+- Detection is on-device: 4-neighbour local-max + threshold + ``lax.top_k``
+  per segment; only O(K) winners (with their 3x3 neighbourhoods for
+  sub-bin refinement) ever reach the host. Host-side refinement fits a
+  parabola in r and z and converts powers to equivalent-Gaussian
+  significance in float64.
+
+Calibration: with the FFT normalized to unit mean noise power (deredden)
+and unit-energy templates, every plane power is mean-1 exponential under
+noise, and an H-harmonic sum is Gamma(H, 1) — significance follows from
+``gammaincc(H, P)`` with a trials correction, no empirical scaling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from scipy.special import gammaincc, gammainccinv, gammaln, log_ndtr, ndtri
+
+from pypulsar_tpu.fourier.zresponse import template_bank, z_halfwidth
+from pypulsar_tpu.ops.fourier_dedisperse import fourier_chunk_len
+from pypulsar_tpu.utils import profiling
+
+__all__ = [
+    "AccelSearchConfig",
+    "AccelCandidate",
+    "accel_search",
+    "equivalent_gaussian_sigma",
+    "power_threshold",
+]
+
+HARM_STAGES = (1, 2, 4, 8)
+
+
+# ---------------------------------------------------------------------------
+# significance (host, float64)
+# ---------------------------------------------------------------------------
+
+
+def _log_gamma_sf(power: float, numsum: int) -> float:
+    """log of P(X > power) for X ~ Gamma(numsum, 1) (sum of ``numsum``
+    unit-mean exponential powers), stable for large powers where
+    ``gammaincc`` underflows."""
+    p = gammaincc(numsum, power)
+    if p > 1e-280:
+        return float(np.log(p))
+    # asymptotic tail: p ~ power^(numsum-1) e^-power / Gamma(numsum)
+    return float((numsum - 1) * np.log(power) - power - gammaln(numsum))
+
+
+def equivalent_gaussian_sigma(logp: float) -> float:
+    """Gaussian sigma whose upper-tail probability is ``exp(logp)``.
+
+    Uses ``ndtri`` directly where the probability is representable; in the
+    far tail solves ``log_ndtr(-x) = logp`` by Newton iteration (converges
+    quadratically; 4-5 iterations from the asymptotic seed)."""
+    if logp > -700.0:
+        p = math.exp(logp)
+        if p >= 1.0:
+            return 0.0
+        return float(-ndtri(p))
+    # seed from log Q(x) ~ -x^2/2 - log(x sqrt(2 pi))
+    x = math.sqrt(-2.0 * logp)
+    for _ in range(6):
+        f = log_ndtr(-x) - logp
+        # d/dx log Q(x) = -phi(x)/Q(x); use asymptotic phi/Q ~ x
+        df = -math.exp(-0.5 * x * x - 0.5 * math.log(2 * math.pi) - log_ndtr(-x))
+        step = f / df
+        x -= step
+        if abs(step) < 1e-10:
+            break
+    return float(x)
+
+
+def candidate_sigma(power: float, numsum: int, numindep: float) -> float:
+    """Equivalent Gaussian significance of a summed power ``power`` over
+    ``numsum`` harmonics given ``numindep`` independent trials."""
+    logp1 = _log_gamma_sf(power, numsum)
+    # p_total = 1 - (1-p1)^numindep, computed in log space
+    if logp1 > math.log(1e-8):
+        p1 = math.exp(logp1)
+        ptot = -math.expm1(numindep * math.log1p(-p1))
+        logp = math.log(max(ptot, 1e-320))
+    else:
+        logp = logp1 + math.log(numindep)
+    return equivalent_gaussian_sigma(min(logp, 0.0))
+
+
+def power_threshold(sigma: float, numsum: int, numindep: float) -> float:
+    """Summed-power threshold whose significance is ``sigma`` after the
+    ``numindep`` trials correction (inverse of candidate_sigma)."""
+    # invert the trials correction p_total = 1 - (1 - p1)^numindep:
+    # p1 = -expm1(log1p(-p_total)/numindep), ~ p_total/numindep when tiny
+    logp = log_ndtr(-sigma)
+    if logp > math.log(1e-8):
+        p1 = -math.expm1(math.log1p(-math.exp(logp)) / numindep)
+    else:
+        p1 = math.exp(logp - math.log(numindep))
+    p1 = min(max(p1, 1e-320), 1.0)
+    return float(gammainccinv(numsum, p1))
+
+
+# ---------------------------------------------------------------------------
+# configuration / results
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AccelSearchConfig:
+    zmax: float = 200.0
+    dz: float = 2.0
+    numharm: int = 8  # highest harmonic stage (1, 2, 4 or 8)
+    sigma_min: float = 2.0
+    flo: float = 1.0  # Hz, lowest searched fundamental frequency
+    fhi: Optional[float] = None  # Hz, default Nyquist
+    seg_width: int = 1 << 14  # fundamental bins per device segment
+    topk: int = 64  # max raw hits per (segment, stage)
+    min_halfwidth: int = 24
+
+    @property
+    def zs(self) -> np.ndarray:
+        """Drift grid at *exactly* ``dz`` spacing starting from -zmax (the
+        top end is trimmed when dz does not divide 2*zmax — spacing, which
+        the sub-cell refinement relies on, wins over symmetry)."""
+        n = int(np.floor(2 * self.zmax / self.dz)) + 1
+        return -self.zmax + self.dz * np.arange(n)
+
+    @property
+    def stages(self) -> Tuple[int, ...]:
+        return tuple(h for h in HARM_STAGES if h <= self.numharm)
+
+
+@dataclasses.dataclass
+class AccelCandidate:
+    """One accepted (r, z) candidate. ``r``/``z`` are fundamental Fourier
+    bin and drift (bins) at the *mid-observation* epoch; ``power`` is the
+    H-harmonic summed matched power; ``sigma`` its trials-corrected
+    equivalent-Gaussian significance."""
+
+    r: float
+    z: float
+    power: float
+    sigma: float
+    numharm: int
+    rerr: float = 0.0
+    zerr: float = 0.0
+
+    def freq(self, T: float) -> float:
+        return self.r / T
+
+    def fdot(self, T: float) -> float:
+        return self.z / (T * T)
+
+    def as_fourierprops(self) -> Dict[str, float]:
+        """Field mapping for io.prestocand.write_rzwcands."""
+        return dict(
+            r=self.r, rerr=self.rerr, z=self.z, zerr=self.zerr,
+            w=0.0, werr=0.0, pow=self.power, powerr=math.sqrt(self.numharm),
+            sig=self.sigma, rawpow=self.power, phs=0.0, phserr=0.0,
+            cen=0.0, cenerr=0.0, pur=0.0, purerr=0.0,
+            locpow=float(self.numharm),
+        )
+
+
+# ---------------------------------------------------------------------------
+# device kernels
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("L",))
+def _corr_pow(spec_pad, tf, start, L):
+    """Correlation powers of one spectrum region against a template bank.
+
+    spec_pad[Np] : padded spectrum (complex64); tf[2Z, L]: FFT of reversed
+    conjugate templates (even rows integer-phase, odd rows half-bin).
+    Returns powers[Z, 2*L] float32, row-major (b, j) flattened: index
+    ``b*L + j`` is the power at spectrum position ``start_bin + j + b/2``
+    where ``start_bin = start - front + hw`` (caller bookkeeping).
+    """
+    sl = jax.lax.dynamic_slice(spec_pad, (start,), (L,))
+    cf = jnp.fft.fft(sl)
+    corr = jnp.fft.ifft(cf[None, :] * tf, axis=1)  # [2Z, L]
+    p = (jnp.abs(corr) ** 2).astype(jnp.float32)
+    Z2, _ = p.shape
+    return p.reshape(Z2 // 2, 2 * L)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _detect(accum, thresh, k):
+    """Threshold + 4-neighbour local max + top-k over plane[Z, R2].
+
+    Returns (vals[k], zidx[k], ridx[k], neigh[k, 3, 3]) — losers padded
+    with val = -inf. The 3x3 power neighbourhood feeds host-side sub-bin
+    refinement without shipping the plane."""
+    Z, R2 = accum.shape
+    neg = jnp.float32(-jnp.inf)
+    pad = jnp.pad(accum, 1, constant_values=neg)
+    c = pad[1:-1, 1:-1]
+    ismax = (
+        (c >= pad[:-2, 1:-1]) & (c >= pad[2:, 1:-1])
+        & (c >= pad[1:-1, :-2]) & (c > pad[1:-1, 2:])
+        & (c > thresh)
+    )
+    flat = jnp.where(ismax, accum, neg).ravel()
+    vals, idx = jax.lax.top_k(flat, k)
+    zi = idx // R2
+    ri = idx % R2
+    # gather 3x3 neighbourhoods from the padded plane
+    zo = zi[:, None, None] + jnp.arange(3)[None, :, None]
+    ro = ri[:, None, None] + jnp.arange(3)[None, None, :]
+    neigh = pad[zo, ro]
+    return vals, zi, ri, neigh
+
+
+@jax.jit
+def _take_add(plane, pow_flat, idx):
+    """plane[Z, W2] += pow_flat[Z, 2L][:, idx] (static stretch gather)."""
+    return plane + jnp.take(pow_flat, idx, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# the search driver
+# ---------------------------------------------------------------------------
+
+
+def _parabola_peak(ym, y0, yp):
+    """Sub-cell offset and peak value of the parabola through three
+    equally spaced samples (offset clipped to the cell)."""
+    denom = ym - 2.0 * y0 + yp
+    if denom >= 0.0 or not np.isfinite(denom):
+        return 0.0, y0
+    d = 0.5 * (ym - yp) / denom
+    d = float(np.clip(d, -0.5, 0.5))
+    return d, float(y0 - 0.25 * (ym - yp) * d)
+
+
+def accel_search(
+    fft,
+    T: float,
+    config: AccelSearchConfig = AccelSearchConfig(),
+) -> List[AccelCandidate]:
+    """Search a *normalized* FFT (unit mean noise power, e.g. the output of
+    fourier.kernels.deredden) for accelerated periodic signals.
+
+    ``fft`` is the one-sided complex spectrum (bin k = frequency k/T);
+    ``T`` is the observation length in seconds. Returns sifted candidates
+    (fundamental ``r``/``z``) sorted by decreasing sigma.
+
+    Harmonic geometry (the PRESTO structure): stage ``H`` searches the grid
+    of the *highest* summed harmonic ``r_top = H*r_fund`` at half-bin
+    resolution and adds subharmonics at ``r_top * b/H`` — downward
+    "stretching", so position quantization is at most 1/4 bin for every
+    subharmonic. (Summing upward from a fundamental grid undersamples
+    harmonic ``h`` by ``h/4`` bins — measurably losing the high harmonics;
+    caught by tests/test_accelsearch.py::test_harmonic_summing_beats_
+    fundamental during development.) ``zmax`` bounds the drift of the top
+    harmonic (PRESTO convention); a stage-``H`` candidate's fundamental
+    drift resolution is ``dz/H``.
+    """
+    cfg = config
+    fftd = jnp.asarray(fft, dtype=jnp.complex64)
+    N = int(fftd.shape[0])
+    zs = cfg.zs  # top-harmonic drift grid
+    Z = len(zs)
+    stages = cfg.stages
+    segw = cfg.seg_width
+    if segw % max(stages):
+        raise ValueError(f"seg_width {segw} must be divisible by "
+                         f"numharm {max(stages)}")
+
+    rlo = max(int(np.ceil(cfg.flo * T)), 1)
+    rhi = int(np.floor((cfg.fhi * T) if cfg.fhi else (N - 1)))
+    rhi = min(rhi, N - 1)
+    if rhi <= rlo:
+        raise ValueError(f"empty search range: rlo={rlo} rhi={rhi}")
+
+    # --- subharmonic ratio banks + static stretch indices (host, once) ---
+    from fractions import Fraction
+
+    ratios = sorted({Fraction(b, H) for H in stages for b in range(1, H + 1)})
+    banks = {}
+    for rho in ratios:
+        rf = float(rho)
+        tb, hw = template_bank(zs * rf, numbetween=2,
+                               min_halfwidth=cfg.min_halfwidth)
+        wrho = (segw * rho.numerator) // rho.denominator
+        m = tb.shape[1]
+        L = fourier_chunk_len(wrho + 2 * hw + m)
+        padded = np.zeros((tb.shape[0], L), dtype=np.complex128)
+        padded[:, :m] = tb
+        rev = np.zeros_like(padded)
+        rev[:, 0] = padded[:, 0]
+        rev[:, 1:] = padded[:, :0:-1]
+        tf = np.fft.fft(rev, axis=1)
+        # static stretch: plane column `col` (top position r0 + col/2) maps
+        # to subharm half-bin index round(rho*col) relative to rho*r0
+        # corr[j] evaluates spectrum position s0 + j (the template's -hw
+        # offset cancels the slice's -hw start), so the column index is
+        # rel//2 with no hw term
+        rel = np.floor(rf * np.arange(2 * segw) + 0.5).astype(np.int64)
+        idx = (rel % 2) * L + (rel // 2)  # into [2, L] row-major
+        banks[rho] = (
+            jnp.asarray(tf, dtype=jnp.complex64), hw, L,
+            jnp.asarray(idx, dtype=jnp.int32),
+        )
+
+    # pad the spectrum: conjugate reflection in front (bin -k of a real
+    # input's FFT is conj(bin k)) so templates overhanging the lowest bins
+    # correlate against physically correct values; zeros past Nyquist
+    maxhw = max(hw for _, hw, _, _ in banks.values())
+    front = maxhw + 1
+    maxL = max(L for _, _, L, _ in banks.values())
+    Np = N + maxL + front + 8
+    spec_pad = jnp.concatenate(
+        [jnp.conj(fftd[1:front + 1][::-1]), fftd,
+         jnp.zeros(max(Np - N, 8), jnp.complex64)]
+    )
+
+    # per-stage trials correction and detection threshold: searched cells /
+    # response footprint (~1 top-bin x 1 z-cell per independent trial,
+    # shared across the H summed harmonics)
+    numindep, thresh = {}, {}
+    for H in stages:
+        ntop = max(min(H * rhi, N - 1) - H * rlo, 1)
+        numindep[H] = max(ntop * Z / H, 1.0)
+        thresh[H] = power_threshold(cfg.sigma_min, H, numindep[H])
+
+    raw_hits = []  # (stage, seg r0, vals, zidx, colidx, neigh, width)
+    for H in stages:
+        top_lo = H * rlo
+        top_hi = min(H * rhi, N - 1)
+        if top_hi <= top_lo:
+            continue
+        n_seg = -(-(top_hi - top_lo) // segw)
+        for si in range(n_seg):
+            r0 = top_lo + si * segw  # divisible by H (segw % H == 0)
+            width = min(segw, top_hi - r0)
+            plane = jnp.zeros((Z, 2 * segw), jnp.float32)
+            with profiling.stage("accel_planes"):
+                for b in range(1, H + 1):
+                    rho = Fraction(b, H)
+                    tf, hw, L, idx = banks[rho]
+                    s0 = (b * r0) // H  # exact: H | r0
+                    start = front + s0 - hw
+                    powf = _corr_pow(spec_pad, tf, start, L)
+                    plane = _take_add(plane, powf, idx)
+            if width < segw:
+                # short last segment: columns past the search range hold
+                # real correlation powers (e.g. RFI just above fhi) and
+                # would crowd genuine candidates out of the top-k
+                plane = plane.at[:, 2 * width:].set(-jnp.inf)
+            with profiling.stage("accel_detect"):
+                vals, zi, ri, neigh = _detect(
+                    plane, jnp.float32(thresh[H]), cfg.topk)
+            raw_hits.append((H, r0, np.asarray(vals), np.asarray(zi),
+                             np.asarray(ri), np.asarray(neigh), width))
+
+    # --- host: refine + significance + sift (float64) ---
+    cands: List[AccelCandidate] = []
+    for H, r0, vals, zi, ri, neigh, width in raw_hits:
+        for j in range(len(vals)):
+            p = float(vals[j])
+            if not np.isfinite(p) or p <= thresh[H]:
+                continue
+            if ri[j] >= 2 * width:  # padding region of a short last segment
+                continue
+            nb = neigh[j].astype(np.float64)
+            dr, _ = _parabola_peak(nb[1, 0], nb[1, 1], nb[1, 2])
+            dzo, _ = _parabola_peak(nb[0, 1], nb[1, 1], nb[2, 1])
+            r_top = r0 + 0.5 * (float(ri[j]) + dr)
+            z_top = zs[int(zi[j])] + dzo * cfg.dz
+            sig = candidate_sigma(p, H, numindep[H])
+            if sig < cfg.sigma_min:
+                continue
+            # matched-filter location uncertainties (linear-chirp Fisher
+            # information approximations, cf. Ransom et al. 2002 app. A),
+            # scaled to the fundamental
+            rerr = 3.0 / (np.pi * math.sqrt(6.0 * p)) / H
+            zerr = 3.0 * math.sqrt(105.0 / p) / np.pi / H
+            cands.append(AccelCandidate(
+                r=r_top / H, z=z_top / H, power=p, sigma=sig,
+                numharm=H, rerr=rerr, zerr=zerr))
+
+    # sift: sort by sigma, greedily keep candidates whose fundamental is
+    # not within 1 bin (and 2 z grid cells) of an already-accepted one
+    cands.sort(key=lambda c: -c.sigma)
+    kept: List[AccelCandidate] = []
+    for c in cands:
+        dup = False
+        for kc in kept:
+            if abs(c.r - kc.r) < 1.0 and abs(c.z - kc.z) <= 2 * cfg.dz:
+                dup = True
+                break
+        if not dup:
+            kept.append(c)
+    return kept

@@ -1,0 +1,117 @@
+"""Fourier-domain two-stage dedispersion: the TPU fast path of the sweep.
+
+Why: the time-domain formulation of the sweep's hot loop — per-row
+``dynamic_slice`` gathers (parallel/sweep.py ``_slice_rows``) — lowers to a
+generic XLA gather that measured **26 GB/s effective on v5e** (3% of the
+819 GB/s HBM roofline; see BENCHNOTES.md for the recorded A/B), and the
+Pallas dynamic-offset-DMA alternative does not compile on this toolchain
+(ops/pallas_dedisperse.py). This module removes the gather entirely: a
+circular shift by ``s`` bins is multiplication by ``exp(2i*pi*k*s/n)`` in
+the Fourier domain, so the whole two-stage shift-and-sum becomes
+
+    X = rfft(chunk)                                    # once per chunk
+    stage 1 (per group):  Xsub[s] = sum_{c in s} X[c] * W^(k*s1[g,c])
+    stage 2 (per trial):  Xts    = sum_s  Xsub[s] * W^(k*s2[d,s])
+    ts = irfft(Xts)[:, :out_len]
+
+— batched power-of-two FFTs plus *elementwise multiply-reduce* streams,
+the access pattern XLA fuses to full bandwidth on TPU. Phases compose
+additively, so the total integer shift per channel is EXACTLY the same
+``s1 + s2`` the time-domain path applies: results agree to FFT f32
+rounding (~1e-6 relative), inside the sweep's SNR parity contract
+(parallel/sweep.py docstring; enforced in tests/test_sweep.py).
+
+Exactness of the phase table: with ``n`` a power of two, the index
+``(k * s) mod n`` needs only the low ``log2(n)`` bits of the product, which
+int32 wraparound multiplication preserves — no int64, no float64, no
+accumulated phase error at large ``k*s``.
+
+Zero-padding to ``n >= chunk_len + max_total_shift`` guarantees circular
+shifts never wrap data into the valid window (the pad region is what wraps,
+and it is zero — matching the time-domain path's zero end-padding).
+
+Reference treatment: nonexistent (the reference dedisperses with per-channel
+Python rolls, formats/spectra.py:54-94, one trial at a time on one core).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pypulsar_tpu.ops.pallas_kernels import boxcar_stats
+
+__all__ = ["sweep_chunk_fourier", "fourier_chunk_len"]
+
+
+def fourier_chunk_len(min_len: int) -> int:
+    """Smallest power-of-two FFT length >= min_len. TPU XLA lowers only
+    power-of-two FFTs efficiently (other sizes fall back to a dense DFT
+    matmul that allocates O(L^2) — observed 77 GB for L=139194)."""
+    n = 1
+    while n < min_len:
+        n <<= 1
+    return n
+
+
+def _phase(shifts, k, n_fft: int):
+    """exp(2i*pi*k*shifts/n) for integer shifts[...] and bins k[F]:
+    shift-LEFT by s in time is multiplication by W^(+k*s) in frequency.
+    Index math wraps mod n via int32 overflow (exact for power-of-two n)."""
+    idx = (k * shifts[..., None]) & jnp.int32(n_fft - 1)
+    ang = (2.0 * jnp.pi / n_fft) * idx.astype(jnp.float32)
+    return jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
+
+
+def sweep_chunk_fourier_impl(
+    data,
+    stage1_bins,
+    stage2_bins,
+    nsub: int,
+    out_len: int,
+    widths: Tuple[int, ...],
+    stat_len: int,
+    n_fft: int,
+    boxcar_backend: str = "auto",
+):
+    """Fourier-path equivalent of parallel.sweep._sweep_chunk_impl.
+
+    data[C, L] (L <= n_fft; n_fft >= out_len + max total shift so shifts
+    cannot wrap); stage1_bins[G, C]; stage2_bins[G, g, S].
+    Returns per-trial (sum[D], sumsq[D], maxbox[D, W], argbox[D, W]) with
+    window starts confined to the first ``stat_len`` samples.
+    """
+    C, L = data.shape
+    G, g, S = stage2_bins.shape
+    per = C // nsub
+    X = jnp.fft.rfft(data, n=n_fft, axis=1)  # [C, F]
+    F = X.shape[1]
+    k = jnp.arange(F, dtype=jnp.int32)
+
+    def per_group(carry, xs):
+        s1, s2 = xs  # [C], [g, S]
+        xsub = (X * _phase(s1, k, n_fft)).reshape(nsub, per, F).sum(axis=1)
+        xts = (xsub[None, :, :] * _phase(s2, k, n_fft)).sum(axis=1)  # [g, F]
+        ts = jnp.fft.irfft(xts, n=n_fft, axis=1)[:, :out_len]
+        s, ss, mb_g, ab_g = boxcar_stats(ts, widths, stat_len,
+                                         backend=boxcar_backend)
+        return carry, (s, ss, mb_g, ab_g)
+
+    _, (s, ss, mb, ab) = jax.lax.scan(per_group, 0, (stage1_bins, stage2_bins))
+    D = G * g
+    return (
+        s.reshape(D),
+        ss.reshape(D),
+        mb.reshape(D, len(widths)),
+        ab.reshape(D, len(widths)),
+    )
+
+
+sweep_chunk_fourier = jax.jit(
+    sweep_chunk_fourier_impl,
+    static_argnames=("nsub", "out_len", "widths", "stat_len", "n_fft",
+                     "boxcar_backend"),
+)

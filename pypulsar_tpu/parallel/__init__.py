@@ -1,7 +1,9 @@
 from pypulsar_tpu.parallel.mesh import make_mesh  # noqa: F401
 from pypulsar_tpu.parallel.sweep import (  # noqa: F401
+    SweepCheckpoint,
     SweepPlan,
     make_sweep_plan,
+    resolve_engine,
     sweep_spectra,
     SweepResult,
 )

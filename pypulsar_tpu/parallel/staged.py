@@ -18,6 +18,7 @@ traffic of high-DM steps falls geometrically exactly as the reference's
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -26,6 +27,7 @@ import numpy as np
 from pypulsar_tpu.ops import kernels
 from pypulsar_tpu.parallel.sweep import (
     DEFAULT_WIDTHS,
+    SweepCheckpoint,
     SweepResult,
     make_sweep_plan,
     sweep_stream,
@@ -171,7 +173,8 @@ def _downsampled_blocks(src, factor: int, payload_ds: int, overlap_ds: int):
 
 def _run_step(src, dms, factor: int, nsub: int, group_size: int,
               widths: Tuple[int, ...], chunk_payload: Optional[int],
-              mesh, verbose: bool = False, label: str = "") -> Optional[StepResult]:
+              mesh, verbose: bool = False, label: str = "",
+              checkpoint: Optional[SweepCheckpoint] = None) -> Optional[StepResult]:
     """Sweep one DM block over ``src`` downsampled by ``factor``."""
     dt_eff = src.tsamp * factor
     n_ds = src.nsamples // factor
@@ -198,6 +201,7 @@ def _run_step(src, dms, factor: int, nsub: int, group_size: int,
         payload,
         mesh=mesh,
         chan_major=True,
+        checkpoint=checkpoint,
     )
     return StepResult(downsamp=factor, dt=dt_eff, result=res)
 
@@ -212,14 +216,19 @@ def sweep_flat(
     chunk_payload: Optional[int] = None,
     mesh=None,
     verbose: bool = False,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 16,
 ) -> StagedSweepResult:
     """Single-stage sweep of an explicit DM grid over a file reader or
     Spectra (the flat counterpart of :func:`sweep_ddplan`, sharing its
-    streaming/downsampling machinery)."""
+    streaming/downsampling machinery). ``checkpoint_path`` enables in-sweep
+    checkpoint/resume (see SweepCheckpoint)."""
     src = _make_source(source)
+    ckpt = (SweepCheckpoint(checkpoint_path, every=checkpoint_every)
+            if checkpoint_path else None)
     step = _run_step(src, np.asarray(dms, dtype=np.float64), int(downsamp),
                      nsub, group_size, tuple(widths), chunk_payload, mesh,
-                     verbose=verbose)
+                     verbose=verbose, checkpoint=ckpt)
     return StagedSweepResult(steps=[] if step is None else [step])
 
 
@@ -232,6 +241,8 @@ def sweep_ddplan(
     chunk_payload: Optional[int] = None,
     mesh=None,
     verbose: bool = False,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 16,
 ) -> StagedSweepResult:
     """Execute every DDstep of ``ddplan`` over ``source``.
 
@@ -239,14 +250,89 @@ def sweep_ddplan(
     Each step sweeps ``step.DMs`` at sampling time ``dt * step.downsamp``
     with its own jit-compiled shapes; chunk_payload is the *downsampled*
     chunk length (default: the whole downsampled series).
+
+    ``checkpoint_path`` is a base path: step ``i`` streams its in-progress
+    accumulator to ``{path}.step{i}.npz`` and, once complete, its full
+    result to ``{path}.step{i}.done.npz`` — so a killed run resumes the
+    interrupted step mid-stream and loads finished steps from their done
+    markers without recompute. All marker files are removed when every
+    step has completed; the combined result is bit-identical to an
+    uninterrupted run (deterministic accumulation order, see
+    SweepCheckpoint).
     """
     src = _make_source(source)
     steps: List[StepResult] = []
+    done_fns: List[str] = []
     for si, step in enumerate(ddplan.DDsteps):
+        done_fn = (f"{checkpoint_path}.step{si}.done.npz"
+                   if checkpoint_path else None)
+        fp = (_step_fingerprint(src, step.DMs, int(step.downsamp), nsub,
+                                group_size, tuple(widths), chunk_payload)
+              if done_fn else "")
+        if done_fn and os.path.exists(done_fn):
+            sr = _load_step_result(done_fn, fp)
+            if sr is not None:
+                if verbose:
+                    print(f"# step {si}: resumed from {done_fn}")
+                steps.append(sr)
+                done_fns.append(done_fn)
+                continue
+        ckpt = (SweepCheckpoint(f"{checkpoint_path}.step{si}.npz",
+                                every=checkpoint_every)
+                if checkpoint_path else None)
         sr = _run_step(src, step.DMs, int(step.downsamp), nsub, group_size,
                        tuple(widths), chunk_payload, mesh, verbose=verbose,
-                       label=f"step {si}: ")
+                       label=f"step {si}: ", checkpoint=ckpt)
         if sr is None:
             break
+        if done_fn:
+            _save_step_result(done_fn, sr, fp)
+            done_fns.append(done_fn)
         steps.append(sr)
+    for fn in done_fns:  # full plan finished: clear the markers
+        if os.path.exists(fn):
+            os.remove(fn)
     return StagedSweepResult(steps=steps)
+
+
+def _step_fingerprint(src, dms, factor, nsub, group_size, widths,
+                      chunk_payload) -> str:
+    """Hash of everything that determines a step's result — a done marker
+    from different parameters or a different input must not be resumed."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for part in (np.asarray(dms, dtype=np.float64).tobytes(),
+                 src.frequencies.tobytes(),
+                 np.float64([src.tsamp]).tobytes(),
+                 np.int64([src.nsamples, factor, nsub, group_size,
+                           -1 if chunk_payload is None else chunk_payload]
+                          ).tobytes(),
+                 np.int64(widths).tobytes()):
+        h.update(part)
+    return h.hexdigest()
+
+
+def _save_step_result(path: str, sr: StepResult, fingerprint: str) -> None:
+    res = sr.result
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, fingerprint=fingerprint,
+             downsamp=sr.downsamp, dt=sr.dt, dms=res.dms,
+             widths=np.asarray(res.widths, dtype=np.int64), snr=res.snr,
+             peak_sample=res.peak_sample, mean=res.mean, std=res.std)
+    os.replace(tmp, path)
+
+
+def _load_step_result(path: str, fingerprint: str) -> Optional[StepResult]:
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            if str(z["fingerprint"]) != fingerprint:
+                return None
+            res = SweepResult(
+                dms=z["dms"], widths=tuple(int(w) for w in z["widths"]),
+                snr=z["snr"], peak_sample=z["peak_sample"],
+                mean=z["mean"], std=z["std"])
+            return StepResult(downsamp=int(z["downsamp"]),
+                              dt=float(z["dt"]), result=res)
+    except Exception:  # noqa: BLE001 - corrupt marker -> recompute the step
+        return None

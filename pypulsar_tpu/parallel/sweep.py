@@ -50,6 +50,33 @@ from pypulsar_tpu.utils import profiling
 
 DEFAULT_WIDTHS = (1, 2, 4, 8, 16, 32)
 
+ENGINES = ("gather", "scan", "fourier")
+
+
+def resolve_engine(engine: str = "auto") -> str:
+    """Pick the chunk-kernel formulation.
+
+    'fourier' (ops/fourier_dedisperse.py) is the default on TPU: the
+    recorded v5e A/B (BENCHNOTES.md) measured the gather path at ~26 GB/s
+    effective (3% of HBM roofline) while the Fourier phase-multiply path
+    streams at bandwidth. 'gather' stays the default off-TPU (CPU XLA
+    handles the vmapped dynamic_slice fine, and it is the bit-parity
+    reference formulation). Override with PYPULSAR_TPU_SWEEP_ENGINE.
+    """
+    if engine != "auto":
+        if engine not in ENGINES:
+            raise ValueError(f"unknown sweep engine {engine!r}; "
+                             f"expected one of {ENGINES + ('auto',)}")
+        return engine
+    env = os.environ.get("PYPULSAR_TPU_SWEEP_ENGINE")
+    if env and env != "auto":  # "auto" in the env var falls through
+        return resolve_engine(env)
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # noqa: BLE001 - backend probing must not fail
+        platform = "cpu"
+    return "fourier" if platform == "tpu" else "gather"
+
 
 @dataclasses.dataclass(frozen=True)
 class SweepPlan:
@@ -177,9 +204,10 @@ def _shift_segment_sum(rows, starts, length, seg: int):
 
     Scan-based alternative to ``_slice_rows(...).reshape(...).sum(axis=1)``:
     one dynamic_slice per scan step accumulating into the output, which
-    lowers to large contiguous copies instead of the vmapped gather
-    (measured ~11 GB/s on v5e) and never materializes the [N, length]
-    intermediate."""
+    lowers to contiguous copies instead of the vmapped gather and never
+    materializes the [N, length] intermediate. The recorded v5e A/B
+    (BENCHNOTES.md) has both formulations far below HBM bandwidth; the
+    Fourier engine supersedes them on TPU."""
     N = rows.shape[0]
     nseg = N // seg
     starts = starts.astype(jnp.int32)
@@ -211,6 +239,7 @@ def _sweep_chunk_impl(
     slack2: int,
     widths: Tuple[int, ...],
     stat_len: int,
+    engine: str = "gather",
 ):
     """Process one chunk for all trial groups.
 
@@ -220,8 +249,24 @@ def _sweep_chunk_impl(
     belong to this chunk (the payload), so streamed chunks don't double-count
     overlap samples.
 
+    ``engine``: 'gather' (vmapped dynamic_slice), 'scan' (sequential
+    dynamic_slice accumulation), 'fourier' (phase-multiply fast path,
+    ops/fourier_dedisperse.py — the TPU default via resolve_engine), or
+    'auto'. All three agree to f32 rounding (tests/test_sweep.py).
+
     Returns per-trial (sum[D], sumsq[D], maxbox[D, W], argbox[D, W]).
     """
+    engine = resolve_engine(engine)
+    if engine == "fourier":
+        from pypulsar_tpu.ops.fourier_dedisperse import (
+            fourier_chunk_len,
+            sweep_chunk_fourier_impl,
+        )
+
+        return sweep_chunk_fourier_impl(
+            data, stage1_bins, stage2_bins, nsub, out_len, widths,
+            stat_len, fourier_chunk_len(data.shape[1]),
+        )
     C, L = data.shape
     G, g, S = stage2_bins.shape
     per = C // nsub
@@ -229,8 +274,8 @@ def _sweep_chunk_impl(
 
     def per_group(carry, xs):
         shift1, shift2 = xs
-        if os.environ.get("PYPULSAR_TPU_SCAN_DEDISP"):
-            # experimental scan-based formulation (see _shift_segment_sum)
+        if engine == "scan":
+            # scan-based formulation (see _shift_segment_sum)
             sub = _shift_segment_sum(data, shift1, L1, per)  # [S, L1]
         else:
             sliced = _slice_rows(data, shift1, L1)  # [C, L1]
@@ -253,15 +298,19 @@ def _sweep_chunk_impl(
     )
 
 
-@partial(jax.jit, static_argnames=("nsub", "out_len", "slack2", "widths", "stat_len"))
-def sweep_chunk(data, stage1_bins, stage2_bins, nsub, out_len, slack2, widths, stat_len):
+@partial(jax.jit, static_argnames=("nsub", "out_len", "slack2", "widths",
+                                   "stat_len", "engine"))
+def sweep_chunk(data, stage1_bins, stage2_bins, nsub, out_len, slack2, widths,
+                stat_len, engine="gather"):
     """Single-device chunk sweep (see _sweep_chunk_impl)."""
     return _sweep_chunk_impl(
-        data, stage1_bins, stage2_bins, nsub, out_len, slack2, widths, stat_len
+        data, stage1_bins, stage2_bins, nsub, out_len, slack2, widths,
+        stat_len, engine=engine
     )
 
 
-def make_sharded_sweep_chunk(mesh: Mesh, nsub, out_len, slack2, widths, stat_len):
+def make_sharded_sweep_chunk(mesh: Mesh, nsub, out_len, slack2, widths,
+                             stat_len, engine="gather"):
     """Chunk sweep with trial groups sharded over the mesh 'dm' axis.
 
     The chunk is replicated to every device; each device scans only its local
@@ -276,6 +325,7 @@ def make_sharded_sweep_chunk(mesh: Mesh, nsub, out_len, slack2, widths, stat_len
         slack2=slack2,
         widths=widths,
         stat_len=stat_len,
+        engine=engine,
     )
     fn = jax.shard_map(
         impl,
@@ -287,7 +337,7 @@ def make_sharded_sweep_chunk(mesh: Mesh, nsub, out_len, slack2, widths, stat_len
 
 
 def make_sharded_sweep_chunk_2d(
-    mesh: Mesh, nsub, local_payload, overlap, slack2, widths
+    mesh: Mesh, nsub, local_payload, overlap, slack2, widths, engine="gather"
 ):
     """Chunk sweep sharded over BOTH mesh axes: trial groups over 'dm' and the
     time axis over 'time' (the long-context axis, SURVEY.md §5).
@@ -314,7 +364,7 @@ def make_sharded_sweep_chunk_2d(
         data_ext = jnp.concatenate([data_local, halo], axis=1)
         s, ss, mb, ab = _sweep_chunk_impl(
             data_ext, s1_local, s2_local, nsub, out_len, slack2, widths,
-            stat_len=local_payload,
+            stat_len=local_payload, engine=engine,
         )
         # moments: payload regions partition the time axis exactly
         s = jax.lax.psum(s, "time")
@@ -390,6 +440,90 @@ class _Accum:
         self.ab = np.where(better, ab, self.ab)
 
 
+class SweepCheckpoint:
+    """In-sweep checkpointing for long streams (SURVEY.md §5: the reference
+    pipeline is file-granular; a multi-hour 4096-trial sweep needs a
+    restart point finer than whole files).
+
+    Persists the host-side accumulator (`_Accum`), the resume cursor (first
+    unprocessed payload sample) and the per-channel baseline every ``every``
+    drained chunks, written atomically (tmp + rename). Chunk accumulation
+    happens in stream order on resume exactly as it would uninterrupted, so
+    a killed-and-resumed sweep reproduces the uninterrupted result
+    bit-for-bit (tested in tests/test_sweep.py).
+
+    A fingerprint of the plan geometry guards against resuming with
+    different parameters: mismatch starts from scratch.
+    """
+
+    def __init__(self, path: str, every: int = 16, cleanup: bool = True):
+        self.path = path
+        self.every = max(1, int(every))
+        self.cleanup = cleanup
+        self._drained = 0
+
+    @staticmethod
+    def _fingerprint(plan: SweepPlan, chunk_payload: int,
+                     context: str = "") -> str:
+        """``context`` carries everything outside the plan that affects the
+        numerics — the resolved engine and the mesh layout — so a
+        checkpoint can only resume under the exact configuration that
+        wrote it (the bit-identity contract; engines agree only to
+        ~1e-4)."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for part in (plan.dms.tobytes(), plan.freqs.tobytes(),
+                     np.float64(plan.dt).tobytes(),
+                     np.int64([plan.nsub, plan.group_size,
+                               plan.n_real_trials, chunk_payload]).tobytes(),
+                     np.int64(plan.widths).tobytes(),
+                     context.encode()):
+            h.update(part)
+        return h.hexdigest()
+
+    def load(self, plan: SweepPlan, chunk_payload: int, context: str = ""):
+        """(acc, cursor, baseline) from a matching checkpoint, else None."""
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with np.load(self.path, allow_pickle=False) as z:
+                if str(z["fingerprint"]) != self._fingerprint(
+                        plan, chunk_payload, context):
+                    return None
+                acc = _Accum(plan.n_trials, len(plan.widths))
+                acc.n = int(z["n"])
+                acc.s = z["s"]
+                acc.ss = z["ss"]
+                acc.mb = z["mb"]
+                acc.ab = z["ab"]
+                return acc, int(z["cursor"]), z["baseline"]
+        except Exception:  # noqa: BLE001 - a corrupt checkpoint restarts
+            return None
+
+    def save(self, plan: SweepPlan, chunk_payload: int, acc: "_Accum",
+             cursor: int, baseline, context: str = "") -> None:
+        tmp = self.path + ".tmp.npz"  # .npz suffix: savez must not append
+        np.savez(tmp,
+                 fingerprint=self._fingerprint(plan, chunk_payload, context),
+                 n=acc.n, s=acc.s, ss=acc.ss, mb=acc.mb, ab=acc.ab,
+                 cursor=cursor,
+                 baseline=np.asarray(baseline, dtype=np.float32))
+        os.replace(tmp, self.path)
+
+    def on_drained(self, plan, chunk_payload, acc, cursor, baseline,
+                   context: str = "") -> None:
+        self._drained += 1
+        if self._drained % self.every == 0:
+            with profiling.stage("checkpoint_save"):
+                self.save(plan, chunk_payload, acc, cursor, baseline,
+                          context)
+
+    def finish(self) -> None:
+        if self.cleanup and os.path.exists(self.path):
+            os.remove(self.path)
+
+
 def sweep_stream(
     plan: SweepPlan,
     blocks,
@@ -397,6 +531,9 @@ def sweep_stream(
     mesh: Optional[Mesh] = None,
     chan_major: bool = False,
     baseline=None,
+    engine: str = "auto",
+    max_pending: Optional[int] = None,
+    checkpoint: Optional[SweepCheckpoint] = None,
 ) -> SweepResult:
     """Run the sweep over a stream of (startsamp, block) chunks.
 
@@ -436,11 +573,21 @@ def sweep_stream(
     zero-padded *after* baseline subtraction, i.e. padded samples sit at the
     channel baseline level in original units.
     """
+    engine = resolve_engine(engine)
     W = max(plan.widths)
     out_len = chunk_payload + W
     slack2 = plan.max_shift2
     D = plan.n_trials
     acc = _Accum(D, len(plan.widths))
+    cursor = 0  # first payload sample not yet accumulated
+    ckpt_context = "engine=%s/meshdm=%s" % (
+        engine, 0 if mesh is None else mesh.shape.get("dm", 0))
+    if checkpoint is not None:
+        state = checkpoint.load(plan, chunk_payload, ckpt_context)
+        if state is not None:
+            acc, cursor, ckpt_baseline = state
+            if baseline is None:
+                baseline = ckpt_baseline  # bit-identical resume needs it
 
     s1 = jnp.asarray(plan.stage1_bins)
     s2 = jnp.asarray(plan.stage2_bins)
@@ -459,25 +606,33 @@ def sweep_stream(
     def run_chunk(data, stat_len):
         if mesh is None:
             return sweep_chunk(
-                data, s1, s2, plan.nsub, out_len, slack2, plan.widths, stat_len
+                data, s1, s2, plan.nsub, out_len, slack2, plan.widths,
+                stat_len, engine=engine
             )
         if stat_len not in sharded_fns:
             sharded_fns[stat_len] = make_sharded_sweep_chunk(
-                mesh, plan.nsub, out_len, slack2, plan.widths, stat_len
+                mesh, plan.nsub, out_len, slack2, plan.widths, stat_len,
+                engine=engine
             )
         return sharded_fns[stat_len](data, s1, s2)
 
     # Dispatch a few chunks ahead of the host-side accumulate so transfers
     # overlap compute, but bound the depth so queued input buffers (one chunk
-    # of HBM each) can be freed.
-    MAX_PENDING = 4
+    # of HBM each) can be freed. Callers with an HBM budget (bench.py) pass
+    # ``max_pending`` explicitly; each pending chunk holds one input buffer.
+    MAX_PENDING = 4 if max_pending is None else max(1, int(max_pending))
     pending = []  # (start, stat_len, device outputs)
 
     def drain(limit):
+        nonlocal cursor
         while len(pending) > limit:
             start, stat_len, (s, ss, mb, ab) = pending.pop(0)
             with profiling.stage("device_wait+accumulate"):
                 acc.update(start, stat_len, s, ss, mb, ab)
+            cursor = start + stat_len
+            if checkpoint is not None:
+                checkpoint.on_drained(plan, chunk_payload, acc, cursor,
+                                      baseline, ckpt_context)
 
     need = out_len + slack2 + plan.max_shift1
 
@@ -496,6 +651,8 @@ def sweep_stream(
     if baseline is not None:
         baseline = jnp.asarray(baseline, dtype=jnp.float32).reshape(-1, 1)
     for start, block in blocks:
+        if start < cursor:  # chunk already accumulated (checkpoint resume)
+            continue
         with profiling.stage("host_to_device"):
             if chan_major:
                 data = jnp.asarray(block, dtype=jnp.float32)
@@ -524,6 +681,8 @@ def sweep_stream(
     if prev is not None:
         process(*prev)
     drain(0)
+    if checkpoint is not None:
+        checkpoint.finish()
 
     mean = acc.s / max(acc.n, 1)
     var = np.maximum(acc.ss / max(acc.n, 1) - mean * mean, 0.0)
@@ -546,7 +705,8 @@ def sweep_stream(
 
 
 def sweep_spectra(spectra, dms, nsub=64, group_size=32, widths=DEFAULT_WIDTHS,
-                  chunk_payload=None, mesh=None, pad_groups_to=None) -> SweepResult:
+                  chunk_payload=None, mesh=None, pad_groups_to=None,
+                  engine="auto", max_pending=None) -> SweepResult:
     """Convenience: sweep an in-memory (possibly device-resident) Spectra
     over ``dms``; chunks are device-side slices, no host round-trips."""
     freqs = np.asarray(spectra.freqs, dtype=np.float64)
@@ -579,4 +739,4 @@ def sweep_spectra(spectra, dms, nsub=64, group_size=32, widths=DEFAULT_WIDTHS,
     else:
         baseline = jnp.mean(data.astype(jnp.float32), axis=1, keepdims=True)
     return sweep_stream(plan, blocks(), chunk_payload, mesh=mesh, chan_major=True,
-                        baseline=baseline)
+                        baseline=baseline, engine=engine, max_pending=max_pending)
