@@ -353,9 +353,12 @@ def run_ab(args):
             results[f"boxcar-{be}"] = round(time.perf_counter() - t0, 4)
         except Exception as e:  # noqa: BLE001
             results[f"boxcar-{be}"] = f"FAILED: {type(e).__name__}"
+    fourier_t = results.get("chunk-fourier", 0.0)
     return {
         "metric": "kernel_ab_seconds",
-        "value": results.get("chunk-fourier", 0.0),
+        # "value" must stay numeric whatever failed (the one-JSON-line
+        # contract); string FAILED markers live in the extras only
+        "value": fourier_t if isinstance(fourier_t, float) else 0.0,
         "unit": "s per 1024-trial chunk (see extras)",
         "vs_baseline": 0.0,
         **results,

@@ -1,0 +1,182 @@
+"""Multi-host execution: ``jax.distributed`` init + the file-batch axis.
+
+SURVEY.md §2.4 rows 4-5: the reference processes multi-beam / multi-file
+observations with sequential per-file Python loops on one core
+(``bin/autozap.py:76``, ``bin/fitkepler.py``); it has no communication
+backend at all. The TPU-native scale-out has two layers:
+
+1. **Within a host (ICI)**: the sweep engine's ``mesh`` argument shards DM
+   trials / the time axis across local devices (parallel/sweep.py) — no
+   code here is involved.
+2. **Across hosts (DCN)**: this module. Each host initializes the JAX
+   distributed runtime (:func:`initialize`), takes its slice of the file
+   list (:func:`shard_files` — the data-parallel batch axis of this
+   domain), sweeps its files locally, and merges the per-file candidate
+   summaries with a fixed-size all-gather over DCN
+   (:func:`allgather_candidates`). Candidate summaries are tiny (top-k
+   records per file), so cross-host traffic is bytes, not data — the
+   layout that keeps collectives off the raw-data path entirely.
+
+The same entry points are no-ops in a single-process run, so pipelines are
+written once: ``initialize()`` returns False and the "all-gather" is the
+identity. A two-process CPU integration test exercises the real
+``jax.distributed`` path (tests/test_distributed.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "initialize",
+    "is_distributed",
+    "process_index",
+    "process_count",
+    "shard_files",
+    "allgather_candidates",
+    "multi_host_sweep",
+]
+
+# environment surface (set by a launcher / scheduler on every host)
+ENV_COORD = "PYPULSAR_TPU_COORDINATOR"  # e.g. "10.0.0.1:9021"
+ENV_NPROC = "PYPULSAR_TPU_NUM_PROCESSES"
+ENV_PID = "PYPULSAR_TPU_PROCESS_ID"
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host runtime; returns True if distributed.
+
+    Arguments default to the ``PYPULSAR_TPU_{COORDINATOR,NUM_PROCESSES,
+    PROCESS_ID}`` environment variables. With no coordinator configured
+    (the common single-host case) this is a no-op returning False. Safe to
+    call more than once.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(ENV_COORD)
+    if not coordinator_address:
+        return False
+    if num_processes is None:
+        num_processes = int(os.environ.get(ENV_NPROC, "1"))
+    if process_id is None:
+        process_id = int(os.environ.get(ENV_PID, "0"))
+    if num_processes <= 1:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def is_distributed() -> bool:
+    return _initialized or process_count() > 1
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def shard_files(files: Sequence[str],
+                index: Optional[int] = None,
+                count: Optional[int] = None) -> List[str]:
+    """This host's slice of the observation file list (round-robin, so
+    hosts stay balanced when file sizes are similar — the batch axis over
+    DCN)."""
+    if index is None:
+        index = process_index()
+    if count is None:
+        count = process_count()
+    return list(files[index::count])
+
+
+def allgather_candidates(records: np.ndarray, pad_to: int) -> np.ndarray:
+    """All-gather fixed-size candidate records across hosts.
+
+    ``records[n, F]`` float64 rows (n <= pad_to); rows are padded with NaN
+    to ``pad_to`` so every host contributes the same static shape (the
+    collective compiles once). Returns the concatenated valid rows from
+    all hosts, on every host. Identity in a single-process run.
+    """
+    records = np.asarray(records, dtype=np.float64)
+    if records.ndim != 2:
+        raise ValueError("records must be [n, fields]")
+    n, F = records.shape
+    if n > pad_to:
+        records = records[:pad_to]
+        n = pad_to
+    padded = np.full((pad_to, F), np.nan)
+    padded[:n] = records
+    if process_count() == 1:
+        gathered = padded[None]
+    else:
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(multihost_utils.process_allgather(padded))
+    flat = gathered.reshape(-1, F)
+    return flat[~np.isnan(flat[:, 0])]
+
+
+def multi_host_sweep(
+    files: Sequence[str],
+    dms,
+    nsub: int = 64,
+    group_size: int = 32,
+    chunk_payload: Optional[int] = None,
+    mesh=None,
+    topk_per_file: int = 16,
+    open_reader=None,
+) -> np.ndarray:
+    """Sweep a file list across hosts; return the merged candidate table.
+
+    Every host sweeps ``shard_files(files)`` with the local engine (its
+    own ICI mesh if ``mesh`` is given), then the per-file top-k summaries
+    are all-gathered over DCN and merged by SNR. Output columns:
+    ``(file_index, dm, snr, width_bins, sample)``; every host returns the
+    same merged table.
+    """
+    from pypulsar_tpu.parallel.staged import sweep_flat
+
+    if open_reader is None:
+        from pypulsar_tpu.io import filterbank
+
+        open_reader = filterbank.FilterbankFile
+
+    rows = []
+    for fn in shard_files(files):
+        fi = list(files).index(fn)
+        reader = open_reader(fn)
+        staged = sweep_flat(reader, dms, nsub=nsub, group_size=group_size,
+                            chunk_payload=chunk_payload, mesh=mesh)
+        for c in staged.best(topk_per_file):
+            rows.append([fi, c["dm"], c["snr"], c["width_bins"],
+                         c["sample"]])
+    local = np.asarray(rows, dtype=np.float64).reshape(-1, 5)
+    # pad_to must be identical on every host (static collective shape):
+    # size for the largest per-host file share
+    max_share = -(-len(files) // max(process_count(), 1))
+    merged = allgather_candidates(local, pad_to=topk_per_file * max(max_share, 1))
+    order = np.argsort(merged[:, 2])[::-1]
+    return merged[order]

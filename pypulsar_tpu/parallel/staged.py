@@ -260,14 +260,21 @@ def sweep_ddplan(
     uninterrupted run (deterministic accumulation order, see
     SweepCheckpoint).
     """
+    from pypulsar_tpu.parallel.sweep import resolve_engine
+
     src = _make_source(source)
+    ckpt_context = "engine=%s/meshdm=%s" % (
+        resolve_engine("auto"),
+        0 if mesh is None else mesh.shape.get("dm", 0))
+    probe = _source_probe(src) if checkpoint_path else b""
     steps: List[StepResult] = []
     done_fns: List[str] = []
     for si, step in enumerate(ddplan.DDsteps):
         done_fn = (f"{checkpoint_path}.step{si}.done.npz"
                    if checkpoint_path else None)
         fp = (_step_fingerprint(src, step.DMs, int(step.downsamp), nsub,
-                                group_size, tuple(widths), chunk_payload)
+                                group_size, tuple(widths), chunk_payload,
+                                ckpt_context, probe)
               if done_fn else "")
         if done_fn and os.path.exists(done_fn):
             sr = _load_step_result(done_fn, fp)
@@ -295,10 +302,24 @@ def sweep_ddplan(
     return StagedSweepResult(steps=steps)
 
 
+def _source_probe(src) -> bytes:
+    """A cheap content sample of the input (first ~1k samples of every
+    channel): catches the input file being swapped for another of
+    identical geometry between checkpoint and resume."""
+    try:
+        _, block = next(src.chan_major_blocks(min(1024, src.nsamples), 0))
+        return np.ascontiguousarray(
+            np.asarray(block, dtype=np.float32)).tobytes()
+    except Exception:  # noqa: BLE001 - probe is best-effort
+        return b""
+
+
 def _step_fingerprint(src, dms, factor, nsub, group_size, widths,
-                      chunk_payload) -> str:
+                      chunk_payload, context, probe) -> str:
     """Hash of everything that determines a step's result — a done marker
-    from different parameters or a different input must not be resumed."""
+    from different parameters, a different engine/mesh, or a different
+    input must not be resumed (the bit-identity contract; engines agree
+    only to ~1e-4)."""
     import hashlib
 
     h = hashlib.sha256()
@@ -308,7 +329,8 @@ def _step_fingerprint(src, dms, factor, nsub, group_size, widths,
                  np.int64([src.nsamples, factor, nsub, group_size,
                            -1 if chunk_payload is None else chunk_payload]
                           ).tobytes(),
-                 np.int64(widths).tobytes()):
+                 np.int64(widths).tobytes(),
+                 context.encode(), probe):
         h.update(part)
     return h.hexdigest()
 
@@ -336,3 +358,86 @@ def _load_step_result(path: str, fingerprint: str) -> Optional[StepResult]:
                               dt=float(z["dt"]), result=res)
     except Exception:  # noqa: BLE001 - corrupt marker -> recompute the step
         return None
+
+
+def sweep_ddplan_2d(
+    source,
+    ddplan,
+    mesh,
+    nsub: int = 64,
+    group_size: int = 8,
+    widths: Sequence[int] = DEFAULT_WIDTHS,
+    engine: str = "auto",
+    max_trials_per_step: Optional[int] = None,
+) -> StagedSweepResult:
+    """Staged DDplan execution over a 2-D {dm, time} device mesh.
+
+    The 1-D path (:func:`sweep_ddplan`) shards trial groups over 'dm' and
+    streams time chunks from the host; here each step instead runs as ONE
+    sharded program over the whole (downsampled) series with the time axis
+    split across the mesh's 'time' axis — halos travel between neighbours
+    over ICI via lax.ppermute instead of through host overlap-save
+    (parallel.sweep.make_sharded_sweep_chunk_2d). This is the long-context
+    layout of SURVEY.md §5 exercised by the driver's multichip dryrun at
+    realistic shapes.
+
+    ``max_trials_per_step`` caps each DDstep's trial count (the dryrun uses
+    it to bound virtual-CPU wall time while keeping real channel counts and
+    sample lengths).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pypulsar_tpu.parallel.sweep import (
+        finalize_sweep,
+        make_sharded_sweep_chunk_2d,
+    )
+
+    src = _make_source(source)
+    nd = mesh.shape["dm"]
+    nt = mesh.shape["time"]
+    steps: List[StepResult] = []
+    for si, step in enumerate(ddplan.DDsteps):
+        factor = int(step.downsamp)
+        dms = np.asarray(step.DMs, dtype=np.float64)
+        if max_trials_per_step is not None:
+            dms = dms[:max_trials_per_step]
+        dt_eff = src.tsamp * factor
+        n_ds = src.nsamples // factor
+        if n_ds == 0:
+            break
+        G = -(-len(dms) // group_size)
+        pad_groups_to = -(-G // nd) * nd
+        plan = make_sweep_plan(dms, src.frequencies, dt_eff, nsub=nsub,
+                               group_size=group_size, widths=tuple(widths),
+                               pad_groups_to=pad_groups_to)
+        local_payload = n_ds // nt
+        if plan.min_overlap >= local_payload:
+            raise ValueError(
+                f"step {si}: time shard {local_payload} samples does not "
+                f"cover the halo {plan.min_overlap}; fewer 'time' shards "
+                f"or more data needed")
+        T_used = local_payload * nt
+        # whole downsampled series on the mesh (one pass; the per-channel
+        # baseline keeps the f32 accumulation at fluctuation scale, as in
+        # sweep_stream's contract)
+        blocks = list(_downsampled_blocks(src, factor, n_ds, 0))
+        data = jnp.concatenate([b for _, b in blocks], axis=1)[:, :T_used]
+        base = jnp.mean(data, axis=1, keepdims=True)
+        base_sum = float(np.asarray(jnp.sum(base), dtype=np.float64))
+        data = data - base
+        fn = make_sharded_sweep_chunk_2d(
+            mesh, plan.nsub, local_payload, plan.min_overlap,
+            plan.max_shift2, tuple(plan.widths), engine=engine)
+        darr = jax.device_put(data, NamedSharding(mesh, P(None, "time")))
+        s1 = jax.device_put(jnp.asarray(plan.stage1_bins),
+                            NamedSharding(mesh, P("dm")))
+        s2 = jax.device_put(jnp.asarray(plan.stage2_bins),
+                            NamedSharding(mesh, P("dm")))
+        s, ss, mb, ab = fn(darr, s1, s2)
+        jax.block_until_ready((s, ss, mb, ab))
+        # mean reported in original units, matching the 1-D staged path
+        res = finalize_sweep(plan, T_used, s, ss, mb, ab,
+                             baseline_sum=base_sum)
+        steps.append(StepResult(downsamp=factor, dt=dt_eff, result=res))
+    return StagedSweepResult(steps=steps)

@@ -684,22 +684,33 @@ def sweep_stream(
     if checkpoint is not None:
         checkpoint.finish()
 
-    mean = acc.s / max(acc.n, 1)
-    var = np.maximum(acc.ss / max(acc.n, 1) - mean * mean, 0.0)
+    B = float(np.asarray(baseline, dtype=np.float64).sum()) if baseline is not None else 0.0
+    return finalize_sweep(plan, acc.n, acc.s, acc.ss, acc.mb, acc.ab, B)
+
+
+def finalize_sweep(plan: SweepPlan, n: int, s, ss, mb, ab,
+                   baseline_sum: float = 0.0) -> SweepResult:
+    """Host-side (float64) SNR formula over accumulated moments + window
+    maxima — step 3 of the accumulation-order contract. ``baseline_sum``
+    restores the reported mean to original (pre-baseline-subtraction)
+    units; snr and std are invariant under the per-channel shift."""
+    s = np.asarray(s, dtype=np.float64)
+    ss = np.asarray(ss, dtype=np.float64)
+    mb = np.asarray(mb, dtype=np.float64)
+    ab = np.asarray(ab, dtype=np.int64)
+    mean = s / max(n, 1)
+    var = np.maximum(ss / max(n, 1) - mean * mean, 0.0)
     std = np.sqrt(var)
     ws = np.array(plan.widths, dtype=np.float64)
-    snr = (acc.mb - ws[None, :] * mean[:, None]) / (
+    snr = (mb - ws[None, :] * mean[:, None]) / (
         np.sqrt(ws)[None, :] * np.where(std > 0, std, 1.0)[:, None]
     )
-    # report mean in original (pre-baseline-subtraction) units; snr and std
-    # are invariant under the per-channel shift
-    B = float(np.asarray(baseline, dtype=np.float64).sum()) if baseline is not None else 0.0
     return SweepResult(
         dms=plan.dms[: plan.n_real_trials],
         widths=plan.widths,
         snr=snr[: plan.n_real_trials],
-        peak_sample=acc.ab[: plan.n_real_trials],
-        mean=mean[: plan.n_real_trials] + B,
+        peak_sample=ab[: plan.n_real_trials],
+        mean=mean[: plan.n_real_trials] + baseline_sum,
         std=std[: plan.n_real_trials],
     )
 
