@@ -60,6 +60,9 @@ def parse_args(argv=None):
     ap.add_argument("--ab", action="store_true",
                     help="run the kernel A/B comparison table instead of the "
                          "headline benchmark")
+    ap.add_argument("--accel", action="store_true",
+                    help="benchmark the acceleration-search engine "
+                         "(configs[4]) instead of the DM sweep")
     ap.add_argument("--cpu-fallback", action="store_true",
                     help="(internal) run on the CPU backend with reduced shapes")
     ap.add_argument("--child", action="store_true",
@@ -365,6 +368,80 @@ def run_ab(args):
     }
 
 
+def run_accel(args):
+    """Acceleration-search throughput (BASELINE configs[4]: the reference
+    defers this stage to PRESTO accelsearch on one core; our engine is
+    fourier/accelsearch.py). Metric: searched (r, z) plane cells per
+    second over the full harmonic ladder; baseline: the same correlation
+    math in single-core NumPy (np.fft) measured on a slice of the z bank
+    and one segment per stage, scaled linearly."""
+    acquire_backend()
+    import jax.numpy as jnp
+    from pypulsar_tpu.fourier.accelsearch import AccelSearchConfig, accel_search
+    from pypulsar_tpu.fourier.zresponse import template_bank
+
+    if args.quick or args.cpu_fallback:
+        N, zmax, segw = 1 << 18, 50.0, 1 << 13
+    else:
+        N, zmax, segw = 1 << 21, 200.0, 1 << 14
+    T = N * 128e-6
+    rng = np.random.RandomState(0)
+    ts = rng.standard_normal(2 * N).astype(np.float32)
+    fft = (np.fft.rfft(ts) / np.sqrt(2 * N)).astype(np.complex64)[:N]
+    cfg = AccelSearchConfig(zmax=zmax, dz=2.0, numharm=8, sigma_min=6.0,
+                            seg_width=segw)
+    Z = len(cfg.zs)
+
+    accel_search(jnp.asarray(fft[: 4 * segw + 8]), T, cfg)  # warm compile
+    t0 = time.perf_counter()
+    cands = accel_search(jnp.asarray(fft), T, cfg)
+    jax_time = time.perf_counter() - t0
+    rlo = max(int(np.ceil(cfg.flo * T)), 1)
+    # stage H searches the top-harmonic bins [H*rlo, N-1] at half-bin
+    # resolution across Z drifts (fhi defaults to Nyquist here)
+    cells = sum(2 * Z * max((N - 1) - H * rlo, 0) for H in cfg.stages)
+    cells_per_sec = cells / jax_time
+
+    # numpy baseline: one stage-1 segment's correlations (the engine's own
+    # math with np.fft), scaled to the full cell count
+    tb, hw = template_bank(cfg.zs, numbetween=2)
+    L = 1
+    while L < segw + 4 * hw:
+        L <<= 1
+    padded = np.zeros((tb.shape[0], L), np.complex128)
+    padded[:, : tb.shape[1]] = tb
+    rev = np.zeros_like(padded)
+    rev[:, 0] = padded[:, 0]
+    rev[:, 1:] = padded[:, :0:-1]
+    tf = np.fft.fft(rev, axis=1)
+    seg = fft[:L].astype(np.complex128)
+    t0 = time.perf_counter()
+    sl = np.fft.fft(seg)
+    corr = np.fft.ifft(sl[None, :] * tf, axis=1)
+    _ = (np.abs(corr) ** 2).astype(np.float32)
+    bl_time = time.perf_counter() - t0
+    bl_cells = 2 * Z * segw  # one fundamental segment's worth
+    bl_cells_per_sec = bl_cells / bl_time
+    speedup = cells_per_sec / bl_cells_per_sec
+
+    print(f"# accel search: {jax_time:.2f}s for {cells/1e6:.0f}M cells "
+          f"({len(cands)} cands); numpy slice {bl_time:.2f}s for "
+          f"{bl_cells/1e6:.1f}M cells", file=sys.stderr)
+    unit = (f"(r,z) cells/s (N={N} bins, zmax={zmax:.0f}, dz=2, H<=8; "
+            f"numpy baseline from one segment x one stage, scaled linearly)")
+    if args.cpu_fallback:
+        unit += " [CPU FALLBACK: accelerator backend unavailable]"
+    return {
+        "metric": "accel_rz_cells_per_sec",
+        "value": round(cells_per_sec, 1),
+        "unit": unit,
+        "vs_baseline": round(speedup, 2),
+        "jax_seconds": round(jax_time, 3),
+        "numpy_seconds_measured": round(bl_time, 3),
+        "n_candidates": len(cands),
+    }
+
+
 def run_child(args, cpu: bool, timeout: float):
     """Run the measurement in a child interpreter; return its JSON record.
 
@@ -386,7 +463,7 @@ def run_child(args, cpu: bool, timeout: float):
         if val is not None:
             argv += [flag, str(val)]
     argv += ["--dm-max", str(args.dm_max), "--engine", args.engine]
-    for flag in ("quick", "profile", "ab"):
+    for flag in ("quick", "profile", "ab", "accel"):
         if getattr(args, flag):
             argv.append("--" + flag)
     proc = subprocess.run(argv, env=env, capture_output=True, text=True,
@@ -404,7 +481,12 @@ def main():
     args = parse_args()
     if args.child:
         # measurement mode: run in this interpreter, print JSON, propagate rc
-        record = run_ab(args) if args.ab else run_benchmark(args)
+        if args.ab:
+            record = run_ab(args)
+        elif args.accel:
+            record = run_accel(args)
+        else:
+            record = run_benchmark(args)
         print(json.dumps(record))
         return
     record = None

@@ -29,6 +29,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 _SRC = os.path.join(os.path.dirname(__file__), "codec.cpp")
+_SRC_PREFETCH = os.path.join(os.path.dirname(__file__), "prefetch.cpp")
 _LIB = os.path.join(os.path.dirname(__file__), "libpsrcodec.so")
 
 _lib: Optional[ctypes.CDLL] = None
@@ -39,7 +40,9 @@ def _build() -> bool:
     # compile to a temp path and rename atomically so concurrent
     # importers never dlopen a half-written .so
     tmp = _LIB + ".tmp.%d" % os.getpid()
-    cmd = ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", tmp]
+    srcs = [s for s in (_SRC, _SRC_PREFETCH) if os.path.isfile(s)]
+    cmd = (["g++", "-O3", "-std=c++17", "-shared", "-fPIC"] + srcs
+           + ["-o", tmp, "-lpthread"])
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)
@@ -63,9 +66,10 @@ def _load() -> Optional[ctypes.CDLL]:
     _tried = True
     if os.environ.get("PYPULSAR_TPU_NO_NATIVE"):
         return None
-    if not os.path.isfile(_LIB) or (
-            os.path.isfile(_SRC) and
-            os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+    stale = not os.path.isfile(_LIB) or any(
+        os.path.isfile(s) and os.path.getmtime(s) > os.path.getmtime(_LIB)
+        for s in (_SRC, _SRC_PREFETCH))
+    if stale:
         if not _build():
             return None
     try:
@@ -86,6 +90,16 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.transpose_to_chan_major.argtypes = [voidp, f32p, sz, sz,
                                             ctypes.c_int]
     lib.boxcar_peak_snr.argtypes = [f32p, sz, i32p, sz, f32p]
+    i64 = ctypes.c_int64
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    if hasattr(lib, "pf_open"):
+        lib.pf_open.argtypes = [ctypes.c_char_p, i64, i64, i64, i64, i64,
+                                ctypes.c_int]
+        lib.pf_open.restype = voidp
+        lib.pf_acquire.argtypes = [voidp, ctypes.POINTER(u8p), i64p, i64p]
+        lib.pf_acquire.restype = ctypes.c_int
+        lib.pf_release.argtypes = [voidp]
+        lib.pf_close.argtypes = [voidp]
     _lib = lib
     return _lib
 
@@ -221,3 +235,91 @@ def boxcar_peak_snr(series: np.ndarray,
         sums = csum[w:] - csum[:-w]
         out[i] = sums.max() / np.sqrt(float(w))
     return out
+
+
+class PrefetchReader:
+    """Background-thread block reader over a raw sample region of a file
+    (native/prefetch.cpp): yields ``(start_spectrum, bytes)`` overlap-save
+    blocks while the next ones load off the critical path — the host-side
+    analogue of the sweep's dispatch pipeline. Falls back to synchronous
+    reads when the native library is unavailable.
+
+    The file region is ``total_spec`` spectra of ``bytes_per_spec`` bytes
+    starting at byte ``data_offset``; blocks advance by ``payload`` and
+    carry ``overlap`` extra trailing spectra.
+    """
+
+    def __init__(self, path: str, data_offset: int, bytes_per_spec: int,
+                 total_spec: int, payload: int, overlap: int = 0,
+                 depth: int = 3):
+        self.path = path
+        self.data_offset = int(data_offset)
+        self.bytes_per_spec = int(bytes_per_spec)
+        self.total_spec = int(total_spec)
+        self.payload = int(payload)
+        self.overlap = int(overlap)
+        self.depth = max(1, int(depth))
+        self._lib = _load()
+        self._h = None
+        if self._lib is not None and hasattr(self._lib, "pf_open"):
+            self._h = self._lib.pf_open(
+                path.encode(), self.data_offset, self.bytes_per_spec,
+                self.total_spec, self.payload, self.overlap, self.depth)
+        self.native = self._h is not None
+
+    def __iter__(self):
+        if self.native and self._h is not None:
+            return self._iter_native()
+        # fallback also covers re-iteration after the native handle was
+        # consumed/closed (a second pass re-reads synchronously)
+        return self._iter_fallback()
+
+    def _iter_native(self):
+        lib = self._lib
+        buf = ctypes.POINTER(ctypes.c_uint8)()
+        start = ctypes.c_int64()
+        nspec = ctypes.c_int64()
+        try:
+            while True:
+                rc = lib.pf_acquire(self._h, ctypes.byref(buf),
+                                    ctypes.byref(start), ctypes.byref(nspec))
+                if rc == 0:
+                    return
+                if rc < 0:
+                    raise IOError(f"prefetch read failed on {self.path}")
+                n = int(nspec.value)
+                if n > 0:
+                    # copy out before release (the slot buffer is reused)
+                    raw = np.ctypeslib.as_array(
+                        buf, shape=(n * self.bytes_per_spec,)).copy()
+                    lib.pf_release(self._h)
+                    yield int(start.value), raw
+                else:
+                    lib.pf_release(self._h)
+        finally:
+            self.close()
+
+    def _iter_fallback(self):
+        with open(self.path, "rb") as f:
+            pos = 0
+            while pos < self.total_spec:
+                n = min(self.payload + self.overlap, self.total_spec - pos)
+                f.seek(self.data_offset + pos * self.bytes_per_spec)
+                raw = np.fromfile(f, dtype=np.uint8,
+                                  count=n * self.bytes_per_spec)
+                if raw.size == 0:
+                    return
+                yield pos, raw
+                pos += self.payload
+
+    def close(self):
+        if self._h is not None:
+            self._lib.pf_close(self._h)
+            self._h = None
+            self.native = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

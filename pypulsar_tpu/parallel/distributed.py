@@ -165,11 +165,17 @@ def multi_host_sweep(
         open_reader = filterbank.FilterbankFile
 
     rows = []
-    for fn in shard_files(files):
-        fi = list(files).index(fn)
-        reader = open_reader(fn)
-        staged = sweep_flat(reader, dms, nsub=nsub, group_size=group_size,
-                            chunk_payload=chunk_payload, mesh=mesh)
+    files = list(files)
+    for fi in range(process_index(), len(files), process_count()):
+        reader = open_reader(files[fi])
+        try:
+            staged = sweep_flat(reader, dms, nsub=nsub,
+                                group_size=group_size,
+                                chunk_payload=chunk_payload, mesh=mesh)
+        finally:
+            close = getattr(reader, "close", None)
+            if close is not None:
+                close()
         for c in staged.best(topk_per_file):
             rows.append([fi, c["dm"], c["snr"], c["width_bins"],
                          c["sample"]])

@@ -132,6 +132,17 @@ class _ReaderSource:
             raise ValueError(f"cannot determine sample count of {reader!r}")
 
     def chan_major_blocks(self, payload: int, overlap: int):
+        iter_blocks = getattr(self.reader, "iter_blocks", None)
+        if iter_blocks is not None and getattr(
+                self.reader, "BLOCK_ITER_ARRAYS", False):
+            # reader-provided streaming (filterbank: native background
+            # prefetch thread, native/prefetch.cpp) — disk reads overlap
+            # device compute. Gated on the marker: fbobs.iter_blocks
+            # yields Spectra with different stepping semantics and must
+            # take the fallback branches below.
+            for pos, block in iter_blocks(payload, overlap):
+                yield pos, np.ascontiguousarray(block.T)
+            return
         get_samples = getattr(self.reader, "get_samples", None)
         get_interval = getattr(self.reader, "get_sample_interval", None)
         pos = 0
