@@ -408,13 +408,15 @@ def run_accel(args):
     L = 1
     while L < segw + 4 * hw:
         L <<= 1
+    # dtype-matched to the engine (complex64) so the comparison is the
+    # same math at the same precision
     padded = np.zeros((tb.shape[0], L), np.complex128)
     padded[:, : tb.shape[1]] = tb
     rev = np.zeros_like(padded)
     rev[:, 0] = padded[:, 0]
     rev[:, 1:] = padded[:, :0:-1]
-    tf = np.fft.fft(rev, axis=1)
-    seg = fft[:L].astype(np.complex128)
+    tf = np.fft.fft(rev, axis=1).astype(np.complex64)
+    seg = fft[:L].astype(np.complex64)
     t0 = time.perf_counter()
     sl = np.fft.fft(seg)
     corr = np.fft.ifft(sl[None, :] * tf, axis=1)

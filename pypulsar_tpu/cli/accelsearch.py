@@ -78,6 +78,11 @@ def build_parser():
                         "(default 200)")
     p.add_argument("--dz", type=float, default=2.0,
                    help="drift step in bins (default 2)")
+    p.add_argument("-w", "--wmax", type=float, default=0.0,
+                   help="max jerk in bins over T^3 (0 = no w search; "
+                        "cost scales with the w grid size)")
+    p.add_argument("--dw", type=float, default=20.0,
+                   help="jerk step in bins (default 20)")
     p.add_argument("-n", "--numharm", type=int, default=8,
                    choices=(1, 2, 4, 8),
                    help="max harmonics summed (default 8)")
@@ -116,12 +121,15 @@ def main(argv=None):
     cfg = AccelSearchConfig(
         zmax=args.zmax, dz=args.dz, numharm=args.numharm,
         sigma_min=args.sigma, flo=args.flo, fhi=args.fhi,
+        wmax=args.wmax, dw=args.dw,
     )
     cands = accel_search(norm, T, cfg)[: args.max_cands]
 
     from pypulsar_tpu.io.prestocand import write_rzwcands
 
     ztag = int(round(args.zmax))
+    if args.wmax > 0:
+        ztag = f"{ztag}_JERK_{int(round(args.wmax))}"
     candfn = f"{outbase}_ACCEL_{ztag}.cand"
     write_rzwcands(candfn, [c.as_fourierprops() for c in cands])
     txtfn = f"{outbase}_ACCEL_{ztag}.txtcand"

@@ -51,7 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 from scipy.special import gammaincc, gammainccinv, gammaln, log_ndtr, ndtri
 
-from pypulsar_tpu.fourier.zresponse import template_bank, z_halfwidth
+from pypulsar_tpu.fourier.zresponse import template_bank_zw
 from pypulsar_tpu.ops.fourier_dedisperse import fourier_chunk_len
 from pypulsar_tpu.utils import profiling
 
@@ -150,6 +150,10 @@ class AccelSearchConfig:
     seg_width: int = 1 << 14  # fundamental bins per device segment
     topk: int = 64  # max raw hits per (segment, stage)
     min_halfwidth: int = 24
+    # jerk search (PRESTO -wmax equivalent): wmax > 0 extends the template
+    # bank to a (z, w) product grid — cost scales by len(ws)
+    wmax: float = 0.0
+    dw: float = 20.0
 
     @property
     def zs(self) -> np.ndarray:
@@ -158,6 +162,15 @@ class AccelSearchConfig:
         the sub-cell refinement relies on, wins over symmetry)."""
         n = int(np.floor(2 * self.zmax / self.dz)) + 1
         return -self.zmax + self.dz * np.arange(n)
+
+    @property
+    def ws(self) -> np.ndarray:
+        """Jerk grid (bins of second-order drift over T^3); [0] when the
+        w dimension is off."""
+        if self.wmax <= 0.0:
+            return np.zeros(1)
+        n = int(np.floor(2 * self.wmax / self.dw)) + 1
+        return -self.wmax + self.dw * np.arange(n)
 
     @property
     def stages(self) -> Tuple[int, ...]:
@@ -178,6 +191,8 @@ class AccelCandidate:
     numharm: int
     rerr: float = 0.0
     zerr: float = 0.0
+    w: float = 0.0
+    werr: float = 0.0
 
     def freq(self, T: float) -> float:
         return self.r / T
@@ -185,11 +200,15 @@ class AccelCandidate:
     def fdot(self, T: float) -> float:
         return self.z / (T * T)
 
+    def fddot(self, T: float) -> float:
+        return self.w / (T * T * T)
+
     def as_fourierprops(self) -> Dict[str, float]:
         """Field mapping for io.prestocand.write_rzwcands."""
         return dict(
             r=self.r, rerr=self.rerr, z=self.z, zerr=self.zerr,
-            w=0.0, werr=0.0, pow=self.power, powerr=math.sqrt(self.numharm),
+            w=self.w, werr=self.werr,
+            pow=self.power, powerr=math.sqrt(self.numharm),
             sig=self.sigma, rawpow=self.power, phs=0.0, phserr=0.0,
             cen=0.0, cenerr=0.0, pur=0.0, purerr=0.0,
             locpow=float(self.numharm),
@@ -295,7 +314,9 @@ def accel_search(
     fftd = jnp.asarray(fft, dtype=jnp.complex64)
     N = int(fftd.shape[0])
     zs = cfg.zs  # top-harmonic drift grid
+    ws = cfg.ws  # top-harmonic jerk grid ([0] unless wmax > 0)
     Z = len(zs)
+    Wn = len(ws)
     stages = cfg.stages
     segw = cfg.seg_width
     if segw % max(stages):
@@ -312,11 +333,13 @@ def accel_search(
     from fractions import Fraction
 
     ratios = sorted({Fraction(b, H) for H in stages for b in range(1, H + 1)})
-    banks = {}
+    banks = {}  # host-side (complex64 numpy): device copies live per stage
     for rho in ratios:
         rf = float(rho)
-        tb, hw = template_bank(zs * rf, numbetween=2,
-                               min_halfwidth=cfg.min_halfwidth)
+        # harmonic b/H of a signal with (z, w) drifts at the top harmonic
+        # has drifts scaled by the same ratio
+        tb, hw = template_bank_zw(zs * rf, ws * rf, numbetween=2,
+                                  min_halfwidth=cfg.min_halfwidth)
         wrho = (segw * rho.numerator) // rho.denominator
         m = tb.shape[1]
         L = fourier_chunk_len(wrho + 2 * hw + m)
@@ -325,18 +348,15 @@ def accel_search(
         rev = np.zeros_like(padded)
         rev[:, 0] = padded[:, 0]
         rev[:, 1:] = padded[:, :0:-1]
-        tf = np.fft.fft(rev, axis=1)
+        tf = np.fft.fft(rev, axis=1).astype(np.complex64)
         # static stretch: plane column `col` (top position r0 + col/2) maps
         # to subharm half-bin index round(rho*col) relative to rho*r0
         # corr[j] evaluates spectrum position s0 + j (the template's -hw
         # offset cancels the slice's -hw start), so the column index is
         # rel//2 with no hw term
         rel = np.floor(rf * np.arange(2 * segw) + 0.5).astype(np.int64)
-        idx = (rel % 2) * L + (rel // 2)  # into [2, L] row-major
-        banks[rho] = (
-            jnp.asarray(tf, dtype=jnp.complex64), hw, L,
-            jnp.asarray(idx, dtype=jnp.int32),
-        )
+        idx = ((rel % 2) * L + (rel // 2)).astype(np.int32)
+        banks[rho] = (tf, hw, L, idx)
 
     # pad the spectrum: conjugate reflection in front (bin -k of a real
     # input's FFT is conj(bin k)) so templates overhanging the lowest bins
@@ -356,24 +376,35 @@ def accel_search(
     numindep, thresh = {}, {}
     for H in stages:
         ntop = max(min(H * rhi, N - 1) - H * rlo, 1)
-        numindep[H] = max(ntop * Z / H, 1.0)
+        numindep[H] = max(ntop * Z * Wn / H, 1.0)
         thresh[H] = power_threshold(cfg.sigma_min, H, numindep[H])
 
-    raw_hits = []  # (stage, seg r0, vals, zidx, colidx, neigh, width)
+    raw_hits = []  # (stage, w idx, seg r0, vals, zidx, colidx, neigh, width)
     for H in stages:
         top_lo = H * rlo
         top_hi = min(H * rhi, N - 1)
         if top_hi <= top_lo:
             continue
+        # device residency bounded per stage: only this stage's <= H ratio
+        # banks live in HBM at once (a full jerk bank set across all
+        # stages would be tens of GB at survey parameters)
+        dev_banks = {
+            Fraction(b, H): (
+                jnp.asarray(banks[Fraction(b, H)][0]),
+                banks[Fraction(b, H)][1],
+                banks[Fraction(b, H)][2],
+                jnp.asarray(banks[Fraction(b, H)][3]),
+            )
+            for b in range(1, H + 1)
+        }
         n_seg = -(-(top_hi - top_lo) // segw)
         for si in range(n_seg):
             r0 = top_lo + si * segw  # divisible by H (segw % H == 0)
             width = min(segw, top_hi - r0)
-            plane = jnp.zeros((Z, 2 * segw), jnp.float32)
+            plane = jnp.zeros((Z * Wn, 2 * segw), jnp.float32)
             with profiling.stage("accel_planes"):
                 for b in range(1, H + 1):
-                    rho = Fraction(b, H)
-                    tf, hw, L, idx = banks[rho]
+                    tf, hw, L, idx = dev_banks[Fraction(b, H)]
                     s0 = (b * r0) // H  # exact: H | r0
                     start = front + s0 - hw
                     powf = _corr_pow(spec_pad, tf, start, L)
@@ -384,14 +415,19 @@ def accel_search(
                 # would crowd genuine candidates out of the top-k
                 plane = plane.at[:, 2 * width:].set(-jnp.inf)
             with profiling.stage("accel_detect"):
-                vals, zi, ri, neigh = _detect(
-                    plane, jnp.float32(thresh[H]), cfg.topk)
-            raw_hits.append((H, r0, np.asarray(vals), np.asarray(zi),
-                             np.asarray(ri), np.asarray(neigh), width))
+                # local-max structure is (z, r) at fixed w: detect per
+                # w-slice of the row-major (z, w) bank layout
+                for wi in range(Wn):
+                    vals, zi, ri, neigh = _detect(
+                        plane[wi::Wn], jnp.float32(thresh[H]), cfg.topk)
+                    raw_hits.append((H, wi, r0, np.asarray(vals),
+                                     np.asarray(zi), np.asarray(ri),
+                                     np.asarray(neigh), width))
+        del dev_banks  # free this stage's HBM before the next
 
     # --- host: refine + significance + sift (float64) ---
     cands: List[AccelCandidate] = []
-    for H, r0, vals, zi, ri, neigh, width in raw_hits:
+    for H, wi, r0, vals, zi, ri, neigh, width in raw_hits:
         for j in range(len(vals)):
             p = float(vals[j])
             if not np.isfinite(p) or p <= thresh[H]:
@@ -403,6 +439,7 @@ def accel_search(
             dzo, _ = _parabola_peak(nb[0, 1], nb[1, 1], nb[2, 1])
             r_top = r0 + 0.5 * (float(ri[j]) + dr)
             z_top = zs[int(zi[j])] + dzo * cfg.dz
+            w_top = float(ws[wi])
             sig = candidate_sigma(p, H, numindep[H])
             if sig < cfg.sigma_min:
                 continue
@@ -411,9 +448,11 @@ def accel_search(
             # scaled to the fundamental
             rerr = 3.0 / (np.pi * math.sqrt(6.0 * p)) / H
             zerr = 3.0 * math.sqrt(105.0 / p) / np.pi / H
+            werr = (cfg.dw / math.sqrt(max(p, 1.0))) / H if Wn > 1 else 0.0
             cands.append(AccelCandidate(
                 r=r_top / H, z=z_top / H, power=p, sigma=sig,
-                numharm=H, rerr=rerr, zerr=zerr))
+                numharm=H, rerr=rerr, zerr=zerr,
+                w=w_top / H, werr=werr))
 
     # sift: sort by sigma, greedily keep candidates whose fundamental is
     # not within 1 bin (and 2 z grid cells) of an already-accepted one

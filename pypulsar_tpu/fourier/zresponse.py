@@ -36,7 +36,9 @@ from scipy.special import fresnel
 __all__ = [
     "z_response",
     "z_halfwidth",
+    "zw_halfwidth",
     "template_bank",
+    "template_bank_zw",
 ]
 
 
@@ -100,4 +102,81 @@ def template_bank(zs: np.ndarray, numbetween: int = 2,
             resp = z_response(z, offs)
             energy = np.sqrt(np.sum(np.abs(resp) ** 2))
             rows.append(np.conj(resp) / energy)
+    return np.asarray(rows, dtype=np.complex128), hw
+
+
+def zw_halfwidth(z: float, w: float, min_halfwidth: int = 24) -> int:
+    """Half-width covering a (z, w) jerk response: the instantaneous
+    frequency f(u) = f0 + z*u + w*u^2/2 excursion from its mean is at most
+    |z|/2 + |w|/3 bins (extrema of the quadratic over [0,1])."""
+    return int(np.ceil(abs(z) / 2.0 + abs(w) / 3.0)) + min_halfwidth
+
+
+def _numeric_response(z: float, w: float, offsets: np.ndarray,
+                      oversample: int = 8) -> np.ndarray:
+    """Response of a (z, w) polynomial chirp at bin offsets from its MEAN
+    frequency, by direct DFT synthesis (no closed form exists for w != 0;
+    for w = 0 this independently validates the Fresnel expression —
+    tests/test_accelsearch.py).
+
+    A chirp ``exp(2i*pi*(f0*u + z*u^2/2 + w*u^3/6))`` is synthesized at
+    ``M`` samples with ``f0`` placed away from DC/Nyquist, FFT'd, and the
+    window around the mean frequency ``f0 + z/2 + w/6`` is interpolated at
+    the requested (generally fractional) offsets via the FFT of the
+    ``oversample``-padded series (exact trigonometric interpolation).
+    """
+    return _numeric_response_multi(z, w, [offsets], oversample)[0]
+
+
+def _numeric_response_multi(z: float, w: float, offset_sets,
+                            oversample: int = 8):
+    """One chirp synthesis + FFT shared across several offset grids (the
+    ``numbetween`` half-bin rows differ only in where they sample the same
+    spectrum — recomputing the FFT per row would double bank-build time)."""
+    offset_sets = [np.asarray(o, dtype=np.float64) for o in offset_sets]
+    span = max((abs(o).max() if o.size else 1.0)
+               for o in offset_sets) + abs(z) + abs(w) / 3.0
+    M = 1 << int(np.ceil(np.log2(max(64.0, 8.0 * span + 1024))))
+    f0 = M // 4
+    u = np.arange(M, dtype=np.float64) / M
+    chirp = np.exp(2j * np.pi * (f0 * u + z * u * u / 2.0
+                                 + w * u * u * u / 6.0))
+    X = np.fft.fft(chirp, n=M * oversample) / M
+    fmean = f0 + z / 2.0 + w / 6.0
+    out = []
+    for offsets in offset_sets:
+        pos = (fmean + offsets) * oversample
+        k = np.round(pos).astype(np.int64) % (M * oversample)
+        # oversampled grid spacing 1/oversample bins: rounding error <=
+        # 1/16 bin, negligible against the >= 48-bin template support
+        out.append(X[k])
+    return out
+
+
+def template_bank_zw(zs: np.ndarray, ws: np.ndarray, numbetween: int = 2,
+                     min_halfwidth: int = 24):
+    """Unit-energy conjugate templates over a (z, w) product grid.
+
+    Returns ``(templates[len(zs)*len(ws)*numbetween, m], hw)``; row
+    ``((zi * len(ws)) + wi) * numbetween + b`` is the centered conjugate
+    response for drift ``zs[zi]``, jerk ``ws[wi]`` at sample offsets
+    ``k - b/numbetween``. With ``ws == [0.0]`` rows reduce to
+    :func:`template_bank`'s (same order), so the z-only search is the
+    special case.
+    """
+    zs = np.asarray(zs, dtype=np.float64)
+    ws = np.asarray(ws, dtype=np.float64)
+    hw = max(zw_halfwidth(z, w, min_halfwidth) for z in zs for w in ws)
+    k = np.arange(-hw, hw, dtype=np.float64)
+    rows = []
+    for z in zs:
+        for w in ws:
+            offsets = [k - b / float(numbetween) for b in range(numbetween)]
+            if w == 0.0:
+                resps = [z_response(z, o + z / 2.0) for o in offsets]
+            else:
+                resps = _numeric_response_multi(z, w, offsets)
+            for resp in resps:
+                energy = np.sqrt(np.sum(np.abs(resp) ** 2))
+                rows.append(np.conj(resp) / energy)
     return np.asarray(rows, dtype=np.complex128), hw

@@ -23,16 +23,17 @@ from pypulsar_tpu.io import sigproc
 
 
 class FilterbankFile:
-    # iter_blocks yields (startsamp, [time, chan] ndarray) blocks stepping
-    # by block_size — the contract _ReaderSource's streaming fast path
-    # requires (fbobs.iter_blocks has different semantics and no marker)
-    BLOCK_ITER_ARRAYS = True
     """Random-access SIGPROC filterbank reader.
 
     Attributes mirror the reference reader: ``header`` dict, ``frequencies``
     (per-channel MHz, in file channel order), ``nspec`` total samples,
     ``is_hifreq_first`` (foff < 0).
     """
+
+    # iter_blocks yields (startsamp, [time, chan] ndarray) blocks stepping
+    # by block_size — the contract _ReaderSource's streaming fast path
+    # requires (fbobs.iter_blocks has different semantics and no marker)
+    BLOCK_ITER_ARRAYS = True
 
     def __init__(self, filfn: str):
         self.filename = filfn
