@@ -1,0 +1,157 @@
+"""Sift per-DM acceleration-search candidates into a ``.accelcands`` list.
+
+Closes the loop the reference leaves external: its ``formats/accelcands.py``
+parses sifted candidate lists produced by the PALFA pipeline's (out-of-repo)
+sifting of PRESTO accelsearch output; here the producer is in-tree. Input is
+a set of per-DM-trial ``*_ACCEL_*.cand`` files (written by
+``cli/accelsearch``) with their ``.inf`` metadata; candidates are clustered
+across DM trials by fundamental frequency (within a tolerance scaled from
+their ``rerr``), each cluster keeps its best-sigma member as the headline
+candidate with the full per-DM hit list attached, and the result is written
+in the reference's text grammar (io/accelcands.write_candlist) so every
+existing consumer of ``.accelcands`` files reads it unchanged.
+
+DM selection physics: a genuine pulsar peaks in significance at its true DM
+and fades symmetrically; ``--min-hits`` discards clusters seen at too few
+trials (narrowband RFI), and clusters peaking at the lowest DM trial can be
+cut with ``--min-dm`` (terrestrial signals peak at DM 0).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from pypulsar_tpu.io.accelcands import Candidate, write_candlist
+from pypulsar_tpu.io.infodata import InfoData
+from pypulsar_tpu.io.prestocand import read_rzwcands
+
+_DM_RE = re.compile(r"DM(\d+(?:\.\d+)?)")
+
+
+def infer_dm(path: str, inf) -> float:
+    """DM of a per-trial file: the .inf DM field when present, else the
+    DM<value> token in the filename (the sweep CLI's naming)."""
+    dm = getattr(inf, "DM", None)
+    if dm is not None:
+        return float(dm)
+    m = _DM_RE.search(os.path.basename(path))
+    if m:
+        return float(m.group(1))
+    raise ValueError(f"cannot determine the DM of {path}")
+
+
+def collect(candfns: List[str]):
+    """[(candfn, dm, T, cands)] for every readable candidate file."""
+    out = []
+    for fn in sorted(candfns):
+        base = fn.split("_ACCEL_")[0]
+        inffn = base + ".inf"
+        if not os.path.exists(inffn):
+            print(f"# skipping {fn}: no {inffn}", file=sys.stderr)
+            continue
+        inf = InfoData(inffn)
+        T = float(inf.dt) * int(inf.N)
+        try:
+            cands = read_rzwcands(fn)
+        except OSError as e:
+            print(f"# skipping {fn}: {e}", file=sys.stderr)
+            continue
+        out.append((fn, infer_dm(fn, inf), T, cands))
+    return out
+
+
+def _numharm_of(rzw) -> int:
+    """Harmonic count of a candidate record.
+
+    The C fourierprops struct has no numharm slot; our writer
+    (fourier/accelsearch.AccelCandidate.as_fourierprops) stores it in
+    ``locpow`` (which is meaningless for matched powers already normalized
+    to unit local power). A genuine PRESTO .cand stores a real local power
+    there, so only small near-integer values decode as harmonic counts —
+    anything else falls back to 1 rather than poisoning the SNRs."""
+    lp = float(getattr(rzw, "locpow", 1.0))
+    if 1.0 - 1e-3 <= lp <= 32.0 and abs(lp - round(lp)) < 1e-3:
+        return int(round(lp))
+    return 1
+
+
+def sift(candfiles, min_sigma: float = 4.0, min_hits: int = 2,
+         freq_tol_bins: float = 1.5) -> List[Candidate]:
+    """Cluster candidates across DM trials by fundamental frequency."""
+    clusters: List[Dict] = []  # {freq, members: [(dm, rzw, fn, idx, T)]}
+    for fn, dm, T, cands in candfiles:
+        for idx, c in enumerate(cands):
+            if c.sig < min_sigma:
+                continue
+            freq = c.r / T
+            tol = max(freq_tol_bins, 3.0 * c.rerr) / T
+            for cl in clusters:
+                if abs(cl["freq"] - freq) < tol:
+                    cl["members"].append((dm, c, fn, idx, T))
+                    break
+            else:
+                clusters.append(
+                    dict(freq=freq, members=[(dm, c, fn, idx, T)]))
+
+    out: List[Candidate] = []
+    for cl in clusters:
+        if len(cl["members"]) < min_hits:
+            continue
+        best = max(cl["members"], key=lambda m: m[1].sig)
+        dm, rzw, fn, idx, T = best
+        nh = _numharm_of(rzw)
+        cand = Candidate(
+            accelfile=os.path.basename(fn), candnum=idx + 1, dm=dm,
+            snr=np.sqrt(max(2.0 * rzw.pow - 2.0 * nh, 0.0)),
+            sigma=rzw.sig, numharm=nh, ipow=rzw.pow, cpow=rzw.pow,
+            period=1.0 / (rzw.r / T), r=rzw.r, z=rzw.z,
+        )
+        for mdm, mc, _, _, _ in sorted(cl["members"], key=lambda m: m[0]):
+            # each hit's SNR from its OWN harmonic count (trials on the
+            # DM shoulder often win with fewer summed harmonics)
+            mnh = _numharm_of(mc)
+            cand.add_dmhit(mdm, np.sqrt(max(2.0 * mc.pow - 2.0 * mnh, 0.0)),
+                           sigma=mc.sig)
+        out.append(cand)
+    out.sort(key=lambda c: -c.sigma)
+    return out
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="sift.py",
+        description="Cluster per-DM accelsearch .cand files into a sifted "
+                    ".accelcands list (TPU backend).")
+    p.add_argument("candfiles", nargs="+", help="*_ACCEL_*.cand files")
+    p.add_argument("-o", "--outfile", default=None,
+                   help="output .accelcands path (default: stdout)")
+    p.add_argument("-s", "--min-sigma", type=float, default=4.0,
+                   help="per-trial significance floor (default 4)")
+    p.add_argument("--min-hits", type=int, default=2,
+                   help="min DM trials a cluster must appear in (default 2)")
+    p.add_argument("--min-dm", type=float, default=None,
+                   help="drop clusters whose best DM is below this")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    files = collect(args.candfiles)
+    cands = sift(files, min_sigma=args.min_sigma, min_hits=args.min_hits)
+    if args.min_dm is not None:
+        cands = [c for c in cands if c.dm >= args.min_dm]
+    write_candlist(cands, args.outfile)
+    if args.outfile:
+        print(f"# {len(cands)} sifted candidates -> {args.outfile}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -317,3 +317,101 @@ def test_recover_jerk_signal_w_dimension():
     p_z = max((c.power for c in c_z
                if abs(c.freq(T) - f_mean_true) < 60.0 / T), default=0.0)
     assert best.power > 1.5 * p_z  # jerk templates recover what z-only loses
+
+
+def test_cli_sift_clusters_across_dms(tmp_path, monkeypatch):
+    """Per-DM accelsearch outputs sift into one .accelcands candidate that
+    peaks at the injected DM, parseable by the reference-format reader."""
+    from pypulsar_tpu.cli import accelsearch as cli_accel
+    from pypulsar_tpu.cli import sift as cli_sift
+    from pypulsar_tpu.io.accelcands import parse_candlist
+    from pypulsar_tpu.io.datfile import write_dat
+    from pypulsar_tpu.io.infodata import InfoData
+
+    monkeypatch.chdir(tmp_path)
+    rng = np.random.RandomState(17)
+    N, dt = 1 << 15, 1e-3
+    T = N * dt
+    t = np.arange(N) * dt
+    f0 = 29.17
+    candfns = []
+    # simulate three DM trials: signal strongest at the middle one
+    for dm, amp in ((38.0, 0.12), (40.0, 0.3), (42.0, 0.12)):
+        ts = rng.standard_normal(N).astype(np.float32)
+        ts += amp * np.cos(2 * np.pi * f0 * t).astype(np.float32)
+        inf = InfoData()
+        inf.epoch = 55000.0
+        inf.dt = dt
+        inf.N = N
+        inf.DM = dm
+        inf.telescope = "Fake"
+        inf.lofreq = 1400.0
+        inf.BW = 100.0
+        inf.numchan = 1
+        inf.chan_width = 100.0
+        inf.object = "SIFT"
+        base = str(tmp_path / f"s_DM{dm:.2f}")
+        write_dat(base, ts, inf)
+        rc = cli_accel.main([base + ".dat", "-z", "0", "-n", "1", "-s", "4"])
+        assert rc == 0
+        candfns.append(base + "_ACCEL_0.cand")
+
+    out = str(tmp_path / "sifted.accelcands")
+    rc = cli_sift.main(candfns + ["-o", out, "--min-hits", "2"])
+    assert rc == 0
+    cands = parse_candlist(out)
+    assert cands, "no sifted candidates"
+    best = cands[0]
+    assert abs(1.0 / best.period - f0) < 1.0 / T
+    assert best.dm == 40.0  # strongest trial wins the cluster
+    assert len(best.dmhits) == 3
+    hit_dms = sorted(h.dm for h in best.dmhits)
+    assert hit_dms == [38.0, 40.0, 42.0]
+
+
+def test_full_pipeline_fil_to_sifted_accelcands(tmp_path, monkeypatch):
+    """The complete periodicity pipeline on one synthetic observation:
+    .fil -> DM sweep (--write-dats) -> per-DM accelsearch -> sift ->
+    .accelcands, recovering the injected (period, DM)."""
+    from pypulsar_tpu.cli import accelsearch as cli_accel
+    from pypulsar_tpu.cli import sift as cli_sift
+    from pypulsar_tpu.cli import sweep as cli_sweep
+    from pypulsar_tpu.io import filterbank
+    from pypulsar_tpu.io.accelcands import parse_candlist
+    from pypulsar_tpu.ops import numpy_ref
+
+    monkeypatch.chdir(tmp_path)
+    rng = np.random.RandomState(23)
+    C, T, dt = 32, 1 << 15, 1e-3
+    dm_true, f0 = 40.0, 23.31
+    freqs = 1500.0 - 4.0 * np.arange(C)
+    tsec = np.arange(T) * dt
+    delays = numpy_ref.bin_delays(dm_true, freqs, dt) * dt
+    data = rng.randn(T, C).astype(np.float32)
+    for c in range(C):
+        data[:, c] += 0.35 * np.cos(
+            2 * np.pi * f0 * (tsec - delays[c])).astype(np.float32)
+    hdr = dict(nchans=C, tsamp=dt, fch1=1500.0, foff=-4.0, tstart=55000.0,
+               nbits=32, nifs=1, source_name="PIPE")
+    filterbank.write_filterbank("obs.fil", hdr, data)
+
+    rc = cli_sweep.main(["obs.fil", "-o", "obs", "--lodm", "32",
+                         "--dmstep", "4", "--numdms", "5", "-s", "8",
+                         "--group-size", "4", "--write-dats"])
+    assert rc == 0
+    candfns = []
+    for dm in (32.0, 36.0, 40.0, 44.0, 48.0):
+        datfn = f"obs_DM{dm:.2f}.dat"
+        assert os.path.exists(datfn)
+        rc = cli_accel.main([datfn, "-z", "0", "-n", "4", "-s", "3"])
+        assert rc == 0
+        candfns.append(f"obs_DM{dm:.2f}_ACCEL_0.cand")
+    rc = cli_sift.main(candfns + ["-o", "obs.accelcands", "--min-hits", "2"])
+    assert rc == 0
+    cands = parse_candlist("obs.accelcands")
+    assert cands
+    best = cands[0]
+    Tobs = T * dt
+    assert abs(1.0 / best.period - f0) < 1.5 / Tobs
+    assert abs(best.dm - dm_true) <= 4.0  # cluster peaks at the true DM
+    assert len(best.dmhits) >= 3  # seen across neighbouring trials
