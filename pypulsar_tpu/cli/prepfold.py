@@ -1,0 +1,170 @@
+"""Fold an observation at a candidate (P, Pdot, DM) into a ``.pfd`` archive.
+
+The reference consumes prepfold archives everywhere (``bin/pfd_snr.py``,
+``pfdinfo``, ``fitkepler`` via ``prepfold.pfd``) but the folder itself is
+external PRESTO C code (SURVEY.md L0). This tool is the in-tree
+equivalent: the candidate-verification step between the search engines'
+output and the profile-SNR / timing tools, producing archives our
+``io/prestopfd.PfdFile`` (and PRESTO's own readers — same byte layout)
+can load.
+
+Fold geometry mirrors prepfold: time is cut into ``npart`` partitions and
+channels into ``nsub`` subbands; each (part, sub) cell is a ``proflen``-bin
+phase profile folded with the device scatter-add engine
+(fold/engine.fold_bins) at the topocentric phase model
+``phi(t) = f0 t + f1 t^2/2 + f2 t^3/6``. Inter-subband dispersion delays
+are left in (archives start at currdm = 0); ``PfdFile.dedisperse(bestdm)``
+rotates them out exactly as prepfold archives behave after loading.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+from pypulsar_tpu.core import psrmath
+
+
+def fold_partitions(blocks, dt, nbins, npart, nsub, f_poly, total_samples):
+    """profs[npart, nsub, nbins] + stats[npart, nsub, 7] from a stream of
+    (startsamp, [chan, time] float32) blocks covering the observation."""
+    import jax.numpy as jnp
+
+    from pypulsar_tpu.fold.engine import fold_bins, phase_to_bins
+
+    f0, f1, f2 = f_poly
+    part_len = total_samples // npart
+    used = part_len * npart
+    profs = np.zeros((npart, nsub, nbins))
+    stats = np.zeros((npart, nsub, 7))
+    for start, data in blocks:
+        C = data.shape[0]
+        per = C // nsub
+        n = data.shape[1]
+        if start >= used:
+            break
+        n = min(n, used - start)
+        t = (start + np.arange(n)) * dt
+        phase = t * (f0 + t * (f1 / 2.0 + t * f2 / 6.0))
+        bin_idx = phase_to_bins(phase, nbins)
+        sub = jnp.asarray(data[:, :n], jnp.float32).reshape(
+            nsub, per, n).sum(axis=1)
+        prof, counts = fold_bins(sub, bin_idx, nbins)
+        prof = np.asarray(prof, dtype=np.float64)
+        sub_np = np.asarray(sub, dtype=np.float64)
+        # a block may span partition boundaries only if blocks are served
+        # partition-aligned; fold_partitions is called with block size ==
+        # part_len so each block is one partition
+        pi = start // part_len
+        profs[pi] += prof
+        for si in range(nsub):
+            d = sub_np[si]
+            stats[pi, si] = (n, d.mean(), d.var(), nbins,
+                             prof[si].mean(), prof[si].var(), 1.0)
+    return profs, stats
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="prepfold.py",
+        description="Fold a .fil/.dat observation at a candidate "
+                    "(P, Pdot, DM) into a PRESTO-format .pfd archive "
+                    "(TPU backend).")
+    p.add_argument("infile", help=".fil filterbank or .dat time series")
+    p.add_argument("-p", "--period", type=float, required=True,
+                   help="topocentric fold period, seconds")
+    p.add_argument("--pd", type=float, default=0.0,
+                   help="period derivative, s/s")
+    p.add_argument("--pdd", type=float, default=0.0,
+                   help="second period derivative, s/s^2")
+    p.add_argument("--dm", type=float, default=0.0,
+                   help="candidate DM (stored as bestdm; subbands stay at "
+                        "DM 0 until PfdFile.dedisperse, like prepfold)")
+    p.add_argument("-n", "--proflen", type=int, default=64,
+                   help="phase bins per profile (default 64)")
+    p.add_argument("--npart", type=int, default=32,
+                   help="time partitions (default 32)")
+    p.add_argument("--nsub", type=int, default=32,
+                   help="frequency subbands (default 32; 1 for .dat)")
+    p.add_argument("-o", "--outfile", default=None,
+                   help="output .pfd path (default <base>_<P-ms>ms.pfd)")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    base, ext = os.path.splitext(args.infile)
+    f_poly = psrmath.p_to_f(args.period, args.pd, args.pdd)
+
+    if ext == ".dat":
+        from pypulsar_tpu.io.datfile import Datfile
+
+        dat = Datfile(args.infile)
+        series = dat.read_all()
+        dt = float(dat.infdata.dt)
+        total = len(series)
+        nsub, numchan = 1, 1
+        lofreq = float(getattr(dat.infdata, "lofreq", 1400.0))
+        chan_wid = float(getattr(dat.infdata, "chan_width", 1.0))
+        tepoch = float(getattr(dat.infdata, "epoch", 56000.0))
+        telescope = str(getattr(dat.infdata, "telescope", "unknown"))
+        part_len = total // args.npart
+
+        def blocks():
+            for pi in range(args.npart):
+                s = pi * part_len
+                yield s, series[np.newaxis, s:s + part_len]
+    else:
+        from pypulsar_tpu.io.filterbank import FilterbankFile
+
+        fb = FilterbankFile(args.infile)
+        dt = float(fb.tsamp)
+        total = fb.number_of_samples
+        numchan = fb.nchans
+        nsub = args.nsub
+        if numchan % nsub:
+            raise SystemExit(f"nsub={nsub} must divide nchans={numchan}")
+        freqs = np.asarray(fb.frequencies)
+        lofreq = float(freqs.min())
+        chan_wid = float(abs(fb.foff))
+        tepoch = float(fb.tstart)
+        from pypulsar_tpu.io.sigproc import ids_to_telescope
+
+        telescope = ids_to_telescope.get(
+            int(fb.header.get("telescope_id", -1)), "unknown")
+        part_len = total // args.npart
+
+        def blocks():
+            for pi in range(args.npart):
+                s = pi * part_len
+                block = fb.get_samples(s, part_len)  # [time, chan]
+                data = np.ascontiguousarray(block.T)
+                if fb.is_hifreq_first:
+                    data = data[::-1]  # low->high so subband 0 = lofreq
+                yield s, data
+
+    profs, stats = fold_partitions(
+        blocks(), dt, args.proflen, args.npart, nsub, f_poly, total)
+
+    from pypulsar_tpu.io.prestopfd import make_pfd
+
+    pfd = make_pfd(
+        profs, dt=dt, lofreq=lofreq, chan_wid=chan_wid, numchan=numchan,
+        fold_p1=args.period, bestdm=args.dm, stats=stats, tepoch=tepoch,
+        candnm=f"{args.period * 1e3:.2f}ms_{args.dm:.1f}dm",
+        telescope=telescope, filenm=os.path.basename(args.infile),
+    )
+    pfd.topo_p1, pfd.topo_p2, pfd.topo_p3 = args.period, args.pd, args.pdd
+    pfd.curr_p1, pfd.curr_p2, pfd.curr_p3 = args.period, args.pd, args.pdd
+    outfn = args.outfile or f"{base}_{args.period * 1e3:.2f}ms.pfd"
+    pfd.write(outfn)
+    print(f"# folded {total} samples into [{args.npart}, {nsub}, "
+          f"{args.proflen}] -> {outfn}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -55,14 +55,16 @@ def collect(candfns: List[str]):
         if not os.path.exists(inffn):
             print(f"# skipping {fn}: no {inffn}", file=sys.stderr)
             continue
-        inf = InfoData(inffn)
-        T = float(inf.dt) * int(inf.N)
         try:
+            inf = InfoData(inffn)
+            T = float(inf.dt) * int(inf.N)
             cands = read_rzwcands(fn)
-        except OSError as e:
-            print(f"# skipping {fn}: {e}", file=sys.stderr)
+            dm = infer_dm(fn, inf)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"# skipping {fn}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
             continue
-        out.append((fn, infer_dm(fn, inf), T, cands))
+        out.append((fn, dm, T, cands))
     return out
 
 
