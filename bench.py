@@ -392,7 +392,9 @@ def run_accel(args):
                             seg_width=segw)
     Z = len(cfg.zs)
 
-    accel_search(jnp.asarray(fft[: 4 * segw + 8]), T, cfg)  # warm compile
+    # warm at the REAL shape (the stage runners' jit keys on the spectrum
+    # length and segment count; a smaller warmup would not populate them)
+    accel_search(jnp.asarray(fft), T, cfg)
     t0 = time.perf_counter()
     cands = accel_search(jnp.asarray(fft), T, cfg)
     jax_time = time.perf_counter() - t0

@@ -37,6 +37,9 @@ def fold_partitions(blocks, dt, nbins, npart, nsub, f_poly, total_samples):
 
     f0, f1, f2 = f_poly
     part_len = total_samples // npart
+    if part_len < 1:
+        raise ValueError(
+            f"npart={npart} exceeds the {total_samples}-sample observation")
     used = part_len * npart
     profs = np.zeros((npart, nsub, nbins))
     stats = np.zeros((npart, nsub, 7))
@@ -55,9 +58,13 @@ def fold_partitions(blocks, dt, nbins, npart, nsub, f_poly, total_samples):
         prof, counts = fold_bins(sub, bin_idx, nbins)
         prof = np.asarray(prof, dtype=np.float64)
         sub_np = np.asarray(sub, dtype=np.float64)
-        # a block may span partition boundaries only if blocks are served
-        # partition-aligned; fold_partitions is called with block size ==
-        # part_len so each block is one partition
+        # precondition: each block is exactly one partition (both callers
+        # serve part_len-sized partition-aligned blocks); stats assignment
+        # and the single-partition attribution below rely on it
+        if start % part_len or n > part_len:
+            raise ValueError(
+                f"block at {start} (len {n}) is not one partition "
+                f"(part_len {part_len}); serve partition-aligned blocks")
         pi = start // part_len
         profs[pi] += prof
         for si in range(nsub):

@@ -42,6 +42,7 @@ noise, and an H-harmonic sum is Gamma(H, 1) — significance follows from
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -220,31 +221,58 @@ class AccelCandidate:
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("L",))
-def _corr_pow(spec_pad, tf, start, L):
-    """Correlation powers of one spectrum region against a template bank.
+@functools.lru_cache(maxsize=64)
+def _make_stage_runner(segw: int, Z: int, Wn: int, topk: int,
+                       bank_meta: Tuple[Tuple[int, int, int, int], ...]):
+    """One compiled program for an ENTIRE harmonic stage.
 
-    spec_pad[Np] : padded spectrum (complex64); tf[2Z, L]: FFT of reversed
-    conjugate templates (even rows integer-phase, odd rows half-bin).
-    Returns powers[Z, 2*L] float32, row-major (b, j) flattened: index
-    ``b*L + j`` is the power at spectrum position ``start_bin + j + b/2``
-    where ``start_bin = start - front + hw`` (caller bookkeeping).
+    The naive driver dispatches (segments x subharmonics) small device
+    calls; on a remote accelerator every dispatch costs tunnel latency
+    (~60 ms measured on the axon v5e link — BENCHNOTES.md), dwarfing the
+    math. Here all segments run inside one lax.scan: slice starts are
+    affine in the segment index (``start = off0 + si * step``, exact
+    because the stage's top_lo and segw are divisible by H), the
+    subharmonic loop unrolls at trace time, and detection emits fixed
+    top-k records per (segment, w), so a stage is ONE dispatch.
+
+    ``bank_meta[b-1] = (off0, step, hw, L)``; the returned callable takes
+    (spec_pad, tfs, idxs, top_lo, top_hi, thresh, n_seg) with tfs/idxs
+    matching bank_meta order.
     """
-    sl = jax.lax.dynamic_slice(spec_pad, (start,), (L,))
-    cf = jnp.fft.fft(sl)
-    corr = jnp.fft.ifft(cf[None, :] * tf, axis=1)  # [2Z, L]
-    p = (jnp.abs(corr) ** 2).astype(jnp.float32)
-    Z2, _ = p.shape
-    return p.reshape(Z2 // 2, 2 * L)
+
+    def run(spec_pad, tfs, idxs, top_lo, top_hi, thresh, n_seg):
+        def body(carry, si):
+            r0 = top_lo + si * segw
+            width = jnp.minimum(segw, top_hi - r0)
+            plane = jnp.zeros((Z * Wn, 2 * segw), jnp.float32)
+            for (off0, step, hw, L), tf, idx in zip(bank_meta, tfs, idxs):
+                start = off0 + si * step
+                sl = jax.lax.dynamic_slice(spec_pad, (start,), (L,))
+                cf = jnp.fft.fft(sl)
+                corr = jnp.fft.ifft(cf[None, :] * tf, axis=1)
+                p = (jnp.abs(corr) ** 2).astype(jnp.float32)
+                p = p.reshape(p.shape[0] // 2, 2 * L)
+                plane = plane + jnp.take(p, idx, axis=1)
+            col = jnp.arange(2 * segw, dtype=jnp.int32)
+            plane = jnp.where(col[None, :] < 2 * width, plane,
+                              jnp.float32(-jnp.inf))
+            outs = []
+            for wi in range(Wn):
+                outs.append(_detect_impl(plane[wi::Wn], thresh, topk))
+            vals = jnp.stack([o[0] for o in outs])
+            zi = jnp.stack([o[1] for o in outs])
+            ri = jnp.stack([o[2] for o in outs])
+            neigh = jnp.stack([o[3] for o in outs])
+            return carry, (vals, zi, ri, neigh)
+
+        _, res = jax.lax.scan(body, 0, jnp.arange(n_seg))
+        return res
+
+    return jax.jit(run, static_argnames=("n_seg",))
 
 
-@partial(jax.jit, static_argnames=("k",))
-def _detect(accum, thresh, k):
-    """Threshold + 4-neighbour local max + top-k over plane[Z, R2].
-
-    Returns (vals[k], zidx[k], ridx[k], neigh[k, 3, 3]) — losers padded
-    with val = -inf. The 3x3 power neighbourhood feeds host-side sub-bin
-    refinement without shipping the plane."""
+def _detect_impl(accum, thresh, k: int):
+    """Traceable body of :func:`_detect` (shared)."""
     Z, R2 = accum.shape
     neg = jnp.float32(-jnp.inf)
     pad = jnp.pad(accum, 1, constant_values=neg)
@@ -258,17 +286,10 @@ def _detect(accum, thresh, k):
     vals, idx = jax.lax.top_k(flat, k)
     zi = idx // R2
     ri = idx % R2
-    # gather 3x3 neighbourhoods from the padded plane
     zo = zi[:, None, None] + jnp.arange(3)[None, :, None]
     ro = ri[:, None, None] + jnp.arange(3)[None, None, :]
     neigh = pad[zo, ro]
     return vals, zi, ri, neigh
-
-
-@jax.jit
-def _take_add(plane, pow_flat, idx):
-    """plane[Z, W2] += pow_flat[Z, 2L][:, idx] (static stretch gather)."""
-    return plane + jnp.take(pow_flat, idx, axis=1)
 
 
 # ---------------------------------------------------------------------------
@@ -385,45 +406,36 @@ def accel_search(
         top_hi = min(H * rhi, N - 1)
         if top_hi <= top_lo:
             continue
+        n_seg = -(-(top_hi - top_lo) // segw)
         # device residency bounded per stage: only this stage's <= H ratio
         # banks live in HBM at once (a full jerk bank set across all
-        # stages would be tens of GB at survey parameters)
-        dev_banks = {
-            Fraction(b, H): (
-                jnp.asarray(banks[Fraction(b, H)][0]),
-                banks[Fraction(b, H)][1],
-                banks[Fraction(b, H)][2],
-                jnp.asarray(banks[Fraction(b, H)][3]),
-            )
-            for b in range(1, H + 1)
-        }
-        n_seg = -(-(top_hi - top_lo) // segw)
+        # stages would be tens of GB at survey parameters). Slice starts
+        # are affine in the segment index — start = off0 + si*step, exact
+        # because H divides both top_lo and segw — so the WHOLE stage runs
+        # as one compiled lax.scan (one dispatch; see _make_stage_runner).
+        bank_meta, tfs, idxs = [], [], []
+        for b in range(1, H + 1):
+            tf, hw, L, idx = banks[Fraction(b, H)]
+            bank_meta.append((front + (b * top_lo) // H - hw,
+                              (b * segw) // H, hw, L))
+            tfs.append(jnp.asarray(tf))
+            idxs.append(jnp.asarray(idx))
+        runner = _make_stage_runner(segw, Z, Wn, cfg.topk, tuple(bank_meta))
+        with profiling.stage("accel_stage"):
+            vals, zi, ri, neigh = runner(
+                spec_pad, tuple(tfs), tuple(idxs), top_lo, top_hi,
+                jnp.float32(thresh[H]), n_seg)
+            vals = np.asarray(vals)
+            zi = np.asarray(zi)
+            ri = np.asarray(ri)
+            neigh = np.asarray(neigh)
+        del tfs, idxs  # free this stage's HBM before the next
         for si in range(n_seg):
-            r0 = top_lo + si * segw  # divisible by H (segw % H == 0)
+            r0 = top_lo + si * segw
             width = min(segw, top_hi - r0)
-            plane = jnp.zeros((Z * Wn, 2 * segw), jnp.float32)
-            with profiling.stage("accel_planes"):
-                for b in range(1, H + 1):
-                    tf, hw, L, idx = dev_banks[Fraction(b, H)]
-                    s0 = (b * r0) // H  # exact: H | r0
-                    start = front + s0 - hw
-                    powf = _corr_pow(spec_pad, tf, start, L)
-                    plane = _take_add(plane, powf, idx)
-            if width < segw:
-                # short last segment: columns past the search range hold
-                # real correlation powers (e.g. RFI just above fhi) and
-                # would crowd genuine candidates out of the top-k
-                plane = plane.at[:, 2 * width:].set(-jnp.inf)
-            with profiling.stage("accel_detect"):
-                # local-max structure is (z, r) at fixed w: detect per
-                # w-slice of the row-major (z, w) bank layout
-                for wi in range(Wn):
-                    vals, zi, ri, neigh = _detect(
-                        plane[wi::Wn], jnp.float32(thresh[H]), cfg.topk)
-                    raw_hits.append((H, wi, r0, np.asarray(vals),
-                                     np.asarray(zi), np.asarray(ri),
-                                     np.asarray(neigh), width))
-        del dev_banks  # free this stage's HBM before the next
+            for wi in range(Wn):
+                raw_hits.append((H, wi, r0, vals[si, wi], zi[si, wi],
+                                 ri[si, wi], neigh[si, wi], width))
 
     # --- host: refine + significance + sift (float64) ---
     cands: List[AccelCandidate] = []
