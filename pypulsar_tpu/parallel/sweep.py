@@ -35,6 +35,7 @@ ICI neighbor via lax.ppermute instead of the host stream.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 from functools import partial
 from typing import Optional, Sequence, Tuple
@@ -715,16 +716,33 @@ def finalize_sweep(plan: SweepPlan, n: int, s, ss, mb, ab,
     )
 
 
+def _mesh_pad_groups(n_dms: int, group_size: int, mesh) -> Optional[int]:
+    """Group padding so trial groups divide the mesh 'dm' axis."""
+    if mesh is None:
+        return None
+    ndm = mesh.shape["dm"]
+    G = -(-n_dms // group_size)
+    return -(-G // ndm) * ndm
+
+
+def _series_baseline(data):
+    """Whole-series per-channel baseline per the SNR contract: host arrays
+    get a float64 host mean (cast to f32), device arrays a device mean —
+    identical across the streamed and resident paths."""
+    if isinstance(data, np.ndarray):
+        return np.mean(data, axis=1, keepdims=True,
+                       dtype=np.float64).astype(np.float32)
+    return jnp.mean(data.astype(jnp.float32), axis=1, keepdims=True)
+
+
 def sweep_spectra(spectra, dms, nsub=64, group_size=32, widths=DEFAULT_WIDTHS,
                   chunk_payload=None, mesh=None, pad_groups_to=None,
                   engine="auto", max_pending=None) -> SweepResult:
     """Convenience: sweep an in-memory (possibly device-resident) Spectra
     over ``dms``; chunks are device-side slices, no host round-trips."""
     freqs = np.asarray(spectra.freqs, dtype=np.float64)
-    if pad_groups_to is None and mesh is not None:
-        ndm = mesh.shape["dm"]
-        G = -(-len(dms) // group_size)
-        pad_groups_to = -(-G // ndm) * ndm
+    if pad_groups_to is None:
+        pad_groups_to = _mesh_pad_groups(len(dms), group_size, mesh)
     plan = make_sweep_plan(dms, freqs, spectra.dt, nsub=nsub, group_size=group_size,
                            widths=widths, pad_groups_to=pad_groups_to)
     T = spectra.numspectra
@@ -744,10 +762,102 @@ def sweep_spectra(spectra, dms, nsub=64, group_size=32, widths=DEFAULT_WIDTHS,
     # end-of-data windows) independent of chunk_payload — see the contract.
     # Host arrays stay on host for this (a device round-trip of the full
     # series would defeat chunked streaming's memory bound).
-    if isinstance(data, np.ndarray):
-        baseline = np.mean(data, axis=1, keepdims=True,
-                           dtype=np.float64).astype(np.float32)
-    else:
-        baseline = jnp.mean(data.astype(jnp.float32), axis=1, keepdims=True)
+    baseline = _series_baseline(data)
     return sweep_stream(plan, blocks(), chunk_payload, mesh=mesh, chan_major=True,
                         baseline=baseline, engine=engine, max_pending=max_pending)
+
+
+def sweep_resident(spectra, dms, nsub=64, group_size=32, widths=DEFAULT_WIDTHS,
+                   chunk_payload=None, engine="auto",
+                   pad_groups_to=None, mesh=None) -> SweepResult:
+    """Whole sweep of a device-resident Spectra as ONE compiled program.
+
+    ``sweep_spectra`` dispatches per chunk and pulls per-chunk statistics
+    to the host accumulator — the right structure for streamed files, but
+    on a remote accelerator every dispatch/pull pays link latency (~60 ms
+    on the axon v5e tunnel, BENCHNOTES.md). Here the chunk loop is a
+    ``lax.scan`` over device-side slices of the resident dataset: per-chunk
+    statistics stack on device and ship in a single transfer, and the host
+    combines them in stream order — the SAME f64 cross-chunk accumulation
+    the streamed path performs, so results are bit-identical to
+    ``sweep_spectra`` with the same chunking (tested).
+
+    The time axis is truncated to a whole number of chunks (bench data is
+    sized accordingly; file pipelines should use the streamed path, which
+    handles ragged tails). With ``mesh``, trial groups shard over its 'dm'
+    axis inside the same single program.
+    """
+    engine = resolve_engine(engine)
+    freqs = np.asarray(spectra.freqs, dtype=np.float64)
+    if pad_groups_to is None:
+        pad_groups_to = _mesh_pad_groups(len(dms), group_size, mesh)
+    plan = make_sweep_plan(dms, freqs, spectra.dt, nsub=nsub,
+                           group_size=group_size, widths=tuple(widths),
+                           pad_groups_to=pad_groups_to)
+    T = spectra.numspectra
+    payload = T if chunk_payload is None else min(chunk_payload, T)
+    n_chunks = max(T // payload, 1)
+    T_used = n_chunks * payload
+    W = max(plan.widths)
+    out_len = payload + W
+    slack2 = plan.max_shift2
+    need = out_len + slack2 + plan.max_shift1
+
+    data = jnp.asarray(spectra.data, dtype=jnp.float32)[:, :T_used]
+    s1 = jnp.asarray(plan.stage1_bins)
+    s2 = jnp.asarray(plan.stage2_bins)
+    if mesh is not None:
+        if plan.n_groups % mesh.shape["dm"]:
+            raise ValueError("group count must divide the mesh 'dm' axis")
+        spec_sh = NamedSharding(mesh, P("dm"))
+        s1 = jax.device_put(s1, spec_sh)
+        s2 = jax.device_put(s2, spec_sh)
+
+    run = _make_resident_runner(plan.nsub, out_len, slack2, plan.widths,
+                                payload, need, engine, mesh)
+    # baseline parity with sweep_spectra: host f64 mean for host arrays
+    # (the docstring's bit-identity contract includes the baseline)
+    baseline = jnp.asarray(
+        _series_baseline(np.asarray(spectra.data)[:, :T_used]
+                         if isinstance(spectra.data, np.ndarray)
+                         else data))
+    s, ss, mb, ab = run(data, s1, s2, baseline, n_chunks)
+    s = np.asarray(s, dtype=np.float64)
+    ss = np.asarray(ss, dtype=np.float64)
+    mb = np.asarray(mb)
+    ab = np.asarray(ab, dtype=np.int64)
+    acc = _Accum(plan.n_trials, len(plan.widths))
+    for ci in range(n_chunks):
+        acc.update(ci * payload, payload, s[ci], ss[ci], mb[ci], ab[ci])
+    B = float(np.asarray(baseline, dtype=np.float64).sum())
+    return finalize_sweep(plan, acc.n, acc.s, acc.ss, acc.mb, acc.ab, B)
+
+
+@functools.lru_cache(maxsize=32)
+def _make_resident_runner(nsub, out_len, slack2, widths, payload, need,
+                          engine, mesh):
+    """Compiled whole-sweep scan program, cached across calls (a fresh
+    jit closure per sweep would recompile every invocation)."""
+    impl = partial(_sweep_chunk_impl, nsub=nsub, out_len=out_len,
+                   slack2=slack2, widths=widths, stat_len=payload,
+                   engine=engine)
+    if mesh is not None:
+        impl = jax.shard_map(impl, mesh=mesh,
+                             in_specs=(P(), P("dm"), P("dm")),
+                             out_specs=P("dm"))
+
+    @partial(jax.jit, static_argnames=("n_chunks",))
+    def run(data, s1, s2, baseline, n_chunks):
+        data = data - baseline
+        # zero tail pad so the final chunk's overlap reads data-shaped zeros
+        padded = jnp.pad(data, ((0, 0), (0, need)))
+
+        def body(carry, ci):
+            chunk = jax.lax.dynamic_slice(
+                padded, (0, ci * payload), (padded.shape[0], need))
+            return carry, impl(chunk, s1, s2)
+
+        _, ys = jax.lax.scan(body, 0, jnp.arange(n_chunks))
+        return ys
+
+    return run
