@@ -846,6 +846,10 @@ def _make_resident_runner(nsub, out_len, slack2, widths, payload, need,
                              in_specs=(P(), P("dm"), P("dm")),
                              out_specs=P("dm"))
 
+    # NOT donated: a full-size slice of the caller's Spectra shares its
+    # buffer (verified), so donation would invalidate the caller's data on
+    # backends that honor it; bench budgeting charges the padded working
+    # copy instead
     @partial(jax.jit, static_argnames=("n_chunks",))
     def run(data, s1, s2, baseline, n_chunks):
         data = data - baseline

@@ -443,3 +443,36 @@ def test_sweep_resident_sharded_matches():
                              chunk_payload=2048, mesh=mesh)
     np.testing.assert_allclose(sharded.snr, single.snr, rtol=1e-4, atol=1e-4)
     np.testing.assert_array_equal(sharded.peak_sample, single.peak_sample)
+
+
+def test_bench_budget_shapes():
+    """bench.py's HBM budgeting: fits in the budget, power-of-two FFT
+    lengths, sane pending depth (VERDICT r2 item 1)."""
+    import importlib.util
+    import os as _os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", _os.path.join(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    C = 1024
+    freqs = (1500.0 - 300.0 / C * np.arange(C)).astype(np.float64)
+    dms = np.linspace(0.0, 500.0, 1024)
+    plan = make_sweep_plan(dms, freqs, 64e-6, nsub=64, group_size=32)
+    T, payload, n, max_pending = bench.budget_shapes(C, 1 << 21, plan, 16e9)
+    assert n & (n - 1) == 0  # power of two
+    assert payload == n - plan.min_overlap
+    assert 1 <= max_pending <= 4
+    # accounting: dataset + pending chunks + workspace within 75% of HBM
+    total = 4 * C * T + max_pending * 4 * C * n + 3 * 4 * C * n
+    assert total <= 0.80 * 16e9
+    # a tiny budget still returns a usable (min-sized) configuration
+    T2, payload2, n2, mp2 = bench.budget_shapes(C, 1 << 21, plan, 2e9)
+    assert T2 >= payload2 and mp2 >= 1
+
+    # analytic traffic is positive and scales with T
+    b1 = bench.sweep_bytes(plan, C, T, payload, n, "fourier")
+    b2 = bench.sweep_bytes(plan, C, 2 * T, payload, n, "fourier")
+    assert 0 < b1 < b2
