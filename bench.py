@@ -359,6 +359,33 @@ def run_ab(args):
             results[f"chunk-{engine}"] = f"FAILED: {type(e).__name__}"
             print(f"# chunk-{engine} FAILED: {type(e).__name__}: "
                   f"{str(e)[:200]}", file=sys.stderr)
+    # two-stage geometry variants (fourier engine): stage1 traffic scales
+    # as (D/group)*C*F and stage2 as D*nsub*F — the sweet spot is chip-
+    # dependent, so record a small grid
+    for nsub2, group2 in ((64, 64), (32, 32), (128, 32)):
+        try:
+            plan2 = make_sweep_plan(dms, freqs, dt, nsub=nsub2,
+                                    group_size=group2)
+            chunk2 = n - plan2.min_overlap
+            out_len2 = chunk2 + W
+            need2 = out_len2 + plan2.max_shift2 + plan2.max_shift1
+            data2 = jax.random.normal(key, (C, need2), dtype=jnp.float32)
+            s1b = jnp.asarray(plan2.stage1_bins)
+            s2b = jnp.asarray(plan2.stage2_bins)
+            fn = lambda: sweep_chunk(data2, s1b, s2b, plan2.nsub, out_len2,
+                                     plan2.max_shift2, plan2.widths, chunk2,
+                                     engine="fourier")
+            force(fn())
+            t0 = time.perf_counter()
+            force(fn())
+            el = time.perf_counter() - t0
+            results[f"fourier-s{nsub2}g{group2}"] = round(el, 4)
+            print(f"# fourier nsub={nsub2} group={group2}: {el*1e3:9.1f} ms",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            results[f"fourier-s{nsub2}g{group2}"] = (
+                f"FAILED: {type(e).__name__}")
+
     ts = jax.random.normal(key, (256, out_len), dtype=jnp.float32)
     float(ts[0, 0])
     for be in ("pallas", "lax"):

@@ -197,3 +197,25 @@ def test_filterbank_iter_blocks_prefetch_parity(tmp_path):
     for (sa, ba), (sb, bb) in zip(a, b):
         assert sa == sb
         np.testing.assert_array_equal(ba, bb)
+
+
+def test_filterbank_prefetch_8bit(tmp_path):
+    """The prefetch path handles packed uint8 files (bytes-per-spectrum
+    accounting differs from float32)."""
+    from pypulsar_tpu.io import filterbank
+
+    rng = np.random.RandomState(7)
+    T, C = 1500, 16
+    data = rng.randint(0, 255, size=(T, C)).astype(np.uint8)
+    fn = str(tmp_path / "b8.fil")
+    hdr = dict(nchans=C, tsamp=1e-3, fch1=1500.0, foff=-2.0, tstart=55000.0,
+               nbits=8, nifs=1, source_name="B8")
+    filterbank.write_filterbank(fn, hdr, data)
+    fb = filterbank.FilterbankFile(fn)
+    a = list(fb.iter_blocks(512, overlap=32, prefetch=True))
+    b = list(fb.iter_blocks(512, overlap=32, prefetch=False))
+    assert len(a) == len(b) and len(a) == 3
+    for (sa, ba), (sb, bb) in zip(a, b):
+        assert sa == sb
+        np.testing.assert_array_equal(ba, bb)
+    np.testing.assert_array_equal(a[0][1][:10], data[:10].astype(np.float32))
