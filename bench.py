@@ -63,6 +63,9 @@ def parse_args(argv=None):
     ap.add_argument("--accel", action="store_true",
                     help="benchmark the acceleration-search engine "
                          "(configs[4]) instead of the DM sweep")
+    ap.add_argument("--fold", action="store_true",
+                    help="benchmark the folding engine (configs[3]) "
+                         "instead of the DM sweep")
     ap.add_argument("--cpu-fallback", action="store_true",
                     help="(internal) run on the CPU backend with reduced shapes")
     ap.add_argument("--child", action="store_true",
@@ -385,6 +388,8 @@ def run_ab(args):
         except Exception as e:  # noqa: BLE001
             results[f"fourier-s{nsub2}g{group2}"] = (
                 f"FAILED: {type(e).__name__}")
+            print(f"# fourier-s{nsub2}g{group2} FAILED: "
+                  f"{type(e).__name__}: {str(e)[:200]}", file=sys.stderr)
 
     ts = jax.random.normal(key, (256, out_len), dtype=jnp.float32)
     float(ts[0, 0])
@@ -487,6 +492,74 @@ def run_accel(args):
     }
 
 
+def run_fold(args):
+    """Folding-engine throughput (BASELINE configs[3]: polyco fold +
+    profile accumulation; the reference folds one rotation at a time in
+    Python, formats/datfile.py:231-275). Metric: samples folded/s into a
+    [npart, nchan, nbins] archive cube (all raw channels kept — the
+    .pfd-style product before subbanding) via the device scatter-add
+    engine vs the single-core NumPy bincount twin."""
+    acquire_backend()
+    import jax.numpy as jnp
+    from pypulsar_tpu.fold.engine import fold_bins, fold_numpy, phase_to_bins
+
+    if args.quick or args.cpu_fallback:
+        C, T = 64, 1 << 18
+    else:
+        # fits HBM with headroom: dataset 4 GB on the 16 GB v5e (there is
+        # no streaming/retry here — a single resident cube is the measure)
+        C, T = 1024, 1 << 20
+    nbins, npart = 128, 64
+    dt, period = 64e-6, 0.033
+    rng = np.random.RandomState(0)
+    data = rng.standard_normal((C, T)).astype(np.float32)
+    t = np.arange(T) * dt
+    phase = t / period
+    bin_idx = phase_to_bins(phase, nbins)
+    part_len = T // npart
+
+    dev = jnp.asarray(data)
+    bi = jnp.asarray(bin_idx)
+    float(dev[0, 0])
+
+    def run():
+        outs = []
+        for pi in range(npart):
+            sl = slice(pi * part_len, (pi + 1) * part_len)
+            prof, counts = fold_bins(dev[:, sl], bi[sl], nbins)
+            outs.append(prof)
+        return [np.asarray(o) for o in outs]
+
+    run()  # warm
+    t0 = time.perf_counter()
+    profs = run()
+    jax_time = time.perf_counter() - t0
+    samples_per_sec = C * T / jax_time
+
+    # numpy twin on one partition, scaled linearly
+    t0 = time.perf_counter()
+    ref, _ = fold_numpy(data[:, :part_len], bin_idx[:part_len], nbins)
+    bl_time = (time.perf_counter() - t0) * npart
+    np.testing.assert_allclose(profs[0].sum(axis=0),
+                               ref.sum(axis=0), rtol=1e-4)
+    bl_samples_per_sec = C * T / bl_time
+    speedup = samples_per_sec / bl_samples_per_sec
+    print(f"# fold: {jax_time:.2f}s for {C}x{T} -> [{npart},{C},{nbins}]; "
+          f"numpy 1/{npart} slice {bl_time/npart:.2f}s", file=sys.stderr)
+    unit = (f"folded samples/s ({C}-chan, {T} samples, {nbins} bins, "
+            f"{npart} partitions; numpy baseline one partition x{npart})")
+    if args.cpu_fallback:
+        unit += " [CPU FALLBACK: accelerator backend unavailable]"
+    return {
+        "metric": "fold_samples_per_sec",
+        "value": round(samples_per_sec, 1),
+        "unit": unit,
+        "vs_baseline": round(speedup, 2),
+        "jax_seconds": round(jax_time, 3),
+        "numpy_seconds_scaled": round(bl_time, 3),
+    }
+
+
 def run_child(args, cpu: bool, timeout: float):
     """Run the measurement in a child interpreter; return its JSON record.
 
@@ -508,7 +581,7 @@ def run_child(args, cpu: bool, timeout: float):
         if val is not None:
             argv += [flag, str(val)]
     argv += ["--dm-max", str(args.dm_max), "--engine", args.engine]
-    for flag in ("quick", "profile", "ab", "accel"):
+    for flag in ("quick", "profile", "ab", "accel", "fold"):
         if getattr(args, flag):
             argv.append("--" + flag)
     proc = subprocess.run(argv, env=env, capture_output=True, text=True,
@@ -530,6 +603,8 @@ def main():
             record = run_ab(args)
         elif args.accel:
             record = run_accel(args)
+        elif args.fold:
+            record = run_fold(args)
         else:
             record = run_benchmark(args)
         print(json.dumps(record))
