@@ -72,7 +72,13 @@ def build_parser():
         prog="accelsearch.py",
         description="Search an FFT or time series for accelerated periodic "
                     "signals (TPU backend).")
-    p.add_argument("infile", help=".dat or .fft file (with matching .inf)")
+    p.add_argument("infiles", nargs="+", metavar="infile",
+                   help=".dat or .fft file(s) with matching .inf; a "
+                        "multi-file run amortizes template banks and "
+                        "compiled search programs over the whole DM set")
+    p.add_argument("--skip-existing", action="store_true",
+                   help="skip inputs whose candidate file already exists "
+                        "(restartable batch runs)")
     p.add_argument("-z", "--zmax", type=float, default=200.0,
                    help="max drift in Fourier bins over the observation "
                         "(default 200)")
@@ -103,34 +109,35 @@ def build_parser():
     return p
 
 
-def main(argv=None):
-    args = build_parser().parse_args(argv)
-    fft, T, base = load_spectrum(args.infile)
-    outbase = args.outbase or base
+def search_one(infile, cfg, args):
+    """Search one input; returns the written .cand path (or None if
+    skipped)."""
+    ztag = int(round(args.zmax))
+    if args.wmax > 0:
+        ztag = f"{ztag}_JERK_{int(round(args.wmax))}"
+    outbase = args.outbase or os.path.splitext(infile)[0]
+    candfn = f"{outbase}_ACCEL_{ztag}.cand"
+    # the skip decision needs no IO: restarting a large batch must not
+    # re-read (and re-FFT) every already-searched file
+    if args.skip_existing and os.path.exists(candfn):
+        print(f"# {infile}: {candfn} exists, skipping", file=sys.stderr)
+        return None
+    fft, T, _ = load_spectrum(infile)
     N = len(fft)
-    print(f"# {args.infile}: {N} bins, T = {T:.1f} s", file=sys.stderr)
+    print(f"# {infile}: {N} bins, T = {T:.1f} s", file=sys.stderr)
 
     if args.no_deredden:
         norm = fft.astype(np.complex64)
     else:
-        sched = deredden_schedule(N)
-        norm = np.asarray(deredden(fft.astype(np.complex64), schedule=sched))
+        norm = np.asarray(deredden(fft.astype(np.complex64),
+                                   schedule=deredden_schedule(N)))
     if args.zapfile:
         norm = zap_spectrum(norm, T, args.zapfile)
 
-    cfg = AccelSearchConfig(
-        zmax=args.zmax, dz=args.dz, numharm=args.numharm,
-        sigma_min=args.sigma, flo=args.flo, fhi=args.fhi,
-        wmax=args.wmax, dw=args.dw,
-    )
     cands = accel_search(norm, T, cfg)[: args.max_cands]
 
     from pypulsar_tpu.io.prestocand import write_rzwcands
 
-    ztag = int(round(args.zmax))
-    if args.wmax > 0:
-        ztag = f"{ztag}_JERK_{int(round(args.wmax))}"
-    candfn = f"{outbase}_ACCEL_{ztag}.cand"
     write_rzwcands(candfn, [c.as_fourierprops() for c in cands])
     txtfn = f"{outbase}_ACCEL_{ztag}.txtcand"
     with open(txtfn, "w") as f:
@@ -145,6 +152,28 @@ def main(argv=None):
             )
     print(f"# wrote {len(cands)} candidates to {candfn} and {txtfn}",
           file=sys.stderr)
+    return candfn
+
+
+def main(argv=None):
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.outbase and len(args.infiles) > 1:
+        parser.error("-o/--outbase only applies to a single input file")
+    cfg = AccelSearchConfig(
+        zmax=args.zmax, dz=args.dz, numharm=args.numharm,
+        sigma_min=args.sigma, flo=args.flo, fhi=args.fhi,
+        wmax=args.wmax, dw=args.dw,
+    )
+    # template banks (fourier.accelsearch._build_ratio_bank), deredden
+    # schedules and compiled stage programs are process-cached: searching
+    # many per-DM files in one invocation pays setup once
+    done = 0
+    for infile in args.infiles:
+        if search_one(infile, cfg, args) is not None:
+            done += 1
+    if len(args.infiles) > 1:
+        print(f"# searched {done}/{len(args.infiles)} files", file=sys.stderr)
     return 0
 
 

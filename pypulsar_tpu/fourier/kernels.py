@@ -24,6 +24,7 @@ Design notes (TPU-first re-design of reference formats/prestofft.py):
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import NamedTuple
 
@@ -104,10 +105,12 @@ class DereddenSchedule(NamedTuple):
     n: int
 
 
+@functools.lru_cache(maxsize=16)
 def deredden_schedule(n, initialbuflen=6, maxbuflen=200) -> DereddenSchedule:
     """Reproduce the reference's block-length recurrence
     (prestofft.py:157-195): buflen grows as int(initialbuflen*log(offset)),
-    capped at maxbuflen."""
+    capped at maxbuflen. Cached: the schedule depends only on the length,
+    and batch searches deredden many same-length spectra."""
     starts, lens = [1], [initialbuflen]
     newoffset = 1 + initialbuflen
     newbuflen = int(initialbuflen * np.log(newoffset))
