@@ -563,6 +563,25 @@ def run_fold(args):
     }
 
 
+def probe_backend(timeout: float = 150.0) -> bool:
+    """Cheap child-process liveness probe of the accelerator tunnel.
+
+    A wedged axon tunnel HANGS (observed for hours) rather than erroring,
+    so the full benchmark child would sit in native code until its whole
+    2400 s timeout before the CPU fallback got a chance. One trivial op in
+    a short-lived child answers the question in seconds when the tunnel is
+    healthy and bounds the damage when it is not."""
+    code = ("import jax, jax.numpy as jnp; "
+            "print(float(jnp.ones((8, 8)).sum()))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+        return proc.returncode == 0 and "64.0" in proc.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def run_child(args, cpu: bool, timeout: float):
     """Run the measurement in a child interpreter; return its JSON record.
 
@@ -614,6 +633,9 @@ def main():
         return
     record = None
     try:
+        if not probe_backend():
+            raise RuntimeError(
+                "accelerator liveness probe failed (wedged tunnel?)")
         record = run_child(args, cpu=False, timeout=2400)
     except Exception as e:  # noqa: BLE001 - the JSON line must happen
         print(f"# benchmark failed on primary backend: {type(e).__name__}: {e}",

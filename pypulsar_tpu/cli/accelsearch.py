@@ -138,7 +138,8 @@ def search_one(infile, cfg, args):
 
     from pypulsar_tpu.io.prestocand import write_rzwcands
 
-    write_rzwcands(candfn, [c.as_fourierprops() for c in cands])
+    # .txtcand first, .cand (atomically) last: the .cand's existence is
+    # the batch-restart completeness marker
     txtfn = f"{outbase}_ACCEL_{ztag}.txtcand"
     with open(txtfn, "w") as f:
         f.write("# cand   sigma    power  numharm          r          z"
@@ -150,6 +151,7 @@ def search_one(infile, cfg, args):
                 f"{c.r:10.2f} {c.z:10.2f} {freq:15.8f} "
                 f"{c.fdot(T):16.6e} {1.0 / freq:14.10f}\n"
             )
+    write_rzwcands(candfn, [c.as_fourierprops() for c in cands])
     print(f"# wrote {len(cands)} candidates to {candfn} and {txtfn}",
           file=sys.stderr)
     return candfn
@@ -168,13 +170,22 @@ def main(argv=None):
     # template banks (fourier.accelsearch._build_ratio_bank), deredden
     # schedules and compiled stage programs are process-cached: searching
     # many per-DM files in one invocation pays setup once
-    done = 0
+    done, failed = 0, 0
     for infile in args.infiles:
-        if search_one(infile, cfg, args) is not None:
-            done += 1
+        try:
+            if search_one(infile, cfg, args) is not None:
+                done += 1
+        except Exception as e:  # noqa: BLE001 - one bad file must not
+            # abort a restartable batch; report and continue
+            if len(args.infiles) == 1:
+                raise
+            failed += 1
+            print(f"# {infile} FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
     if len(args.infiles) > 1:
-        print(f"# searched {done}/{len(args.infiles)} files", file=sys.stderr)
-    return 0
+        print(f"# searched {done}/{len(args.infiles)} files"
+              + (f" ({failed} failed)" if failed else ""), file=sys.stderr)
+    return 0 if failed == 0 else 1
 
 
 if __name__ == "__main__":

@@ -297,7 +297,11 @@ def _detect_impl(accum, thresh, k: int):
 # ---------------------------------------------------------------------------
 
 
-@functools.lru_cache(maxsize=24)
+_BANK_CACHE: Dict[tuple, tuple] = {}
+_BANK_CACHE_BYTES = [0]
+_BANK_CACHE_LIMIT = 4e9  # host RAM; jerk banks reach GB scale
+
+
 def _build_ratio_bank(rho_num: int, rho_den: int, zs: tuple, ws: tuple,
                       segw: int, min_halfwidth: int):
     """(tf[rows, L] complex64, hw, L, stretch idx[2*segw] int32) for one
@@ -326,6 +330,26 @@ def _build_ratio_bank(rho_num: int, rho_den: int, zs: tuple, ws: tuple,
     rel = np.floor(rf * np.arange(2 * segw) + 0.5).astype(np.int64)
     idx = ((rel % 2) * L + (rel // 2)).astype(np.int32)
     return tf, hw, L, idx
+
+
+def _cached_ratio_bank(rho_num, rho_den, zs, ws, segw, min_halfwidth):
+    """Byte-bounded memo of :func:`_build_ratio_bank` — repeated searches
+    with one configuration (the 4096-trial batch) reuse banks, while a
+    parameter sweep cannot pin unbounded host RAM (the cache clears when
+    it would exceed ~4 GB)."""
+    key = (rho_num, rho_den, zs, ws, segw, min_halfwidth)
+    hit = _BANK_CACHE.get(key)
+    if hit is not None:
+        return hit
+    bank = _build_ratio_bank(rho_num, rho_den, zs, ws, segw, min_halfwidth)
+    size = bank[0].nbytes + bank[3].nbytes
+    if _BANK_CACHE_BYTES[0] + size > _BANK_CACHE_LIMIT:
+        _BANK_CACHE.clear()
+        _BANK_CACHE_BYTES[0] = 0
+    if size <= _BANK_CACHE_LIMIT:
+        _BANK_CACHE[key] = bank
+        _BANK_CACHE_BYTES[0] += size
+    return bank
 
 
 def _parabola_peak(ym, y0, yp):
@@ -387,9 +411,9 @@ def accel_search(
 
     ratios = sorted({Fraction(b, H) for H in stages for b in range(1, H + 1)})
     banks = {
-        rho: _build_ratio_bank(rho.numerator, rho.denominator,
-                               tuple(zs), tuple(ws), segw,
-                               cfg.min_halfwidth)
+        rho: _cached_ratio_bank(rho.numerator, rho.denominator,
+                                tuple(zs), tuple(ws), segw,
+                                cfg.min_halfwidth)
         for rho in ratios
     }  # host-side (complex64 numpy): device copies live per stage
 

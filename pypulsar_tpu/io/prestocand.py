@@ -56,12 +56,20 @@ def read_rzwcands(candfn: str) -> List[RzwCand]:
 
 def write_rzwcands(candfn: str, cands) -> str:
     """Write candidates (mappings or objects with fourierprops attribute
-    names) as a .cand file."""
+    names) as a .cand file.
+
+    Atomic (tmp + rename): an existing .cand file always holds a complete
+    record set — batch restarts key resumability on its existence
+    (cli/accelsearch --skip-existing)."""
+    import os
+
     recs = np.zeros(len(cands), dtype=FOURIERPROPS_DTYPE)
     for i, cand in enumerate(cands):
         get = cand.get if hasattr(cand, "get") \
             else lambda k, d=0.0: getattr(cand, k, d)
         for name in RzwCand._FIELDS:
             recs[i][name] = get(name, 0.0)
-    recs.tofile(candfn)
+    tmp = candfn + ".tmp"
+    recs.tofile(tmp)
+    os.replace(tmp, candfn)
     return candfn
