@@ -7,7 +7,6 @@ Behavioral spec: reference ``bin/massfunc.py`` — solve the cubic
 from __future__ import annotations
 
 import argparse
-import sys
 
 import numpy as np
 

@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import argparse
 import os
-import sys
 
 import numpy as np
 
@@ -108,6 +107,10 @@ def main(argv=None):
                     help="candidates to print")
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard DM trials over this many devices")
+    ap.add_argument("--engine", default="auto",
+                    choices=("auto", "gather", "scan", "fourier"),
+                    help="chunk-kernel formulation (auto: fourier on TPU, "
+                         "gather elsewhere)")
     ap.add_argument("--write-dats", action="store_true",
                     help="flat mode: also write per-DM .dat/.inf series")
     ap.add_argument("--checkpoint", default=None, metavar="PATH",
@@ -174,7 +177,8 @@ def main(argv=None):
                               chunk_payload=args.chunk, mesh=mesh,
                               verbose=True,
                               checkpoint_path=args.checkpoint,
-                              checkpoint_every=args.checkpoint_every)
+                              checkpoint_every=args.checkpoint_every,
+                              engine=args.engine)
     else:
         if args.numdms is None:
             ap.error("flat mode requires --numdms (or use --ddplan)")
@@ -184,7 +188,8 @@ def main(argv=None):
                             widths=widths, chunk_payload=args.chunk,
                             mesh=mesh,
                             checkpoint_path=args.checkpoint,
-                            checkpoint_every=args.checkpoint_every)
+                            checkpoint_every=args.checkpoint_every,
+                            engine=args.engine)
         if args.write_dats:
             _write_dats(outbase, reader, dms, args.downsamp)
 

@@ -22,7 +22,7 @@ tests (SURVEY.md §4 strategy 1).
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Optional, Tuple, Union
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

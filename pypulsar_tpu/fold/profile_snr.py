@@ -17,7 +17,7 @@ reference's interactive matplotlib picker becomes the CLI's job.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 

@@ -22,7 +22,7 @@ SURVEY.md §2.5) — the Princeton TOA format:
 from __future__ import annotations
 
 import sys
-from typing import Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import re
 import sys
-from typing import IO, List, Optional, Sequence, Union
+from typing import IO, List, Sequence, Union
 
 __all__ = [
     "Candidate",

@@ -24,7 +24,6 @@ fft_rotate); the dedispersion ref is the highest subband, as prepfold.
 
 from __future__ import annotations
 
-import os
 import struct
 from typing import Optional
 

@@ -36,7 +36,6 @@ Python rolls, formats/spectra.py:54-94, one trial at a time on one core).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import jax

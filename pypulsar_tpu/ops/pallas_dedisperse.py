@@ -24,11 +24,9 @@ the kernel cannot yet be enabled by default.  Re-evaluate with
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from pypulsar_tpu.ops.pallas_kernels import _on_tpu  # noqa: F401 (shared)
 

@@ -23,7 +23,6 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 D_BLOCK = 8  # float32 sublane count: one tile of trials per grid step
 

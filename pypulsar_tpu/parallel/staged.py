@@ -185,7 +185,8 @@ def _downsampled_blocks(src, factor: int, payload_ds: int, overlap_ds: int):
 def _run_step(src, dms, factor: int, nsub: int, group_size: int,
               widths: Tuple[int, ...], chunk_payload: Optional[int],
               mesh, verbose: bool = False, label: str = "",
-              checkpoint: Optional[SweepCheckpoint] = None) -> Optional[StepResult]:
+              checkpoint: Optional[SweepCheckpoint] = None,
+              engine: str = "auto") -> Optional[StepResult]:
     """Sweep one DM block over ``src`` downsampled by ``factor``."""
     dt_eff = src.tsamp * factor
     n_ds = src.nsamples // factor
@@ -213,6 +214,7 @@ def _run_step(src, dms, factor: int, nsub: int, group_size: int,
         mesh=mesh,
         chan_major=True,
         checkpoint=checkpoint,
+        engine=engine,
     )
     return StepResult(downsamp=factor, dt=dt_eff, result=res)
 
@@ -229,6 +231,7 @@ def sweep_flat(
     verbose: bool = False,
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 16,
+    engine: str = "auto",
 ) -> StagedSweepResult:
     """Single-stage sweep of an explicit DM grid over a file reader or
     Spectra (the flat counterpart of :func:`sweep_ddplan`, sharing its
@@ -239,7 +242,7 @@ def sweep_flat(
             if checkpoint_path else None)
     step = _run_step(src, np.asarray(dms, dtype=np.float64), int(downsamp),
                      nsub, group_size, tuple(widths), chunk_payload, mesh,
-                     verbose=verbose, checkpoint=ckpt)
+                     verbose=verbose, checkpoint=ckpt, engine=engine)
     return StagedSweepResult(steps=[] if step is None else [step])
 
 
@@ -254,6 +257,7 @@ def sweep_ddplan(
     verbose: bool = False,
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 16,
+    engine: str = "auto",
 ) -> StagedSweepResult:
     """Execute every DDstep of ``ddplan`` over ``source``.
 
@@ -275,7 +279,7 @@ def sweep_ddplan(
 
     src = _make_source(source)
     ckpt_context = "engine=%s/meshdm=%s" % (
-        resolve_engine("auto"),
+        resolve_engine(engine),
         0 if mesh is None else mesh.shape.get("dm", 0))
     probe = _source_probe(src) if checkpoint_path else b""
     steps: List[StepResult] = []
@@ -300,7 +304,7 @@ def sweep_ddplan(
                 if checkpoint_path else None)
         sr = _run_step(src, step.DMs, int(step.downsamp), nsub, group_size,
                        tuple(widths), chunk_payload, mesh, verbose=verbose,
-                       label=f"step {si}: ", checkpoint=ckpt)
+                       label=f"step {si}: ", checkpoint=ckpt, engine=engine)
         if sr is None:
             break
         if done_fn:
