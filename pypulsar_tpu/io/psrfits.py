@@ -365,9 +365,17 @@ class PsrfitsFile:
         self.npoln = self.specinfo.num_polns
         self.nsamp_per_subint = self.specinfo.spectra_per_subint
         self.nsubints = int(self.specinfo.num_subint[0])
-        self.freqs = np.atleast_1d(
+        self.dat_freqs = np.atleast_1d(
             np.asarray(self.fits["SUBINT"].data[0]["DAT_FREQ"], dtype=np.float64)
         )
+        # the public frequency table matches get_spectra's delivered
+        # channel order (high-frequency-first unless the file is already
+        # inverted) — a low-first table paired with flipped data sent
+        # dedispersion delays to the wrong channels
+        if not self.specinfo.need_flipband:
+            self.freqs = self.dat_freqs[::-1].copy()
+        else:
+            self.freqs = self.dat_freqs
         self.frequencies = self.freqs
         self.tsamp = self.specinfo.dt
         self.nspec = int(self.nsamp_per_subint) * self.nsubints
@@ -451,12 +459,10 @@ class PsrfitsFile:
         data = data.T[:, skip : skip + N]
         if not self.specinfo.need_flipband:
             # file stores low->high; Spectra wants high-frequency first
+            # (self.freqs is already in the delivered order)
             data = data[::-1, :]
-            freqs = self.freqs[::-1]
-        else:
-            freqs = self.freqs
         return Spectra(
-            freqs,
+            self.freqs,
             self.tsamp,
             np.ascontiguousarray(data, dtype=np.float32),
             starttime=self.tsamp * startsamp,
