@@ -97,11 +97,21 @@ class StagedSweepResult:
         return out
 
 
+def _band_orientation(freqs):
+    """(normalized_freqs, flip): high-frequency-first view of a channel
+    table (the sweep plan's convention; an ascending table silently sent
+    delays to the wrong channels before this normalization)."""
+    freqs = np.asarray(freqs, dtype=np.float64)
+    flip = len(freqs) > 1 and freqs[0] < freqs[-1]
+    return (freqs[::-1].copy() if flip else freqs), flip
+
+
 class _SpectraSource:
-    """Block source over an in-memory (possibly device-resident) Spectra."""
+    """Block source over an in-memory (possibly device-resident) Spectra,
+    delivered high-frequency-first (see _band_orientation)."""
 
     def __init__(self, spectra):
-        self.frequencies = np.asarray(spectra.freqs, dtype=np.float64)
+        self.frequencies, self._flip = _band_orientation(spectra.freqs)
         self.tsamp = float(spectra.dt)
         self.nsamples = int(spectra.numspectra)
         self._data = spectra.data
@@ -110,7 +120,10 @@ class _SpectraSource:
         pos = 0
         while pos < self.nsamples:
             n = min(payload + overlap, self.nsamples - pos)
-            yield pos, self._data[:, pos:pos + n]
+            block = self._data[:, pos:pos + n]
+            # per-block flip: a whole-dataset reversed copy would double
+            # device residency for the sweep's lifetime
+            yield pos, (block[::-1] if self._flip else block)
             pos += payload
 
 
@@ -121,7 +134,7 @@ class _ReaderSource:
 
     def __init__(self, reader):
         self.reader = reader
-        self.frequencies = np.asarray(reader.frequencies, dtype=np.float64)
+        self.frequencies, self._flip = _band_orientation(reader.frequencies)
         self.tsamp = float(reader.tsamp)
         for attr in ("number_of_samples", "nspec", "nsamples"):
             n = getattr(reader, attr, None)
@@ -141,7 +154,7 @@ class _ReaderSource:
             # yields Spectra with different stepping semantics and must
             # take the fallback branches below.
             for pos, block in iter_blocks(payload, overlap):
-                yield pos, np.ascontiguousarray(block.T)
+                yield pos, self._orient(np.ascontiguousarray(block.T))
             return
         get_samples = getattr(self.reader, "get_samples", None)
         get_interval = getattr(self.reader, "get_sample_interval", None)
@@ -154,8 +167,13 @@ class _ReaderSource:
                 block = np.ascontiguousarray(get_interval(pos, pos + n).T)
             else:
                 block = self.reader.get_spectra(pos, n).data
-            yield pos, block
+            yield pos, self._orient(block)
             pos += payload
+
+    def _orient(self, block):
+        """High-frequency-first channel rows (every yield goes through
+        here so a future reader branch cannot forget the flip)."""
+        return block[::-1] if self._flip else block
 
 
 def _make_source(source):

@@ -142,6 +142,12 @@ def make_sweep_plan(
     dms = np.asarray(dms, dtype=np.float64)
     freqs = np.asarray(freqs, dtype=np.float64)
     C = len(freqs)
+    if C > 1 and not np.all(np.diff(freqs) <= 0):
+        raise ValueError(
+            "make_sweep_plan needs monotonically descending (high-"
+            "frequency-first) channels: flip/sort the data and frequency "
+            "axes first (the staged block sources flip ascending tables "
+            "automatically)")
     if C % nsub:
         raise ValueError(f"nsub={nsub} must divide nchan={C}")
     per = C // nsub

@@ -130,3 +130,39 @@ def test_accelsearch_fft_input_and_zaplist(tmp_path, monkeypatch):
     assert zcands, "pulsar lost after zapping"
     assert abs(zcands[0].r / T - f_psr) < 1.0 / T  # pulsar now on top
     assert all(abs(c.r / T - f_rfi) > 0.5 for c in zcands)
+
+
+def test_ascending_band_filterbank_through_sweep(tmp_path):
+    """A foff>0 (low-frequency-first) filterbank sweeps identically to the
+    same data stored high-first: the block sources normalize channel
+    order to the plan's convention instead of silently clamping negative
+    shifts."""
+    from pypulsar_tpu.parallel.staged import sweep_flat
+
+    rng = np.random.RandomState(41)
+    C, T, dt, dm = 32, 6144, 1e-3, 50.0
+    freqs_hi = 1500.0 - 4.0 * np.arange(C)  # descending
+    data = rng.randn(T, C).astype(np.float32)  # columns follow freqs_hi
+    bins = numpy_ref.bin_delays(dm, freqs_hi, dt)
+    for c in range(C):
+        idx = 2500 + bins[c]
+        if idx < T:
+            data[idx, c] += 9.0
+
+    hi = str(tmp_path / "hi.fil")
+    filterbank.write_filterbank(hi, dict(
+        nchans=C, tsamp=dt, fch1=1500.0, foff=-4.0, tstart=55000.0,
+        nbits=32, nifs=1, source_name="HI"), data)
+    lo = str(tmp_path / "lo.fil")
+    filterbank.write_filterbank(lo, dict(
+        nchans=C, tsamp=dt, fch1=float(freqs_hi[-1]), foff=4.0,
+        tstart=55000.0, nbits=32, nifs=1, source_name="LO"),
+        data[:, ::-1])  # same samples, stored ascending
+
+    dms = np.linspace(0.0, 100.0, 16)
+    a = sweep_flat(filterbank.FilterbankFile(hi), dms, nsub=8, group_size=4)
+    b = sweep_flat(filterbank.FilterbankFile(lo), dms, nsub=8, group_size=4)
+    ba, bb = a.best(1)[0], b.best(1)[0]
+    assert bb["dm"] == ba["dm"] and bb["sample"] == ba["sample"]
+    np.testing.assert_allclose(bb["snr"], ba["snr"], rtol=1e-5)
+    assert abs(ba["dm"] - dm) <= 8.0  # and it is the injected pulse
