@@ -1,0 +1,39 @@
+# Developer entry points. (The reference's Makefile only deleted .pyc
+# files; these targets drive the real workflows.)
+
+PY ?= python
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test test-fourier dryrun bench bench-quick bench-ab bench-accel bench-fold native clean
+
+test:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q
+
+# the whole suite with the TPU-default engine forced (cross-engine check)
+test-fourier:
+	PYPULSAR_TPU_SWEEP_ENGINE=fourier $(CPU_ENV) $(PY) -m pytest tests/ -q
+
+dryrun:
+	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+bench:
+	$(PY) bench.py
+
+bench-quick:
+	$(PY) bench.py --quick
+
+bench-ab:
+	$(PY) bench.py --ab
+
+bench-accel:
+	$(PY) bench.py --accel
+
+bench-fold:
+	$(PY) bench.py --fold
+
+native:
+	$(PY) -c "from pypulsar_tpu import native; assert native.available(); print('native codec OK')"
+
+clean:
+	find . -name '__pycache__' -type d -exec rm -rf {} + 2>/dev/null; \
+	rm -f pypulsar_tpu/native/libpsrcodec.so
