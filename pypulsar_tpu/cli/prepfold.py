@@ -11,8 +11,11 @@ can load.
 Fold geometry mirrors prepfold: time is cut into ``npart`` partitions and
 channels into ``nsub`` subbands; each (part, sub) cell is a ``proflen``-bin
 phase profile folded with the device scatter-add engine
-(fold/engine.fold_bins) at the topocentric phase model
-``phi(t) = f0 t + f1 t^2/2 + f2 t^3/6``. Inter-subband dispersion delays
+(fold/engine.fold_bins). The phase model is either the constant-period
+polynomial ``phi(t) = f0 t + f1 t^2/2 + f2 t^3/6`` (-p/--pd/--pdd) or a
+parfile ephemeris via polyco generation (--par: TEMPO when available,
+the native spin-down/Keplerian generators for barycentred data
+otherwise — fold/polycos.create_polycos). Inter-subband dispersion delays
 are left in (archives start at currdm = 0); ``PfdFile.dedisperse(bestdm)``
 rotates them out exactly as prepfold archives behave after loading.
 """
@@ -28,14 +31,18 @@ import numpy as np
 from pypulsar_tpu.core import psrmath
 
 
-def fold_partitions(blocks, dt, nbins, npart, nsub, f_poly, total_samples):
+def fold_partitions(blocks, dt, nbins, npart, nsub, phase_fn,
+                    total_samples):
     """profs[npart, nsub, nbins] + stats[npart, nsub, 7] from a stream of
-    (startsamp, [chan, time] float32) blocks covering the observation."""
+    (startsamp, [chan, time] float32) blocks covering the observation.
+
+    ``phase_fn(start, n)`` returns the rotation phase of samples
+    [start, start+n) — a polynomial for constant-period folds, polyco
+    evaluation for ephemeris folds."""
     import jax.numpy as jnp
 
     from pypulsar_tpu.fold.engine import fold_bins, phase_to_bins
 
-    f0, f1, f2 = f_poly
     part_len = total_samples // npart
     if part_len < 1:
         raise ValueError(
@@ -50,8 +57,7 @@ def fold_partitions(blocks, dt, nbins, npart, nsub, f_poly, total_samples):
         if start >= used:
             break
         n = min(n, used - start)
-        t = (start + np.arange(n)) * dt
-        phase = t * (f0 + t * (f1 / 2.0 + t * f2 / 6.0))
+        phase = phase_fn(start, n)
         bin_idx = phase_to_bins(phase, nbins)
         sub = jnp.asarray(data[:, :n], jnp.float32).reshape(
             nsub, per, n).sum(axis=1)
@@ -81,8 +87,12 @@ def build_parser():
                     "(P, Pdot, DM) into a PRESTO-format .pfd archive "
                     "(TPU backend).")
     p.add_argument("infile", help=".fil filterbank or .dat time series")
-    p.add_argument("-p", "--period", type=float, required=True,
+    p.add_argument("-p", "--period", type=float, default=None,
                    help="topocentric fold period, seconds")
+    p.add_argument("--par", default=None, metavar="PARFILE",
+                   help="fold at a parfile ephemeris via native polyco "
+                        "generation (spin-down, or BT/ELL1 binaries) "
+                        "instead of a constant period")
     p.add_argument("--pd", type=float, default=0.0,
                    help="period derivative, s/s")
     p.add_argument("--pdd", type=float, default=0.0,
@@ -102,9 +112,13 @@ def build_parser():
 
 
 def main(argv=None):
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if (args.period is None) == (args.par is None):
+        parser.error("give exactly one of -p/--period or --par")
+    if args.par is not None and (args.pd or args.pdd):
+        parser.error("--pd/--pdd come from the parfile when --par is given")
     base, ext = os.path.splitext(args.infile)
-    f_poly = psrmath.p_to_f(args.period, args.pd, args.pdd)
 
     if ext == ".dat":
         from pypulsar_tpu.io.datfile import Datfile
@@ -153,20 +167,59 @@ def main(argv=None):
                     data = data[::-1]  # low->high so subband 0 = lofreq
                 yield s, data
 
+    if args.par is not None:
+        from pypulsar_tpu.fold.engine import phases_from_polycos
+        from pypulsar_tpu.fold.polycos import create_polycos
+        from pypulsar_tpu.io.parfile import PsrPar
+        from pypulsar_tpu.astro.telescopes import telescope_to_id
+
+        par = PsrPar(args.par)
+        obs_days = total * dt / psrmath.SECPERDAY
+        # the dispatcher handles TEMPO / native binary / native spin-down
+        # and refuses topocentric data without TEMPO (a pure spin-down
+        # polyco would smear the fold by the Earth's v/c)
+        tel_id = telescope_to_id.get(telescope, "@")
+        cfreq = lofreq + (numchan / 2 - 0.5) * chan_wid
+        pcs = create_polycos(par, str(tel_id), cfreq, int(tepoch),
+                             int(tepoch + obs_days) + 1)
+
+        def phase_fn(start, n):
+            mjd = tepoch + start * dt / psrmath.SECPERDAY
+            return phases_from_polycos(pcs, mjd, n, dt)
+
+        # header spin parameters at the OBSERVATION epoch (PEPOCH can be
+        # far away; consumers use curr_p1 for bin widths and rotations)
+        mjdi = int(tepoch)
+        f_here = float(pcs.get_freq(mjdi, tepoch - mjdi))
+        fold_p = 1.0 / f_here
+        f1 = float(getattr(par, "F1", 0.0) or 0.0)
+        f2 = float(getattr(par, "F2", 0.0) or 0.0)
+        fold_pd = -f1 / (f_here * f_here)
+        fold_pdd = (2.0 * f1 * f1 / f_here ** 3 - f2 / (f_here * f_here)) \
+            if (f1 or f2) else 0.0
+    else:
+        f0, f1, f2 = psrmath.p_to_f(args.period, args.pd, args.pdd)
+
+        def phase_fn(start, n):
+            t = (start + np.arange(n)) * dt
+            return t * (f0 + t * (f1 / 2.0 + t * f2 / 6.0))
+
+        fold_p, fold_pd, fold_pdd = args.period, args.pd, args.pdd
+
     profs, stats = fold_partitions(
-        blocks(), dt, args.proflen, args.npart, nsub, f_poly, total)
+        blocks(), dt, args.proflen, args.npart, nsub, phase_fn, total)
 
     from pypulsar_tpu.io.prestopfd import make_pfd
 
     pfd = make_pfd(
         profs, dt=dt, lofreq=lofreq, chan_wid=chan_wid, numchan=numchan,
-        fold_p1=args.period, bestdm=args.dm, stats=stats, tepoch=tepoch,
-        candnm=f"{args.period * 1e3:.2f}ms_{args.dm:.1f}dm",
+        fold_p1=fold_p, bestdm=args.dm, stats=stats, tepoch=tepoch,
+        candnm=f"{fold_p * 1e3:.2f}ms_{args.dm:.1f}dm",
         telescope=telescope, filenm=os.path.basename(args.infile),
     )
-    pfd.topo_p1, pfd.topo_p2, pfd.topo_p3 = args.period, args.pd, args.pdd
-    pfd.curr_p1, pfd.curr_p2, pfd.curr_p3 = args.period, args.pd, args.pdd
-    outfn = args.outfile or f"{base}_{args.period * 1e3:.2f}ms.pfd"
+    pfd.topo_p1, pfd.topo_p2, pfd.topo_p3 = fold_p, fold_pd, fold_pdd
+    pfd.curr_p1, pfd.curr_p2, pfd.curr_p3 = fold_p, fold_pd, fold_pdd
+    outfn = args.outfile or f"{base}_{fold_p * 1e3:.2f}ms.pfd"
     pfd.write(outfn)
     print(f"# folded {total} samples into [{args.npart}, {nsub}, "
           f"{args.proflen}] -> {outfn}", file=sys.stderr)

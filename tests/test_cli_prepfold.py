@@ -103,3 +103,58 @@ def test_prepfold_dat_single_subband(tmp_path, monkeypatch):
     assert (prof.max() - np.median(prof)) > 5.0 * prof.std() * 0.2
     peak_phase = prof.argmax() / 64.0
     assert abs(peak_phase - 0.25) < 0.08
+
+
+def test_prepfold_par_ephemeris_fold(tmp_path, monkeypatch):
+    """--par folds through native polyco generation: a pulsar with a real
+    spin-down (P changing over the observation) stays phase-coherent
+    under the ephemeris fold but smears under the constant-period fold."""
+    from pypulsar_tpu.cli import prepfold as cli_fold
+    from pypulsar_tpu.core import psrmath
+    from pypulsar_tpu.io.datfile import write_dat
+    from pypulsar_tpu.io.infodata import InfoData
+
+    monkeypatch.chdir(tmp_path)
+    rng = np.random.RandomState(8)
+    N, dt = 1 << 16, 1e-3
+    epoch = 55000.0
+    f0, f1 = 19.37, -6e-3  # strong spin-down: ~13 rotations of drift over T
+    t = np.arange(N) * dt
+    phase = f0 * t + 0.5 * f1 * t * t
+    ts = rng.standard_normal(N).astype(np.float32)
+    ts += 1.0 * np.exp(
+        -0.5 * (((phase % 1.0) - 0.5) / 0.03) ** 2).astype(np.float32)
+    inf = InfoData()
+    inf.epoch = epoch
+    inf.dt = dt
+    inf.N = N
+    inf.telescope = "Fake"
+    inf.lofreq = 1400.0
+    inf.BW = 100.0
+    inf.numchan = 1
+    inf.chan_width = 100.0
+    inf.object = "PARFOLD"
+    write_dat("pf", ts, inf)
+    with open("pf.par", "w") as f:
+        f.write(f"PSR J0000+0000\nF0 {f0}\nF1 {f1}\nPEPOCH {epoch}\nDM 0\n")
+
+    rc = cli_fold.main(["pf.dat", "--par", "pf.par", "-n", "64",
+                        "--npart", "16", "-o", "par.pfd"])
+    assert rc == 0
+    rc = cli_fold.main(["pf.dat", "-p", str(1.0 / f0), "-n", "64",
+                        "--npart", "16", "-o", "const.pfd"])
+    assert rc == 0
+
+    from pypulsar_tpu.io.prestopfd import PfdFile
+
+    def contrast(fn):
+        prof = PfdFile(fn).sumprof
+        return (prof.max() - np.median(prof)) / max(prof.std(), 1e-9)
+
+    c_par, c_const = contrast("par.pfd"), contrast("const.pfd")
+    assert c_par > 1.5 * c_const, (c_par, c_const)
+    # per-partition peaks aligned under the ephemeris fold
+    tvp = PfdFile("par.pfd").time_vs_phase()
+    peaks = tvp.argmax(axis=1)
+    spread = np.ptp(((peaks - peaks[0] + 32) % 64))
+    assert spread <= 8, f"ephemeris fold not coherent: {peaks}"
