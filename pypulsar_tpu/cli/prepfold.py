@@ -97,9 +97,10 @@ def build_parser():
                    help="period derivative, s/s")
     p.add_argument("--pdd", type=float, default=0.0,
                    help="second period derivative, s/s^2")
-    p.add_argument("--dm", type=float, default=0.0,
+    p.add_argument("--dm", type=float, default=None,
                    help="candidate DM (stored as bestdm; subbands stay at "
-                        "DM 0 until PfdFile.dedisperse, like prepfold)")
+                        "DM 0 until PfdFile.dedisperse, like prepfold). "
+                        "Defaults to the parfile's DM with --par, else 0")
     p.add_argument("-n", "--proflen", type=int, default=64,
                    help="phase bins per profile (default 64)")
     p.add_argument("--npart", type=int, default=32,
@@ -124,6 +125,7 @@ def main(argv=None):
         from pypulsar_tpu.io.datfile import Datfile
 
         dat = Datfile(args.infile)
+        inf_meta = dat.infdata
         series = dat.read_all()
         dt = float(dat.infdata.dt)
         total = len(series)
@@ -156,6 +158,17 @@ def main(argv=None):
 
         telescope = ids_to_telescope.get(
             int(fb.header.get("telescope_id", -1)), "unknown")
+        from pypulsar_tpu.io.infodata import InfoData
+
+        inf_meta = InfoData()
+        inf_meta.telescope = telescope
+        inf_meta.epoch = tepoch
+        inf_meta.dt = dt
+        inf_meta.N = total
+        inf_meta.lofreq = lofreq
+        inf_meta.numchan = numchan
+        inf_meta.chan_width = chan_wid
+        inf_meta.bary = int(fb.header.get("barycentric", 0) or 0)
         part_len = total // args.npart
 
         def blocks():
@@ -169,34 +182,36 @@ def main(argv=None):
 
     if args.par is not None:
         from pypulsar_tpu.fold.engine import phases_from_polycos
-        from pypulsar_tpu.fold.polycos import create_polycos
+        from pypulsar_tpu.fold.polycos import create_polycos_from_inf
         from pypulsar_tpu.io.parfile import PsrPar
-        from pypulsar_tpu.astro.telescopes import telescope_to_id
 
         par = PsrPar(args.par)
-        obs_days = total * dt / psrmath.SECPERDAY
-        # the dispatcher handles TEMPO / native binary / native spin-down
-        # and refuses topocentric data without TEMPO (a pure spin-down
-        # polyco would smear the fold by the Earth's v/c)
-        tel_id = telescope_to_id.get(telescope, "@")
-        cfreq = lofreq + (numchan / 2 - 0.5) * chan_wid
-        pcs = create_polycos(par, str(tel_id), cfreq, int(tepoch),
-                             int(tepoch + obs_days) + 1)
+        # the shared dispatcher handles bary-flag / telescope-site lookup
+        # and TEMPO / native binary / native spin-down generation,
+        # refusing topocentric data it cannot correct
+        pcs = create_polycos_from_inf(par, inf_meta)
 
         def phase_fn(start, n):
             mjd = tepoch + start * dt / psrmath.SECPERDAY
             return phases_from_polycos(pcs, mjd, n, dt)
 
-        # header spin parameters at the OBSERVATION epoch (PEPOCH can be
-        # far away; consumers use curr_p1 for bin widths and rotations)
-        mjdi = int(tepoch)
-        f_here = float(pcs.get_freq(mjdi, tepoch - mjdi))
-        fold_p = 1.0 / f_here
-        f1 = float(getattr(par, "F1", 0.0) or 0.0)
-        f2 = float(getattr(par, "F2", 0.0) or 0.0)
-        fold_pd = -f1 / (f_here * f_here)
-        fold_pdd = (2.0 * f1 * f1 / f_here ** 3 - f2 / (f_here * f_here)) \
-            if (f1 or f2) else 0.0
+        # header spin parameters: the APPARENT f, fdot, fddot over this
+        # observation, sampled from the polycos (binary orbits dominate
+        # fdot; PEPOCH-copied intrinsic values would be wrong by orders
+        # of magnitude) — consumers use curr_p1/p2/p3 for bin widths,
+        # dedispersion rotations and adjust_period
+        Tsec = total * dt
+
+        def f_at(sec):
+            mjd = tepoch + sec / psrmath.SECPERDAY
+            return float(pcs.get_freq(int(mjd), mjd - int(mjd)))
+
+        f_a, f_b, f_c = f_at(0.0), f_at(Tsec / 2.0), f_at(Tsec)
+        f1_app = (f_c - f_a) / Tsec
+        f2_app = 4.0 * (f_a - 2.0 * f_b + f_c) / (Tsec * Tsec)
+        fold_p, fold_pd, fold_pdd = psrmath.f_to_p(f_a, f1_app, f2_app)
+        if args.dm is None:
+            args.dm = float(getattr(par, "DM", 0.0) or 0.0)
     else:
         f0, f1, f2 = psrmath.p_to_f(args.period, args.pd, args.pdd)
 
@@ -205,6 +220,8 @@ def main(argv=None):
             return t * (f0 + t * (f1 / 2.0 + t * f2 / 6.0))
 
         fold_p, fold_pd, fold_pdd = args.period, args.pd, args.pdd
+    if args.dm is None:
+        args.dm = 0.0
 
     profs, stats = fold_partitions(
         blocks(), dt, args.proflen, args.npart, nsub, phase_fn, total)

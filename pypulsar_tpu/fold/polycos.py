@@ -556,12 +556,24 @@ def create_polycos_from_inf(par, infdata) -> Polycos:
     if isinstance(infdata, str):
         infdata = InfoData(infdata)
     obslength = (infdata.dt * infdata.N) / psrmath.SECPERDAY
-    telescope_id = telescope_to_id[infdata.telescope]
+    # Barycentred data needs no Earth-motion correction whatever the
+    # telescope was — check the flag BEFORE the site lookup so barycentred
+    # products from unmapped/synthetic telescopes work, and topocentric
+    # data from an unknown site fails loudly instead of folding smeared.
+    if getattr(infdata, "bary", 0):
+        telescope_id = "@"
+    else:
+        try:
+            telescope_id = telescope_to_id[infdata.telescope]
+        except KeyError:
+            raise PolycoError(
+                f"unknown telescope {infdata.telescope!r}: topocentric "
+                "polycos need a TEMPO site id (astro/telescopes.py); "
+                "barycentred data should set the .inf 'Barycentered?' flag"
+            ) from None
     # '0' = Geocenter, '@' = barycenter (optical/X-ray/gamma-ray data)
     if telescope_id not in ("0", "@"):
         center_freq = infdata.lofreq + (infdata.numchan / 2 - 0.5) * infdata.chan_width
-        if getattr(infdata, "bary", 0):
-            telescope_id = "@"
     else:
         center_freq = 0.0
     start_mjd = int(infdata.epoch)

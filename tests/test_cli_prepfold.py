@@ -134,9 +134,10 @@ def test_prepfold_par_ephemeris_fold(tmp_path, monkeypatch):
     inf.numchan = 1
     inf.chan_width = 100.0
     inf.object = "PARFOLD"
+    inf.bary = 1  # synthetic series is barycentred; 'Fake' has no site id
     write_dat("pf", ts, inf)
     with open("pf.par", "w") as f:
-        f.write(f"PSR J0000+0000\nF0 {f0}\nF1 {f1}\nPEPOCH {epoch}\nDM 0\n")
+        f.write(f"PSR J0000+0000\nF0 {f0}\nF1 {f1}\nPEPOCH {epoch}\nDM 12.5\n")
 
     rc = cli_fold.main(["pf.dat", "--par", "pf.par", "-n", "64",
                         "--npart", "16", "-o", "par.pfd"])
@@ -153,6 +154,10 @@ def test_prepfold_par_ephemeris_fold(tmp_path, monkeypatch):
 
     c_par, c_const = contrast("par.pfd"), contrast("const.pfd")
     assert c_par > 1.5 * c_const, (c_par, c_const)
+    assert PfdFile("par.pfd").bestdm == 12.5  # DM defaulted from the par
+    # header pdot reflects the apparent spin-down the fold used
+    pd = PfdFile("par.pfd").curr_p2
+    assert abs(pd - (-f1 / f0 ** 2)) < 0.1 * abs(f1 / f0 ** 2)
     # per-partition peaks aligned under the ephemeris fold
     tvp = PfdFile("par.pfd").time_vs_phase()
     peaks = tvp.argmax(axis=1)
