@@ -113,6 +113,14 @@ def main(argv=None):
                          "gather elsewhere)")
     ap.add_argument("--write-dats", action="store_true",
                     help="flat mode: also write per-DM .dat/.inf series")
+    ap.add_argument("--all-events", action="store_true",
+                    help="flat mode: record the strongest peak per "
+                         "streaming chunk for every (DM, width) and write "
+                         "all above-threshold events to {outbase}.events. "
+                         "Event granularity is one per chunk, so --chunk "
+                         "sets the minimum pulse separation (defaults to "
+                         "16384 samples with this flag); incompatible "
+                         "with --checkpoint")
     ap.add_argument("--checkpoint", default=None, metavar="PATH",
                     help="persist in-sweep state to PATH for --resume")
     ap.add_argument("--checkpoint-every", type=int, default=16,
@@ -131,6 +139,14 @@ def main(argv=None):
     if args.ddplan and args.downsamp != 1:
         ap.error("--downsamp is a flat-mode option (DDplan sets per-step "
                  "downsampling itself)")
+    if args.all_events and args.ddplan:
+        ap.error("--all-events is a flat-mode option")
+    if args.all_events and args.checkpoint:
+        ap.error("--all-events does not persist through --checkpoint")
+    if args.all_events and args.chunk is None:
+        # without chunking the whole series is one chunk and the event
+        # list degenerates to the single best peak per (DM, width)
+        args.chunk = 16384
     if args.resume and not args.checkpoint:
         ap.error("--resume requires --checkpoint PATH")
     widths = tuple(int(w) for w in args.widths.split(","))
@@ -189,12 +205,17 @@ def main(argv=None):
                             mesh=mesh,
                             checkpoint_path=args.checkpoint,
                             checkpoint_every=args.checkpoint_every,
-                            engine=args.engine)
+                            engine=args.engine,
+                            keep_chunk_peaks=args.all_events)
         if args.write_dats:
             _write_dats(outbase, reader, dms, args.downsamp)
 
     hits = staged.above_threshold(args.threshold)
     _write_cands(outbase + ".cands", hits)
+    if args.all_events:
+        events = staged.events(args.threshold)
+        _write_cands(outbase + ".events", events)
+        print(f"# {len(events)} above-threshold events -> {outbase}.events")
     print(f"# {staged.n_trials} DM trials swept; {len(hits)} detections "
           f">= {args.threshold} sigma -> {outbase}.cands")
     for c in staged.best(args.topk):

@@ -96,6 +96,22 @@ class StagedSweepResult:
         out.sort(key=lambda c: (c["dm"], c["time_sec"]))
         return out
 
+    def events(self, snr: float) -> List[dict]:
+        """Multi-event single-pulse list: every per-chunk peak above
+        ``snr`` across all steps, in physical units (needs the sweep run
+        with keep_chunk_peaks)."""
+        out = []
+        for s in self.steps:
+            for e in s.result.events(snr):
+                out.append(dict(
+                    dm=e["dm"], snr=e["snr"], width_bins=e["width"],
+                    width_sec=e["width"] * s.dt,
+                    sample=e["sample"], time_sec=e["sample"] * s.dt,
+                    downsamp=s.downsamp,
+                ))
+        out.sort(key=lambda c: (c["dm"], c["time_sec"]))
+        return out
+
 
 def _band_orientation(freqs):
     """(normalized_freqs, flip): high-frequency-first view of a channel
@@ -204,7 +220,8 @@ def _run_step(src, dms, factor: int, nsub: int, group_size: int,
               widths: Tuple[int, ...], chunk_payload: Optional[int],
               mesh, verbose: bool = False, label: str = "",
               checkpoint: Optional[SweepCheckpoint] = None,
-              engine: str = "auto") -> Optional[StepResult]:
+              engine: str = "auto",
+              keep_chunk_peaks: bool = False) -> Optional[StepResult]:
     """Sweep one DM block over ``src`` downsampled by ``factor``."""
     dt_eff = src.tsamp * factor
     n_ds = src.nsamples // factor
@@ -233,6 +250,7 @@ def _run_step(src, dms, factor: int, nsub: int, group_size: int,
         chan_major=True,
         checkpoint=checkpoint,
         engine=engine,
+        keep_chunk_peaks=keep_chunk_peaks,
     )
     return StepResult(downsamp=factor, dt=dt_eff, result=res)
 
@@ -250,6 +268,7 @@ def sweep_flat(
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 16,
     engine: str = "auto",
+    keep_chunk_peaks: bool = False,
 ) -> StagedSweepResult:
     """Single-stage sweep of an explicit DM grid over a file reader or
     Spectra (the flat counterpart of :func:`sweep_ddplan`, sharing its
@@ -260,7 +279,8 @@ def sweep_flat(
             if checkpoint_path else None)
     step = _run_step(src, np.asarray(dms, dtype=np.float64), int(downsamp),
                      nsub, group_size, tuple(widths), chunk_payload, mesh,
-                     verbose=verbose, checkpoint=ckpt, engine=engine)
+                     verbose=verbose, checkpoint=ckpt, engine=engine,
+                     keep_chunk_peaks=keep_chunk_peaks)
     return StagedSweepResult(steps=[] if step is None else [step])
 
 

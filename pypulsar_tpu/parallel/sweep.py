@@ -411,6 +411,34 @@ class SweepResult:
     peak_sample: np.ndarray  # [D, W] global sample index of best box start
     mean: np.ndarray
     std: np.ndarray
+    # with keep_chunk_peaks: per-chunk peak SNRs/samples [nchunks, D, W]
+    chunk_snr: Optional[np.ndarray] = None
+    chunk_sample: Optional[np.ndarray] = None
+
+    def events(self, threshold: float):
+        """Every per-chunk peak above ``threshold`` SNR, as (dm, width,
+        snr, sample) records — one event per (chunk, trial, width) cell,
+        so a trial can report many pulses across the observation (the
+        single-best ``snr``/``peak_sample`` fields keep only the global
+        max). Requires the sweep to have run with ``keep_chunk_peaks``;
+        raises otherwise."""
+        if self.chunk_snr is None:
+            raise ValueError(
+                "per-chunk peaks were not recorded: run the sweep with "
+                "keep_chunk_peaks=True (cli: --all-events)")
+        out = []
+        nch, D, W = self.chunk_snr.shape
+        for ci in range(nch):
+            hits = np.argwhere(self.chunk_snr[ci] >= threshold)
+            for di, wi in hits:
+                out.append(dict(
+                    dm=float(self.dms[di]),
+                    width=int(self.widths[wi]),
+                    snr=float(self.chunk_snr[ci, di, wi]),
+                    sample=int(self.chunk_sample[ci, di, wi]),
+                ))
+        out.sort(key=lambda e: (e["dm"], e["sample"]))
+        return out
 
     def best(self, k: int = 10):
         """Top-k (dm, width, snr, sample) candidates over all trials."""
@@ -429,12 +457,21 @@ class SweepResult:
 
 
 class _Accum:
-    def __init__(self, D, W):
+    def __init__(self, D, W, keep_chunk_peaks: bool = False,
+                 n_real: Optional[int] = None):
         self.n = 0
         self.s = np.zeros(D)
         self.ss = np.zeros(D)
         self.mb = np.full((D, W), -np.inf)
         self.ab = np.zeros((D, W), dtype=np.int64)
+        # optional per-chunk peak record: one (maxbox, argbox) pair per
+        # (chunk, trial, width), stored f32 and sliced to the real trials
+        # — ~n_chunks * D * W * 12 bytes (e.g. ~90 MB for a 2000-trial,
+        # 2700-chunk survey sweep)
+        self.keep_chunk_peaks = keep_chunk_peaks
+        self.n_real = D if n_real is None else n_real
+        self.chunk_mb: list = []
+        self.chunk_ab: list = []
 
     def update(self, start, stat_len, s, ss, mb, ab):
         self.n += stat_len
@@ -442,6 +479,9 @@ class _Accum:
         self.ss += np.asarray(ss, dtype=np.float64)
         mb = np.asarray(mb)
         ab = np.asarray(ab, dtype=np.int64) + start
+        if self.keep_chunk_peaks:
+            self.chunk_mb.append(mb[: self.n_real].astype(np.float32))
+            self.chunk_ab.append(ab[: self.n_real].copy())
         better = mb > self.mb
         self.mb = np.where(better, mb, self.mb)
         self.ab = np.where(better, ab, self.ab)
@@ -541,6 +581,7 @@ def sweep_stream(
     engine: str = "auto",
     max_pending: Optional[int] = None,
     checkpoint: Optional[SweepCheckpoint] = None,
+    keep_chunk_peaks: bool = False,
 ) -> SweepResult:
     """Run the sweep over a stream of (startsamp, block) chunks.
 
@@ -585,7 +626,12 @@ def sweep_stream(
     out_len = chunk_payload + W
     slack2 = plan.max_shift2
     D = plan.n_trials
-    acc = _Accum(D, len(plan.widths))
+    if keep_chunk_peaks and checkpoint is not None:
+        raise ValueError(
+            "keep_chunk_peaks does not persist through checkpoints yet; "
+            "run multi-event sweeps without --checkpoint")
+    acc = _Accum(D, len(plan.widths), keep_chunk_peaks=keep_chunk_peaks,
+                 n_real=plan.n_real_trials)
     cursor = 0  # first payload sample not yet accumulated
     ckpt_context = "engine=%s/meshdm=%s" % (
         engine, 0 if mesh is None else mesh.shape.get("dm", 0))
@@ -692,15 +738,19 @@ def sweep_stream(
         checkpoint.finish()
 
     B = float(np.asarray(baseline, dtype=np.float64).sum()) if baseline is not None else 0.0
-    return finalize_sweep(plan, acc.n, acc.s, acc.ss, acc.mb, acc.ab, B)
+    return finalize_sweep(plan, acc.n, acc.s, acc.ss, acc.mb, acc.ab, B,
+                          chunk_mb=acc.chunk_mb, chunk_ab=acc.chunk_ab)
 
 
 def finalize_sweep(plan: SweepPlan, n: int, s, ss, mb, ab,
-                   baseline_sum: float = 0.0) -> SweepResult:
+                   baseline_sum: float = 0.0,
+                   chunk_mb=None, chunk_ab=None) -> SweepResult:
     """Host-side (float64) SNR formula over accumulated moments + window
     maxima — step 3 of the accumulation-order contract. ``baseline_sum``
     restores the reported mean to original (pre-baseline-subtraction)
-    units; snr and std are invariant under the per-channel shift."""
+    units; snr and std are invariant under the per-channel shift.
+    ``chunk_mb``/``chunk_ab`` (lists of per-chunk [D, W] peaks) populate
+    the multi-event fields using the same whole-series moments."""
     s = np.asarray(s, dtype=np.float64)
     ss = np.asarray(ss, dtype=np.float64)
     mb = np.asarray(mb, dtype=np.float64)
@@ -709,16 +759,30 @@ def finalize_sweep(plan: SweepPlan, n: int, s, ss, mb, ab,
     var = np.maximum(ss / max(n, 1) - mean * mean, 0.0)
     std = np.sqrt(var)
     ws = np.array(plan.widths, dtype=np.float64)
-    snr = (mb - ws[None, :] * mean[:, None]) / (
-        np.sqrt(ws)[None, :] * np.where(std > 0, std, 1.0)[:, None]
-    )
+    denom = np.sqrt(ws)[None, :] * np.where(std > 0, std, 1.0)[:, None]
+
+    def to_snr(maxbox):
+        return (maxbox - ws[None, :] * mean[:, None]) / denom
+
+    snr = to_snr(mb)
+    nr = plan.n_real_trials
+    chunk_snr = chunk_sample = None
+    if chunk_mb:
+        # entries are already [:nr]; SNR math in f64, stored f32
+        chunk_snr = np.stack([
+            to_snr(np.asarray(m, dtype=np.float64)[:nr]).astype(np.float32)
+            for m in chunk_mb])
+        chunk_sample = np.stack([np.asarray(a, dtype=np.int64)[:nr]
+                                 for a in chunk_ab])
     return SweepResult(
-        dms=plan.dms[: plan.n_real_trials],
+        dms=plan.dms[:nr],
         widths=plan.widths,
-        snr=snr[: plan.n_real_trials],
-        peak_sample=ab[: plan.n_real_trials],
-        mean=mean[: plan.n_real_trials] + baseline_sum,
-        std=std[: plan.n_real_trials],
+        snr=snr[:nr],
+        peak_sample=ab[:nr],
+        mean=mean[:nr] + baseline_sum,
+        std=std[:nr],
+        chunk_snr=chunk_snr,
+        chunk_sample=chunk_sample,
     )
 
 

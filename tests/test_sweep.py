@@ -476,3 +476,53 @@ def test_bench_budget_shapes():
     b1 = bench.sweep_bytes(plan, C, T, payload, n, "fourier")
     b2 = bench.sweep_bytes(plan, C, 2 * T, payload, n, "fourier")
     assert 0 < b1 < b2
+
+
+def test_multi_event_chunk_peaks():
+    """keep_chunk_peaks records one event per (chunk, trial, width): two
+    injected pulses in different chunks both appear in events(), while the
+    single-best fields keep only the stronger."""
+    rng = np.random.RandomState(51)
+    C, T, dt, dm = 32, 8192, 1e-3, 60.0
+    freqs = 1500.0 - 4.0 * np.arange(C)
+    data = rng.randn(C, T).astype(np.float32)
+    bins = numpy_ref.bin_delays(dm, freqs, dt)
+    for t0, amp in ((1000, 10.0), (6000, 7.0)):
+        for c in range(C):
+            idx = t0 + bins[c]
+            if idx < T:
+                data[c, idx] += amp
+
+    from pypulsar_tpu.parallel.sweep import sweep_stream
+
+    dms = np.linspace(0.0, 120.0, 16)
+    plan = make_sweep_plan(dms, freqs, dt, nsub=8, group_size=4)
+    payload = 2048
+    baseline = data.mean(axis=1, keepdims=True).astype(np.float32)
+
+    def blocks():
+        ov = plan.min_overlap
+        pos = 0
+        while pos < T:
+            n = min(payload + ov, T - pos)
+            yield pos, data[:, pos:pos + n]
+            pos += payload
+
+    res = sweep_stream(plan, blocks(), payload, chan_major=True,
+                       baseline=baseline, keep_chunk_peaks=True)
+    events = res.events(8.0)
+    assert events
+    # both pulses present at a near-true DM
+    near = [e for e in events if abs(e["dm"] - dm) <= 16.0]
+    samples = {e["sample"] // 1000 for e in near}
+    assert 1 in samples and 6 in samples, near
+    # the single-best surface keeps only the stronger pulse
+    di = int(np.argmin(np.abs(res.dms - dm)))
+    wi = int(np.argmax(res.snr[di]))
+    assert abs(res.peak_sample[di, wi] - 1000) < 50
+
+    # without the flag, events() refuses
+    res2 = sweep_stream(plan, blocks(), payload, chan_major=True,
+                        baseline=baseline)
+    with pytest.raises(ValueError):
+        res2.events(8.0)
