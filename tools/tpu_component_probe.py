@@ -1,0 +1,105 @@
+"""Split timing of the Fourier sweep engine's components on the live TPU.
+
+Run from the repo root with the axon tunnel up (`python
+tools/tpu_component_probe.py`). Prints per-component wall times with the
+~60 ms tunnel dispatch overhead calibrated out: batched rfft/irfft
+throughput at the sweep's shapes, the stage-1/stage-2 phase-multiply
+reduces, a gather-free LUT-factorized phase variant, boxcar backends, and
+smaller FFT sizes — the data needed to decide where the next 10x comes
+from (BENCHNOTES.md round-3 notes; the round-3 tunnel outage prevented
+this run)."""
+import sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+from functools import partial
+
+key = jax.random.PRNGKey(0)
+n = 1 << 17
+F = n // 2 + 1
+C, S, G, g = 1024, 64, 32, 32
+D = G * g
+
+def force(x):
+    return float(jnp.asarray(x).ravel()[0])
+
+def timeit(fn, *args):
+    force(fn(*args))  # compile
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        force(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+null = jax.jit(lambda x: x + 1.0)
+xs = jnp.zeros((8,))
+overhead = timeit(null, xs)
+print(f"overhead {overhead*1e3:.1f} ms", file=sys.stderr)
+
+data = jax.random.normal(key, (C, n), dtype=jnp.float32)
+force(data[:1, :1])
+t = timeit(jax.jit(lambda d: jnp.fft.rfft(d, axis=1).real), data) - overhead
+print(f"rfft [{C},{n}]     {t*1e3:8.1f} ms  {C*2.5*n*17/t/1e9:6.1f} GFLOP/s", file=sys.stderr)
+
+Xd = (jax.random.normal(key, (D, F)) + 1j*jax.random.normal(jax.random.PRNGKey(1), (D, F))).astype(jnp.complex64)
+force(Xd.real[:1, :1])
+t = timeit(jax.jit(lambda X: jnp.fft.irfft(X, n=n, axis=1)), Xd) - overhead
+print(f"irfft [{D},{F}]   {t*1e3:8.1f} ms  {D*2.5*n*17/t/1e9:6.1f} GFLOP/s", file=sys.stderr)
+
+Xc = (jax.random.normal(key, (C, F)) + 1j*jax.random.normal(jax.random.PRNGKey(2), (C, F))).astype(jnp.complex64)
+force(Xc.real[:1, :1])
+sh1 = jnp.asarray(np.random.RandomState(0).randint(0, 160, size=C), jnp.int32)
+k = jnp.arange(F, dtype=jnp.int32)
+
+@jax.jit
+def stage1_one(X, sh):
+    idx = (k * sh[:, None]) & jnp.int32(n - 1)
+    ang = (2.0*jnp.pi/n) * idx.astype(jnp.float32)
+    ph = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
+    return ((X * ph).reshape(S, C // S, F).sum(axis=1)).real
+
+t = timeit(stage1_one, Xc, sh1) - overhead
+print(f"stage1 x1 group    {t*1e3:8.1f} ms  -> x{G} = {t*G*1e3:8.1f} ms  ({C*F*8/t/1e9:5.1f} GB/s)", file=sys.stderr)
+
+Xs = Xc[:S]
+sh2 = jnp.asarray(np.random.RandomState(1).randint(0, 8000, size=(g, S)), jnp.int32)
+
+@jax.jit
+def stage2_one(X, sh):
+    idx = (k[None, None, :] * sh[:, :, None]) & jnp.int32(n - 1)
+    ang = (2.0*jnp.pi/n) * idx.astype(jnp.float32)
+    ph = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
+    return ((X[None] * ph).sum(axis=1)).real
+
+t = timeit(stage2_one, Xs, sh2) - overhead
+print(f"stage2 x1 group    {t*1e3:8.1f} ms  -> x{G} = {t*G*1e3:8.1f} ms  ({g*S*F*8/t/1e9:5.1f} GB/s)", file=sys.stderr)
+
+# no-transcendental stage2: phase from gathered per-shift row tables
+t1 = jnp.exp(2j*jnp.pi*jnp.arange(128)[:, None]*k[None, :]*64.0/n).astype(jnp.complex64)  # W^(k*64*j)
+t2 = jnp.exp(2j*jnp.pi*jnp.arange(64)[:, None]*k[None, :]/n).astype(jnp.complex64)
+force(t1.real[:1, :1])
+
+@jax.jit
+def stage2_lut(X, sh):
+    hi = sh // 64
+    lo = sh % 64
+    ph = t1[hi] * t2[lo]   # [g, S, F]
+    return ((X[None] * ph).sum(axis=1)).real
+
+t = timeit(stage2_lut, Xs, sh2) - overhead
+print(f"stage2-lut x1      {t*1e3:8.1f} ms  -> x{G} = {t*G*1e3:8.1f} ms", file=sys.stderr)
+
+from pypulsar_tpu.ops.pallas_kernels import boxcar_stats
+ts_arr = jax.random.normal(key, (D, 123000), dtype=jnp.float32)
+force(ts_arr[:1, :1])
+for be in ("pallas", "lax"):
+    try:
+        # boxcar_stats is already jitted; re-wrapping would trace its
+        # static kwargs as arguments
+        fn = partial(boxcar_stats, widths=(1, 2, 4, 8, 16, 32),
+                     stat_len=122850, backend=be)
+        t = timeit(fn, ts_arr) - overhead
+        print(f"boxcar-{be} [{D}]  {t*1e3:8.1f} ms "
+              f"({2*4*D*123000/t/1e9:5.1f} GB/s)", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - pallas needs a real TPU
+        print(f"boxcar-{be} unavailable: {type(e).__name__}", file=sys.stderr)
