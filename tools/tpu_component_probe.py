@@ -20,6 +20,8 @@ C, S, G, g = 1024, 64, 32, 32
 D = G * g
 
 def force(x):
+    if isinstance(x, (tuple, list)):
+        x = x[0]
     return float(jnp.asarray(x).ravel()[0])
 
 def timeit(fn, *args):
