@@ -422,7 +422,6 @@ def run_accel(args):
     math in single-core NumPy (np.fft) measured on a slice of the z bank
     and one segment per stage, scaled linearly."""
     acquire_backend()
-    import jax.numpy as jnp
     from pypulsar_tpu.fourier.accelsearch import AccelSearchConfig, accel_search
     from pypulsar_tpu.fourier.zresponse import template_bank
 
@@ -439,10 +438,12 @@ def run_accel(args):
     Z = len(cfg.zs)
 
     # warm at the REAL shape (the stage runners' jit keys on the spectrum
-    # length and segment count; a smaller warmup would not populate them)
-    accel_search(jnp.asarray(fft), T, cfg)
+    # length and segment count; a smaller warmup would not populate them).
+    # accel_search handles the host->device transfer itself (complex
+    # buffers cannot ship directly over the axon link, ops/transfer.py)
+    accel_search(fft, T, cfg)
     t0 = time.perf_counter()
-    cands = accel_search(jnp.asarray(fft), T, cfg)
+    cands = accel_search(fft, T, cfg)
     jax_time = time.perf_counter() - t0
     rlo = max(int(np.ceil(cfg.flo * T)), 1)
     # stage H searches the top-harmonic bins [H*rlo, N-1] at half-bin
@@ -501,7 +502,7 @@ def run_fold(args):
     engine vs the single-core NumPy bincount twin."""
     acquire_backend()
     import jax.numpy as jnp
-    from pypulsar_tpu.fold.engine import fold_bins, fold_numpy, phase_to_bins
+    from pypulsar_tpu.fold.engine import fold_numpy, fold_parts, phase_to_bins
 
     if args.quick or args.cpu_fallback:
         C, T = 64, 1 << 18
@@ -524,12 +525,11 @@ def run_fold(args):
     float(dev[0, 0])
 
     def run():
-        outs = []
-        for pi in range(npart):
-            sl = slice(pi * part_len, (pi + 1) * part_len)
-            prof, counts = fold_bins(dev[:, sl], bi[sl], nbins)
-            outs.append(prof)
-        return [np.asarray(o) for o in outs]
+        # whole [npart, C, nbins] cube in ONE dispatch (fold_parts): the
+        # per-partition loop this replaces paid ~60 ms tunnel latency per
+        # partition, drowning the kernel (bench r3)
+        profs, _ = fold_parts(dev, bi, nbins, npart)
+        return np.asarray(profs)
 
     run()  # warm
     t0 = time.perf_counter()

@@ -19,6 +19,7 @@ from pypulsar_tpu.fold import profile_snr  # noqa: F401
 from pypulsar_tpu.fold.engine import (  # noqa: F401
     fold_bins,
     fold_numpy,
+    fold_parts,
     fold_timeseries,
     fold_spectra,
     phases_from_polycos,

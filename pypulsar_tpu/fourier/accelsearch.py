@@ -54,6 +54,7 @@ from scipy.special import gammaincc, gammainccinv, gammaln, log_ndtr, ndtri
 
 from pypulsar_tpu.fourier.zresponse import template_bank_zw
 from pypulsar_tpu.ops.fourier_dedisperse import fourier_chunk_len
+from pypulsar_tpu.ops.transfer import join_planes, split_complex
 from pypulsar_tpu.utils import profiling
 
 __all__ = [
@@ -221,6 +222,20 @@ class AccelCandidate:
 # ---------------------------------------------------------------------------
 
 
+@partial(jax.jit, static_argnames=("front", "pad"))
+def _build_spec_pad(re, im, front, pad):
+    """Padded search spectrum as [2, Np] float planes: conjugate
+    reflection in front (bin -k of a real input's FFT is conj(bin k)) so
+    templates overhanging the lowest bins correlate against physically
+    correct values; zeros past Nyquist. Float planes in and out — complex
+    buffers cannot cross executable boundaries on the axon platform
+    (ops/transfer.py)."""
+    f = join_planes(re, im)
+    sp = jnp.concatenate([jnp.conj(jnp.flip(f[1:front + 1])), f,
+                          jnp.zeros(pad, jnp.complex64)])
+    return jnp.stack([sp.real, sp.imag])
+
+
 @functools.lru_cache(maxsize=64)
 def _make_stage_runner(segw: int, Z: int, Wn: int, topk: int,
                        bank_meta: Tuple[Tuple[int, int, int, int], ...]):
@@ -240,12 +255,18 @@ def _make_stage_runner(segw: int, Z: int, Wn: int, topk: int,
     matching bank_meta order.
     """
 
-    def run(spec_pad, tfs, idxs, top_lo, top_hi, thresh, n_seg):
+    def run(spec_pad2, tfs, idxs, top_lo, top_hi, thresh, n_seg):
+        # complex never crosses the jit boundary (axon cannot move
+        # complex buffers between programs, ops/transfer.py): the padded
+        # spectrum and the template banks arrive as [2, ...] float planes
+        spec_pad = join_planes(spec_pad2[0], spec_pad2[1])
+
         def body(carry, si):
             r0 = top_lo + si * segw
             width = jnp.minimum(segw, top_hi - r0)
             plane = jnp.zeros((Z * Wn, 2 * segw), jnp.float32)
-            for (off0, step, hw, L), tf, idx in zip(bank_meta, tfs, idxs):
+            for (off0, step, hw, L), tf2, idx in zip(bank_meta, tfs, idxs):
+                tf = join_planes(tf2[0], tf2[1])
                 start = off0 + si * step
                 sl = jax.lax.dynamic_slice(spec_pad, (start,), (L,))
                 cf = jnp.fft.fft(sl)
@@ -387,8 +408,8 @@ def accel_search(
     drift resolution is ``dz/H``.
     """
     cfg = config
-    fftd = jnp.asarray(fft, dtype=jnp.complex64)
-    N = int(fftd.shape[0])
+    f_re, f_im = split_complex(fft)
+    N = int(f_re.shape[0])
     zs = cfg.zs  # top-harmonic drift grid
     ws = cfg.ws  # top-harmonic jerk grid ([0] unless wmax > 0)
     Z = len(zs)
@@ -424,10 +445,8 @@ def accel_search(
     front = maxhw + 1
     maxL = max(L for _, _, L, _ in banks.values())
     Np = N + maxL + front + 8
-    spec_pad = jnp.concatenate(
-        [jnp.conj(fftd[1:front + 1][::-1]), fftd,
-         jnp.zeros(max(Np - N, 8), jnp.complex64)]
-    )
+    spec_pad2 = _build_spec_pad(jnp.asarray(f_re), jnp.asarray(f_im),
+                                front, int(max(Np - N, 8)))
 
     # per-stage trials correction and detection threshold: searched cells /
     # response footprint (~1 top-bin x 1 z-cell per independent trial,
@@ -456,12 +475,13 @@ def accel_search(
             tf, hw, L, idx = banks[Fraction(b, H)]
             bank_meta.append((front + (b * top_lo) // H - hw,
                               (b * segw) // H, hw, L))
-            tfs.append(jnp.asarray(tf))
+            tfs.append(jnp.asarray(
+                np.stack([tf.real, tf.imag]).astype(np.float32)))
             idxs.append(jnp.asarray(idx))
         runner = _make_stage_runner(segw, Z, Wn, cfg.topk, tuple(bank_meta))
         with profiling.stage("accel_stage"):
             vals, zi, ri, neigh = runner(
-                spec_pad, tuple(tfs), tuple(idxs), top_lo, top_hi,
+                spec_pad2, tuple(tfs), tuple(idxs), top_lo, top_hi,
                 jnp.float32(thresh[H]), n_seg)
             vals = np.asarray(vals)
             zi = np.asarray(zi)

@@ -32,11 +32,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pypulsar_tpu.ops.transfer import (join_planes, split_complex,
+                                        to_host_complex)
 
-@partial(jax.jit, static_argnames=("m",))
-def fourier_interpolate(fft, r, m=32):
-    """Interpolate complex FFT coefficients at real bin indices ``r`` using
-    the ``m+1`` nearest bins. Out-of-range window bins contribute zero."""
+
+def _interpolate_body(fft, r, m):
+    """Traceable interpolation core (complex in/out — call only inside
+    jit; complex cannot cross executable boundaries, ops/transfer.py)."""
     if m % 2 != 0:
         raise ValueError("Input 'm' must be an even integer: %s" % str(m))
     nn = fft.shape[0]
@@ -49,6 +51,25 @@ def fourier_interpolate(fft, r, m=32):
     expterm = jnp.exp(-1.0j * jnp.pi * x)
     sincterm = jnp.sinc(x)  # sin(pi x)/(pi x): exact at integer bins
     return jnp.sum(coefs * expterm * sincterm, axis=1)
+
+
+@partial(jax.jit, static_argnames=("m",))
+def _fourier_interpolate_jit(re, im, r, m=32):
+    out = _interpolate_body(join_planes(re, im), r, m)
+    return out.real, out.imag
+
+
+def fourier_interpolate(fft, r, m=32) -> np.ndarray:
+    """Interpolate complex FFT coefficients at real bin indices ``r`` using
+    the ``m+1`` nearest bins. Out-of-range window bins contribute zero.
+
+    Returns HOST complex64: complex buffers cannot cross executable
+    boundaries on the axon platform, so the complex FFT enters as float
+    planes and the result recombines host-side (ops/transfer.py)."""
+    re, im = split_complex(fft)
+    our, oui = _fourier_interpolate_jit(jnp.asarray(re), jnp.asarray(im),
+                                        jnp.asarray(r), m)
+    return to_host_complex(our, oui)
 
 
 @partial(jax.jit, static_argnames=("nharm",))
@@ -64,28 +85,42 @@ def harmonic_sum(powers, nharm=8):
 
 
 @partial(jax.jit, static_argnames=("nharm", "m"))
-def incoherent_harmonic_sum(fft, powers, nharm=8, m=2):
-    """Sum |FFT interpolated at r/nh|^2 over harmonics onto each bin
-    (reference prestofft.py:115-131). Returns powers array of full length;
-    bin i corresponds to frequency freqs[i]/nharm."""
+def _incoherent_harmonic_sum_jit(re, im, powers, nharm=8, m=2):
+    fft = join_planes(re, im)
     nn = fft.shape[0]
     out = powers
     for nh in range(2, nharm + 1):
         r = jnp.arange(nn) / float(nh)
-        out = out + jnp.abs(fourier_interpolate(fft, r, m)) ** 2
+        out = out + jnp.abs(_interpolate_body(fft, r, m)) ** 2
     return out
 
 
 @partial(jax.jit, static_argnames=("nharm", "m"))
-def coherent_harmonic_sum(fft, nharm=8, m=2):
-    """Sum complex FFT interpolated at r/nh over harmonics, then square
-    (reference prestofft.py:133-149)."""
+def _coherent_harmonic_sum_jit(re, im, nharm=8, m=2):
+    fft = join_planes(re, im)
     nn = fft.shape[0]
     out = fft
     for nh in range(2, nharm + 1):
         r = jnp.arange(nn) / float(nh)
-        out = out + fourier_interpolate(fft, r, m)
+        out = out + _interpolate_body(fft, r, m)
     return jnp.abs(out) ** 2
+
+
+def incoherent_harmonic_sum(fft, powers, nharm=8, m=2):
+    """Sum |FFT interpolated at r/nh|^2 over harmonics onto each bin
+    (reference prestofft.py:115-131). Returns powers array of full length;
+    bin i corresponds to frequency freqs[i]/nharm."""
+    re, im = split_complex(fft)
+    return _incoherent_harmonic_sum_jit(jnp.asarray(re), jnp.asarray(im),
+                                        jnp.asarray(powers), nharm, m)
+
+
+def coherent_harmonic_sum(fft, nharm=8, m=2):
+    """Sum complex FFT interpolated at r/nh over harmonics, then square
+    (reference prestofft.py:133-149)."""
+    re, im = split_complex(fft)
+    return _coherent_harmonic_sum_jit(jnp.asarray(re), jnp.asarray(im),
+                                      nharm, m)
 
 
 class DereddenSchedule(NamedTuple):
@@ -168,7 +203,8 @@ def _masked_block_stat(values, starts, lens, maxlen, stat):
 
 
 @partial(jax.jit, static_argnames=("maxlen",))
-def _deredden_apply(fft, powers, starts, lens, elem_block, elem_off, maxlen):
+def _deredden_apply(re, im, powers, starts, lens, elem_block, elem_off, maxlen):
+    fft = join_planes(re, im)
     LN2 = float(np.log(2.0))
     med = _masked_block_stat(powers, starts, lens, maxlen, "median") / LN2
     B = starts.shape[0]
@@ -186,7 +222,8 @@ def _deredden_apply(fft, powers, starts, lens, elem_block, elem_off, maxlen):
     lineval = m_old[c] + slope[c] * (lineoffset[c] - j)
     scale = 1.0 / jnp.sqrt(lineval)
     out = fft * scale.astype(fft.real.dtype)
-    return out.at[0].set(1.0 + 0.0j)
+    out = out.at[0].set(1.0 + 0.0j)
+    return out.real, out.imag
 
 
 def deredden(fft, powers=None, initialbuflen=6, maxbuflen=200,
@@ -198,17 +235,18 @@ def deredden(fft, powers=None, initialbuflen=6, maxbuflen=200,
     module docstring). Pass ``schedule`` to reuse the host geometry across
     many same-length FFTs.
     """
-    fft = jnp.asarray(fft)
+    re, im = split_complex(fft)
     if powers is None:
-        powers = jnp.abs(fft) ** 2
+        powers = re * re + im * im
     if schedule is None:
-        schedule = deredden_schedule(fft.shape[0], initialbuflen, maxbuflen)
-    return _deredden_apply(
-        fft, powers,
+        schedule = deredden_schedule(re.shape[0], initialbuflen, maxbuflen)
+    our, oui = _deredden_apply(
+        jnp.asarray(re), jnp.asarray(im), jnp.asarray(powers),
         jnp.asarray(schedule.starts), jnp.asarray(schedule.lens),
         jnp.asarray(schedule.elem_block), jnp.asarray(schedule.elem_off),
         maxlen=schedule.maxlen,
     )
+    return to_host_complex(our, oui)
 
 
 @partial(jax.jit, static_argnames=("maxlen",))

@@ -1,0 +1,61 @@
+"""Complex-array boundary helpers for backends with incomplete buffer
+support.
+
+The axon remote-TPU platform cannot move complex buffers across any
+executable boundary: host->device transfer (device_put / jit arguments),
+device->host pulls (np.asarray of a complex output), and handing one
+program's complex output to another program all raise UNIMPLEMENTED
+(observed on v5e, bench r3). Complex arithmetic *inside* a single
+compiled program is fully supported.
+
+Consequently the framework's rule is: complex64 lives only inside jit.
+Every jit signature that logically takes/returns a complex array takes/
+returns separate real and imaginary float32 planes instead, recombined
+with ``jax.lax.complex`` on entry and split with ``.real``/``.imag``
+before returning. These helpers cover the host side of that contract.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+__all__ = ["split_complex", "to_host_complex", "join_planes"]
+
+
+def join_planes(re, im):
+    """Recombine float planes into complex — INSIDE jit only (the result
+    must not cross an executable boundary). The canonical other half of
+    :func:`split_complex`: plane order is (real, imaginary)."""
+    import jax.lax
+
+    return jax.lax.complex(re, im)
+
+
+def split_complex(arr):
+    """(re, im) float32 planes of a possibly-complex array.
+
+    Host arrays split in NumPy; device arrays (already past a boundary,
+    so CPU/TPU-internal backends only) split with eager ``.real``/
+    ``.imag``, which the axon platform supports. Real input gets a zero
+    imaginary plane."""
+    if isinstance(arr, jax.Array):
+        import jax.numpy as jnp
+
+        if jnp.iscomplexobj(arr):
+            return (arr.real.astype(jnp.float32),
+                    arr.imag.astype(jnp.float32))
+        return arr.astype(jnp.float32), jnp.zeros_like(arr, jnp.float32)
+    a = np.asarray(arr)
+    if np.iscomplexobj(a):
+        return (np.ascontiguousarray(a.real, dtype=np.float32),
+                np.ascontiguousarray(a.imag, dtype=np.float32))
+    return a.astype(np.float32), np.zeros_like(a, dtype=np.float32)
+
+
+def to_host_complex(re, im) -> np.ndarray:
+    """Host complex64 from separate (device or host) float planes — the
+    device->host pull happens per real plane, which every backend
+    supports."""
+    return (np.asarray(re, dtype=np.float32)
+            + 1j * np.asarray(im, dtype=np.float32)).astype(np.complex64)

@@ -211,6 +211,23 @@ class TestFoldEngine:
         # device accumulates f32; twin f64
         np.testing.assert_allclose(np.asarray(jp), np_, rtol=1e-4, atol=1e-5)
 
+    def test_fold_parts_matches_per_partition_folds(self):
+        from pypulsar_tpu.fold.engine import fold_parts
+
+        rng = np.random.RandomState(2)
+        C, T, nbins, npart = 4, 1030, 16, 8  # remainder of 6 dropped
+        data = rng.randn(C, T).astype(np.float32)
+        bins = rng.randint(0, nbins, T).astype(np.int32)
+        profs, counts = fold_parts(data, bins, nbins, npart)
+        assert profs.shape == (npart, C, nbins)
+        part_len = T // npart
+        for pi in range(npart):
+            sl = slice(pi * part_len, (pi + 1) * part_len)
+            ref_p, ref_c = fold_numpy(data[:, sl], bins[sl], nbins)
+            np.testing.assert_allclose(np.asarray(profs[pi]), ref_p,
+                                       rtol=1e-4, atol=1e-5)
+            np.testing.assert_array_equal(np.asarray(counts[pi]), ref_c)
+
     def test_constant_period_fold_recovers_pulse(self):
         dt, period, nbins = 1e-3, 0.1, 50
         n = 100_000
