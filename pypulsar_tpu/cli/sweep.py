@@ -40,13 +40,21 @@ def _write_cands(path, cands):
                     f"{c['sample']:<9d} {c['width_bins']:<11d} {c['downsamp']}\n")
 
 
-def _write_dats(outbase, reader, dms, downsamp):
-    """Write per-DM dedispersed time series (.dat + .inf), flat mode only."""
+def _write_dats(outbase, reader, dms, downsamp, rfimask=None):
+    """Write per-DM dedispersed time series (.dat + .inf), flat mode only.
+    ``rfimask`` applies the same median-mid80 mask fill the sweep used —
+    the .dat series must describe the data the candidates came from."""
     from pypulsar_tpu.io.datfile import write_dat
     from pypulsar_tpu.io.infodata import InfoData
     from pypulsar_tpu.parallel.staged import _make_source
 
     spec = reader.get_spectra(0, _make_source(reader).nsamples)
+    if rfimask is not None:
+        hifreq_first = bool(np.asarray(spec.freqs)[0]
+                            > np.asarray(spec.freqs)[-1])
+        chanmask = rfimask.get_chan_mask(0, spec.numspectra,
+                                         hifreq_first=hifreq_first)
+        spec = spec.masked(chanmask, maskval="median-mid80")
     if downsamp > 1:
         spec = spec.downsample(downsamp)
     freqs = np.asarray(spec.freqs)
@@ -111,6 +119,9 @@ def main(argv=None):
                     choices=("auto", "gather", "scan", "fourier"),
                     help="chunk-kernel formulation (auto: fourier on TPU, "
                          "gather elsewhere)")
+    ap.add_argument("--mask", dest="maskfile", default=None,
+                    help="rfifind .mask file (ours or PRESTO's) applied "
+                         "per block with median-mid80 fill")
     ap.add_argument("--write-dats", action="store_true",
                     help="flat mode: also write per-DM .dat/.inf series")
     ap.add_argument("--all-events", action="store_true",
@@ -165,6 +176,11 @@ def main(argv=None):
             if os.path.exists(fn):
                 os.remove(fn)
     reader = _open_reader(args.infile)
+    rfimask = None
+    if args.maskfile:
+        from pypulsar_tpu.io.rfimask import RfifindMask
+
+        rfimask = RfifindMask(args.maskfile)
     mesh = None
     if args.mesh:
         import jax
@@ -194,7 +210,7 @@ def main(argv=None):
                               verbose=True,
                               checkpoint_path=args.checkpoint,
                               checkpoint_every=args.checkpoint_every,
-                              engine=args.engine)
+                              engine=args.engine, rfimask=rfimask)
     else:
         if args.numdms is None:
             ap.error("flat mode requires --numdms (or use --ddplan)")
@@ -206,9 +222,11 @@ def main(argv=None):
                             checkpoint_path=args.checkpoint,
                             checkpoint_every=args.checkpoint_every,
                             engine=args.engine,
-                            keep_chunk_peaks=args.all_events)
+                            keep_chunk_peaks=args.all_events,
+                            rfimask=rfimask)
         if args.write_dats:
-            _write_dats(outbase, reader, dms, args.downsamp)
+            _write_dats(outbase, reader, dms, args.downsamp,
+                        rfimask=rfimask)
 
     hits = staged.above_threshold(args.threshold)
     _write_cands(outbase + ".cands", hits)

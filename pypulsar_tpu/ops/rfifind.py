@@ -1,0 +1,370 @@
+"""TPU-native RFI mask generation — a PRESTO ``rfifind`` equivalent.
+
+The reference *consumes* rfifind ``.mask`` files (bin/waterfaller.py:21,
+28-48; ``rfifind`` imported 3x per SURVEY.md §2.5) but the mask generator
+itself is PRESTO's external C program — one of the L0 native dependencies
+SURVEY.md says must be replaced. This module closes that gap so a user can
+go raw file -> mask -> masked pipeline without PRESTO installed:
+
+  1. device pass (jit): per-(interval, channel) block statistics — mean,
+     standard deviation, and the maximum normalized Fourier power of the
+     block (periodic-interference detector);
+  2. host pass: iterative sigma clipping of the small [nint, nchan] stat
+     tables along both axes (each channel's timeline and each interval's
+     bandpass), PRESTO-style;
+  3. reduction to the mask products: whole channels / whole intervals are
+     zapped when more than ``chanfrac`` / ``intfrac`` of their blocks are
+     flagged, the remainder becomes the per-interval zap lists; written in
+     the reference binary layout by io.rfimask.write_mask.
+
+The Fourier detector pads each block to a power of two before the rfft —
+non-power-of-two FFTs lower to a dense O(L^2) DFT matmul on this TPU
+toolchain (BENCHNOTES.md). Padding only dilutes a tone's power by the duty
+factor, which the significance threshold absorbs.
+
+Statistics are flagged against a robust center/scale (median and
+interquartile-range-derived sigma) so that the estimate itself is immune
+to the outliers being hunted; the max-power test uses the exponential null
+distribution of normalized powers: P(max over B bins > p) ~ B*exp(-p),
+thresholded at the single-sided Gaussian tail probability of
+``freq_sigma``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import warnings
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pypulsar_tpu.ops.fourier_dedisperse import fourier_chunk_len
+
+__all__ = [
+    "RfiStats",
+    "block_stats",
+    "block_stats_numpy",
+    "clip_stats",
+    "mask_products",
+    "rfifind",
+]
+
+
+@partial(jax.jit, static_argnames=("pts", "n_fft"))
+def _block_stats_impl(data, pts: int, n_fft: int):
+    """data[C, nint*pts] -> (mean[nint, C], std[nint, C], maxpow[nint, C]).
+
+    maxpow is the largest normalized power over the block's positive-
+    frequency bins: powers / (their own mean), so a flat (white) block
+    scores ~ln(B) and a coherent tone scores its SNR^2-scale power —
+    interval-to-interval gain drifts cancel out.
+    """
+    C = data.shape[0]
+    nint = data.shape[1] // pts
+    blocks = data[:, : nint * pts].reshape(C, nint, pts)
+    mean = jnp.mean(blocks, axis=2)
+    # f32 two-pass variance: centered sum of squares (one-pass sum/sumsq
+    # catastrophically cancels for offset-dominated 8-bit data)
+    centered = blocks - mean[:, :, None]
+    var = jnp.mean(centered * centered, axis=2)
+    std = jnp.sqrt(var)
+    spec = jnp.fft.rfft(centered, n=n_fft, axis=2)
+    pow_ = spec.real * spec.real + spec.imag * spec.imag
+    pow_ = pow_[:, :, 1:]  # DC removed by centering; drop it anyway
+    norm = jnp.mean(pow_, axis=2, keepdims=True)
+    maxpow = jnp.max(pow_ / jnp.maximum(norm, 1e-30), axis=2)
+    return mean.T, std.T, maxpow.T
+
+
+def block_stats(data, pts: int):
+    """Device per-block stats of ``data[C, T]`` (whole intervals only)."""
+    n_fft = fourier_chunk_len(pts)
+    return _block_stats_impl(jnp.asarray(data, jnp.float32), pts, n_fft)
+
+
+def block_stats_numpy(data: np.ndarray, pts: int):
+    """float64 NumPy twin of block_stats (parity tests)."""
+    C = data.shape[0]
+    nint = data.shape[1] // pts
+    blocks = data[:, : nint * pts].reshape(C, nint, pts).astype(np.float64)
+    mean = blocks.mean(axis=2)
+    centered = blocks - mean[:, :, None]
+    std = np.sqrt((centered * centered).mean(axis=2))
+    spec = np.fft.rfft(centered, n=fourier_chunk_len(pts), axis=2)
+    pow_ = (spec.real**2 + spec.imag**2)[:, :, 1:]
+    norm = np.maximum(pow_.mean(axis=2, keepdims=True), 1e-30)
+    maxpow = (pow_ / norm).max(axis=2)
+    return mean.T, std.T, maxpow.T
+
+
+@dataclasses.dataclass
+class RfiStats:
+    """Per-(interval, channel) statistics of an observation, in *file*
+    channel order (the .mask convention; io/rfimask.py docstring)."""
+
+    mean: np.ndarray  # [nint, nchan]
+    std: np.ndarray
+    maxpow: np.ndarray
+    ptsperint: int
+    dtint: float
+    lofreq: float
+    df: float
+    mjd: float = 0.0
+
+    @property
+    def nint(self) -> int:
+        return self.mean.shape[0]
+
+    @property
+    def nchan(self) -> int:
+        return self.mean.shape[1]
+
+    def save(self, fn: str) -> str:
+        """Sidecar stats file (our own npz schema — PRESTO's .stats binary
+        carries the same tables; kept separate so the .mask stays
+        reference-layout)."""
+        np.savez(fn, mean=self.mean, std=self.std, maxpow=self.maxpow,
+                 ptsperint=self.ptsperint, dtint=self.dtint,
+                 lofreq=self.lofreq, df=self.df, mjd=self.mjd)
+        return fn
+
+    @classmethod
+    def load(cls, fn: str) -> "RfiStats":
+        with np.load(fn) as z:
+            return cls(mean=z["mean"], std=z["std"], maxpow=z["maxpow"],
+                       ptsperint=int(z["ptsperint"]), dtint=float(z["dtint"]),
+                       lofreq=float(z["lofreq"]), df=float(z["df"]),
+                       mjd=float(z["mjd"]))
+
+
+def _robust_center_scale(x: np.ndarray, good: np.ndarray, axis: int):
+    """(median, sigma) along ``axis`` using only ``good`` cells; sigma from
+    the 25-75 interquartile range (IQR/1.349 estimates a Gaussian sigma
+    robustly). Cells where everything is flagged get sigma=inf (no new
+    flags can arise from them)."""
+    masked = np.where(good, x, np.nan)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)  # all-NaN slices
+        med = np.nanmedian(masked, axis=axis, keepdims=True)
+        q75 = np.nanpercentile(masked, 75, axis=axis, keepdims=True)
+        q25 = np.nanpercentile(masked, 25, axis=axis, keepdims=True)
+    med = np.where(np.isnan(med), 0.0, med)
+    sigma = (q75 - q25) / 1.349
+    sigma = np.where(np.isnan(sigma) | (sigma <= 0), np.inf, sigma)
+    return med, sigma
+
+
+def clip_stats(
+    stats: RfiStats,
+    time_sigma: float = 10.0,
+    freq_sigma: float = 4.0,
+    max_iter: int = 10,
+) -> np.ndarray:
+    """Boolean flag table [nint, nchan] (True = bad block).
+
+    Time-domain test: a block's mean or std is an outlier at
+    ``time_sigma`` against its channel's timeline (axis 0) or its
+    interval's bandpass (axis 1). Fourier test: the block's max normalized
+    power exceeds the exponential-null threshold at the ``freq_sigma``
+    Gaussian-equivalent tail probability. Clipping iterates so that loud
+    blocks do not inflate the scale estimate that judges the others.
+    """
+    mean, std, maxpow = stats.mean, stats.std, stats.maxpow
+    # exponential null for the max of B normalized powers (mean power = 1):
+    # P(max > p) ~ B * exp(-p)  ->  p_thresh = ln(B / q)
+    B = fourier_chunk_len(stats.ptsperint) // 2
+    q = 0.5 * math.erfc(freq_sigma / math.sqrt(2.0))
+    power_thresh = math.log(B / max(q, 1e-300))
+    flags = maxpow > power_thresh
+
+    # flags accumulate monotonically: a fully-flagged row/column has no
+    # good cells left to estimate a scale from (sigma=inf), so re-deriving
+    # flags from scratch each pass would silently unflag it
+    for _ in range(max_iter):
+        good = ~flags
+        new = flags.copy()
+        for x in (mean, std):
+            for axis in (0, 1):
+                med, sigma = _robust_center_scale(x, good, axis)
+                new |= np.abs(x - med) > time_sigma * sigma
+        if np.array_equal(new, flags):
+            break
+        flags = new
+    return flags
+
+
+def mask_products(
+    flags: np.ndarray,
+    chanfrac: float = 0.7,
+    intfrac: float = 0.3,
+    extra_zap_chans: Sequence[int] = (),
+    extra_zap_ints: Sequence[int] = (),
+) -> Tuple[List[int], List[int], List[List[int]]]:
+    """Reduce the flag table to (zap_chans, zap_ints, zap_chans_per_int).
+
+    A channel flagged in more than ``chanfrac`` of intervals is zapped
+    outright (likewise intervals at ``intfrac``) — PRESTO's -chanfrac /
+    -intfrac semantics; remaining flags become per-interval lists. The
+    per-interval lists exclude globally zapped channels (the reader
+    re-unions them), keeping the file small.
+    """
+    nint, nchan = flags.shape
+    chan_bad = flags.mean(axis=0)
+    int_bad = flags.mean(axis=1)
+    zap_chans = set(np.nonzero(chan_bad > chanfrac)[0].tolist())
+    zap_chans.update(int(c) for c in extra_zap_chans)
+    zap_ints = set(np.nonzero(int_bad > intfrac)[0].tolist())
+    zap_ints.update(int(i) for i in extra_zap_ints)
+    per_int: List[List[int]] = []
+    for i in range(nint):
+        if i in zap_ints:
+            per_int.append([])
+            continue
+        chans = np.nonzero(flags[i])[0]
+        per_int.append([int(c) for c in chans if int(c) not in zap_chans])
+    return sorted(zap_chans), sorted(zap_ints), per_int
+
+
+def _iter_file_blocks(reader, samples_per_read: int):
+    """Yield [nchan, n] LOW-frequency-first blocks from a filterbank /
+    PSRFITS reader — the .mask channel convention (PRESTO reorders every
+    band ascending on read, so mask channel 0 is always the lowest
+    frequency regardless of on-disk order; io/rfimask.py docstring).
+    ``get_samples`` (filterbank) returns on-disk order, flipped here when
+    foff < 0; the ``get_spectra`` fallback (PSRFITS) delivers high-
+    frequency-first Spectra, always flipped."""
+    total = int(reader.nspec)
+    raw = hasattr(reader, "get_samples")
+    if raw:
+        f = np.asarray(reader.frequencies, dtype=float)  # on-disk order
+        flip = len(f) > 1 and f[0] > f[-1]
+    else:
+        flip = True
+    pos = 0
+    while pos < total:
+        n = min(samples_per_read, total - pos)
+        d = (reader.get_samples(pos, n).T if raw
+             else np.asarray(reader.get_spectra(pos, n).data))
+        yield d[::-1] if flip else d
+        pos += n
+
+
+def rfifind(
+    source,
+    *,
+    time: float = 1.0,
+    dt: Optional[float] = None,
+    time_sigma: float = 10.0,
+    freq_sigma: float = 4.0,
+    chanfrac: float = 0.7,
+    intfrac: float = 0.3,
+    zap_chans: Sequence[int] = (),
+    zap_ints: Sequence[int] = (),
+    outbase: Optional[str] = None,
+    lofreq: float = 0.0,
+    df: float = 0.0,
+    mjd: float = 0.0,
+    ints_per_read: int = 16,
+    hifreq_first: bool = True,
+):
+    """End-to-end mask generation.
+
+    ``source`` is a reader (FilterbankFile / PsrfitsFile: dt, nspec/fch1
+    discovered) or a raw [nchan, T] array (then ``dt`` is required and
+    lofreq/df/mjd may be given; rows are taken high-frequency-first — the
+    framework's Spectra convention — unless ``hifreq_first=False``).
+    Returns (RfiStats, flags, maskfn-or-None), all in the .mask channel
+    convention (channel 0 = lowest frequency); pass ``outbase`` to write
+    ``{outbase}_rfifind.mask`` (+ ``.stats.npz``).
+
+    The interval length is ``time`` seconds rounded to whole samples; a
+    trailing partial interval shorter than half an interval is dropped
+    (it has too few samples for stable statistics), otherwise it is
+    padded by repeating its last sample into a full interval.
+    """
+    if isinstance(source, np.ndarray) or hasattr(source, "ndim"):
+        if dt is None:
+            raise ValueError("dt is required for array input")
+        data = np.asarray(source)
+        if hifreq_first:
+            data = data[::-1]
+        nchan = data.shape[0]
+        blocks = [data]
+    else:
+        dt = float(getattr(source, "dt", None) or source.tsamp)
+        nchan = int(getattr(source, "nchans", None)
+                    or getattr(source, "nchan"))
+        f = np.asarray(source.frequencies, dtype=float)
+        lofreq = float(f.min())
+        df = float(abs(f[1] - f[0])) if len(f) > 1 else 0.0
+        mjd = 0.0
+        for attr in ("tstart",):  # SIGPROC header
+            try:
+                mjd = float(getattr(source, attr))
+                break
+            except (AttributeError, TypeError):
+                pass
+        if not mjd and hasattr(source, "specinfo"):  # PSRFITS
+            try:
+                mjd = float(np.atleast_1d(source.specinfo.start_MJD)[0])
+            except (AttributeError, TypeError, IndexError):
+                pass
+        blocks = None
+
+    pts = max(int(round(time / dt)), 2)
+    means, stds, maxpows = [], [], []
+    carry = np.zeros((nchan, 0), dtype=np.float32)
+
+    def consume(chunk, final=False):
+        nonlocal carry
+        buf = np.concatenate([carry, np.asarray(chunk, np.float32)], axis=1)
+        nint = buf.shape[1] // pts
+        if final:
+            tail = buf.shape[1] - nint * pts
+            if tail >= pts // 2:
+                pad = np.repeat(buf[:, -1:], pts - tail, axis=1)
+                buf = np.concatenate([buf, pad], axis=1)
+                nint += 1
+        if nint:
+            m, s, p = block_stats(buf[:, : nint * pts], pts)
+            means.append(np.asarray(m))
+            stds.append(np.asarray(s))
+            maxpows.append(np.asarray(p))
+        carry = buf[:, nint * pts:]
+
+    if blocks is not None:
+        for b in blocks:
+            consume(b)
+    else:
+        for b in _iter_file_blocks(source, pts * ints_per_read):
+            consume(b)
+    consume(np.zeros((nchan, 0), np.float32), final=True)
+
+    if not means:
+        raise ValueError("no complete intervals: data shorter than time/2")
+    stats = RfiStats(
+        mean=np.concatenate(means), std=np.concatenate(stds),
+        maxpow=np.concatenate(maxpows), ptsperint=pts, dtint=pts * dt,
+        lofreq=lofreq, df=df, mjd=mjd,
+    )
+    flags = clip_stats(stats, time_sigma=time_sigma, freq_sigma=freq_sigma)
+    zc, zi, per_int = mask_products(flags, chanfrac=chanfrac, intfrac=intfrac,
+                                    extra_zap_chans=zap_chans,
+                                    extra_zap_ints=zap_ints)
+    maskfn = None
+    if outbase is not None:
+        from pypulsar_tpu.io.rfimask import write_mask
+
+        maskfn = write_mask(
+            outbase + "_rfifind.mask", time_sigma=time_sigma,
+            freq_sigma=freq_sigma, mjd=stats.mjd, dtint=stats.dtint,
+            lofreq=stats.lofreq, df=stats.df, nchan=stats.nchan,
+            nint=stats.nint, ptsperint=pts, zap_chans=zc, zap_ints=zi,
+            zap_chans_per_int=per_int,
+        )
+        stats.save(outbase + "_rfifind.stats.npz")
+    return stats, flags, maskfn

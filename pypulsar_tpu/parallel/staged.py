@@ -21,6 +21,7 @@ import dataclasses
 import os
 from typing import List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -138,8 +139,13 @@ class _SpectraSource:
             n = min(payload + overlap, self.nsamples - pos)
             block = self._data[:, pos:pos + n]
             # per-block flip: a whole-dataset reversed copy would double
-            # device residency for the sweep's lifetime
-            yield pos, (block[::-1] if self._flip else block)
+            # device residency for the sweep's lifetime. jnp.flip for
+            # device arrays — an eager [::-1] dispatches a strided slice
+            # the axon remote-TPU platform does not implement
+            if self._flip:
+                block = (jnp.flip(block, axis=0)
+                         if isinstance(block, jax.Array) else block[::-1])
+            yield pos, block
             pos += payload
 
 
@@ -192,10 +198,53 @@ class _ReaderSource:
         return block[::-1] if self._flip else block
 
 
-def _make_source(source):
-    if hasattr(source, "numspectra"):  # Spectra pytree
-        return _SpectraSource(source)
-    return _ReaderSource(source)
+class _MaskedSource:
+    """Decorates a block source with rfifind mask application: masked
+    cells are replaced per block with the channel's median-mid80 fill —
+    the reference's waterfaller semantics (bin/waterfaller.py:67-100 via
+    formats/spectra.py:190-227) applied at the sweep's streaming boundary.
+    The wrapped source delivers high-frequency-first rows; .mask channel
+    indices are low-frequency-first, so get_chan_mask flips."""
+
+    def __init__(self, src, rfimask):
+        self.frequencies = src.frequencies
+        self.tsamp = src.tsamp
+        self.nsamples = src.nsamples
+        self._src = src
+        self._mask = rfimask
+
+    def chan_major_blocks(self, payload: int, overlap: int):
+        for pos, block in self._src.chan_major_blocks(payload, overlap):
+            m = self._mask.get_chan_mask(pos, block.shape[1],
+                                         hifreq_first=True)
+            if m.any():
+                block = kernels.masked(
+                    jnp.asarray(block, dtype=jnp.float32), jnp.asarray(m))
+            yield pos, block
+
+
+def _make_source(source, rfimask=None):
+    src = (_SpectraSource(source) if hasattr(source, "numspectra")
+           else _ReaderSource(source))
+    if rfimask is not None:
+        src = _MaskedSource(src, rfimask)
+    return src
+
+
+def _mask_tag(rfimask) -> str:
+    """Checkpoint-context tag identifying the applied mask: a checkpoint
+    written with a different (or no) mask must not resume, and the cheap
+    source probe only samples the first ~1k samples — zaps in later
+    intervals would slip past it."""
+    if rfimask is None:
+        return ""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(np.int64([rfimask.nchan, rfimask.nint,
+                       rfimask.ptsperint]).tobytes())
+    h.update(np.packbits(rfimask._zap_table).tobytes())
+    return "/mask=" + h.hexdigest()[:16]
 
 
 def _downsampled_blocks(src, factor: int, payload_ds: int, overlap_ds: int):
@@ -221,7 +270,8 @@ def _run_step(src, dms, factor: int, nsub: int, group_size: int,
               mesh, verbose: bool = False, label: str = "",
               checkpoint: Optional[SweepCheckpoint] = None,
               engine: str = "auto",
-              keep_chunk_peaks: bool = False) -> Optional[StepResult]:
+              keep_chunk_peaks: bool = False,
+              ckpt_extra: str = "") -> Optional[StepResult]:
     """Sweep one DM block over ``src`` downsampled by ``factor``."""
     dt_eff = src.tsamp * factor
     n_ds = src.nsamples // factor
@@ -251,6 +301,7 @@ def _run_step(src, dms, factor: int, nsub: int, group_size: int,
         checkpoint=checkpoint,
         engine=engine,
         keep_chunk_peaks=keep_chunk_peaks,
+        checkpoint_context=ckpt_extra,
     )
     return StepResult(downsamp=factor, dt=dt_eff, result=res)
 
@@ -269,18 +320,21 @@ def sweep_flat(
     checkpoint_every: int = 16,
     engine: str = "auto",
     keep_chunk_peaks: bool = False,
+    rfimask=None,
 ) -> StagedSweepResult:
     """Single-stage sweep of an explicit DM grid over a file reader or
     Spectra (the flat counterpart of :func:`sweep_ddplan`, sharing its
     streaming/downsampling machinery). ``checkpoint_path`` enables in-sweep
-    checkpoint/resume (see SweepCheckpoint)."""
-    src = _make_source(source)
+    checkpoint/resume (see SweepCheckpoint); ``rfimask`` (an
+    io.rfimask.RfifindMask) applies median-mid80 mask fill per block."""
+    src = _make_source(source, rfimask)
     ckpt = (SweepCheckpoint(checkpoint_path, every=checkpoint_every)
             if checkpoint_path else None)
     step = _run_step(src, np.asarray(dms, dtype=np.float64), int(downsamp),
                      nsub, group_size, tuple(widths), chunk_payload, mesh,
                      verbose=verbose, checkpoint=ckpt, engine=engine,
-                     keep_chunk_peaks=keep_chunk_peaks)
+                     keep_chunk_peaks=keep_chunk_peaks,
+                     ckpt_extra=_mask_tag(rfimask))
     return StagedSweepResult(steps=[] if step is None else [step])
 
 
@@ -296,6 +350,7 @@ def sweep_ddplan(
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 16,
     engine: str = "auto",
+    rfimask=None,
 ) -> StagedSweepResult:
     """Execute every DDstep of ``ddplan`` over ``source``.
 
@@ -315,10 +370,11 @@ def sweep_ddplan(
     """
     from pypulsar_tpu.parallel.sweep import resolve_engine
 
-    src = _make_source(source)
-    ckpt_context = "engine=%s/meshdm=%s" % (
+    src = _make_source(source, rfimask)
+    mtag = _mask_tag(rfimask)
+    ckpt_context = "engine=%s/meshdm=%s%s" % (
         resolve_engine(engine),
-        0 if mesh is None else mesh.shape.get("dm", 0))
+        0 if mesh is None else mesh.shape.get("dm", 0), mtag)
     probe = _source_probe(src) if checkpoint_path else b""
     steps: List[StepResult] = []
     done_fns: List[str] = []
@@ -342,7 +398,8 @@ def sweep_ddplan(
                 if checkpoint_path else None)
         sr = _run_step(src, step.DMs, int(step.downsamp), nsub, group_size,
                        tuple(widths), chunk_payload, mesh, verbose=verbose,
-                       label=f"step {si}: ", checkpoint=ckpt, engine=engine)
+                       label=f"step {si}: ", checkpoint=ckpt, engine=engine,
+                       ckpt_extra=mtag)
         if sr is None:
             break
         if done_fn:

@@ -582,8 +582,12 @@ def sweep_stream(
     max_pending: Optional[int] = None,
     checkpoint: Optional[SweepCheckpoint] = None,
     keep_chunk_peaks: bool = False,
+    checkpoint_context: str = "",
 ) -> SweepResult:
     """Run the sweep over a stream of (startsamp, block) chunks.
+    ``checkpoint_context`` is appended to the checkpoint fingerprint
+    context for result-affecting state the plan cannot see (e.g. the
+    rfifind mask applied by the block source).
 
     Blocks are [time, chan] host arrays (e.g. FilterbankFile.iter_blocks with
     overlap >= plan.min_overlap) or, with ``chan_major=True``, [chan, time]
@@ -633,8 +637,9 @@ def sweep_stream(
     acc = _Accum(D, len(plan.widths), keep_chunk_peaks=keep_chunk_peaks,
                  n_real=plan.n_real_trials)
     cursor = 0  # first payload sample not yet accumulated
-    ckpt_context = "engine=%s/meshdm=%s" % (
-        engine, 0 if mesh is None else mesh.shape.get("dm", 0))
+    ckpt_context = "engine=%s/meshdm=%s%s" % (
+        engine, 0 if mesh is None else mesh.shape.get("dm", 0),
+        checkpoint_context)
     if checkpoint is not None:
         state = checkpoint.load(plan, chunk_payload, ckpt_context)
         if state is not None:

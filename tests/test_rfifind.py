@@ -1,0 +1,196 @@
+"""Native RFI-mask generator tests (ops/rfifind.py): device-vs-NumPy
+stat parity, sigma-clip detection of injected interference, mask-file
+round-trip through the reference binary layout, and the CLI."""
+
+import numpy as np
+import pytest
+
+from pypulsar_tpu.io.filterbank import write_filterbank
+from pypulsar_tpu.io.rfimask import RfifindMask
+from pypulsar_tpu.ops.rfifind import (
+    RfiStats,
+    block_stats,
+    block_stats_numpy,
+    clip_stats,
+    mask_products,
+    rfifind,
+)
+
+RNG = np.random.RandomState(11)
+
+
+def make_rfi_data(C=64, nint=20, pts=512):
+    """Unit-noise data with three injected interference modes:
+    channel 37 loud (20x std), intervals 5-6 broadband (offset +30),
+    channel 50 carrying a strong coherent tone (periodic RFI)."""
+    T = nint * pts
+    data = RNG.randn(C, T).astype(np.float32)
+    data[37 % C] *= 20.0
+    data[:, 5 * pts : 7 * pts] += 30.0
+    t = np.arange(T)
+    data[50 % C] += 12.0 * np.sin(2 * np.pi * t / 16.0).astype(np.float32)
+    return data, pts
+
+
+def test_block_stats_matches_numpy_twin():
+    data = RNG.randn(8, 4 * 100).astype(np.float32)
+    m, s, p = (np.asarray(x) for x in block_stats(data, 100))
+    mr, sr, pr = block_stats_numpy(data, 100)
+    assert m.shape == (4, 8)
+    np.testing.assert_allclose(m, mr, atol=1e-5)
+    np.testing.assert_allclose(s, sr, atol=1e-5)
+    np.testing.assert_allclose(p, pr, rtol=2e-3)
+
+
+def test_clip_flags_injected_rfi():
+    data, pts = make_rfi_data()
+    # hifreq_first=False: treat rows as already being in mask channel
+    # order so the injected row indices map straight onto flag columns
+    stats, flags, _ = rfifind(data, dt=1e-3, time=pts * 1e-3,
+                              hifreq_first=False)
+    assert stats.nint == 20 and stats.nchan == 64
+    # loud channel: every interval's std is a bandpass outlier
+    assert flags[:, 37].all()
+    # broadband intervals: most channels' means are timeline outliers
+    assert flags[5].mean() > 0.8 and flags[6].mean() > 0.8
+    # coherent tone: Fourier max-power detector fires in every interval
+    assert flags[:, 50].all()
+    # clean cells stay clean (well under the whole-channel threshold)
+    clean = np.delete(flags, [37, 50], axis=1)
+    clean = np.delete(clean, [5, 6], axis=0)
+    assert clean.mean() < 0.05
+
+
+def test_mask_products_thresholds():
+    flags = np.zeros((10, 16), dtype=bool)
+    flags[:, 3] = True  # always-bad channel
+    flags[7, :10] = True  # mostly-bad interval
+    flags[2, 8] = True  # isolated block
+    zc, zi, per_int = mask_products(flags, chanfrac=0.7, intfrac=0.3,
+                                    extra_zap_chans=[12])
+    assert zc == [3, 12]
+    assert zi == [7]
+    assert per_int[2] == [8]
+    assert per_int[7] == []  # covered by the interval zap
+    # globally zapped channels are excluded from per-interval lists
+    assert all(3 not in chans for chans in per_int)
+
+
+def test_end_to_end_mask_file(tmp_path):
+    data, pts = make_rfi_data(C=32, nint=12, pts=256)
+    dt = 64e-6
+    hdr = dict(telescope_id=1, machine_id=2, source_name="FAKE",
+               src_raj=0.0, src_dej=0.0, tstart=59000.0, tsamp=dt,
+               fch1=1500.0, foff=-0.5, nchans=32, nbits=32, nifs=1)
+    # SIGPROC foff<0 stores high-frequency-first: data here IS file order
+    fn = str(tmp_path / "rfi.fil")
+    write_filterbank(fn, hdr, data.T)
+
+    from pypulsar_tpu.cli.rfifind import main as rfifind_main
+
+    out = str(tmp_path / "test")
+    assert rfifind_main([fn, "-o", out, "-t", str(pts * dt),
+                         "--zapchan", "2"]) == 0
+
+    mask = RfifindMask(out + "_rfifind.mask")
+    assert mask.nchan == 32 and mask.nint == 12
+    assert mask.ptsperint == pts
+    assert mask.dtint == pytest.approx(pts * dt)
+    assert mask.lofreq == pytest.approx(1500.0 - 0.5 * 31)
+    # the .fil is foff<0 (file order = high-first); mask channels are
+    # low-first, so loud data row 5 is mask channel 32-1-5 = 26
+    assert {2, 31 - 37 % 32} <= mask.mask_zap_chans_set
+    # the sample-mask expansion covers the broadband intervals
+    chan_mask = mask.get_sample_mask(5 * pts, pts)
+    assert chan_mask.all()
+    stats = RfiStats.load(out + "_rfifind.stats.npz")
+    assert stats.mean.shape == (12, 32)
+
+
+def test_partial_tail_interval_padding():
+    # 3 full intervals + 60% of one more: the tail becomes interval 4
+    data = RNG.randn(8, 3 * 200 + 120).astype(np.float32)
+    stats, flags, _ = rfifind(data, dt=1e-3, time=0.2)
+    assert stats.nint == 4
+    # under half an interval is dropped instead
+    data = RNG.randn(8, 3 * 200 + 50).astype(np.float32)
+    stats, _, _ = rfifind(data, dt=1e-3, time=0.2)
+    assert stats.nint == 3
+
+
+def test_sweep_with_mask_suppresses_rfi():
+    """rfifind mask -> sweep --mask loop: a loud RFI channel that drowns
+    an injected dispersed pulse is masked out and the pulse recovers."""
+    from pypulsar_tpu.core.spectra import Spectra
+    from pypulsar_tpu.io.rfimask import RfifindMask, write_mask
+    from pypulsar_tpu.ops import numpy_ref
+    from pypulsar_tpu.parallel.staged import sweep_flat
+
+    C, T, dt, dm_true = 32, 6144, 1e-3, 40.0
+    rng = np.random.RandomState(3)
+    freqs = (1500.0 - 4.0 * np.arange(C)).astype(np.float64)
+    data = rng.randn(C, T).astype(np.float32)
+    bins = numpy_ref.bin_delays(dm_true, freqs, dt)
+    for c in range(C):
+        idx = 900 + bins[c]
+        if idx < T:
+            data[c, idx] += 10.0
+    # bursty RFI in channel 6 (hi-first): strong enough to dominate the
+    # zero-DM end of the trial grid and inflate every trial's variance
+    data[6, ::37] += 60.0
+
+    stats, flags, _ = rfifind(data, dt=dt, time=512 * dt)
+    lo_idx = C - 1 - 6
+    assert flags[:, lo_idx].all()
+
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as td:
+        maskfn = os.path.join(td, "t.mask")
+        zc, zi, per_int = mask_products(flags)
+        write_mask(maskfn, nchan=stats.nchan, nint=stats.nint,
+                   ptsperint=stats.ptsperint, zap_chans=zc, zap_ints=zi,
+                   zap_chans_per_int=per_int)
+        mask = RfifindMask(maskfn)
+
+    spec = Spectra(freqs, dt, data)
+    dms = np.arange(0.0, 80.0, 2.0)
+    res_masked = sweep_flat(spec, dms, nsub=8, group_size=8,
+                            rfimask=mask).best(1)[0]
+    assert abs(res_masked["dm"] - dm_true) <= 4.0
+    assert res_masked["snr"] > 7.0
+    # unmasked control: the RFI channel's spikes beat the pulse
+    res_raw = sweep_flat(spec, dms, nsub=8, group_size=8).best(1)[0]
+    assert res_raw["snr"] < res_masked["snr"] or \
+        abs(res_raw["dm"] - dm_true) > 4.0
+
+
+def test_mask_tag_distinguishes_masks(tmp_path):
+    """Checkpoint contexts must change when the applied mask changes —
+    else a resume could mix masked and unmasked chunk results."""
+    from pypulsar_tpu.io.rfimask import RfifindMask, write_mask
+    from pypulsar_tpu.parallel.staged import _mask_tag
+
+    assert _mask_tag(None) == ""
+    fn1 = str(tmp_path / "a.mask")
+    fn2 = str(tmp_path / "b.mask")
+    write_mask(fn1, nchan=8, nint=4, ptsperint=100, zap_chans=[1])
+    write_mask(fn2, nchan=8, nint=4, ptsperint=100, zap_chans=[2])
+    t1 = _mask_tag(RfifindMask(fn1))
+    t2 = _mask_tag(RfifindMask(fn2))
+    assert t1.startswith("/mask=") and t1 != t2
+
+
+def test_clip_stats_is_iterative():
+    """A strong outlier block must not mask a moderate one: with a single
+    pass the strong block inflates the IQR-scale; iteration re-judges."""
+    nint, C = 30, 4
+    mean = np.zeros((nint, C))
+    mean[:, 0] = np.linspace(-0.01, 0.01, nint)
+    mean[3, 0] = 1000.0
+    mean[4, 0] = 0.2  # ~moderate outlier vs the 0.01-scale spread
+    stats = RfiStats(mean=mean, std=np.ones((nint, C)),
+                     maxpow=np.full((nint, C), 5.0), ptsperint=256,
+                     dtint=1.0, lofreq=1400.0, df=1.0)
+    flags = clip_stats(stats, time_sigma=10.0)
+    assert flags[3, 0] and flags[4, 0]
+    assert not flags[10, 0]
