@@ -563,7 +563,7 @@ def run_fold(args):
     }
 
 
-def probe_backend(timeout: float = 150.0) -> bool:
+def probe_backend(timeout: float = 300.0) -> bool:
     """Cheap child-process liveness probe of the accelerator tunnel.
 
     A wedged axon tunnel HANGS (observed for hours) rather than erroring,

@@ -100,10 +100,57 @@ def build_parser():
     parser.add_argument("-m", "--model-file", default=None,
                         help="paas-created .m file of von-Mises "
                              "components")
+    parser.add_argument("-i", "--interactive", action="store_true",
+                        help="show the profile and drag-select the "
+                             "on-pulse region (the reference's manual "
+                             "picker); SNR reprints on every selection")
     parser.add_argument("-g", "--gaussian-file", dest="gauss_file",
                         default=None,
                         help="pygaussfit-created Gaussians file")
     return parser
+
+
+def interactive_snr(pfd, sefd=None, show=True):
+    """Manual on-pulse selection (the reference's interactive mode):
+    drag over the profile; SNR recomputes and prints on every selection.
+    Returns the last selection's result (None if the last drag was
+    invalid or nothing was picked).
+
+    The archive is dedispersed and period-adjusted BEFORE plotting so the
+    displayed profile is the one each selection is scored against
+    (``pfd_snr(dedisperse=False)`` below) — selecting on the raw profile
+    and scoring the rotated one would mis-place the on-pulse window."""
+    import matplotlib.pyplot as plt
+
+    from pypulsar_tpu.fold.profile_snr import OnPulseError
+    from pypulsar_tpu.utils.interactive import OnPulsePicker
+
+    pfd.dedisperse(doppler=True)
+    pfd.adjust_period()
+    proflen = pfd.proflen
+
+    def evaluate(lo, hi):
+        regions = [(int(lo * proflen), int(np.ceil(hi * proflen)))]
+        try:
+            result = profile_snr.pfd_snr(pfd, regions=regions, sefd=sefd,
+                                         dedisperse=False)
+        except OnPulseError as e:
+            print("on-pulse [%.3f, %.3f]: %s" % (lo, hi, e))
+            return None
+        print("on-pulse [%.3f, %.3f] -> SNR %.3f" % (lo, hi, result["snr"]))
+        return result
+
+    picker = OnPulsePicker(evaluate)
+    if show:
+        fig, ax = plt.subplots()
+        phases = np.arange(proflen) / proflen
+        ax.plot(phases, np.asarray(pfd.sumprof), drawstyle="steps-post")
+        ax.set_xlabel("Pulse phase")
+        ax.set_ylabel("Intensity")
+        ax.set_title("drag to select the on-pulse region; close when done")
+        picker.connect(ax)
+        plt.show()
+    return picker.result
 
 
 def main(argv=None):
@@ -122,6 +169,16 @@ def main(argv=None):
         print(pfdfn)
         pfd = PfdFile(pfdfn)
         sefd = effective_sefd(args, pfd)
+
+        if args.interactive:
+            result = interactive_snr(pfd, sefd)
+            if result is not None:
+                print("SNR: %.3f" % result["snr"])
+                if result["smean"] is not None:
+                    print("Mean flux density (mJy): %.4f" % result["smean"])
+            else:
+                print("no valid on-pulse selection")
+            continue
 
         regions = None
         model = None

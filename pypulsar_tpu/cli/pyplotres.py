@@ -84,6 +84,10 @@ def build_parser():
                         help="Plot prefit residuals (default: postfit)")
     parser.add_argument("--both", action="store_true",
                         help="Plot prefit and postfit panels")
+    parser.add_argument("-i", "--interactive", action="store_true",
+                        help="click a residual to identify its TOA; keys "
+                             "'x'/'y' cycle the plotted axes (the "
+                             "reference's interactive plotter)")
     parser.add_argument("-o", "--outfile", default=None,
                         help="Write plot to file instead of showing")
     return parser
@@ -107,21 +111,66 @@ def main(argv=None):
                else "Postfit")]
     fig, axes = plt.subplots(len(panels), 1, sharex=True,
                              figsize=(10, 4 * len(panels)), squeeze=False)
-    xdata, xlabel = get_xdata(resids, options.xaxis)
-    for ax_row, (postfit, title) in zip(axes, panels):
-        ax = ax_row[0]
-        ydata, yerr, ylabel = get_ydata(resids, options.yaxis, postfit)
-        ax.errorbar(xdata, ydata, yerr=yerr, fmt="k.", capsize=0)
-        ax.axhline(0, ls="--", c="0.6", lw=0.5)
-        ax.set_ylabel(ylabel)
-        ax.set_title("%s residuals (RMS: %.3g %s)"
-                     % (title, float(np.sqrt(np.mean(ydata ** 2))),
-                        {"phase": "turns", "usec": "us",
-                         "sec": "s"}[options.yaxis]))
-    axes[-1][0].set_xlabel(xlabel)
-    fig.tight_layout()
+
+    # holder[0] is the CURRENT picker: draw() rebuilds it on every axis
+    # cycle so clicks always match the displayed coordinates and units
+    # (a picker built once would keep the old axis's data)
+    picker_holder = [None]
+
+    def draw(xaxis, yaxis):
+        xdata, xlabel = get_xdata(resids, xaxis)
+        for ax_row, (postfit, title) in zip(axes, panels):
+            ax = ax_row[0]
+            ax.clear()
+            ydata, yerr, ylabel = get_ydata(resids, yaxis, postfit)
+            ax.errorbar(xdata, ydata, yerr=yerr, fmt="k.", capsize=0)
+            ax.axhline(0, ls="--", c="0.6", lw=0.5)
+            ax.set_ylabel(ylabel)
+            ax.set_title("%s residuals (RMS: %.3g %s)"
+                         % (title, float(np.sqrt(np.mean(ydata ** 2))),
+                            {"phase": "turns", "usec": "us",
+                             "sec": "s"}[yaxis]))
+        axes[-1][0].set_xlabel(xlabel)
+        fig.tight_layout()
+        picker_holder[0] = make_picker(resids, xdata, yaxis, panels[-1][0])
+        if fig.canvas.manager is not None:  # live figure: repaint
+            fig.canvas.draw_idle()
+        return xdata
+
+    draw(options.xaxis, options.yaxis)
+    if options.interactive:
+        from pypulsar_tpu.utils.interactive import AxisCycler
+
+        fig.canvas.mpl_connect(
+            "button_press_event",
+            lambda ev: (ev.xdata is not None and ev.ydata is not None
+                        and picker_holder[0].on_click(ev.xdata, ev.ydata)))
+        cycler = AxisCycler(XAXIS_CHOICES, YAXIS_CHOICES,
+                            options.xaxis, options.yaxis, redraw=draw)
+        cycler.connect(fig)
     show_or_save(options.outfile)
     return 0
+
+
+def make_picker(resids, xdata, yaxis, postfit):
+    """Click-to-identify picker over the plotted residuals (reference
+    bin/pyplotres.py interactive mode): prints TOA #, MJD, frequency and
+    the residual value of the nearest point, in the currently plotted
+    y units (``postfit`` selects which panel's residuals clicks match —
+    the bottom one in --both mode)."""
+    from pypulsar_tpu.utils.interactive import NearestPointPicker
+
+    ydata, _, _ = get_ydata(resids, yaxis, postfit)
+
+    def info(i, label):
+        print("TOA %d: MJD %.6f  freq %.3f MHz  residual %.4g %s"
+              % (i, float(resids.bary_TOA[i]), float(resids.bary_freq[i]),
+                 float(ydata[i]),
+                 {"phase": "turns", "usec": "us", "sec": "s"}[yaxis]))
+
+    return NearestPointPicker(xdata, ydata,
+                              [str(i) for i in range(len(xdata))],
+                              callback=info)
 
 
 if __name__ == "__main__":

@@ -266,6 +266,9 @@ def build_parser():
     parser.add_argument("--info", default=None,
                         help="Print the catalog entry for this pulsar "
                              "name and exit")
+    parser.add_argument("-i", "--interactive", action="store_true",
+                        help="click a point to print that pulsar's "
+                             "parameters (the reference's picker UI)")
     parser.add_argument("-o", "--outfile", default=None,
                         help="Write plot to file instead of showing")
     return parser
@@ -313,8 +316,27 @@ def main(argv=None):
     plot_data(pulsars, highlight, binaries=args.binaries, rrats=args.rrats,
               magnetars=args.magnetars, snrs=args.snrs, edots=args.edots,
               ages=args.ages, bsurfs=args.bsurfs)
+    if args.interactive:
+        # axes are log-log: event coords arrive in data units
+        make_picker(pulsars + highlight).connect(
+            fig, transform=lambda x, y: (np.log10(x), np.log10(y)))
     show_or_save(args.outfile)
     return 0
+
+
+def make_picker(pulsars):
+    """Nearest-pulsar click picker over the P-Pdot plane (the reference's
+    interactive UI, bin/pyppdot.py:459-620). Distances in log space — the
+    plot's axes; pulsars without a plottable pdot are excluded."""
+    from pypulsar_tpu.utils.interactive import NearestPointPicker
+
+    plottable = [p for p in pulsars
+                 if p.p and p.pdot and p.p > 0 and p.pdot > 0]
+    return NearestPointPicker(
+        [np.log10(p.p) for p in plottable],
+        [np.log10(p.pdot) for p in plottable],
+        [p.name for p in plottable],
+        callback=lambda i, name: print(plottable[i].get_info(extended=True)))
 
 
 if __name__ == "__main__":

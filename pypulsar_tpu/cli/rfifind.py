@@ -59,8 +59,10 @@ def build_parser():
                              "fraction of its channels are bad "
                              "(default: %(default)s)")
     parser.add_argument("--zapchan", type=parse_int_list, default=[],
-                        help="extra channels to zap, e.g. '2,5,7:10' "
-                             "(file channel order)")
+                        help="extra channels to zap, e.g. '2,5,7:10', in "
+                             "MASK channel order (channel 0 = lowest "
+                             "frequency, the PRESTO convention — the "
+                             "reverse of on-disk order for foff<0 files)")
     parser.add_argument("--zapints", type=parse_int_list, default=[],
                         help="extra intervals to zap")
     return parser

@@ -42,8 +42,11 @@ def _write_cands(path, cands):
 
 def _write_dats(outbase, reader, dms, downsamp, rfimask=None):
     """Write per-DM dedispersed time series (.dat + .inf), flat mode only.
-    ``rfimask`` applies the same median-mid80 mask fill the sweep used —
-    the .dat series must describe the data the candidates came from."""
+    ``rfimask`` applies the sweep's median-mid80 mask fill so the .dat
+    series reflects the masked data the candidates came from. One
+    difference remains: fill values here are whole-file per-channel
+    statistics, while the streaming sweep computes them per chunk —
+    masked cells can differ where a channel's level drifts."""
     from pypulsar_tpu.io.datfile import write_dat
     from pypulsar_tpu.io.infodata import InfoData
     from pypulsar_tpu.parallel.staged import _make_source

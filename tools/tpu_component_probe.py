@@ -4,14 +4,20 @@ Run from the repo root with the axon tunnel up (`python
 tools/tpu_component_probe.py`). Prints per-component wall times with the
 ~60 ms tunnel dispatch overhead calibrated out: batched rfft/irfft
 throughput at the sweep's shapes, the stage-1/stage-2 phase-multiply
-reduces, a gather-free LUT-factorized phase variant, boxcar backends, and
-smaller FFT sizes — the data needed to decide where the next 10x comes
-from (BENCHNOTES.md round-3 notes; the round-3 tunnel outage prevented
-this run)."""
-import sys, time
+reduces, a gather-free LUT-factorized phase variant, and boxcar backends
+— the data needed to decide where the next speedup comes from
+(BENCHNOTES.md round-3 tables).
+
+Complex-boundary rule (ops/transfer.py): the axon platform cannot move
+complex buffers across executable boundaries, so every timed program
+takes float planes and combines them internally with lax.complex.
+"""
+import os, sys, time
 import numpy as np
 import jax, jax.numpy as jnp
 from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 key = jax.random.PRNGKey(0)
 n = 1 << 17
@@ -43,52 +49,62 @@ force(data[:1, :1])
 t = timeit(jax.jit(lambda d: jnp.fft.rfft(d, axis=1).real), data) - overhead
 print(f"rfft [{C},{n}]     {t*1e3:8.1f} ms  {C*2.5*n*17/t/1e9:6.1f} GFLOP/s", file=sys.stderr)
 
-Xd = (jax.random.normal(key, (D, F)) + 1j*jax.random.normal(jax.random.PRNGKey(1), (D, F))).astype(jnp.complex64)
-force(Xd.real[:1, :1])
-t = timeit(jax.jit(lambda X: jnp.fft.irfft(X, n=n, axis=1)), Xd) - overhead
+Xr = jax.random.normal(key, (D, F), dtype=jnp.float32)
+Xi = jax.random.normal(jax.random.PRNGKey(1), (D, F), dtype=jnp.float32)
+force(Xr[:1, :1])
+t = timeit(jax.jit(lambda re, im: jnp.fft.irfft(
+    jax.lax.complex(re, im), n=n, axis=1)), Xr, Xi) - overhead
 print(f"irfft [{D},{F}]   {t*1e3:8.1f} ms  {D*2.5*n*17/t/1e9:6.1f} GFLOP/s", file=sys.stderr)
 
-Xc = (jax.random.normal(key, (C, F)) + 1j*jax.random.normal(jax.random.PRNGKey(2), (C, F))).astype(jnp.complex64)
-force(Xc.real[:1, :1])
+Cr = jax.random.normal(key, (C, F), dtype=jnp.float32)
+Ci = jax.random.normal(jax.random.PRNGKey(2), (C, F), dtype=jnp.float32)
+force(Cr[:1, :1])
 sh1 = jnp.asarray(np.random.RandomState(0).randint(0, 160, size=C), jnp.int32)
 k = jnp.arange(F, dtype=jnp.int32)
 
 @jax.jit
-def stage1_one(X, sh):
+def stage1_one(re, im, sh):
+    X = jax.lax.complex(re, im)
     idx = (k * sh[:, None]) & jnp.int32(n - 1)
     ang = (2.0*jnp.pi/n) * idx.astype(jnp.float32)
     ph = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
     return ((X * ph).reshape(S, C // S, F).sum(axis=1)).real
 
-t = timeit(stage1_one, Xc, sh1) - overhead
+t = timeit(stage1_one, Cr, Ci, sh1) - overhead
 print(f"stage1 x1 group    {t*1e3:8.1f} ms  -> x{G} = {t*G*1e3:8.1f} ms  ({C*F*8/t/1e9:5.1f} GB/s)", file=sys.stderr)
 
-Xs = Xc[:S]
+Sr, Si = Cr[:S], Ci[:S]
 sh2 = jnp.asarray(np.random.RandomState(1).randint(0, 8000, size=(g, S)), jnp.int32)
 
 @jax.jit
-def stage2_one(X, sh):
+def stage2_one(re, im, sh):
+    X = jax.lax.complex(re, im)
     idx = (k[None, None, :] * sh[:, :, None]) & jnp.int32(n - 1)
     ang = (2.0*jnp.pi/n) * idx.astype(jnp.float32)
     ph = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
     return ((X[None] * ph).sum(axis=1)).real
 
-t = timeit(stage2_one, Xs, sh2) - overhead
+t = timeit(stage2_one, Sr, Si, sh2) - overhead
 print(f"stage2 x1 group    {t*1e3:8.1f} ms  -> x{G} = {t*G*1e3:8.1f} ms  ({g*S*F*8/t/1e9:5.1f} GB/s)", file=sys.stderr)
 
-# no-transcendental stage2: phase from gathered per-shift row tables
-t1 = jnp.exp(2j*jnp.pi*jnp.arange(128)[:, None]*k[None, :]*64.0/n).astype(jnp.complex64)  # W^(k*64*j)
-t2 = jnp.exp(2j*jnp.pi*jnp.arange(64)[:, None]*k[None, :]/n).astype(jnp.complex64)
-force(t1.real[:1, :1])
-
+# no-transcendental stage2: phase from gathered per-shift row tables,
+# built on device inside the jit (complex tables cannot transfer)
 @jax.jit
-def stage2_lut(X, sh):
-    hi = sh // 64
-    lo = sh % 64
-    ph = t1[hi] * t2[lo]   # [g, S, F]
+def stage2_lut(re, im, sh):
+    X = jax.lax.complex(re, im)
+    j64 = jnp.arange(128, dtype=jnp.int32)
+    # exact: W^(k*64*j) with (k*64*j) mod n via int32 wraparound
+    idx1 = ((k[None, :] * (64*j64)[:, None]) & jnp.int32(n-1)).astype(jnp.float32)
+    t1 = jax.lax.complex(jnp.cos((2.0*jnp.pi/n)*idx1),
+                         jnp.sin((2.0*jnp.pi/n)*idx1))
+    j2 = jnp.arange(64, dtype=jnp.int32)
+    idx2 = ((k[None, :] * j2[:, None]) & jnp.int32(n-1)).astype(jnp.float32)
+    t2 = jax.lax.complex(jnp.cos((2.0*jnp.pi/n)*idx2),
+                         jnp.sin((2.0*jnp.pi/n)*idx2))
+    ph = t1[sh // 64] * t2[sh % 64]   # [g, S, F]
     return ((X[None] * ph).sum(axis=1)).real
 
-t = timeit(stage2_lut, Xs, sh2) - overhead
+t = timeit(stage2_lut, Sr, Si, sh2) - overhead
 print(f"stage2-lut x1      {t*1e3:8.1f} ms  -> x{G} = {t*G*1e3:8.1f} ms", file=sys.stderr)
 
 from pypulsar_tpu.ops.pallas_kernels import boxcar_stats
@@ -105,3 +121,26 @@ for be in ("pallas", "lax"):
               f"({2*4*D*123000/t/1e9:5.1f} GB/s)", file=sys.stderr)
     except Exception as e:  # noqa: BLE001 - pallas needs a real TPU
         print(f"boxcar-{be} unavailable: {type(e).__name__}", file=sys.stderr)
+
+# full fourier chunk at the two-stage geometries the A/B grid covers
+from pypulsar_tpu.parallel import make_sweep_plan
+from pypulsar_tpu.parallel.sweep import sweep_chunk
+dt = 64e-6
+freqs = (1500.0 - 300.0 / C * np.arange(C)).astype(np.float64)
+dms = np.linspace(0.0, 500.0, D)
+for nsub2, group2 in ((64, 32), (32, 32), (64, 64)):
+    plan = make_sweep_plan(dms, freqs, dt, nsub=nsub2, group_size=group2)
+    chunk = n - plan.min_overlap
+    out_len = chunk + max(plan.widths)
+    need = out_len + plan.max_shift2 + plan.max_shift1
+    d2 = jax.random.normal(key, (C, need), dtype=jnp.float32)
+    s1 = jnp.asarray(plan.stage1_bins)
+    s2 = jnp.asarray(plan.stage2_bins)
+    force(d2[:1, :1])
+    fn = lambda: sweep_chunk(d2, s1, s2, plan.nsub, out_len,
+                             plan.max_shift2, plan.widths, chunk,
+                             engine="fourier")
+    force(fn())
+    t0 = time.perf_counter(); force(fn()); el = time.perf_counter() - t0
+    print(f"chunk-fourier s{nsub2} g{group2}  {el*1e3:8.1f} ms "
+          f"({D/el:7.1f} trials/s/chunk)", file=sys.stderr)
