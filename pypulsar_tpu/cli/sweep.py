@@ -133,8 +133,7 @@ def main(argv=None):
                          "all above-threshold events to {outbase}.events. "
                          "Event granularity is one per chunk, so --chunk "
                          "sets the minimum pulse separation (defaults to "
-                         "16384 samples with this flag); incompatible "
-                         "with --checkpoint")
+                         "16384 samples with this flag)")
     ap.add_argument("--checkpoint", default=None, metavar="PATH",
                     help="persist in-sweep state to PATH for --resume")
     ap.add_argument("--checkpoint-every", type=int, default=16,
@@ -155,8 +154,6 @@ def main(argv=None):
                  "downsampling itself)")
     if args.all_events and args.ddplan:
         ap.error("--all-events is a flat-mode option")
-    if args.all_events and args.checkpoint:
-        ap.error("--all-events does not persist through --checkpoint")
     if args.all_events and args.chunk is None:
         # without chunking the whole series is one chunk and the event
         # list degenerates to the single best peak per (DM, width)

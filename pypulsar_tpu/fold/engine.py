@@ -13,10 +13,11 @@ so its representative phase is the bin *center* (b+0.5)/nbins — TOA code
 comparing a folded profile against a template sampled at b/nbins must
 account for the half-bin offset (as PRESTO's fold does).
 
-``jax.ops.segment_sum`` lowers to an efficient XLA scatter-add; for 2-D
-[chan, time] inputs the scatter vmaps over channels (the .pfd-style
-chan x phase archive).  NumPy golden twins live alongside for parity
-tests (SURVEY.md §4 strategy 1).
+1-D series fold with ``jax.ops.segment_sum``; 2-D [chan, time] folds (the
+.pfd-style chan x phase archive) as a one-hot matmul on the MXU at
+HIGHEST precision — the TPU-native scatter formulation (see fold_bins).
+NumPy golden twins live alongside for parity tests (SURVEY.md §4
+strategy 1).
 """
 
 from __future__ import annotations
@@ -69,7 +70,8 @@ def _onehot_fold_2d(data, bin_idx, nbins: int):
     C, T = data.shape
     if T <= _FOLD_BLOCK:
         onehot = jax.nn.one_hot(bin_idx, nbins, dtype=data.dtype)
-        return jnp.dot(data, onehot, preferred_element_type=jnp.float32)
+        return jnp.dot(data, onehot, preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST)
     nblk = -(-T // _FOLD_BLOCK)
     pad = nblk * _FOLD_BLOCK - T
     d = jnp.pad(data, ((0, 0), (0, pad)))
@@ -80,8 +82,12 @@ def _onehot_fold_2d(data, bin_idx, nbins: int):
     def body(acc, xs):
         dblk, bblk = xs
         onehot = jax.nn.one_hot(bblk, nbins, dtype=dblk.dtype)
+        # HIGHEST: the default TPU matmul rounds inputs to bf16, which
+        # visibly degrades fold sums (caught by the bench parity check);
+        # one-hot selection must reproduce f32 adds
         return acc + jnp.dot(dblk, onehot,
-                             preferred_element_type=jnp.float32), None
+                             preferred_element_type=jnp.float32,
+                             precision=jax.lax.Precision.HIGHEST), None
 
     prof, _ = jax.lax.scan(body, jnp.zeros((C, nbins), jnp.float32), (d, b))
     return prof

@@ -325,11 +325,11 @@ _BANK_CACHE_LIMIT = 4e9  # host RAM; jerk banks reach GB scale
 
 def _build_ratio_bank(rho_num: int, rho_den: int, zs: tuple, ws: tuple,
                       segw: int, min_halfwidth: int):
-    """(tf[rows, L] complex64, hw, L, stretch idx[2*segw] int32) for one
-    subharmonic ratio: harmonic b/H of a signal with (z, w) drifts at the
-    top harmonic has drifts scaled by the same ratio. Cached — bank
-    construction (host FFT synthesis) dominates setup when many spectra
-    are searched with one configuration."""
+    """(tf[2, rows, L] float32 re/im planes, hw, L, stretch idx[2*segw]
+    int32) for one subharmonic ratio: harmonic b/H of a signal with
+    (z, w) drifts at the top harmonic has drifts scaled by the same
+    ratio. Cached — bank construction (host FFT synthesis) dominates
+    setup when many spectra are searched with one configuration."""
     rf = rho_num / rho_den
     zs = np.asarray(zs)
     ws = np.asarray(ws)
@@ -343,7 +343,12 @@ def _build_ratio_bank(rho_num: int, rho_den: int, zs: tuple, ws: tuple,
     rev = np.zeros_like(padded)
     rev[:, 0] = padded[:, 0]
     rev[:, 1:] = padded[:, :0:-1]
-    tf = np.fft.fft(rev, axis=1).astype(np.complex64)
+    tf_c = np.fft.fft(rev, axis=1).astype(np.complex64)
+    # stored as [2, rows, L] float32 planes: that is the form shipped to
+    # the device every search (complex cannot cross the jit boundary,
+    # ops/transfer.py), so caching planes avoids a bank-sized stack +
+    # copy per accel_search call
+    tf = np.stack([tf_c.real, tf_c.imag])
     # static stretch: plane column `col` (top position r0 + col/2) maps to
     # subharm half-bin index round(rho*col) relative to rho*r0; corr[j]
     # evaluates spectrum position s0 + j (the template's -hw offset cancels
@@ -475,8 +480,7 @@ def accel_search(
             tf, hw, L, idx = banks[Fraction(b, H)]
             bank_meta.append((front + (b * top_lo) // H - hw,
                               (b * segw) // H, hw, L))
-            tfs.append(jnp.asarray(
-                np.stack([tf.real, tf.imag]).astype(np.float32)))
+            tfs.append(jnp.asarray(tf))  # [2, rows, L] float planes
             idxs.append(jnp.asarray(idx))
         runner = _make_stage_runner(segw, Z, Wn, cfg.topk, tuple(bank_meta))
         with profiling.stage("accel_stage"):

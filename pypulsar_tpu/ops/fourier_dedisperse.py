@@ -65,6 +65,20 @@ def _phase(shifts, k, n_fft: int):
     return jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
 
 
+def _phase_table(max_shift: int, k, n_fft: int, stride: int = 1):
+    """[max_shift//stride + 1, F] rows of W^(k * stride * j) — the phase
+    of every possible (strided) integer shift, built once per dispatch so
+    the per-trial phase becomes a row gather (+ one complex multiply for
+    a hi*lo factorization) instead of per-element cos/sin. The v5e probe
+    measured the gathered stage-2 ~2x the transcendental formulation
+    (BENCHNOTES.md round-3 component table)."""
+    j = jnp.arange(max_shift // stride + 1, dtype=jnp.int32) * stride
+    return _phase(j, k, n_fft)
+
+
+_LUT_LO = 64  # stage-2 shifts factor as s = 64*hi + lo; tables stay ~100 MB
+
+
 def sweep_chunk_fourier_impl(
     data,
     stage1_bins,
@@ -75,6 +89,9 @@ def sweep_chunk_fourier_impl(
     stat_len: int,
     n_fft: int,
     boxcar_backend: str = "auto",
+    phase_mode: str = "direct",
+    max_shift1: int = 0,
+    max_shift2: int = 0,
 ):
     """Fourier-path equivalent of parallel.sweep._sweep_chunk_impl.
 
@@ -82,6 +99,20 @@ def sweep_chunk_fourier_impl(
     cannot wrap); stage1_bins[G, C]; stage2_bins[G, g, S].
     Returns per-trial (sum[D], sumsq[D], maxbox[D, W], argbox[D, W]) with
     window starts confined to the first ``stat_len`` samples.
+
+    ``phase_mode``: 'direct' (default) computes cos/sin per element;
+    'lut' gathers per-shift phase rows from tables built once per
+    dispatch, stage 2 factoring ``s = 64*hi + lo`` into two table rows
+    and one complex multiply. Both use the same exact int32-wraparound
+    index math; they differ by the one extra f32 complex multiply
+    (~1e-7 relative), inside the sweep's SNR parity budget. Measured
+    verdict on v5e (round 3): an ISOLATED stage-2 LUT beat the
+    transcendental version ~2x, but inside this fused scan the gathers
+    do not amortize and the whole chunk ran 2x SLOWER (646 vs 323 ms at
+    the bench geometry) — the VPU's transcendental throughput is not
+    the bottleneck here. 'lut' is kept selectable for future
+    toolchains; it needs the static bounds ``max_shift1``/``max_shift2``
+    (<=0 falls back to 'direct').
     """
     C, L = data.shape
     G, g, S = stage2_bins.shape
@@ -89,11 +120,23 @@ def sweep_chunk_fourier_impl(
     X = jnp.fft.rfft(data, n=n_fft, axis=1)  # [C, F]
     F = X.shape[1]
     k = jnp.arange(F, dtype=jnp.int32)
+    use_lut = phase_mode == "lut" and max_shift1 >= 0 and max_shift2 >= 0 \
+        and (max_shift1 or max_shift2)
+    if use_lut:
+        t1 = _phase_table(max_shift1, k, n_fft)  # [max1+1, F]
+        t_hi = _phase_table(max_shift2, k, n_fft, stride=_LUT_LO)
+        t_lo = _phase_table(min(_LUT_LO - 1, max_shift2), k, n_fft)
 
     def per_group(carry, xs):
         s1, s2 = xs  # [C], [g, S]
-        xsub = (X * _phase(s1, k, n_fft)).reshape(nsub, per, F).sum(axis=1)
-        xts = (xsub[None, :, :] * _phase(s2, k, n_fft)).sum(axis=1)  # [g, F]
+        if use_lut:
+            ph1 = t1[s1]
+            ph2 = t_hi[s2 // _LUT_LO] * t_lo[s2 % _LUT_LO]
+        else:
+            ph1 = _phase(s1, k, n_fft)
+            ph2 = _phase(s2, k, n_fft)
+        xsub = (X * ph1).reshape(nsub, per, F).sum(axis=1)
+        xts = (xsub[None, :, :] * ph2).sum(axis=1)  # [g, F]
         ts = jnp.fft.irfft(xts, n=n_fft, axis=1)[:, :out_len]
         s, ss, mb_g, ab_g = boxcar_stats(ts, widths, stat_len,
                                          backend=boxcar_backend)
@@ -112,5 +155,6 @@ def sweep_chunk_fourier_impl(
 sweep_chunk_fourier = jax.jit(
     sweep_chunk_fourier_impl,
     static_argnames=("nsub", "out_len", "widths", "stat_len", "n_fft",
-                     "boxcar_backend"),
+                     "boxcar_backend", "phase_mode", "max_shift1",
+                     "max_shift2"),
 )

@@ -6,8 +6,9 @@ The sweep engine's inner operation (both subband stages) is
 
 i.e. sum K shifted rows of a [R, L] array into each of O outputs.  The
 XLA formulation (vmapped ``lax.dynamic_slice``) lowers to a generic
-gather that runs ~70x below HBM bandwidth on TPU (measured ~11 GB/s on
-v5e).  This kernel instead streams each needed row segment HBM->VMEM with
+gather measured ~26 GB/s effective on v5e (3% of the HBM roofline;
+BENCHNOTES.md round-3 A/B — the Fourier phase-multiply engine has since
+superseded both).  This kernel instead streams each row segment HBM->VMEM with
 explicit double-buffered DMA whose offsets come from scalar-prefetched
 shift tables, and accumulates in VMEM — the access pattern the hardware
 DMA engines are built for.

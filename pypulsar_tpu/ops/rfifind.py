@@ -213,6 +213,14 @@ def mask_products(
     re-unions them), keeping the file small.
     """
     nint, nchan = flags.shape
+    for c in extra_zap_chans:
+        if not 0 <= int(c) < nchan:
+            raise ValueError(
+                f"zap channel {c} outside [0, {nchan}) — indices are in "
+                f"mask channel order (channel 0 = lowest frequency)")
+    for i in extra_zap_ints:
+        if not 0 <= int(i) < nint:
+            raise ValueError(f"zap interval {i} outside [0, {nint})")
     chan_bad = flags.mean(axis=0)
     int_bad = flags.mean(axis=1)
     zap_chans = set(np.nonzero(chan_bad > chanfrac)[0].tolist())
@@ -302,12 +310,10 @@ def rfifind(
         lofreq = float(f.min())
         df = float(abs(f[1] - f[0])) if len(f) > 1 else 0.0
         mjd = 0.0
-        for attr in ("tstart",):  # SIGPROC header
-            try:
-                mjd = float(getattr(source, attr))
-                break
-            except (AttributeError, TypeError):
-                pass
+        try:
+            mjd = float(source.tstart)  # SIGPROC header
+        except (AttributeError, TypeError):
+            pass
         if not mjd and hasattr(source, "specinfo"):  # PSRFITS
             try:
                 mjd = float(np.atleast_1d(source.specinfo.start_MJD)[0])

@@ -270,9 +270,14 @@ def _sweep_chunk_impl(
             sweep_chunk_fourier_impl,
         )
 
+        # static shift bounds for the LUT phase tables: every sweep path
+        # sizes data as out_len + slack2 + max_shift1, so the stage-1
+        # bound falls out of the (static) chunk shape
+        max_s1 = max(int(data.shape[1]) - out_len - slack2, 0)
         return sweep_chunk_fourier_impl(
             data, stage1_bins, stage2_bins, nsub, out_len, widths,
             stat_len, fourier_chunk_len(data.shape[1]),
+            max_shift1=max_s1, max_shift2=slack2,
         )
     C, L = data.shape
     G, g, S = stage2_bins.shape
@@ -529,8 +534,12 @@ class SweepCheckpoint:
             h.update(part)
         return h.hexdigest()
 
-    def load(self, plan: SweepPlan, chunk_payload: int, context: str = ""):
-        """(acc, cursor, baseline) from a matching checkpoint, else None."""
+    def load(self, plan: SweepPlan, chunk_payload: int, context: str = "",
+             keep_chunk_peaks: bool = False):
+        """(acc, cursor, baseline) from a matching checkpoint, else None.
+        ``keep_chunk_peaks`` must match the value the checkpoint was
+        written with (it is part of the fingerprinted state: a resume
+        without the per-chunk record would silently drop events)."""
         if not os.path.exists(self.path):
             return None
         try:
@@ -538,12 +547,20 @@ class SweepCheckpoint:
                 if str(z["fingerprint"]) != self._fingerprint(
                         plan, chunk_payload, context):
                     return None
-                acc = _Accum(plan.n_trials, len(plan.widths))
+                has_peaks = "chunk_mb" in z
+                if has_peaks != keep_chunk_peaks:
+                    return None
+                acc = _Accum(plan.n_trials, len(plan.widths),
+                             keep_chunk_peaks=keep_chunk_peaks,
+                             n_real=plan.n_real_trials)
                 acc.n = int(z["n"])
                 acc.s = z["s"]
                 acc.ss = z["ss"]
                 acc.mb = z["mb"]
                 acc.ab = z["ab"]
+                if keep_chunk_peaks:
+                    acc.chunk_mb = list(z["chunk_mb"])
+                    acc.chunk_ab = list(z["chunk_ab"])
                 return acc, int(z["cursor"]), z["baseline"]
         except Exception:  # noqa: BLE001 - a corrupt checkpoint restarts
             return None
@@ -551,11 +568,23 @@ class SweepCheckpoint:
     def save(self, plan: SweepPlan, chunk_payload: int, acc: "_Accum",
              cursor: int, baseline, context: str = "") -> None:
         tmp = self.path + ".tmp.npz"  # .npz suffix: savez must not append
+        extra = {}
+        if acc.keep_chunk_peaks:
+            # every entry is [n_real, W]; the key must exist even before
+            # the first drain so load() can tell peak checkpoints apart
+            W = acc.mb.shape[1]
+            extra["chunk_mb"] = (np.stack(acc.chunk_mb) if acc.chunk_mb
+                                 else np.zeros((0, acc.n_real, W),
+                                               np.float32))
+            extra["chunk_ab"] = (np.stack(acc.chunk_ab) if acc.chunk_ab
+                                 else np.zeros((0, acc.n_real, W),
+                                               np.int64))
         np.savez(tmp,
                  fingerprint=self._fingerprint(plan, chunk_payload, context),
                  n=acc.n, s=acc.s, ss=acc.ss, mb=acc.mb, ab=acc.ab,
                  cursor=cursor,
-                 baseline=np.asarray(baseline, dtype=np.float32))
+                 baseline=np.asarray(baseline, dtype=np.float32),
+                 **extra)
         os.replace(tmp, self.path)
 
     def on_drained(self, plan, chunk_payload, acc, cursor, baseline,
@@ -630,10 +659,6 @@ def sweep_stream(
     out_len = chunk_payload + W
     slack2 = plan.max_shift2
     D = plan.n_trials
-    if keep_chunk_peaks and checkpoint is not None:
-        raise ValueError(
-            "keep_chunk_peaks does not persist through checkpoints yet; "
-            "run multi-event sweeps without --checkpoint")
     acc = _Accum(D, len(plan.widths), keep_chunk_peaks=keep_chunk_peaks,
                  n_real=plan.n_real_trials)
     cursor = 0  # first payload sample not yet accumulated
@@ -641,7 +666,8 @@ def sweep_stream(
         engine, 0 if mesh is None else mesh.shape.get("dm", 0),
         checkpoint_context)
     if checkpoint is not None:
-        state = checkpoint.load(plan, chunk_payload, ckpt_context)
+        state = checkpoint.load(plan, chunk_payload, ckpt_context,
+                                keep_chunk_peaks=keep_chunk_peaks)
         if state is not None:
             acc, cursor, ckpt_baseline = state
             if baseline is None:
@@ -773,9 +799,14 @@ def finalize_sweep(plan: SweepPlan, n: int, s, ss, mb, ab,
     nr = plan.n_real_trials
     chunk_snr = chunk_sample = None
     if chunk_mb:
-        # entries are already [:nr]; SNR math in f64, stored f32
+        # entries are already [:nr] — slice the moments to match (trials
+        # can be padded to a group multiple, so nr < D is the norm)
+        mean_r = mean[:nr]
+        denom_r = denom[:nr]
         chunk_snr = np.stack([
-            to_snr(np.asarray(m, dtype=np.float64)[:nr]).astype(np.float32)
+            ((np.asarray(m, dtype=np.float64)[:nr]
+              - ws[None, :] * mean_r[:, None]) / denom_r)
+            .astype(np.float32)
             for m in chunk_mb])
         chunk_sample = np.stack([np.asarray(a, dtype=np.int64)[:nr]
                                  for a in chunk_ab])

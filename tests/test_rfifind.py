@@ -74,6 +74,14 @@ def test_mask_products_thresholds():
     assert per_int[7] == []  # covered by the interval zap
     # globally zapped channels are excluded from per-interval lists
     assert all(3 not in chans for chans in per_int)
+    # out-of-range extra zaps are rejected (a mask with them would crash
+    # every consumer at load)
+    with pytest.raises(ValueError):
+        mask_products(flags, extra_zap_chans=[16])
+    with pytest.raises(ValueError):
+        mask_products(flags, extra_zap_chans=[-1])
+    with pytest.raises(ValueError):
+        mask_products(flags, extra_zap_ints=[10])
 
 
 def test_end_to_end_mask_file(tmp_path):

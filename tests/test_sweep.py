@@ -332,6 +332,73 @@ def test_checkpoint_kill_and_resume_bit_exact(tmp_path):
     assert not os.path.exists(ck_path), "checkpoint not cleaned up"
 
 
+def test_checkpoint_resume_with_chunk_peaks(tmp_path):
+    """keep_chunk_peaks persists through a kill-and-resume: the multi-
+    event list matches the uninterrupted run exactly, and a checkpoint
+    written without peaks is not resumed into a peak run."""
+    from pypulsar_tpu.parallel.sweep import SweepCheckpoint, sweep_stream
+
+    rng = np.random.RandomState(13)
+    C, T, payload = 32, 9000, 2048
+    freqs = 1500.0 - 4.0 * np.arange(C)
+    data = rng.randn(C, T).astype(np.float32)
+    data[:, 1000] += 4.0  # chunk-0 event
+    data[:, 7000] += 4.0  # chunk-3 event
+    # 14 trials with group_size 4 -> padded to 16: n_real < n_trials
+    # exercises the chunk-peak slice against the padded moment arrays
+    dms = np.linspace(0.0, 60.0, 14)
+    plan = make_sweep_plan(dms, freqs, 1e-3, nsub=8, group_size=4)
+    baseline = data.mean(axis=1, keepdims=True).astype(np.float32)
+
+    def blocks():
+        ov = plan.min_overlap
+        pos = 0
+        while pos < T:
+            n = min(payload + ov, T - pos)
+            yield pos, data[:, pos:pos + n]
+            pos += payload
+
+    ref = sweep_stream(plan, blocks(), payload, chan_major=True,
+                       baseline=baseline, keep_chunk_peaks=True)
+    ref_events = ref.events(5.0)
+    assert len({e["sample"] // payload for e in ref_events}) >= 2
+
+    class Killed(Exception):
+        pass
+
+    def killing_blocks(n):
+        for i, (pos, blk) in enumerate(blocks()):
+            if i >= n:
+                raise Killed()
+            yield pos, blk
+
+    ck = str(tmp_path / "pk.ckpt.npz")
+    with pytest.raises(Killed):
+        sweep_stream(plan, killing_blocks(3), payload, chan_major=True,
+                     baseline=baseline, keep_chunk_peaks=True,
+                     checkpoint=SweepCheckpoint(ck, every=1),
+                     max_pending=1)
+    assert os.path.exists(ck)
+    res = sweep_stream(plan, blocks(), payload, chan_major=True,
+                       baseline=baseline, keep_chunk_peaks=True,
+                       checkpoint=SweepCheckpoint(ck, every=1))
+    np.testing.assert_array_equal(res.chunk_snr, ref.chunk_snr)
+    np.testing.assert_array_equal(res.chunk_sample, ref.chunk_sample)
+    assert res.events(5.0) == ref_events
+
+    # a peak-less checkpoint must not satisfy a keep_chunk_peaks resume
+    ck2 = str(tmp_path / "nopk.ckpt.npz")
+    with pytest.raises(Killed):
+        sweep_stream(plan, killing_blocks(3), payload, chan_major=True,
+                     baseline=baseline,
+                     checkpoint=SweepCheckpoint(ck2, every=1),
+                     max_pending=1)
+    res2 = sweep_stream(plan, blocks(), payload, chan_major=True,
+                        baseline=baseline, keep_chunk_peaks=True,
+                        checkpoint=SweepCheckpoint(ck2, every=1))
+    np.testing.assert_array_equal(res2.chunk_snr, ref.chunk_snr)
+
+
 def test_checkpoint_fingerprint_mismatch_restarts(tmp_path):
     """A checkpoint from different sweep parameters is ignored."""
     from pypulsar_tpu.parallel.sweep import SweepCheckpoint, sweep_stream
