@@ -1,0 +1,59 @@
+"""Complex-boundary transfer helpers (ops/transfer.py): the contract
+that lets the framework run on backends that cannot move complex
+buffers across executable boundaries."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pypulsar_tpu.ops.transfer import (
+    join_planes,
+    split_complex,
+    to_host_complex,
+)
+
+
+def test_split_host_complex_roundtrip():
+    a = (np.arange(6) + 1j * np.arange(6)[::-1]).astype(np.complex64)
+    re, im = split_complex(a)
+    assert isinstance(re, np.ndarray) and re.dtype == np.float32
+    np.testing.assert_array_equal(re, a.real)
+    np.testing.assert_array_equal(im, a.imag)
+    back = to_host_complex(re, im)
+    assert back.dtype == np.complex64
+    np.testing.assert_array_equal(back, a)
+
+
+def test_split_host_real_gets_zero_imag():
+    re, im = split_complex(np.arange(4, dtype=np.float64))
+    np.testing.assert_array_equal(im, np.zeros(4))
+    assert re.dtype == np.float32
+
+
+def test_split_complex128_downcasts():
+    a = np.array([1.5 + 2.5j], dtype=np.complex128)
+    re, im = split_complex(a)
+    assert re.dtype == np.float32 and float(re[0]) == 1.5
+
+
+def test_split_device_array():
+    dev = jnp.asarray(np.array([1.0, 2.0], np.float32))
+    cx = jax.jit(lambda x: x + 1j * x)(dev)
+    re, im = split_complex(cx)
+    assert isinstance(re, jax.Array)
+    np.testing.assert_array_equal(np.asarray(re), [1.0, 2.0])
+    np.testing.assert_array_equal(np.asarray(im), [1.0, 2.0])
+
+
+def test_split_noncontiguous_input():
+    a = (np.arange(12).reshape(3, 4) * (1 + 1j)).astype(np.complex64)
+    re, im = split_complex(a[:, ::2])  # strided view
+    assert re.flags["C_CONTIGUOUS"]
+    np.testing.assert_array_equal(re, a[:, ::2].real)
+
+
+def test_join_planes_inside_jit():
+    re = np.array([3.0, 0.0], np.float32)
+    im = np.array([4.0, 1.0], np.float32)
+    mag = jax.jit(lambda r, i: jnp.abs(join_planes(r, i)))(re, im)
+    np.testing.assert_allclose(np.asarray(mag), [5.0, 1.0], rtol=1e-6)

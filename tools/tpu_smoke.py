@@ -1,0 +1,148 @@
+"""One-command live-TPU validation of every device-facing engine.
+
+Usage (repo root, axon tunnel up): ``python tools/tpu_smoke.py``
+
+Runs each engine at small shapes with a correctness assertion and prints
+one PASS/FAIL line per engine plus wall time — fast triage separating
+"tunnel down" (liveness fails), "toolchain regression" (one engine
+fails: e.g. a new complex-boundary or Mosaic limitation), and "all good"
+(exit 0). The CPU test suite cannot catch axon-platform-only failures
+(tests/conftest.py pins JAX_PLATFORMS=cpu); this can.
+"""
+import os
+import sys
+import time
+import traceback
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FAILED = []
+
+
+def check(name, fn):
+    t0 = time.perf_counter()
+    try:
+        fn()
+        print(f"PASS  {name:28s} {time.perf_counter() - t0:6.1f}s")
+    except Exception as e:  # noqa: BLE001 - report and continue
+        FAILED.append(name)
+        print(f"FAIL  {name:28s} {time.perf_counter() - t0:6.1f}s  "
+              f"{type(e).__name__}: {str(e)[:120]}")
+        traceback.print_exc(limit=3)
+
+
+def liveness():
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    assert float(jnp.ones((128, 128)).sum()) == 128 * 128
+    print(f"#     device: {dev} ({dev.platform})")
+
+
+def sweep_chunk():
+    import jax.numpy as jnp
+
+    from pypulsar_tpu.core.spectra import Spectra
+    from pypulsar_tpu.ops import numpy_ref
+    from pypulsar_tpu.parallel.sweep import sweep_resident
+
+    C, T, dt, dm = 128, 1 << 15, 64e-6, 120.0
+    freqs = (1500.0 - 2.0 * np.arange(C)).astype(np.float64)
+    rng = np.random.RandomState(0)
+    data = rng.randn(C, T).astype(np.float32)
+    bins = numpy_ref.bin_delays(dm, freqs, dt)
+    for c in range(C):
+        idx = 9000 + bins[c]
+        if idx < T:
+            data[c, idx] += 8.0
+    dms = np.linspace(0.0, 240.0, 64)
+    res = sweep_resident(Spectra(freqs, dt, jnp.asarray(data)), dms,
+                         nsub=32, group_size=16, engine="fourier")
+    best = res.best(1)[0]
+    assert abs(best["dm"] - dm) <= 8.0 and best["snr"] > 6.0, best
+
+
+def accel():
+    from pypulsar_tpu.fourier.accelsearch import (
+        AccelSearchConfig,
+        accel_search,
+    )
+    from pypulsar_tpu.fourier.kernels import deredden
+
+    N = 1 << 16
+    dt = 1e-3
+    T = 2 * N * dt
+    t = np.arange(2 * N) * dt
+    sig = np.random.RandomState(0).standard_normal(2 * N).astype(np.float32)
+    sig += 6.0 * np.sin(2 * np.pi * 50.0 * t).astype(np.float32)
+    fft = (np.fft.rfft(sig) / np.sqrt(2 * N)).astype(np.complex64)[:N]
+    fft = deredden(fft)  # exercises the complex-plane jit boundary too
+    cfg = AccelSearchConfig(zmax=8.0, dz=2.0, numharm=2, sigma_min=5.0,
+                            seg_width=1 << 12)
+    cands = accel_search(fft, T, cfg)
+    best = max(cands, key=lambda c: c.sigma)
+    assert abs(best.freq(T) - 50.0) < 0.1, best
+
+
+def fold():
+    import jax.numpy as jnp
+
+    from pypulsar_tpu.fold.engine import fold_parts, phase_to_bins
+
+    # nbins <= samples per rotation (50) so no phase bin is ever empty
+    C, T, nbins, npart = 64, 1 << 17, 32, 8
+    rng = np.random.RandomState(1)
+    data = rng.standard_normal((C, T)).astype(np.float32)
+    bi = phase_to_bins(np.arange(T) * 1e-3 / 0.05, nbins)
+    data[:, bi == 10] += 1.0
+    profs, counts = fold_parts(jnp.asarray(data), jnp.asarray(bi),
+                               nbins, npart)
+    prof = (np.asarray(profs).sum(axis=(0, 1))
+            / np.asarray(counts).sum(axis=0) / C)
+    assert prof[10] > 0.8 and abs(prof[11]) < 0.2, prof[9:12]
+
+
+def rfi_stats():
+    from pypulsar_tpu.ops.rfifind import rfifind
+
+    rng = np.random.RandomState(2)
+    data = rng.randn(32, 10 * 512).astype(np.float32)
+    data[5] *= 20.0
+    stats, flags, _ = rfifind(data, dt=1e-3, time=0.512,
+                              hifreq_first=False)
+    assert flags[:, 5].all()
+
+
+def boxcar():
+    import jax.numpy as jnp
+
+    from pypulsar_tpu.ops.pallas_kernels import boxcar_stats
+
+    import jax
+
+    ts = jax.random.normal(jax.random.PRNGKey(0), (64, 8192), jnp.float32)
+    s, ss, mb, ab = boxcar_stats(ts, (1, 2, 4, 8), 8000, backend="pallas")
+    s2, ss2, mb2, ab2 = boxcar_stats(ts, (1, 2, 4, 8), 8000, backend="lax")
+    np.testing.assert_allclose(np.asarray(mb), np.asarray(mb2),
+                               rtol=1e-5, atol=1e-4)
+
+
+def main():
+    check("liveness", liveness)
+    check("sweep (fourier, resident)", sweep_chunk)
+    check("accel search + deredden", accel)
+    check("fold_parts (one-hot MXU)", fold)
+    check("rfifind block stats", rfi_stats)
+    check("boxcar pallas-vs-lax", boxcar)
+    if FAILED:
+        print(f"\n{len(FAILED)} FAILED: {', '.join(FAILED)}")
+        return 1
+    print("\nALL ENGINES PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
