@@ -4,7 +4,15 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier dryrun bench bench-quick bench-ab bench-accel bench-fold native clean
+.PHONY: test test-fourier dryrun smoke probe bench bench-quick bench-ab bench-accel bench-fold native clean
+
+# every device engine on the live TPU, one PASS/FAIL line each (~1 min)
+smoke:
+	$(PY) tools/tpu_smoke.py
+
+# per-component kernel timings on the live TPU (BENCHNOTES tables)
+probe:
+	$(PY) tools/tpu_component_probe.py
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q

@@ -233,9 +233,13 @@ def run_benchmark(args):
 
             profile_ctx = contextlib.nullcontext()
         with profile_ctx:
-            t0 = time.perf_counter()
-            res = run()
-            jax_time = time.perf_counter() - t0
+            # best of 2: single tunnel measurements vary with server-
+            # side load (observed >2x on the fold bench, BENCHNOTES)
+            jax_time = float("inf")
+            for _ in range(1 if args.profile else 2):
+                t0 = time.perf_counter()
+                res = run()
+                jax_time = min(jax_time, time.perf_counter() - t0)
         return res, jax_time
 
     res = None
@@ -292,8 +296,9 @@ def run_benchmark(args):
           f"roofline); 1-hr extrapolation {trials_1hr:.1f} trials/s",
           file=sys.stderr)
     unit = (f"DM-trials/s ({C}-chan, {T*dt:.0f}s @ 64us, nsub={nsub}, "
-            f"engine={engine}; numpy baseline measured on {bl_T/T:.2f} of "
-            f"the data x {nb}/{D} trials, scaled linearly)")
+            f"engine={engine}, best of 2 runs; numpy baseline measured "
+            f"on {bl_T/T:.2f} of the data x {nb}/{D} trials, scaled "
+            f"linearly)")
     if args.cpu_fallback:
         unit += " [CPU FALLBACK: accelerator backend unavailable]"
     return {
@@ -532,10 +537,27 @@ def run_fold(args):
         return np.asarray(profs)
 
     run()  # warm
-    t0 = time.perf_counter()
-    profs = run()
-    jax_time = time.perf_counter() - t0
+    # min-of-3: single measurements through the shared tunnel vary by
+    # >2x run to run (observed 0.73/1.69/1.99 s for identical code)
+    jax_time = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        profs = run()
+        jax_time = min(jax_time, time.perf_counter() - t0)
     samples_per_sec = C * T / jax_time
+    # split out the device compute from the cube's device->host pull —
+    # through the remote tunnel the 33 MB result transfer can dominate
+    # the kernel; both are reported (bench r3). The scalar pull is the
+    # only reliable sync on this platform (block_until_ready returns
+    # early, BENCHNOTES), so kernel_time includes one sync dispatch's
+    # ~60 ms tunnel roundtrip — kernel_samples_per_sec is a LOWER bound
+    kernel_time = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        profs_dev, _ = fold_parts(dev, bi, nbins, npart)
+        float(jnp.ravel(profs_dev)[0])
+        kernel_time = min(kernel_time, time.perf_counter() - t0)
+    kernel_samples_per_sec = C * T / kernel_time
 
     # numpy twin on one partition, scaled linearly
     t0 = time.perf_counter()
@@ -547,10 +569,15 @@ def run_fold(args):
                                ref.sum(axis=0), rtol=1e-3, atol=0.5)
     bl_samples_per_sec = C * T / bl_time
     speedup = samples_per_sec / bl_samples_per_sec
-    print(f"# fold: {jax_time:.2f}s for {C}x{T} -> [{npart},{C},{nbins}]; "
-          f"numpy 1/{npart} slice {bl_time/npart:.2f}s", file=sys.stderr)
+    print(f"# fold: {jax_time:.2f}s for {C}x{T} -> [{npart},{C},{nbins}] "
+          f"(kernel {kernel_time:.3f}s = "
+          f"{kernel_samples_per_sec/1e9:.2f} Gsamp/s before the result "
+          f"pull); numpy 1/{npart} slice {bl_time/npart:.2f}s",
+          file=sys.stderr)
     unit = (f"folded samples/s ({C}-chan, {T} samples, {nbins} bins, "
-            f"{npart} partitions; numpy baseline one partition x{npart})")
+            f"{npart} partitions, min of 3 runs, INCLUDING the archive "
+            f"cube's device->host transfer; kernel-only rate in extras; "
+            f"numpy baseline one partition x{npart})")
     if args.cpu_fallback:
         unit += " [CPU FALLBACK: accelerator backend unavailable]"
     return {
@@ -559,6 +586,8 @@ def run_fold(args):
         "unit": unit,
         "vs_baseline": round(speedup, 2),
         "jax_seconds": round(jax_time, 3),
+        "kernel_seconds": round(kernel_time, 3),
+        "kernel_samples_per_sec": round(kernel_samples_per_sec, 1),
         "numpy_seconds_scaled": round(bl_time, 3),
     }
 

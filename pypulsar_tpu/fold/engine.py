@@ -55,7 +55,7 @@ def fold_bins(data, bin_idx, nbins: int):
     if data.ndim == 1:
         prof = jax.ops.segment_sum(data, bin_idx, num_segments=nbins)
     else:
-        prof = _onehot_fold_2d(data, bin_idx, nbins)
+        prof, _ = _onehot_fold_2d(data, bin_idx, nbins)
     return prof, counts
 
 
@@ -66,12 +66,22 @@ def _onehot_fold_2d(data, bin_idx, nbins: int):
     """``data[C, T] @ one_hot(bin_idx)`` accumulated over time blocks so
     the selection matrix never exceeds _FOLD_BLOCK x nbins (a monolithic
     one-hot is T*nbins*4 bytes — 64 GB for a 2^27-sample fold). The tail
-    pads with index ``nbins``, which one_hot maps to an all-zero row."""
+    pads with index ``nbins``, which one_hot maps to an all-zero row.
+
+    Returns (prof[C, nbins], counts_f32[nbins]) — counts are column sums
+    of the same one-hot matrices: exact in f32 per block (0/1 sums up to
+    _FOLD_BLOCK << 2^24) and across the f32 block accumulation until
+    ~2^24 samples/bin. Callers needing exact counts beyond that
+    (fold_bins' whole-series totals) use an integer segment_sum instead.
+    HIGHEST precision throughout: the default TPU matmul rounds inputs
+    to bf16, which visibly degrades fold sums (caught by the bench
+    parity check)."""
     C, T = data.shape
     if T <= _FOLD_BLOCK:
         onehot = jax.nn.one_hot(bin_idx, nbins, dtype=data.dtype)
-        return jnp.dot(data, onehot, preferred_element_type=jnp.float32,
+        prof = jnp.dot(data, onehot, preferred_element_type=jnp.float32,
                        precision=jax.lax.Precision.HIGHEST)
+        return prof, onehot.sum(axis=0)
     nblk = -(-T // _FOLD_BLOCK)
     pad = nblk * _FOLD_BLOCK - T
     d = jnp.pad(data, ((0, 0), (0, pad)))
@@ -81,16 +91,16 @@ def _onehot_fold_2d(data, bin_idx, nbins: int):
 
     def body(acc, xs):
         dblk, bblk = xs
+        acc_p, acc_c = acc
         onehot = jax.nn.one_hot(bblk, nbins, dtype=dblk.dtype)
-        # HIGHEST: the default TPU matmul rounds inputs to bf16, which
-        # visibly degrades fold sums (caught by the bench parity check);
-        # one-hot selection must reproduce f32 adds
-        return acc + jnp.dot(dblk, onehot,
-                             preferred_element_type=jnp.float32,
-                             precision=jax.lax.Precision.HIGHEST), None
+        prof = jnp.dot(dblk, onehot, preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST)
+        return (acc_p + prof, acc_c + onehot.sum(axis=0)), None
 
-    prof, _ = jax.lax.scan(body, jnp.zeros((C, nbins), jnp.float32), (d, b))
-    return prof
+    (prof, cnt), _ = jax.lax.scan(
+        body, (jnp.zeros((C, nbins), jnp.float32),
+               jnp.zeros((nbins,), jnp.float32)), (d, b))
+    return prof, cnt
 
 
 @partial(jax.jit, static_argnames=("nbins", "npart"))
@@ -104,23 +114,30 @@ def fold_parts(data, bin_idx, nbins: int, npart: int):
     partition's selection matrix live. One dispatch for the whole cube —
     the per-partition dispatch loop it replaces paid ~60 ms of remote-
     tunnel latency per partition (bench r3, BENCHNOTES.md).
+
+    Two measured costs are engineered out (v5e A/B, BENCHNOTES): the
+    per-partition ``segment_sum`` count scatters (counts come from
+    column sums of the SAME one-hot matrix — exact in f32 while
+    part_len < 2^24, asserted host-side) and a whole-array pre-transpose
+    (partitions slice out of the original layout inside the scan).
     Returns (profiles[npart, C, nbins], counts[npart, nbins])."""
     data = jnp.asarray(data)
     bin_idx = jnp.asarray(bin_idx, jnp.int32)
     C, T = data.shape
     part_len = T // npart
-    used = npart * part_len
-    d = data[:, :used].reshape(C, npart, part_len).transpose(1, 0, 2)
-    b = bin_idx[:used].reshape(npart, part_len)
+    if part_len >= 1 << 24:
+        raise ValueError(
+            f"part_len={part_len} >= 2^24: f32 one-hot counts would lose "
+            f"exactness; use more partitions")
+    b = bin_idx[: npart * part_len].reshape(npart, part_len)
 
-    def body(carry, xs):
-        dpart, bpart = xs  # [C, L], [L]
-        prof = _onehot_fold_2d(dpart, bpart, nbins)
-        cnt = jax.ops.segment_sum(jnp.ones(bpart.shape, jnp.int32), bpart,
-                                  num_segments=nbins)
-        return carry, (prof, cnt)
+    def body(carry, ci):
+        dpart = jax.lax.dynamic_slice(
+            data, (0, ci * part_len), (C, part_len))
+        prof, cnt = _onehot_fold_2d(dpart, b[ci], nbins)
+        return carry, (prof, cnt.astype(jnp.int32))
 
-    _, (profs, counts) = jax.lax.scan(body, 0, (d, b))
+    _, (profs, counts) = jax.lax.scan(body, 0, jnp.arange(npart))
     return profs, counts
 
 
