@@ -91,9 +91,9 @@ def main(argv=None):
         )
     finally:
         reader.close()
-    frac = float(flags.mean())
     print(f"wrote {maskfn}: {stats.nint} intervals x {stats.nchan} "
-          f"channels, {frac * 100:.2f}% of blocks flagged")
+          f"channels, {float(flags.mean()) * 100:.2f}% of blocks flagged, "
+          f"mask covers {stats.mask_coverage * 100:.2f}% of the data")
     return 0
 
 

@@ -25,6 +25,26 @@ from typing import List, Sequence
 import numpy as np
 
 
+def build_zap_table(nint: int, nchan: int, zap_chans, zap_ints,
+                    zap_chans_per_int) -> np.ndarray:
+    """Boolean [nint, nchan] zap table (True = zapped): the union of the
+    per-interval channel lists, globally zapped channels, and fully
+    zapped intervals — the single definition of what a mask covers,
+    shared by the reader and the generator's coverage accounting."""
+    table = np.zeros((nint, nchan), dtype=bool)
+    for i, chans in enumerate(zap_chans_per_int):
+        chans = np.asarray(chans, dtype=int)
+        if chans.size:
+            table[i, chans] = True
+    zap_chans = np.asarray(list(zap_chans), dtype=int)
+    if zap_chans.size:
+        table[:, zap_chans] = True
+    zap_ints = np.asarray(list(zap_ints), dtype=int)
+    if zap_ints.size:
+        table[zap_ints, :] = True
+    return table
+
+
 class RfifindMask:
     """Parsed rfifind mask.  Attributes mirror PRESTO's ``rfifind`` object:
     time_sigma, freq_sigma, MJD, dtint, lofreq, df, nchan, nint, ptsperint,
@@ -51,17 +71,9 @@ class RfifindMask:
             for n in nzap_per_int:
                 self.mask_zap_chans_per_int.append(np.fromfile(f, "<i4", n))
         self.mask_zap_chans_set = set(int(c) for c in self.mask_zap_chans)
-        # per-interval boolean table [nint, nchan]: union of the per-interval
-        # lists, the globally zapped channels, and fully zapped intervals
-        table = np.zeros((self.nint, self.nchan), dtype=bool)
-        for i, chans in enumerate(self.mask_zap_chans_per_int):
-            if chans.size:
-                table[i, chans] = True
-        if self.mask_zap_chans.size:
-            table[:, self.mask_zap_chans] = True
-        if self.mask_zap_ints.size:
-            table[np.asarray(self.mask_zap_ints, dtype=int), :] = True
-        self._zap_table = table
+        self._zap_table = build_zap_table(
+            self.nint, self.nchan, self.mask_zap_chans, self.mask_zap_ints,
+            self.mask_zap_chans_per_int)
 
     def get_sample_mask(self, startsamp: int, N: int) -> np.ndarray:
         """Boolean [nchan, N] mask (True = zapped) for samples

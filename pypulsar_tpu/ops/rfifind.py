@@ -114,6 +114,9 @@ class RfiStats:
     lofreq: float
     df: float
     mjd: float = 0.0
+    # set by rfifind(): fraction of (interval, channel) cells the final
+    # mask products zap (None until products are computed)
+    mask_coverage: Optional[float] = None
 
     @property
     def nint(self) -> int:
@@ -361,6 +364,24 @@ def rfifind(
     zc, zi, per_int = mask_products(flags, chanfrac=chanfrac, intfrac=intfrac,
                                     extra_zap_chans=zap_chans,
                                     extra_zap_ints=zap_ints)
+    # effective mask coverage (union of whole-channel, whole-interval and
+    # per-interval zaps, via the reader's own table builder). A BRIGHT
+    # PULSAR trips the Fourier max-power detector in every (interval,
+    # channel) exactly like periodic RFI would — a known failure mode of
+    # this class of detector (PRESTO's rfifind shares it); masking most
+    # of the band deletes the signal the downstream search is looking
+    # for, so shout.
+    from pypulsar_tpu.io.rfimask import build_zap_table
+
+    coverage = float(build_zap_table(stats.nint, stats.nchan, zc, zi,
+                                     per_int).mean())
+    stats.mask_coverage = coverage
+    if coverage > 0.5:
+        warnings.warn(
+            f"mask covers {coverage * 100:.0f}% of the data — either RFI "
+            f"is pervasive or a bright periodic source is being flagged "
+            f"as interference; consider raising freq_sigma/time_sigma "
+            f"or zapping known-bad channels explicitly", stacklevel=2)
     maskfn = None
     if outbase is not None:
         from pypulsar_tpu.io.rfimask import write_mask
