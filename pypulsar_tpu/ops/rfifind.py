@@ -132,16 +132,20 @@ class RfiStats:
         reference-layout)."""
         np.savez(fn, mean=self.mean, std=self.std, maxpow=self.maxpow,
                  ptsperint=self.ptsperint, dtint=self.dtint,
-                 lofreq=self.lofreq, df=self.df, mjd=self.mjd)
+                 lofreq=self.lofreq, df=self.df, mjd=self.mjd,
+                 mask_coverage=(np.nan if self.mask_coverage is None
+                                else self.mask_coverage))
         return fn
 
     @classmethod
     def load(cls, fn: str) -> "RfiStats":
         with np.load(fn) as z:
+            cov = float(z["mask_coverage"]) if "mask_coverage" in z else np.nan
             return cls(mean=z["mean"], std=z["std"], maxpow=z["maxpow"],
                        ptsperint=int(z["ptsperint"]), dtint=float(z["dtint"]),
                        lofreq=float(z["lofreq"]), df=float(z["df"]),
-                       mjd=float(z["mjd"]))
+                       mjd=float(z["mjd"]),
+                       mask_coverage=None if np.isnan(cov) else cov)
 
 
 def _robust_center_scale(x: np.ndarray, good: np.ndarray, axis: int):
@@ -242,14 +246,18 @@ def mask_products(
 
 def _iter_file_blocks(reader, samples_per_read: int):
     """Yield [nchan, n] LOW-frequency-first blocks from a filterbank /
-    PSRFITS reader — the .mask channel convention (PRESTO reorders every
-    band ascending on read, so mask channel 0 is always the lowest
-    frequency regardless of on-disk order; io/rfimask.py docstring).
-    ``get_samples`` (filterbank) returns on-disk order, flipped here when
-    foff < 0; the ``get_spectra`` fallback (PSRFITS) delivers high-
-    frequency-first Spectra, always flipped."""
-    total = int(reader.nspec)
-    raw = hasattr(reader, "get_samples")
+    PSRFITS / multi-file (fbobs) reader — the .mask channel convention
+    (PRESTO reorders every band ascending on read, so mask channel 0 is
+    always the lowest frequency regardless of on-disk order;
+    io/rfimask.py docstring). ``get_samples`` (filterbank) and
+    ``get_sample_interval`` (fbobs) return on-disk order, flipped here
+    when the band is descending; the ``get_spectra`` fallback (PSRFITS)
+    delivers high-frequency-first Spectra, always flipped."""
+    total = int(getattr(reader, "nspec", None)
+                or reader.number_of_samples)
+    get_samples = getattr(reader, "get_samples", None)
+    get_interval = getattr(reader, "get_sample_interval", None)
+    raw = get_samples is not None or get_interval is not None
     if raw:
         f = np.asarray(reader.frequencies, dtype=float)  # on-disk order
         flip = len(f) > 1 and f[0] > f[-1]
@@ -258,8 +266,12 @@ def _iter_file_blocks(reader, samples_per_read: int):
     pos = 0
     while pos < total:
         n = min(samples_per_read, total - pos)
-        d = (reader.get_samples(pos, n).T if raw
-             else np.asarray(reader.get_spectra(pos, n).data))
+        if get_samples is not None:
+            d = get_samples(pos, n).T
+        elif get_interval is not None:
+            d = get_interval(pos, pos + n).T
+        else:
+            d = np.asarray(reader.get_spectra(pos, n).data)
         yield d[::-1] if flip else d
         pos += n
 
@@ -322,6 +334,8 @@ def rfifind(
                 mjd = float(np.atleast_1d(source.specinfo.start_MJD)[0])
             except (AttributeError, TypeError, IndexError):
                 pass
+        if not mjd and hasattr(source, "startmjds"):  # fbobs multi-file
+            mjd = float(np.atleast_1d(source.startmjds)[0])
         blocks = None
 
     pts = max(int(round(time / dt)), 2)

@@ -115,6 +115,56 @@ def test_end_to_end_mask_file(tmp_path):
     assert stats.mean.shape == (12, 32)
 
 
+def test_rfifind_psrfits_reader(tmp_path):
+    """Mask generation from a PSRFITS file: the get_spectra fallback path
+    (always flipped to low-first) finds the same loud channel."""
+    from pypulsar_tpu.io import psrfits
+    from pypulsar_tpu.ops.rfifind import rfifind as run_rfifind
+
+    C, T = 16, 8 * 256
+    rng = np.random.RandomState(4)
+    # write_psrfits takes [chan, time] with ascending freqs (file order)
+    data = rng.randn(C, T).astype(np.float32) * 2.0 + 10.0
+    data[3] *= 25.0  # loud channel, file order = mask channel 3
+    freqs = 1400.0 + 1.0 * np.arange(C)
+    fn = str(tmp_path / "rfi.fits")
+    psrfits.write_psrfits(fn, data, freqs, tsamp=1e-3,
+                          nsamp_per_subint=256, nbits=32)
+    with psrfits.PsrfitsFile(fn) as pf:
+        stats, flags, _ = run_rfifind(pf, time=0.256)
+    assert stats.nchan == C and stats.nint == 8
+    assert flags[:, 3].all()
+    clean = np.delete(flags, 3, axis=1)
+    assert clean.mean() < 0.1
+
+
+def test_rfifind_fbobs_multifile(tmp_path):
+    """Mask generation across a multi-file observation (fbobs reader)."""
+    from pypulsar_tpu.io.fbobs import FilterbankObs
+    from pypulsar_tpu.io.filterbank import write_filterbank
+    from pypulsar_tpu.ops.rfifind import rfifind as run_rfifind
+
+    C, Tpart, dt = 16, 1024, 1e-3
+    rng = np.random.RandomState(5)
+    hdr = dict(telescope_id=1, machine_id=2, source_name="MULTI",
+               src_raj=0.0, src_dej=0.0, tsamp=dt, fch1=1500.0,
+               foff=-2.0, nchans=C, nbits=32, nifs=1)
+    fns = []
+    for i in range(3):
+        data = rng.randn(Tpart, C).astype(np.float32)
+        data[:, 2] *= 25.0  # loud in file order (hi-first row 2)
+        fn = str(tmp_path / f"part{i}.fil")
+        write_filterbank(fn, dict(hdr, tstart=56000.0 + i * Tpart * dt
+                                  / 86400.0), data)
+        fns.append(fn)
+    obs = FilterbankObs(fns)
+    stats, flags, _ = run_rfifind(obs, time=0.256)
+    assert stats.nint == 12  # 3 files x 1024 samples / 256
+    # file order hi-first: loud row 2 -> mask channel C-1-2
+    assert flags[:, C - 1 - 2].all()
+    assert stats.mjd == 56000.0
+
+
 def test_partial_tail_interval_padding():
     # 3 full intervals + 60% of one more: the tail becomes interval 4
     data = RNG.randn(8, 3 * 200 + 120).astype(np.float32)
