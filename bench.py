@@ -170,7 +170,11 @@ def run_benchmark(args):
     import jax.numpy as jnp
     from pypulsar_tpu.core.spectra import Spectra
     from pypulsar_tpu.ops import numpy_ref
-    from pypulsar_tpu.parallel import make_sweep_plan, sweep_spectra
+    from pypulsar_tpu.parallel import (
+        choose_group_size,
+        make_sweep_plan,
+        sweep_spectra,
+    )
     from pypulsar_tpu.parallel.sweep import resolve_engine, sweep_resident
 
     dt = 64e-6
@@ -181,6 +185,9 @@ def run_benchmark(args):
 
     freqs = (1500.0 - 300.0 / C * np.arange(C)).astype(np.float64)
     dms = np.linspace(0.0, args.dm_max, D)
+    # stage-1 group from the smearing bound: dense trial grids afford
+    # larger groups (measured 25% faster at g=64, BENCHNOTES.md)
+    group = max(group, choose_group_size(dms, freqs, dt, nsub))
     plan = make_sweep_plan(dms, freqs, dt, nsub=nsub, group_size=group)
     if args.cpu_fallback or args.quick:
         from pypulsar_tpu.ops.fourier_dedisperse import fourier_chunk_len

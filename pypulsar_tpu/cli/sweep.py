@@ -104,8 +104,11 @@ def main(argv=None):
                     help="DDplan acceptable time resolution (ms)")
     ap.add_argument("-s", "--nsub", type=int, default=64,
                     help="sweep-engine subbands (two-stage dedispersion)")
-    ap.add_argument("--group-size", type=int, default=32,
-                    help="DM trials per stage-1 group")
+    ap.add_argument("--group-size", type=int, default=0,
+                    help="DM trials per stage-1 group; 0 (default) picks "
+                         "the largest group whose extra subband smearing "
+                         "stays under one sample (25%% faster at dense "
+                         "trial spacing, measured BENCHNOTES.md)")
     ap.add_argument("--downsamp", type=int, default=1,
                     help="flat-mode downsample factor")
     ap.add_argument("--chunk", type=int, default=None,

@@ -2,6 +2,7 @@ from pypulsar_tpu.parallel.mesh import make_mesh  # noqa: F401
 from pypulsar_tpu.parallel.sweep import (  # noqa: F401
     SweepCheckpoint,
     SweepPlan,
+    choose_group_size,
     make_sweep_plan,
     resolve_engine,
     sweep_spectra,

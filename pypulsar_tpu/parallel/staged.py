@@ -272,11 +272,17 @@ def _run_step(src, dms, factor: int, nsub: int, group_size: int,
               engine: str = "auto",
               keep_chunk_peaks: bool = False,
               ckpt_extra: str = "") -> Optional[StepResult]:
-    """Sweep one DM block over ``src`` downsampled by ``factor``."""
+    """Sweep one DM block over ``src`` downsampled by ``factor``.
+    ``group_size`` <= 0 picks the largest group within the default
+    smearing bound (parallel.sweep.choose_group_size)."""
     dt_eff = src.tsamp * factor
     n_ds = src.nsamples // factor
     if n_ds == 0:
         return None
+    if group_size <= 0:
+        from pypulsar_tpu.parallel.sweep import choose_group_size
+
+        group_size = choose_group_size(dms, src.frequencies, dt_eff, nsub)
     pad_groups_to = None
     if mesh is not None:
         ndm = mesh.shape["dm"]

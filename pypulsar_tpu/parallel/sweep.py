@@ -79,6 +79,44 @@ def resolve_engine(engine: str = "auto") -> str:
     return "fourier" if platform == "tpu" else "gather"
 
 
+def choose_group_size(
+    dms,
+    freqs,
+    dt: float,
+    nsub: int = 64,
+    max_extra_smear_bins: float = 1.0,
+    max_group: int = 128,
+) -> int:
+    """Largest power-of-two stage-1 group size whose extra subband
+    smearing stays under ``max_extra_smear_bins`` samples.
+
+    Stage 1 dedisperses each subband at the GROUP's mean DM; a trial at
+    the group edge is off by ``(g/2) * dDM``, smearing the worst (lowest)
+    subband by ``dm_smear(dDM_off, BW_sub, f_low)``. Larger groups
+    amortize the expensive full-channel stage-1 pass over more trials —
+    the measured v5e geometry grid (BENCHNOTES.md) has (nsub=64, g=64)
+    25% faster than g=32 — and at dense trial spacing (the 4096-trial
+    north-star grid has dDM ~ 0.12) the smearing cost of g=64-128 is a
+    fraction of a sample. This chooser makes that tradeoff explicit:
+    DDplan's own numsub/dsubDM machinery, applied to the engine geometry
+    (reference utils/DDplan2b.py:132-150 is the same bound for its
+    subband steps)."""
+    dms = np.asarray(dms, dtype=np.float64)
+    if len(dms) < 2:
+        return 1
+    ddm = float(np.max(np.abs(np.diff(dms))))
+    freqs = np.asarray(freqs, dtype=np.float64)
+    f_low = float(freqs.min())
+    bw_sub = float(abs(freqs.max() - freqs.min())) / nsub
+    g = 1
+    while g * 2 <= max_group:  # honors non-power-of-two caps too
+        off = g * ddm  # next candidate's worst-case offset = (2g/2)*ddm
+        if psrmath.dm_smear(off, bw_sub, f_low) > max_extra_smear_bins * dt:
+            break
+        g *= 2
+    return g
+
+
 @dataclasses.dataclass(frozen=True)
 class SweepPlan:
     """Host-side precomputed geometry of a sweep.
@@ -141,6 +179,8 @@ def make_sweep_plan(
     """
     dms = np.asarray(dms, dtype=np.float64)
     freqs = np.asarray(freqs, dtype=np.float64)
+    if group_size <= 0:  # auto: largest group within the smearing bound
+        group_size = choose_group_size(dms, freqs, dt, nsub)
     C = len(freqs)
     if C > 1 and not np.all(np.diff(freqs) <= 0):
         raise ValueError(
@@ -847,6 +887,8 @@ def sweep_spectra(spectra, dms, nsub=64, group_size=32, widths=DEFAULT_WIDTHS,
     """Convenience: sweep an in-memory (possibly device-resident) Spectra
     over ``dms``; chunks are device-side slices, no host round-trips."""
     freqs = np.asarray(spectra.freqs, dtype=np.float64)
+    if group_size <= 0:
+        group_size = choose_group_size(dms, freqs, spectra.dt, nsub)
     if pad_groups_to is None:
         pad_groups_to = _mesh_pad_groups(len(dms), group_size, mesh)
     plan = make_sweep_plan(dms, freqs, spectra.dt, nsub=nsub, group_size=group_size,
@@ -895,6 +937,8 @@ def sweep_resident(spectra, dms, nsub=64, group_size=32, widths=DEFAULT_WIDTHS,
     """
     engine = resolve_engine(engine)
     freqs = np.asarray(spectra.freqs, dtype=np.float64)
+    if group_size <= 0:
+        group_size = choose_group_size(dms, freqs, spectra.dt, nsub)
     if pad_groups_to is None:
         pad_groups_to = _mesh_pad_groups(len(dms), group_size, mesh)
     plan = make_sweep_plan(dms, freqs, spectra.dt, nsub=nsub,

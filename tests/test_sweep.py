@@ -332,6 +332,33 @@ def test_checkpoint_kill_and_resume_bit_exact(tmp_path):
     assert not os.path.exists(ck_path), "checkpoint not cleaned up"
 
 
+def test_choose_group_size_scales_with_trial_density():
+    from pypulsar_tpu.parallel import choose_group_size
+
+    freqs = (1500.0 - 300.0 / 1024 * np.arange(1024)).astype(np.float64)
+    dt = 64e-6
+    # dDM ~ 0.031 / 0.12 / 7.9 pc/cm^3
+    denser = np.linspace(0.0, 500.0, 16384)
+    dense = np.linspace(0.0, 500.0, 4096)
+    sparse = np.linspace(0.0, 500.0, 64)
+    g_denser = choose_group_size(denser, freqs, dt, nsub=64)
+    g_dense = choose_group_size(dense, freqs, dt, nsub=64)
+    g_sparse = choose_group_size(sparse, freqs, dt, nsub=64)
+    assert g_denser > g_dense > g_sparse  # monotone in trial density
+    assert g_denser == 128  # hits max_group
+    assert g_sparse <= 4
+    assert choose_group_size([10.0], freqs, dt) == 1  # single trial
+    # the chosen group's own smearing respects the bound
+    from pypulsar_tpu.core import psrmath
+
+    bw_sub = 300.0 / 64
+    for g, dms in ((g_dense, dense), (g_sparse, sparse)):
+        ddm = float(np.diff(dms)[0])
+        # worst trial sits ((g-1)/2) steps from the group mean DM
+        assert psrmath.dm_smear(((g - 1) / 2) * ddm, bw_sub,
+                                float(freqs.min())) <= 1.0 * dt
+
+
 def test_checkpoint_resume_with_chunk_peaks(tmp_path):
     """keep_chunk_peaks persists through a kill-and-resume: the multi-
     event list matches the uninterrupted run exactly, and a checkpoint
