@@ -27,7 +27,6 @@ import scipy.stats
 
 from pypulsar_tpu.cli import show_or_save, use_headless_backend_if_needed
 from pypulsar_tpu.fourier.prestofft import PrestoFFT
-from pypulsar_tpu.utils.detrend import old_detrend
 
 BLOCKSIZE = 10000
 SMOOTHFACTOR = 10
@@ -101,27 +100,48 @@ def gen_mask(freqs, powerspec, nsig=3.5) -> np.ndarray:
 def hone_mask(freqs, powerspec, inmask, nsig) -> np.ndarray:
     """One iteration of mask improvement: per-block masked quadratic
     log-log detrend, threshold at nsig * unmasked std (reference
-    autozap.py:195-243)."""
-    outmask = np.zeros(powerspec.size, dtype=bool)
-    for block in range(0, powerspec.size, BLOCKSIZE):
-        blockend = min(block + BLOCKSIZE, powerspec.size)
+    autozap.py:195-243).
+
+    All blocks' masked fits run as ONE device batch
+    (utils.detrend.detrend_blocks); the reference looped a host lstsq
+    per block. Blocks are padded to a common length with omitted cells
+    (weight 0 in the fit), preserving the ragged last block and the
+    SMOOTHFACTOR edge overlaps exactly."""
+    from pypulsar_tpu.utils.detrend import detrend_blocks
+
+    n = powerspec.size
+    starts = list(range(0, n, BLOCKSIZE))
+    L = BLOCKSIZE + 2 * SMOOTHFACTOR
+    B = len(starts)
+    yb = np.zeros((B, L), dtype=np.float64)
+    xb = np.zeros((B, L), dtype=np.float64)
+    omit = np.ones((B, L), dtype=bool)
+    spans = []  # (lo, blocklen) per block, for output extraction
+    for bi, block in enumerate(starts):
+        blockend = min(block + BLOCKSIZE, n)
         # overlap blocks so smoothing doesn't de-weight block edges
         lo = SMOOTHFACTOR if block - SMOOTHFACTOR >= 0 else 0
-        hi = SMOOTHFACTOR if blockend + SMOOTHFACTOR < powerspec.size else 0
-        spec_block = powerspec[block - lo:blockend + hi]
-        freq_block = freqs[block - lo:blockend + hi]
-        mask_block = inmask[block - lo:blockend + hi]
-        if mask_block.all():
+        hi = SMOOTHFACTOR if blockend + SMOOTHFACTOR < n else 0
+        sl = slice(block - lo, blockend + hi)
+        m = sl.stop - sl.start
+        yb[bi, :m] = np.log10(powerspec[sl])
+        xb[bi, :m] = np.log10(freqs[sl])
+        omit[bi, :m] = inmask[sl]
+        spans.append((lo, blockend - block, m))
+
+    detrended = detrend_blocks(yb, xb, omit, order=2)
+
+    outmask = np.zeros(n, dtype=bool)
+    for bi, (block, (lo, blocklen, m)) in enumerate(zip(starts, spans)):
+        if omit[bi, :m].all():
             # fully masked block: keep it masked (an empty unmasked
             # selection would give a NaN std and silently clear it)
-            outmask[block:blockend] = True
+            outmask[block:block + blocklen] = True
             continue
-        detrended = old_detrend(np.log10(spec_block),
-                                xdata=np.log10(freq_block),
-                                mask=mask_block, order=2)
-        std_block = detrended[~mask_block].std()
-        smoothed = smooth(detrended, SMOOTHFACTOR)[lo:detrended.size - hi]
-        outmask[block:blockend] = smoothed > (std_block * nsig)
+        d = detrended[bi, :m]
+        std_block = d[~omit[bi, :m]].std()
+        smoothed = smooth(d, SMOOTHFACTOR)[lo:lo + blocklen]
+        outmask[block:block + blocklen] = smoothed > (std_block * nsig)
     return outmask
 
 

@@ -3,7 +3,16 @@
 Like scipy.signal.detrend but masked-array aware: masked samples are omitted
 from the fit while the polynomial is still subtracted everywhere. Used by the
 zaplist pipeline's iterative masked log-log honing (bin/autozap.py:196-244).
+
+TPU-era addition: :func:`detrend_blocks` batches the masked fit over a
+stack of blocks as ONE jitted weighted-least-squares solve (masked cells
+get weight zero in the normal equations; per-block x centering/scaling
+keeps the Vandermonde well-conditioned in float32). The zaplist honing
+loop's per-block host lstsq calls collapse into a single device dispatch
+(cli/autozap.py).
 """
+
+from functools import partial
 
 import numpy as np
 import scipy.linalg
@@ -52,6 +61,76 @@ def detrend(ydata, xdata=None, order=1, bp=[], numpieces=None):
     if np.ma.isMaskedArray(ydata):
         return detrended
     return detrended.data
+
+
+def detrend_blocks(y, x, omit, order=1):
+    """Masked polynomial detrend of a BLOCK STACK on device.
+
+    ``y``/``x``/``omit`` are [B, L]: B independent blocks of L samples
+    with per-cell omit masks (True = excluded from the fit, still
+    detrended in the output). Equivalent to ``old_detrend`` applied per
+    block, but the B fits run as one compiled weighted-least-squares
+    batch: omitted cells get weight 0 in the normal equations
+    ``(A^T W A) c = A^T W y``, and x is centered/scaled per block over
+    its kept cells so the (order+1)^2 system stays well-conditioned in
+    float32. Blocks with no kept cells return y unchanged (callers keep
+    them masked). Returns a [B, L] float32 array.
+    """
+    import jax.numpy as jnp
+
+    out = _detrend_blocks_jit(
+        jnp.asarray(np.asarray(y, dtype=np.float32)),
+        jnp.asarray(np.asarray(x, dtype=np.float32)),
+        jnp.asarray(~np.asarray(omit, dtype=bool)),
+        int(order),
+    )
+    return np.asarray(out)
+
+
+_DETREND_BLOCKS_JIT = None  # built on first use: keeps `import
+# pypulsar_tpu.utils.detrend` jax-free for the host-only helpers
+
+
+def _detrend_blocks_jit(y, x, keep, order):
+    global _DETREND_BLOCKS_JIT
+    if _DETREND_BLOCKS_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, static_argnames=("order",))
+        def run(y, x, keep, order):
+            # zero-weighting alone is NOT exclusion: 0 * (-inf or NaN)
+            # is NaN and would poison the whole block's fit (log10 of a
+            # zeroed power bin is -inf), so non-finite cells are dropped
+            # from the FIT while the returned y - fit still carries the
+            # original values everywhere (old_detrend semantics)
+            finite = jnp.isfinite(y) & jnp.isfinite(x)
+            w = (keep & finite).astype(jnp.float32)  # [B, L]
+            y_fit = jnp.where(finite, y, 0.0)
+            x_fit = jnp.where(finite, x, 0.0)
+            n = jnp.maximum(w.sum(axis=1, keepdims=True), 1.0)
+            # center + scale x over kept cells: Vandermonde stays O(1)
+            xc = (x_fit * w).sum(axis=1, keepdims=True) / n
+            xs = jnp.sqrt((w * (x_fit - xc) ** 2).sum(axis=1,
+                                                      keepdims=True) / n)
+            xn = (x_fit - xc) / jnp.maximum(xs, 1e-12)
+            A = xn[:, :, None] ** jnp.arange(order + 1)  # [B, L, k]
+            Aw = A * w[:, :, None]
+            M = jnp.einsum("bli,blj->bij", Aw, A)
+            r = jnp.einsum("bli,bl->bi", Aw, y_fit)
+            # tiny ridge: blocks with fewer kept cells than coefficients
+            # would otherwise be singular (minimum-norm-ish, never NaN)
+            M = M + 1e-6 * jnp.eye(order + 1)
+            c = jnp.linalg.solve(M, r[..., None])[..., 0]  # [B, k]
+            # evaluate the polynomial at the TRUE (finite) x positions
+            An = ((x - xc) / jnp.maximum(xs, 1e-12))[:, :, None] \
+                ** jnp.arange(order + 1)
+            fit = jnp.einsum("bli,bi->bl", An, c)
+            any_kept = (w > 0).any(axis=1, keepdims=True)
+            return jnp.where(any_kept, y - fit, y)
+
+        _DETREND_BLOCKS_JIT = run
+    return _DETREND_BLOCKS_JIT(y, x, keep, order)
 
 
 def fit_poly(ydata, xdata, order=1):

@@ -214,3 +214,49 @@ class TestColour:
     def test_bad_colour_raises(self):
         with pytest.raises(ValueError):
             colour.cstring("x", fg="chartreuse")
+
+
+class TestDetrendBlocks:
+    def test_matches_old_detrend_per_block(self):
+        from pypulsar_tpu.utils.detrend import detrend_blocks
+
+        rng = np.random.RandomState(0)
+        B, L = 6, 400
+        x = np.sort(rng.uniform(1.0, 3.0, size=(B, L)), axis=1)
+        y = (0.5 + 1.5 * x - 0.3 * x**2
+             + 0.05 * rng.randn(B, L))
+        omit = rng.rand(B, L) < 0.2
+        omit[2] = False  # one fully-kept block
+        got = detrend_blocks(y, x, omit, order=2)
+        for b in range(B):
+            ref = old_detrend(y[b], xdata=x[b], mask=omit[b], order=2)
+            np.testing.assert_allclose(got[b], ref, atol=2e-3)
+
+    def test_fully_omitted_block_passes_through(self):
+        from pypulsar_tpu.utils.detrend import detrend_blocks
+
+        y = np.ones((2, 16))
+        x = np.tile(np.arange(16.0), (2, 1))
+        omit = np.zeros((2, 16), dtype=bool)
+        omit[1] = True  # nothing to fit: y returned unchanged
+        out = detrend_blocks(y, x, omit, order=1)
+        np.testing.assert_allclose(out[0], 0.0, atol=1e-5)
+        np.testing.assert_allclose(out[1], 1.0)
+
+    def test_nonfinite_masked_cells_do_not_poison_the_fit(self):
+        """log10 of a zeroed power bin is -inf; once masked it must be
+        EXCLUDED from the fit (0 * -inf = NaN would otherwise poison the
+        whole block), while the output still carries the original cell."""
+        from pypulsar_tpu.utils.detrend import detrend_blocks
+
+        rng = np.random.RandomState(1)
+        L = 200
+        x = np.linspace(1.0, 2.0, L)[None]
+        y = (3.0 + 2.0 * x + 0.01 * rng.randn(1, L))
+        y[0, 50] = -np.inf  # masked non-finite cell
+        omit = np.zeros((1, L), dtype=bool)
+        omit[0, 50] = True
+        out = detrend_blocks(y, x, omit, order=1)
+        assert np.isfinite(np.delete(out[0], 50)).all()
+        assert np.abs(np.delete(out[0], 50)).max() < 0.1
+        assert out[0, 50] == -np.inf  # original value minus finite fit
