@@ -32,12 +32,19 @@ def _open_reader(fn: str):
     return filterbank.FilterbankFile(fn)
 
 
-def _write_cands(path, cands):
+def _write_cands(path, cands, extra_cols=()):
+    """Write candidate/event/pulse rows; ``extra_cols`` appends
+    (header, key, fmt) columns after the shared six."""
     with open(path, "w") as f:
-        f.write("# DM      SNR      time_s       sample    width_bins  downsamp\n")
+        f.write("# DM      SNR      time_s       sample    width_bins  "
+                "downsamp" + "".join("  " + h for h, _, _ in extra_cols)
+                + "\n")
         for c in cands:
             f.write(f"{c['dm']:<9.4f} {c['snr']:<8.3f} {c['time_sec']:<12.6f} "
-                    f"{c['sample']:<9d} {c['width_bins']:<11d} {c['downsamp']}\n")
+                    f"{c['sample']:<9d} {c['width_bins']:<11d} "
+                    f"{c['downsamp']:<8d}"
+                    + "".join("  " + fmt % c[k] for _, k, fmt in extra_cols)
+                    + "\n")
 
 
 def _write_dats(outbase, reader, dms, downsamp, rfimask=None):
@@ -130,6 +137,12 @@ def main(argv=None):
                          "per block with median-mid80 fill")
     ap.add_argument("--write-dats", action="store_true",
                     help="flat mode: also write per-DM .dat/.inf series")
+    ap.add_argument("--group-time-tol", type=float, default=None,
+                    help="event-grouping time tolerance in seconds "
+                         "(default: 4x the widest boxcar)")
+    ap.add_argument("--group-dm-tol", type=float, default=None,
+                    help="event-grouping DM tolerance (default: 3x the "
+                         "trial step, floor 1)")
     ap.add_argument("--all-events", action="store_true",
                     help="flat mode: record the strongest peak per "
                          "streaming chunk for every (DM, width) and write "
@@ -234,9 +247,24 @@ def main(argv=None):
     hits = staged.above_threshold(args.threshold)
     _write_cands(outbase + ".cands", hits)
     if args.all_events:
+        from pypulsar_tpu.parallel.events import group_events
+
         events = staged.events(args.threshold)
         _write_cands(outbase + ".events", events)
-        print(f"# {len(events)} above-threshold events -> {outbase}.events")
+        # grouping tolerances follow the search grid unless overridden:
+        # one pulse spans adjacent trials (DM) and boxcar widths (time)
+        dm_tol = (args.group_dm_tol if args.group_dm_tol is not None
+                  else max(3.0 * args.dmstep, 1.0))
+        time_tol = (args.group_time_tol if args.group_time_tol is not None
+                    else 4.0 * max(e["width_sec"] for e in events)
+                    if events else 0.02)
+        pulses = group_events(events, time_tol=time_tol, dm_tol=dm_tol)
+        _write_cands(outbase + ".pulses", pulses, extra_cols=(
+            ("n_hits", "n_hits", "%-7d"), ("dm_lo", "dm_lo", "%-8.3f"),
+            ("dm_hi", "dm_hi", "%-8.3f")))
+        print(f"# {len(events)} above-threshold events -> {outbase}.events; "
+              f"{len(pulses)} grouped pulses -> {outbase}.pulses "
+              f"(time_tol={time_tol:.4g}s, dm_tol={dm_tol:.4g})")
     print(f"# {staged.n_trials} DM trials swept; {len(hits)} detections "
           f">= {args.threshold} sigma -> {outbase}.cands")
     for c in staged.best(args.topk):

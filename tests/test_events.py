@@ -1,0 +1,66 @@
+"""Event grouping (parallel/events.py): friends-of-friends association
+of the sweep's per-(DM, width, chunk) events into pulse candidates."""
+
+import numpy as np
+
+from pypulsar_tpu.parallel.events import group_events
+
+
+def ev(dm, snr, t, sample=0, width=1, ds=1):
+    return dict(dm=dm, snr=snr, time_sec=t, sample=sample,
+                width_bins=width, downsamp=ds)
+
+
+def test_one_pulse_many_trials_collapses_to_one_group():
+    # a bright pulse detected across 20 adjacent DM trials and 3 widths
+    events = [ev(30 + 0.5 * i, 10 - 0.1 * i, 5.0 + 1e-4 * i, width=w)
+              for i in range(20) for w in (1, 2, 4)]
+    groups = group_events(events)
+    assert len(groups) == 1
+    g = groups[0]
+    assert g["n_hits"] == 60
+    assert g["snr"] == 10.0 and g["dm"] == 30.0  # peak member kept
+    assert g["dm_lo"] == 30.0 and g["dm_hi"] == 39.5
+
+
+def test_pulses_separated_in_time_stay_apart():
+    events = [ev(30, 9, 5.0), ev(30.5, 8, 5.001),
+              ev(31, 12, 50.0), ev(30, 7, 50.005)]
+    groups = group_events(events)
+    assert len(groups) == 2
+    assert groups[0]["snr"] == 12 and groups[0]["n_hits"] == 2
+    assert groups[1]["snr"] == 9 and groups[1]["n_hits"] == 2
+
+
+def test_coincident_but_dm_distant_events_stay_apart():
+    # same instant, wildly different DM: different phenomena
+    events = [ev(5, 9, 5.0), ev(400, 8, 5.0)]
+    groups = group_events(events, dm_tol=10.0)
+    assert len(groups) == 2
+
+
+def test_transitive_time_chaining():
+    # each event within tol of its neighbor, ends far apart: one group
+    events = [ev(20, 5 + i, 1.0 + 0.015 * i) for i in range(10)]
+    groups = group_events(events, time_tol=0.02)
+    assert len(groups) == 1
+    assert groups[0]["time_hi"] - groups[0]["time_lo"] > 0.1
+
+
+def test_empty_and_ordering():
+    assert group_events([]) == []
+    groups = group_events([ev(10, 6, 1.0), ev(50, 9, 30.0)])
+    assert [g["snr"] for g in groups] == [9, 6]  # descending peak SNR
+
+
+def test_bridging_event_merges_open_groups():
+    """True friends-of-friends: an event within tolerance of TWO open
+    groups fuses them into one (greedy first-match would report one
+    physical pulse as two rows)."""
+    events = [ev(30, 9, 5.0000), ev(50, 8, 5.0001), ev(40, 7, 5.0002)]
+    groups = group_events(events, time_tol=0.02, dm_tol=10.0)
+    assert len(groups) == 1
+    g = groups[0]
+    assert g["n_hits"] == 3
+    assert (g["dm_lo"], g["dm_hi"]) == (30, 50)
+    assert g["snr"] == 9  # peak survives the merge
