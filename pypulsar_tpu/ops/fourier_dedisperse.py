@@ -15,7 +15,12 @@ the Fourier domain, so the whole two-stage shift-and-sum becomes
     ts = irfft(Xts)[:, :out_len]
 
 — batched power-of-two FFTs plus *elementwise multiply-reduce* streams,
-the access pattern XLA fuses to full bandwidth on TPU. Phases compose
+the access pattern XLA fuses to full bandwidth on TPU. The default
+``phase_mode='factored'`` further factors the frequency-bin axis
+(k = M*hi + lo) so the per-shift phase costs ~2*sqrt(F) transcendentals
+instead of F — the round-3 profile showed the stages were
+phase-generation-bound at ~92G cos-sin/s, and this lifted the measured
+chunk time from 323 ms to 146 ms on v5e (BENCHNOTES.md round-4 A/B). Phases compose
 additively, so the total integer shift per channel is EXACTLY the same
 ``s1 + s2`` the time-domain path applies: results agree to FFT f32
 rounding (~1e-6 relative), inside the sweep's SNR parity contract
@@ -79,6 +84,21 @@ def _phase_table(max_shift: int, k, n_fft: int, stride: int = 1):
 _LUT_LO = 64  # stage-2 shifts factor as s = 64*hi + lo; tables stay ~100 MB
 
 
+def _fact_split(F: int) -> int:
+    """Power-of-two M minimizing ceil(F/M) + M — the per-shift
+    transcendental count of the bin-axis factorization below."""
+    best, best_cost = 1, F + 1
+    m = 1
+    while m <= F:
+        cost = -(-F // m) + m
+        if cost < best_cost:
+            best, best_cost = m, cost
+        m <<= 1
+    return best
+
+
+
+
 def sweep_chunk_fourier_impl(
     data,
     stage1_bins,
@@ -89,7 +109,7 @@ def sweep_chunk_fourier_impl(
     stat_len: int,
     n_fft: int,
     boxcar_backend: str = "auto",
-    phase_mode: str = "direct",
+    phase_mode: str = "factored",
     max_shift1: int = 0,
     max_shift2: int = 0,
 ):
@@ -100,18 +120,23 @@ def sweep_chunk_fourier_impl(
     Returns per-trial (sum[D], sumsq[D], maxbox[D, W], argbox[D, W]) with
     window starts confined to the first ``stat_len`` samples.
 
-    ``phase_mode``: 'direct' (default) computes cos/sin per element;
-    'lut' gathers per-shift phase rows from tables built once per
-    dispatch, stage 2 factoring ``s = 64*hi + lo`` into two table rows
-    and one complex multiply. Both use the same exact int32-wraparound
-    index math; they differ by the one extra f32 complex multiply
-    (~1e-7 relative), inside the sweep's SNR parity budget. Measured
-    verdict on v5e (round 3): an ISOLATED stage-2 LUT beat the
-    transcendental version ~2x, but inside this fused scan the gathers
-    do not amortize and the whole chunk ran 2x SLOWER (646 vs 323 ms at
-    the bench geometry) — the VPU's transcendental throughput is not
-    the bottleneck here. 'lut' is kept selectable for future
-    toolchains; it needs the static bounds ``max_shift1``/``max_shift2``
+    ``phase_mode``: 'factored' (default) factors the BIN axis
+    (k = M*hi + lo => W^(s*k) = W^((s*M)*hi) * W^(s*lo)) so each shift
+    costs ~2*sqrt(F) cos/sin pairs instead of F, applied as two rank-3
+    broadcast complex multiplies over the spectrum viewed as [C, Fh, M]
+    — gather-free, no F-length phase row ever materialized. 'direct'
+    computes cos/sin per element; 'lut' gathers per-shift phase rows
+    from tables built once per dispatch, stage 2 factoring
+    ``s = 64*hi + lo`` into two table rows and one complex multiply.
+    All use the same exact int32-wraparound index math; factored/lut
+    differ from direct by one extra f32 complex multiply (~3e-7
+    relative), inside the sweep's SNR parity budget. Measured on v5e
+    (round-4 A/B, bench geometry, 1024-trial chunk): factored 146 ms
+    vs direct 323 ms vs lut 646 ms — the round-3 "transcendental
+    floor" was real (the stages were phase-generation-bound) and the
+    bin-axis factorization removes it; the earlier LUT attempt lost
+    because it factored the SHIFT axis and paid per-element gathers.
+    'lut' needs the static bounds ``max_shift1``/``max_shift2``
     (<=0 falls back to 'direct').
     """
     C, L = data.shape
@@ -126,6 +151,34 @@ def sweep_chunk_fourier_impl(
         t1 = _phase_table(max_shift1, k, n_fft)  # [max1+1, F]
         t_hi = _phase_table(max_shift2, k, n_fft, stride=_LUT_LO)
         t_lo = _phase_table(min(_LUT_LO - 1, max_shift2), k, n_fft)
+
+    if phase_mode == "factored":
+        # Bin-axis factorization k = M*hi + lo: view the spectrum as
+        # [C, Fh, M] (zero-padded to Fh*M bins) and apply the phase as two
+        # rank-3 broadcast multiplies — hi along axis 1, lo along axis 2 —
+        # so no F-length phase row is ever materialized and each shift
+        # costs only Fh + M ~ 2*sqrt(F) cos/sin pairs.
+        M = _fact_split(F)
+        Fh = -(-F // M)
+        k_hi = jnp.arange(Fh, dtype=jnp.int32)
+        k_lo = jnp.arange(M, dtype=jnp.int32)
+        Xp = jnp.pad(X, ((0, 0), (0, Fh * M - F))).reshape(C, Fh, M)
+
+        def per_group_fact(carry, xs):
+            s1, s2 = xs  # [C], [g, S]
+            hi1 = _phase(s1 * jnp.int32(M), k_hi, n_fft)  # [C, Fh]
+            lo1 = _phase(s1, k_lo, n_fft)                 # [C, M]
+            xsub = (Xp * hi1[:, :, None] * lo1[:, None, :]) \
+                .reshape(nsub, per, Fh, M).sum(axis=1)     # [S, Fh, M]
+            hi2 = _phase(s2 * jnp.int32(M), k_hi, n_fft)  # [g, S, Fh]
+            lo2 = _phase(s2, k_lo, n_fft)                 # [g, S, M]
+            xts = (xsub[None] * hi2[..., None] * lo2[..., None, :]) \
+                .sum(axis=1)                               # [g, Fh, M]
+            xts = xts.reshape(-1, Fh * M)[:, :F]
+            ts = jnp.fft.irfft(xts, n=n_fft, axis=1)[:, :out_len]
+            s, ss, mb_g, ab_g = boxcar_stats(ts, widths, stat_len,
+                                             backend=boxcar_backend)
+            return carry, (s, ss, mb_g, ab_g)
 
     def per_group(carry, xs):
         s1, s2 = xs  # [C], [g, S]
@@ -142,7 +195,8 @@ def sweep_chunk_fourier_impl(
                                          backend=boxcar_backend)
         return carry, (s, ss, mb_g, ab_g)
 
-    _, (s, ss, mb, ab) = jax.lax.scan(per_group, 0, (stage1_bins, stage2_bins))
+    body = per_group_fact if phase_mode == "factored" else per_group
+    _, (s, ss, mb, ab) = jax.lax.scan(body, 0, (stage1_bins, stage2_bins))
     D = G * g
     return (
         s.reshape(D),
