@@ -132,7 +132,7 @@ class FilterbankFile:
 
     def iter_blocks(
         self, block_size: int, overlap: int = 0, start: int = 0,
-        end: Optional[int] = None, prefetch: bool = True,
+        end: Optional[int] = None, prefetch: bool = True, raw: bool = False,
     ) -> Iterator[Tuple[int, np.ndarray]]:
         """Stream [time, chan] blocks with ``overlap`` samples of lookahead
         beyond each block (overlap-save for chunked dedispersion; the TPU
@@ -143,6 +143,12 @@ class FilterbankFile:
         (pypulsar_tpu.native.PrefetchReader, prefetch.cpp), so disk reads
         overlap device compute; falls back to synchronous reads when the
         native library is unavailable.
+
+        ``raw`` yields blocks in the file's native dtype instead of
+        float32: an 8-bit file then ships 1 byte/sample to the device,
+        where the f32 cast is exact and fused — through a remote-
+        accelerator link the host->device transfer is the streamed
+        sweep's bottleneck, so the 4x matters (BENCHNOTES.md round 4).
 
         Yields (startsamp, block[time, chan]) with block length
         block_size + overlap except possibly at the tail.
@@ -155,15 +161,19 @@ class FilterbankFile:
             reader = native.PrefetchReader(
                 self.filename, self.header_size, bytes_per_spec,
                 self.number_of_samples, payload=block_size, overlap=overlap)
-            for pos, raw in reader:
-                block = np.frombuffer(raw, dtype=self.dtype).reshape(
+            for pos, rawbuf in reader:
+                block = np.frombuffer(rawbuf, dtype=self.dtype).reshape(
                     -1, self.nchans)
-                yield pos, block.astype(np.float32)
+                yield pos, (block if raw else block.astype(np.float32))
             return
         pos = start
         while pos < end:
             n = min(block_size + overlap, end - pos)
-            yield pos, self.get_samples(pos, n)
+            if raw:
+                block = self._read_raw_block(pos, n).reshape(-1, self.nchans)
+            else:
+                block = self.get_samples(pos, n)
+            yield pos, block
             pos += block_size
 
 

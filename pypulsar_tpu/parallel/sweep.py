@@ -774,7 +774,17 @@ def sweep_stream(
     prev = None
     if baseline is not None:
         baseline = jnp.asarray(baseline, dtype=jnp.float32).reshape(-1, 1)
-    for start, block in blocks:
+    # explicit iteration so the time spent PRODUCING each block (disk read
+    # wait + host->device ship in the source generator) is attributed to
+    # its own profiling stage — the streamed-bench overlap accounting
+    # needs transfer separated from device wait (BENCHNOTES.md round 4)
+    _block_iter = iter(blocks)
+    while True:
+        with profiling.stage("block_source"):
+            nxt = next(_block_iter, None)
+        if nxt is None:
+            break
+        start, block = nxt
         if start < cursor:  # chunk already accumulated (checkpoint resume)
             continue
         with profiling.stage("host_to_device"):

@@ -107,6 +107,70 @@ def test_noise_false_alarm_rate():
     assert len(cands) <= 1  # P(any 4-sigma FA) is a few percent
 
 
+def test_batched_search_matches_serial():
+    """accel_search_batch == [accel_search(f) for f] candidate-for-
+    candidate (VERDICT r3 item 2): the template banks are DM-independent,
+    so batching B spectra into one dispatch per stage must change no
+    result."""
+    from pypulsar_tpu.fourier.accelsearch import accel_search_batch
+
+    rng = np.random.RandomState(7)
+    N = 1 << 14
+    T = N * 2 * 128e-6
+    cfg = AccelSearchConfig(zmax=20.0, dz=2.0, numharm=4, sigma_min=2.5,
+                            seg_width=1 << 12)
+    ffts = []
+    for b in range(3):
+        ts = rng.standard_normal(2 * N).astype(np.float32)
+        ts += 0.15 * np.sin(2 * np.pi * (40.0 + 13.0 * b)
+                            * np.arange(2 * N) * 128e-6)
+        ffts.append((np.fft.rfft(ts) / np.sqrt(2 * N))
+                    .astype(np.complex64)[:N])
+    serial = [accel_search(f, T, cfg) for f in ffts]
+    batch = accel_search_batch(np.stack(ffts), T, cfg)
+    assert [len(s) for s in serial] == [len(b) for b in batch]
+    for s, bt in zip(serial, batch):
+        assert s, "injection not detected"
+        for cs, cb in zip(s, bt):
+            assert abs(cs.r - cb.r) < 1e-6
+            assert abs(cs.z - cb.z) < 1e-6
+            assert abs(cs.power - cb.power) < 1e-3
+            assert cs.numharm == cb.numharm
+
+
+def test_batched_search_sharded_matches_unsharded():
+    """The shard_map'd batch runner (batch axis over the 'dm' mesh axis)
+    reproduces the single-device batched result on the virtual CPU mesh."""
+    import jax
+
+    from pypulsar_tpu.fourier.accelsearch import accel_search_batch
+
+    if len(jax.devices()) < 4:
+        import pytest
+
+        pytest.skip("needs >= 4 virtual devices")
+    rng = np.random.RandomState(8)
+    N = 1 << 13
+    T = N * 2 * 128e-6
+    cfg = AccelSearchConfig(zmax=20.0, dz=2.0, numharm=2, sigma_min=2.5,
+                            seg_width=1 << 11)
+    ffts = []
+    for b in range(4):
+        ts = rng.standard_normal(2 * N).astype(np.float32)
+        ts += 0.2 * np.sin(2 * np.pi * (50.0 + 9.0 * b)
+                           * np.arange(2 * N) * 128e-6)
+        ffts.append((np.fft.rfft(ts) / np.sqrt(2 * N))
+                    .astype(np.complex64)[:N])
+    ffts = np.stack(ffts)
+    plain = accel_search_batch(ffts, T, cfg)
+    sharded = accel_search_batch(ffts, T, cfg, mesh_devices=4)
+    assert [len(p) for p in plain] == [len(s) for s in sharded]
+    for p, s in zip(plain, sharded):
+        for cp, cs in zip(p, s):
+            assert abs(cp.r - cs.r) < 1e-5
+            assert abs(cp.power - cs.power) < 1e-2
+
+
 # ---------------------------------------------------------------------------
 # injection recovery
 # ---------------------------------------------------------------------------
