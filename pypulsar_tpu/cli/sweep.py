@@ -88,11 +88,158 @@ def _write_dats(outbase, reader, dms, downsamp, rfimask=None):
         write_dat(f"{outbase}_DM{dm:.2f}", ts, inf)
 
 
+def _make_ddplan(reader, args):
+    """DDplan2b plan from a reader's header geometry + the CLI's
+    --lodm/--hidm/--plan-numsub/--resolution (shared by the single-file
+    and multi-file paths)."""
+    import numpy as np
+
+    from pypulsar_tpu.plan.ddplan import Observation
+
+    freqs = np.asarray(reader.frequencies, dtype=np.float64)
+    bw = abs(freqs.max() - freqs.min()) + abs(
+        freqs[1] - freqs[0] if len(freqs) > 1 else 0.0)
+    obs = Observation(dt=float(reader.tsamp),
+                      fctr=float(freqs.mean()),
+                      BW=float(bw), numchan=len(freqs))
+    return obs.gen_ddplan(args.lodm, args.hidm,
+                          numsub=args.plan_numsub,
+                          resolution=args.resolution)
+
+
+def _remove_stale_checkpoints(base):
+    """Remove exactly the checkpoint files a run rooted at ``base`` could
+    have written (never a glob: a prefix pattern could match unrelated
+    user files living next to the checkpoint)."""
+    stale = [base, base + ".tmp.npz"]
+    for i in range(256):
+        stale += [f"{base}.step{i}.npz",
+                  f"{base}.step{i}.npz.tmp.npz",
+                  f"{base}.step{i}.done.npz",
+                  f"{base}.step{i}.done.npz.tmp.npz"]
+    for fn in stale:
+        if os.path.exists(fn):
+            os.remove(fn)
+
+
+def _close(reader):
+    close = getattr(reader, "close", None)
+    if close is not None:
+        close()
+
+
+def _main_multi(args, ap, widths):
+    """Multi-file / multi-host sweep (SURVEY.md §2.4 rows 4-5): this
+    host's round-robin share of the file list is swept locally (flat or
+    DDplan-staged), REAL per-file artifacts are written next to each
+    swept file (``{base}.cands``; flat mode honors ``--write-dats``), and
+    the per-file top-k summaries are all-gathered over DCN into one
+    merged table every host writes identically
+    (``{outbase}_merged.cands``)."""
+    import numpy as np
+
+    from pypulsar_tpu.parallel import distributed as dist
+    from pypulsar_tpu.parallel import make_mesh
+
+    files = list(args.infile)
+    rfimask = None
+    if args.maskfile:
+        from pypulsar_tpu.io.rfimask import RfifindMask
+
+        rfimask = RfifindMask(args.maskfile)
+    mesh = None
+    if args.mesh:
+        import jax
+
+        # local_devices, NOT devices: under jax.distributed the global
+        # list includes other hosts' devices, which a host-local
+        # shard_map cannot address
+        mesh = make_mesh([args.mesh], ("dm",),
+                         devices=jax.local_devices()[: args.mesh])
+    if args.all_events:
+        ap.error("--all-events is a single-file option")
+
+    ddplan = None
+    dms = None
+    if args.ddplan:
+        if args.hidm is None:
+            ap.error("--ddplan requires --hidm")
+        # plan geometry from the FIRST file's header so every host
+        # executes the identical plan (survey files share geometry)
+        reader0 = _open_reader(files[0])
+        try:
+            ddplan = _make_ddplan(reader0, args)
+        finally:
+            _close(reader0)
+        if dist.process_index() == 0:
+            print(f"# DDplan: {len(ddplan.DDsteps)} steps, "
+                  f"{sum(s.numDMs for s in ddplan.DDsteps)} DM trials, "
+                  f"{len(files)} files over {dist.process_count()} hosts")
+    else:
+        if args.numdms is None:
+            ap.error("flat mode requires --numdms (or use --ddplan)")
+        dms = args.lodm + args.dmstep * np.arange(args.numdms)
+
+    if args.checkpoint and not args.resume:
+        # clean only THIS host's round-robin share: on shared storage a
+        # slow rank cleaning all indices would race a fast rank already
+        # writing its fresh checkpoints
+        for fi in range(dist.process_index(), len(files),
+                        dist.process_count()):
+            _remove_stale_checkpoints(f"{args.checkpoint}.f{fi}")
+
+    def per_file(fi, path, staged):
+        base = os.path.splitext(path)[0]
+        hits = staged.above_threshold(args.threshold)
+        _write_cands(base + ".cands", hits)
+        if args.write_dats and not args.ddplan:
+            reader = _open_reader(path)
+            try:
+                _write_dats(base, reader, dms, args.downsamp,
+                            rfimask=rfimask)
+            finally:
+                _close(reader)
+        print(f"# [host {dist.process_index()}] {path}: "
+              f"{staged.n_trials} trials, {len(hits)} detections "
+              f">= {args.threshold} sigma -> {base}.cands")
+
+    merged = dist.multi_host_sweep(
+        files, dms, nsub=args.nsub, group_size=args.group_size,
+        chunk_payload=args.chunk, mesh=mesh, topk_per_file=args.topk,
+        open_reader=_open_reader, ddplan=ddplan, downsamp=args.downsamp,
+        widths=widths, engine=args.engine, rfimask=rfimask,
+        checkpoint_base=args.checkpoint,
+        checkpoint_every=args.checkpoint_every, per_file=per_file)
+
+    outbase = args.outbase or (os.path.splitext(files[0])[0] + "_multi")
+    rows = [dict(dm=m[1], snr=m[2], sample=int(m[4]),
+                 width_bins=int(m[3]), downsamp=int(m[5]),
+                 file=files[int(m[0])]) for m in merged]
+    with open(outbase + "_merged.cands", "w") as f:
+        f.write("# DM      SNR      sample    width_bins  downsamp  file\n")
+        for r in rows:
+            f.write(f"{r['dm']:<9.4f} {r['snr']:<8.3f} {r['sample']:<9d} "
+                    f"{r['width_bins']:<11d} {r['downsamp']:<9d} "
+                    f"{r['file']}\n")
+    print(f"# merged: {len(rows)} candidates over {len(files)} files "
+          f"({dist.process_count()} hosts) -> {outbase}_merged.cands")
+    for r in rows[: args.topk]:
+        print(f"DM {r['dm']:8.3f}  SNR {r['snr']:7.2f}  sample "
+              f"{r['sample']:9d}  width {r['width_bins']:3d}  "
+              f"ds {r['downsamp']}  {r['file']}")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="sweep",
         description="DM-trial sweep of a .fil/.fits file on the TPU engine")
-    ap.add_argument("infile", help=".fil or PSRFITS input")
+    ap.add_argument("infile", nargs="+",
+                    help=".fil or PSRFITS input(s). More than one file "
+                         "engages the multi-file batch axis: each file is "
+                         "swept on this host's share (round-robin across "
+                         "hosts under jax.distributed) with per-file "
+                         ".cands artifacts plus one merged table")
     ap.add_argument("-o", "--outbase", default=None,
                     help="output basename (default: input sans extension)")
     ap.add_argument("--lodm", type=float, default=0.0, help="lowest trial DM")
@@ -157,8 +304,19 @@ def main(argv=None):
     ap.add_argument("--resume", action="store_true",
                     help="resume from an existing --checkpoint file "
                          "(without this flag stale checkpoints are removed)")
+    ap.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                    help="multi-host mode: jax.distributed coordinator "
+                         "(defaults to $PYPULSAR_TPU_COORDINATOR; no-op "
+                         "when unset)")
+    ap.add_argument("--num-processes", type=int, default=None,
+                    help="multi-host mode: total host count "
+                         "($PYPULSAR_TPU_NUM_PROCESSES)")
+    ap.add_argument("--process-id", type=int, default=None,
+                    help="multi-host mode: this host's rank "
+                         "($PYPULSAR_TPU_PROCESS_ID)")
     args = ap.parse_args(argv)
 
+    from pypulsar_tpu.parallel import distributed as dist
     from pypulsar_tpu.parallel import make_mesh
     from pypulsar_tpu.parallel.staged import sweep_ddplan, sweep_flat
 
@@ -177,20 +335,13 @@ def main(argv=None):
     if args.resume and not args.checkpoint:
         ap.error("--resume requires --checkpoint PATH")
     widths = tuple(int(w) for w in args.widths.split(","))
+    dist.initialize(args.coordinator, args.num_processes, args.process_id)
+    if len(args.infile) > 1 or dist.is_distributed():
+        return _main_multi(args, ap, widths)
+    args.infile = args.infile[0]
     outbase = args.outbase or os.path.splitext(args.infile)[0]
     if args.checkpoint and not args.resume:
-        # remove exactly the files this run's checkpointing could have
-        # written (never a glob: a prefix pattern could match unrelated
-        # user files living next to the checkpoint)
-        stale = [args.checkpoint, args.checkpoint + ".tmp.npz"]
-        for i in range(256):
-            stale += [f"{args.checkpoint}.step{i}.npz",
-                      f"{args.checkpoint}.step{i}.npz.tmp.npz",
-                      f"{args.checkpoint}.step{i}.done.npz",
-                      f"{args.checkpoint}.step{i}.done.npz.tmp.npz"]
-        for fn in stale:
-            if os.path.exists(fn):
-                os.remove(fn)
+        _remove_stale_checkpoints(args.checkpoint)
     reader = _open_reader(args.infile)
     rfimask = None
     if args.maskfile:
@@ -207,17 +358,7 @@ def main(argv=None):
     if args.ddplan:
         if args.hidm is None:
             ap.error("--ddplan requires --hidm")
-        from pypulsar_tpu.plan.ddplan import Observation
-
-        freqs = np.asarray(reader.frequencies, dtype=np.float64)
-        bw = abs(freqs.max() - freqs.min()) + abs(
-            freqs[1] - freqs[0] if len(freqs) > 1 else 0.0)
-        obs = Observation(dt=float(reader.tsamp),
-                          fctr=float(freqs.mean()),
-                          BW=float(bw), numchan=len(freqs))
-        plan = obs.gen_ddplan(args.lodm, args.hidm,
-                              numsub=args.plan_numsub,
-                              resolution=args.resolution)
+        plan = _make_ddplan(reader, args)
         print(f"# DDplan: {len(plan.DDsteps)} steps, "
               f"{sum(s.numDMs for s in plan.DDsteps)} total DM trials")
         staged = sweep_ddplan(reader, plan, nsub=args.nsub,

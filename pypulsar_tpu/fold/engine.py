@@ -141,6 +141,144 @@ def fold_parts(data, bin_idx, nbins: int, npart: int):
     return profs, counts
 
 
+@partial(jax.jit, static_argnames=("nbins", "npart"))
+def fold_stats(data, bin_idx, nbins: int, npart: int, dp_offsets):
+    """One-dispatch fold + ON-DEVICE profile statistics (VERDICT r3
+    item 4): everything pfd_snr-style analysis needs leaves the device as
+    KILOBYTES instead of the [npart, C, nbins] archive cube (33 MB at
+    bench shapes — through a remote-accelerator link that pull dominated
+    the fold end-to-end by up to 10x, BENCHNOTES r3).
+
+    Computed inside the one program, on top of the fold_parts cube:
+      - ``part_profs[npart, nbins]``: channel-summed sub-integration
+        profiles (the .pfd time-phase plot),
+      - ``chan_profs[C, nbins]``: partition-summed channel-phase archive
+        (the frequency-phase plot / subband view),
+      - ``counts[npart, nbins]``,
+      - ``dsum, dsumsq``: folded-data moments for the off-pulse std
+        (profile_snr.profile_std / L&K eq. 7.1, reference
+        bin/pfd_snr.py:674-718),
+      - ``dp_profs[J, nbins]``: bestprof-style period refinement — trial
+        ``j`` rotates partition ``i`` by ``dp_offsets[j, i]`` cycles
+        (Fourier rotation, exact for band-limited profiles) and sums;
+        the host picks the chi2-max trial (reference surface:
+        prepfold's .bestprof via bin/pfd_snr.py:151-156
+        ``adjust_period``).
+
+    ``dp_offsets[J, npart]`` float32 cycles. The cube itself never
+    leaves the device and is freed with the program.
+    """
+    profs, counts = fold_parts(data, bin_idx, nbins, npart)  # traced inline
+    part_profs = profs.sum(axis=1)  # [npart, nbins]
+    chan_profs = profs.sum(axis=0)  # [C, nbins]
+    C, T = data.shape
+    part_len = T // npart
+    used = data[:, : npart * part_len]
+    dsum = jnp.sum(used, dtype=jnp.float32)
+    dsumsq = jnp.sum(used * used, dtype=jnp.float32)
+    # Fourier rotation: shifting a profile by x cycles multiplies rfft
+    # bin k by exp(-2i*pi*k*x)
+    pf = jnp.fft.rfft(part_profs, axis=1)  # [npart, K]
+    k = jnp.arange(pf.shape[1], dtype=jnp.float32)
+    ang = -2.0 * jnp.pi * dp_offsets[:, :, None] * k[None, None, :]
+    rot = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))  # [J, npart, K]
+    # HIGHEST: the default TPU matmul rounds f32 inputs to bf16 (~2e-3
+    # relative — the same trap _onehot_fold_2d documents), which would
+    # swamp the 2e-4 twin-parity tolerance and noise the chi2 argmax
+    dp_f = jnp.einsum("ik,jik->jk", pf, rot,
+                      precision=jax.lax.Precision.HIGHEST)
+    dp_profs = jnp.fft.irfft(dp_f, n=nbins, axis=1)  # [J, nbins]
+    return part_profs, chan_profs, counts, dsum, dsumsq, dp_profs
+
+
+def fold_stats_numpy(data, bin_idx, nbins: int, npart: int, dp_offsets):
+    """Golden float64 twin of :func:`fold_stats`."""
+    data = np.asarray(data, np.float64)
+    C, T = data.shape
+    part_len = T // npart
+    profs = []
+    counts = []
+    for i in range(npart):
+        p, c = fold_numpy(data[:, i * part_len:(i + 1) * part_len],
+                          bin_idx[i * part_len:(i + 1) * part_len], nbins)
+        profs.append(p)
+        counts.append(c)
+    profs = np.stack(profs)  # [npart, C, nbins]
+    counts = np.stack(counts)
+    part_profs = profs.sum(axis=1)
+    chan_profs = profs.sum(axis=0)
+    used = data[:, : npart * part_len]
+    dsum = used.sum()
+    dsumsq = (used * used).sum()
+    pf = np.fft.rfft(part_profs, axis=1)
+    k = np.arange(pf.shape[1])
+    rot = np.exp(-2j * np.pi * np.asarray(dp_offsets)[:, :, None]
+                 * k[None, None, :])
+    dp_profs = np.fft.irfft(np.einsum("ik,jik->jk", pf, rot), n=nbins,
+                            axis=1)
+    return part_profs, chan_profs, counts, dsum, dsumsq, dp_profs
+
+
+def bestprof_offsets(npart: int, T_sec: float, period: float,
+                     ntrial: int = 65, max_drift_cycles: float = 2.0):
+    """(dp_trials[J] seconds, dp_offsets[J, npart] cycles) for the
+    fold_stats period refinement: a fold at period ``P`` of a signal with
+    true period ``P + dp`` drifts by ``t * dp / P**2`` cycles at time t;
+    trial j rotates partition i (mid-time t_i) by the OPPOSITE so the
+    matching trial re-aligns the summed profile. ``max_drift_cycles`` is
+    the drift across the whole observation at the largest trial."""
+    dp_max = max_drift_cycles * period * period / max(T_sec, 1e-12)
+    dps = np.linspace(-dp_max, dp_max, ntrial)
+    t_mid = (np.arange(npart) + 0.5) * (T_sec / npart)
+    off = -t_mid[None, :] * dps[:, None] / (period * period)
+    return dps, off.astype(np.float32)
+
+
+def fold_snr_stats(data, bin_idx, nbins: int, npart: int, dt: float,
+                   period: float, ntrial: int = 65):
+    """Device fold + fused statistics, then the host-side (float64, tiny)
+    finishing math: off-pulse std from the data moments, L&K eq. 7.1 SNR
+    of the summed profile with an auto on-pulse region, and the refined
+    period from the chi2-max dp trial. One device dispatch; ~100 KB
+    pulled (vs the 33 MB cube).
+
+    Returns a dict with ``snr``, ``best_period``, ``chi2`` [J],
+    ``dp_trials`` [J], ``profile`` [nbins], ``part_profs``,
+    ``chan_profs``, ``counts``.
+    """
+    import jax.numpy as jnp
+
+    from pypulsar_tpu.fold.profile_snr import (
+        OnPulseError,
+        calc_snr,
+        onpulse_auto,
+        profile_std,
+    )
+
+    C, T = np.shape(data)
+    part_len = T // npart
+    T_sec = npart * part_len * dt
+    dps, off = bestprof_offsets(npart, T_sec, period, ntrial=ntrial)
+    out = fold_stats(jnp.asarray(data), jnp.asarray(bin_idx), nbins, npart,
+                     jnp.asarray(off))
+    part_profs, chan_profs, counts, dsum, dsumsq, dp_profs = \
+        (np.asarray(x, dtype=np.float64) for x in out)
+    n_used = C * npart * part_len
+    data_var = dsumsq / n_used - (dsum / n_used) ** 2
+    std = profile_std(max(data_var, 0.0), n_used, nbins, 1.0)
+    prof = part_profs.sum(axis=0)
+    try:
+        snr = calc_snr(prof, onpulse_auto(prof), std)[0]
+    except OnPulseError:
+        snr = 0.0
+    chi2 = ((dp_profs - dp_profs.mean(axis=1, keepdims=True)) ** 2).sum(axis=1)
+    j = int(np.argmax(chi2))
+    return dict(snr=float(snr), best_period=float(period + dps[j]),
+                dp_trials=dps, chi2=chi2, profile=prof,
+                part_profs=part_profs, chan_profs=chan_profs,
+                counts=counts)
+
+
 def phase_to_bins(phases: np.ndarray, nbins: int) -> np.ndarray:
     """Fractional rotation counts -> phase bin indices (host, float64)."""
     return (np.floor(np.asarray(phases, np.float64) * nbins).astype(np.int64)

@@ -92,6 +92,69 @@ _RANK_SCRIPT = textwrap.dedent("""
 """)
 
 
+_CLI_RANK_SCRIPT = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    os.chdir({out!r})
+    # rank from the env, NOT jax.process_index(): touching the backend
+    # before the CLI's own distributed.initialize() would break init
+    rank = os.environ["PYPULSAR_TPU_PROCESS_ID"]
+    from pypulsar_tpu.cli.sweep import main
+    rc = main([{f0!r}, {f1!r}, "--ddplan", "--hidm", "100", "-s", "8",
+               "--group-size", "4", "--threshold", "6",
+               "-o", "rank" + rank])
+    assert rc == 0
+    print("RANK", rank, "OK")
+""")
+
+
+def test_cli_sweep_ddplan_two_process(tmp_path):
+    """The user-facing path (VERDICT r3 item 5): two jax.distributed CPU
+    ranks run ``cli sweep --ddplan`` over two files; each rank writes the
+    .cands artifact for its own file share and both write identical
+    merged tables."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    f0 = str(tmp_path / "a.fil")
+    f1 = str(tmp_path / "b.fil")
+    _write_fil(f0, dm=40.0, t0=2000, seed=0)
+    _write_fil(f1, dm=90.0, t0=5000, seed=1)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    script = _CLI_RANK_SCRIPT.format(repo=repo, f0=f0, f1=f1,
+                                     out=str(tmp_path))
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env[distributed.ENV_COORD] = f"127.0.0.1:{port}"
+        env[distributed.ENV_NPROC] = "2"
+        env[distributed.ENV_PID] = str(rank)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = [p.communicate(timeout=240) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"rank failed:\n{out}\n{err[-2000:]}"
+
+    # per-file artifacts written by the owning rank (round-robin share)
+    assert (tmp_path / "a.cands").exists()
+    assert (tmp_path / "b.cands").exists()
+    # each rank wrote a merged table; contents must be identical
+    m0 = (tmp_path / "rank0_merged.cands").read_text()
+    m1 = (tmp_path / "rank1_merged.cands").read_text()
+    assert m0 == m1 and len(m0.splitlines()) > 2
+    # both files' candidates are in the merged table
+    assert "a.fil" in m0 and "b.fil" in m0
+
+
 def test_multi_host_sweep_two_process(tmp_path):
     """Real jax.distributed: 2 CPU ranks, disjoint file shares, merged
     candidate tables identical on both ranks and covering both files."""

@@ -228,6 +228,46 @@ class TestFoldEngine:
                                        rtol=1e-4, atol=1e-5)
             np.testing.assert_array_equal(np.asarray(counts[pi]), ref_c)
 
+    def test_fold_stats_matches_numpy_twin(self):
+        from pypulsar_tpu.fold.engine import (
+            bestprof_offsets, fold_stats, fold_stats_numpy)
+
+        rng = np.random.RandomState(3)
+        C, T, nbins, npart = 8, 4096, 16, 8
+        data = rng.randn(C, T).astype(np.float32)
+        bins = rng.randint(0, nbins, T).astype(np.int32)
+        _, off = bestprof_offsets(npart, T * 1e-3, 0.05, ntrial=9)
+        dev = [np.asarray(x, np.float64)
+               for x in fold_stats(data, bins, nbins, npart, off)]
+        ref = list(fold_stats_numpy(data, bins, nbins, npart, off))
+        for d, r, tol in zip(dev, ref, (1e-4,) * 3 + (2e-4,) * 3):
+            np.testing.assert_allclose(d, r, rtol=tol, atol=1e-2)
+
+    def test_fold_snr_stats_recovers_snr_and_period(self):
+        """The fused device fold+stats path (VERDICT r3 item 4) detects an
+        injected pulsar and refines a deliberately-off fold period back to
+        the true one."""
+        from pypulsar_tpu.fold.engine import fold_snr_stats, phase_to_bins
+
+        rng = np.random.RandomState(4)
+        C, T, nbins, npart = 16, 200_000, 64, 25
+        dt = 1e-3
+        p_true = 0.512  # seconds
+        p_fold = p_true * (1 + 2.0e-5)  # off by ~8 ms drift over the obs
+        t = np.arange(T) * dt
+        data = rng.randn(C, T).astype(np.float32)
+        pulse = (np.abs(((t / p_true) % 1.0) - 0.5) < 0.02)
+        data += 0.6 * pulse[None, :].astype(np.float32)
+        bins = phase_to_bins(t / p_fold, nbins)
+        out = fold_snr_stats(data, bins, nbins, npart, dt, p_fold)
+        assert out["snr"] > 10.0, out["snr"]
+        # refined period within a quarter of the trial-grid spacing
+        dgrid = out["dp_trials"][1] - out["dp_trials"][0]
+        assert abs(out["best_period"] - p_true) <= (p_fold - p_true) * 0.3 \
+            + dgrid, (out["best_period"], p_true)
+        assert out["part_profs"].shape == (npart, nbins)
+        assert out["chan_profs"].shape == (C, nbins)
+
     def test_constant_period_fold_recovers_pulse(self):
         dt, period, nbins = 1e-3, 0.1, 50
         n = 100_000
