@@ -9,6 +9,16 @@ through our FITS codec.
 The Haslam FITS blob is absent from the reference snapshot
 (.MISSING_LARGE_BLOBS), so the map path is configurable: pass ``mapfn``,
 set $PYPULSAR_TPU_HASLAM, or drop the file at lib/lambda_haslam408_dsds.fits
+
+Fetch recipe (the public NASA LAMBDA archive hosts the destriped/
+desourced Haslam 408 MHz map, ~50 MB HEALPix FITS)::
+
+    curl -L -o lib/lambda_haslam408_dsds.fits \\
+      https://lambda.gsfc.nasa.gov/data/foregrounds/haslam/lambda_haslam408_dsds.fits
+    # or: export PYPULSAR_TPU_HASLAM=/path/to/lambda_haslam408_dsds.fits
+
+tests/test_snr_stack.py writes a small synthetic map with the same
+layout, so the suite never needs the download.
 under the package root.  ``write_healpix_map`` lets tests (and users with
 their own surveys) supply maps.
 """
