@@ -44,6 +44,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import os
 from functools import partial
 from typing import Dict, List, Optional, Tuple
 
@@ -654,11 +655,27 @@ def accel_search(
     return _refine_hits(raw_hits, zs, ws, cfg, numindep, thresh)
 
 
+def _stage_chunk_bytes(tfs, Z: int, Wn: int, segw: int) -> int:
+    """Estimated device bytes PER BATCHED SPECTRUM for one harmonic
+    stage's scan body: every ratio bank (``tfs`` entry, [2, rows, L])
+    materializes a [rows, L] complex64 correlation plus its FFT-input
+    product (16 B/cell live at once), the |.|^2 power (4 B/cell), and
+    the [Z*Wn, 2*segw] gathered plane (two f32 copies around the
+    accumulate). Used to pick the batch chunk that fits HBM — the axon
+    backend HARD-CRASHES the TPU worker on oversized allocations instead
+    of raising RESOURCE_EXHAUSTED (observed at B=32, N=2^21, zmax=200),
+    so the budget must be respected up front, not discovered via
+    retry."""
+    tot = sum(int(t.shape[1]) * int(t.shape[2]) * 20 for t in tfs)
+    return tot + Z * Wn * 2 * segw * 8
+
+
 def accel_search_batch(
     ffts,
     T: float,
     config: AccelSearchConfig = AccelSearchConfig(),
     mesh_devices: int = 0,
+    hbm_budget_bytes: Optional[int] = None,
 ) -> List[List[AccelCandidate]]:
     """Search a BATCH of normalized FFTs sharing one configuration
     (VERDICT r3 item 2: the 4096-DM-trial workload searches thousands of
@@ -672,8 +689,15 @@ def accel_search_batch(
     sifted candidate list per input spectrum, in order — identical to
     ``[accel_search(f, T, config) for f in ffts]`` (parity-tested).
 
+    The batch axis is internally processed in per-stage chunks sized so
+    the stage's working set fits ``hbm_budget_bytes`` (default: the
+    ``PYPULSAR_TPU_ACCEL_HBM`` env var or 5e9). The full batch of padded
+    spectra stays device-resident across stages (B*Np complex ~ 17 MB
+    per 2^21-bin spectrum); only the scan working set is chunked.
+
     ``mesh_devices`` > 0 shards the batch over that many devices
-    (shard_map over a 'dm' mesh axis; B must be a multiple of it).
+    (shard_map over a 'dm' mesh axis; B must be a multiple of it, and
+    chunks round down to a multiple of it).
     """
     cfg = config
     ffts = np.asarray(ffts)
@@ -692,6 +716,10 @@ def accel_search_batch(
     spec_pad2 = _build_spec_pad_batch(jnp.asarray(re), jnp.asarray(im),
                                       front, int(max(Np - N, 8)))
 
+    if hbm_budget_bytes is None:
+        hbm_budget_bytes = int(float(
+            os.environ.get("PYPULSAR_TPU_ACCEL_HBM", 5e9)))
+
     raw_per_b: List[list] = [[] for _ in range(B)]
     for H in stages:
         top_lo = H * rlo
@@ -700,26 +728,38 @@ def accel_search_batch(
             continue
         n_seg = -(-(top_hi - top_lo) // segw)
         bank_meta, tfs, idxs = _stage_banks(banks, H, top_lo, segw, front)
+        # the budget is per device: a sharded chunk splits across the
+        # mesh, so the whole chunk may hold mesh_devices x the budget
+        per_dev = max(1, hbm_budget_bytes
+                      // _stage_chunk_bytes(tfs, Z, Wn, segw))
+        chunk = max(1, min(B, per_dev * max(1, mesh_devices)))
+        if mesh_devices:
+            chunk = max(mesh_devices, (chunk // mesh_devices) * mesh_devices)
         runner = _make_stage_runner_batch(segw, Z, Wn, cfg.topk,
                                           tuple(bank_meta),
                                           mesh_batch=mesh_devices)
-        with profiling.stage("accel_stage_batch"):
-            vals, zi, ri, neigh = runner(
-                spec_pad2, tuple(tfs), tuple(idxs), top_lo, top_hi,
-                jnp.float32(thresh[H]), n_seg)
-            vals = np.asarray(vals)   # [n_seg, B, Wn, k]
-            zi = np.asarray(zi)
-            ri = np.asarray(ri)
-            neigh = np.asarray(neigh)
+        for c0 in range(0, B, chunk):
+            # slice (not pad): a short tail chunk costs one extra compile
+            # for its shape but never ships dead spectra through the scan
+            sl = spec_pad2[c0:c0 + chunk]
+            nb = int(sl.shape[0])
+            with profiling.stage("accel_stage_batch"):
+                vals, zi, ri, neigh = runner(
+                    sl, tuple(tfs), tuple(idxs), top_lo, top_hi,
+                    jnp.float32(thresh[H]), n_seg)
+                vals = np.asarray(vals)   # [n_seg, nb, Wn, k]
+                zi = np.asarray(zi)
+                ri = np.asarray(ri)
+                neigh = np.asarray(neigh)
+            for si in range(n_seg):
+                r0 = top_lo + si * segw
+                width = min(segw, top_hi - r0)
+                for bl in range(nb):
+                    for wi in range(Wn):
+                        raw_per_b[c0 + bl].append(
+                            (H, wi, r0, vals[si, bl, wi], zi[si, bl, wi],
+                             ri[si, bl, wi], neigh[si, bl, wi], width))
         del tfs, idxs
-        for si in range(n_seg):
-            r0 = top_lo + si * segw
-            width = min(segw, top_hi - r0)
-            for b in range(B):
-                for wi in range(Wn):
-                    raw_per_b[b].append(
-                        (H, wi, r0, vals[si, b, wi], zi[si, b, wi],
-                         ri[si, b, wi], neigh[si, b, wi], width))
 
     return [_refine_hits(raw, zs, ws, cfg, numindep, thresh)
             for raw in raw_per_b]

@@ -138,6 +138,39 @@ def test_batched_search_matches_serial():
             assert cs.numharm == cb.numharm
 
 
+def test_batched_search_chunked_matches_unchunked():
+    """A tiny HBM budget forces accel_search_batch to process the batch
+    in per-stage chunks (the axon worker hard-crashes on oversized
+    allocations, so the budget is enforced analytically up front);
+    chunking must change no candidate."""
+    from pypulsar_tpu.fourier.accelsearch import accel_search_batch
+
+    rng = np.random.RandomState(11)
+    N = 1 << 13
+    T = N * 2 * 128e-6
+    cfg = AccelSearchConfig(zmax=20.0, dz=2.0, numharm=2, sigma_min=2.5,
+                            seg_width=1 << 11)
+    ffts = []
+    for b in range(3):
+        ts = rng.standard_normal(2 * N).astype(np.float32)
+        ts += 0.2 * np.sin(2 * np.pi * (60.0 + 11.0 * b)
+                           * np.arange(2 * N) * 128e-6)
+        ffts.append((np.fft.rfft(ts) / np.sqrt(2 * N))
+                    .astype(np.complex64)[:N])
+    ffts = np.stack(ffts)
+    whole = accel_search_batch(ffts, T, cfg)
+    chunked = accel_search_batch(ffts, T, cfg, hbm_budget_bytes=1)  # chunk=1
+    assert [len(w) for w in whole] == [len(c) for c in chunked]
+    for w, c in zip(whole, chunked):
+        for cw, cc in zip(w, c):
+            # chunk-size-dependent XLA fusion moves powers by last-ulp
+            # amounts, which the parabola refinement amplifies to ~1e-6
+            # in (r, z) — physically meaningless at dz=2
+            assert abs(cw.r - cc.r) < 1e-5
+            assert abs(cw.z - cc.z) < 1e-5
+            assert abs(cw.power - cc.power) < 1e-3
+
+
 def test_batched_search_sharded_matches_unsharded():
     """The shard_map'd batch runner (batch axis over the 'dm' mesh axis)
     reproduces the single-device batched result on the virtual CPU mesh."""

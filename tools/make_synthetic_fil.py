@@ -57,6 +57,9 @@ def parse_args(argv=None):
 
 def main(argv=None):
     a = parse_args(argv)
+    if not 1 <= a.noise_hi <= 256:
+        raise SystemExit("--noise-hi must be in [1, 256] (uint8 data; the "
+                         "multiply-shift map overflows uint16 beyond that)")
     C, P = a.nchan, a.period_samples
     nsamp = int(round(a.duration / a.tsamp))
     nsamp = max((nsamp // P) * P, P)  # whole periods; simplifies tiling only
@@ -86,7 +89,13 @@ def main(argv=None):
         written = 0
         while written < nsamp:
             n = min(B, nsamp - written)
-            block = rng.integers(0, a.noise_hi, size=(n, C), dtype=np.uint8)
+            # raw bit-generator bytes + multiply-shift range map: ~10x the
+            # throughput of bounded rng.integers (which Lemire-rejects per
+            # byte); the map is near-uniform on {0..noise_hi-1}, which is
+            # all synthetic noise needs
+            raw = np.frombuffer(rng.bytes(n * C), np.uint8).reshape(n, C)
+            block = ((raw.astype(np.uint16) * np.uint16(a.noise_hi))
+                     >> np.uint16(8)).astype(np.uint8)
             block.reshape(n // P, P, C)[:] += pattern[None]
             block.tofile(f)
             written += n
