@@ -711,14 +711,31 @@ def accel_search_batch(
      numindep, thresh) = _search_setup(N, T, cfg)
     Z, Wn = len(zs), len(ws)
 
+    if hbm_budget_bytes is None:
+        hbm_budget_bytes = int(float(
+            os.environ.get("PYPULSAR_TPU_ACCEL_HBM", 5e9)))
+
+    # the padded spectra themselves stay device-resident across stages
+    # (~8*Np bytes each); a batch large enough to blow half the budget on
+    # residency alone is processed in top-level slices (each slice still
+    # amortizes the banks over its spectra)
+    max_resident = max(1, (hbm_budget_bytes // 2) // (Np * 8))
+    if mesh_devices:
+        max_resident = max(mesh_devices,
+                           (max_resident // mesh_devices) * mesh_devices)
+    if B > max_resident:
+        out: List[List[AccelCandidate]] = []
+        for c0 in range(0, B, max_resident):
+            out.extend(accel_search_batch(
+                ffts[c0:c0 + max_resident], T, config,
+                mesh_devices=mesh_devices,
+                hbm_budget_bytes=hbm_budget_bytes))
+        return out
+
     re = np.ascontiguousarray(ffts.real, dtype=np.float32)
     im = np.ascontiguousarray(ffts.imag, dtype=np.float32)
     spec_pad2 = _build_spec_pad_batch(jnp.asarray(re), jnp.asarray(im),
                                       front, int(max(Np - N, 8)))
-
-    if hbm_budget_bytes is None:
-        hbm_budget_bytes = int(float(
-            os.environ.get("PYPULSAR_TPU_ACCEL_HBM", 5e9)))
 
     raw_per_b: List[list] = [[] for _ in range(B)]
     for H in stages:

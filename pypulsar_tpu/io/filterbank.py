@@ -153,18 +153,21 @@ class FilterbankFile:
         Yields (startsamp, block[time, chan]) with block length
         block_size + overlap except possibly at the tail.
         """
+        if start < 0:
+            raise ValueError(f"iter_blocks start must be >= 0; got {start}")
         end = self.number_of_samples if end is None else min(end, self.number_of_samples)
-        if prefetch and start == 0 and end == self.number_of_samples:
+        if prefetch and start < end:
             from pypulsar_tpu import native
 
             bytes_per_spec = self.nchans * (self.nbits // 8)
             reader = native.PrefetchReader(
-                self.filename, self.header_size, bytes_per_spec,
-                self.number_of_samples, payload=block_size, overlap=overlap)
+                self.filename,
+                self.header_size + start * bytes_per_spec, bytes_per_spec,
+                end - start, payload=block_size, overlap=overlap)
             for pos, rawbuf in reader:
                 block = np.frombuffer(rawbuf, dtype=self.dtype).reshape(
                     -1, self.nchans)
-                yield pos, (block if raw else block.astype(np.float32))
+                yield pos + start, (block if raw else block.astype(np.float32))
             return
         pos = start
         while pos < end:

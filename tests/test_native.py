@@ -199,6 +199,31 @@ def test_filterbank_iter_blocks_prefetch_parity(tmp_path):
         np.testing.assert_array_equal(ba, bb)
 
 
+def test_filterbank_iter_blocks_windowed_prefetch(tmp_path):
+    """A [start, end) window rides the native prefetcher too (the gate
+    used to require the whole file, silently dropping to synchronous
+    reads for bounded sweeps); positions stay absolute."""
+    from pypulsar_tpu.io import filterbank
+
+    rng = np.random.RandomState(9)
+    T, C = 3000, 16
+    data = rng.randn(T, C).astype(np.float32)
+    fn = str(tmp_path / "win.fil")
+    hdr = dict(nchans=C, tsamp=1e-3, fch1=1500.0, foff=-2.0, tstart=55000.0,
+               nbits=32, nifs=1, source_name="WIN")
+    filterbank.write_filterbank(fn, hdr, data)
+    fb = filterbank.FilterbankFile(fn)
+    for start, end in ((0, 1100), (700, 2500), (512, T)):
+        a = list(fb.iter_blocks(512, overlap=64, start=start, end=end,
+                                prefetch=True))
+        b = list(fb.iter_blocks(512, overlap=64, start=start, end=end,
+                                prefetch=False))
+        assert len(a) == len(b) and a[0][0] == start
+        for (sa, ba), (sb, bb) in zip(a, b):
+            assert sa == sb
+            np.testing.assert_array_equal(ba, bb)
+
+
 def test_filterbank_prefetch_8bit(tmp_path):
     """The prefetch path handles packed uint8 files (bytes-per-spectrum
     accounting differs from float32)."""
