@@ -873,8 +873,11 @@ def run_fold(args):
     float(offd[0, 0])
 
     def run_fused():
-        return [np.asarray(x) for x in
-                fold_stats(dev, bi, nbins, npart, offd)]
+        # one batched pull — per-array np.asarray pays a tunnel roundtrip
+        # per output (ops/transfer.pull_host, BENCHNOTES r4)
+        from pypulsar_tpu.ops.transfer import pull_host
+
+        return list(pull_host(*fold_stats(dev, bi, nbins, npart, offd)))
 
     run_fused()  # warm
     fused_time = float("inf")

@@ -261,8 +261,12 @@ def fold_snr_stats(data, bin_idx, nbins: int, npart: int, dt: float,
     dps, off = bestprof_offsets(npart, T_sec, period, ntrial=ntrial)
     out = fold_stats(jnp.asarray(data), jnp.asarray(bin_idx), nbins, npart,
                      jnp.asarray(off))
+    # one batched pull, then f64 on host: six per-array np.asarray pulls
+    # would pay six ~65 ms tunnel roundtrips (ops/transfer.pull_host)
+    from pypulsar_tpu.ops.transfer import pull_host
+
     part_profs, chan_profs, counts, dsum, dsumsq, dp_profs = \
-        (np.asarray(x, dtype=np.float64) for x in out)
+        (np.asarray(x, dtype=np.float64) for x in pull_host(*out))
     n_used = C * npart * part_len
     data_var = dsumsq / n_used - (dsum / n_used) ** 2
     std = profile_std(max(data_var, 0.0), n_used, nbins, 1.0)

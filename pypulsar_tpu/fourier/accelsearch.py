@@ -55,7 +55,7 @@ from scipy.special import gammaincc, gammainccinv, gammaln, log_ndtr, ndtri
 
 from pypulsar_tpu.fourier.zresponse import template_bank_zw
 from pypulsar_tpu.ops.fourier_dedisperse import fourier_chunk_len
-from pypulsar_tpu.ops.transfer import join_planes, split_complex
+from pypulsar_tpu.ops.transfer import join_planes, pull_host, split_complex
 from pypulsar_tpu.utils import profiling
 
 __all__ = [
@@ -637,13 +637,9 @@ def accel_search(
         bank_meta, tfs, idxs = _stage_banks(banks, H, top_lo, segw, front)
         runner = _make_stage_runner(segw, Z, Wn, cfg.topk, tuple(bank_meta))
         with profiling.stage("accel_stage"):
-            vals, zi, ri, neigh = runner(
+            vals, zi, ri, neigh = pull_host(*runner(
                 spec_pad2, tuple(tfs), tuple(idxs), top_lo, top_hi,
-                jnp.float32(thresh[H]), n_seg)
-            vals = np.asarray(vals)
-            zi = np.asarray(zi)
-            ri = np.asarray(ri)
-            neigh = np.asarray(neigh)
+                jnp.float32(thresh[H]), n_seg))
         del tfs, idxs  # free this stage's HBM before the next
         for si in range(n_seg):
             r0 = top_lo + si * segw
@@ -761,13 +757,10 @@ def accel_search_batch(
             sl = spec_pad2[c0:c0 + chunk]
             nb = int(sl.shape[0])
             with profiling.stage("accel_stage_batch"):
-                vals, zi, ri, neigh = runner(
+                # [n_seg, nb, Wn, k] each; one batched pull (pull_host)
+                vals, zi, ri, neigh = pull_host(*runner(
                     sl, tuple(tfs), tuple(idxs), top_lo, top_hi,
-                    jnp.float32(thresh[H]), n_seg)
-                vals = np.asarray(vals)   # [n_seg, nb, Wn, k]
-                zi = np.asarray(zi)
-                ri = np.asarray(ri)
-                neigh = np.asarray(neigh)
+                    jnp.float32(thresh[H]), n_seg))
             for si in range(n_seg):
                 r0 = top_lo + si * segw
                 width = min(segw, top_hi - r0)

@@ -20,7 +20,20 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-__all__ = ["split_complex", "to_host_complex", "join_planes"]
+__all__ = ["split_complex", "to_host_complex", "join_planes", "pull_host"]
+
+
+def pull_host(*arrays):
+    """Fetch several device arrays to host in ONE batched transfer.
+
+    Through the axon tunnel every individual ``np.asarray(device_array)``
+    pull pays its own ~65 ms roundtrip; ``jax.device_get`` issues the
+    fetches together and waits once (measured on chip: 4 small arrays,
+    262 ms per-array vs 70 ms batched — BENCHNOTES.md round 4). Use this
+    for every multi-output pull on a hot path. Always returns a tuple
+    (same arity as the arguments), so star-splatted call sites unpack
+    predictably even for one output."""
+    return jax.device_get(arrays)
 
 
 def join_planes(re, im):

@@ -46,6 +46,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pypulsar_tpu.core import psrmath
+from pypulsar_tpu.ops import transfer
 from pypulsar_tpu.ops.pallas_kernels import boxcar_stats
 from pypulsar_tpu.utils import profiling
 
@@ -752,6 +753,9 @@ def sweep_stream(
         while len(pending) > limit:
             start, stat_len, (s, ss, mb, ab) = pending.pop(0)
             with profiling.stage("device_wait+accumulate"):
+                # one batched pull: per-array np.asarray would pay four
+                # tunnel roundtrips per chunk (ops/transfer.pull_host)
+                s, ss, mb, ab = transfer.pull_host(s, ss, mb, ab)
                 acc.update(start, stat_len, s, ss, mb, ab)
             cursor = start + stat_len
             if checkpoint is not None:
@@ -981,7 +985,7 @@ def sweep_resident(spectra, dms, nsub=64, group_size=32, widths=DEFAULT_WIDTHS,
         _series_baseline(np.asarray(spectra.data)[:, :T_used]
                          if isinstance(spectra.data, np.ndarray)
                          else data))
-    s, ss, mb, ab = run(data, s1, s2, baseline, n_chunks)
+    s, ss, mb, ab = transfer.pull_host(*run(data, s1, s2, baseline, n_chunks))
     s = np.asarray(s, dtype=np.float64)
     ss = np.asarray(ss, dtype=np.float64)
     mb = np.asarray(mb)
