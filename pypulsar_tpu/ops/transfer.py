@@ -69,6 +69,7 @@ def split_complex(arr):
 def to_host_complex(re, im) -> np.ndarray:
     """Host complex64 from separate (device or host) float planes — the
     device->host pull happens per real plane, which every backend
-    supports."""
+    supports; both planes fetch in one batched transfer (pull_host)."""
+    re, im = pull_host(re, im)
     return (np.asarray(re, dtype=np.float32)
             + 1j * np.asarray(im, dtype=np.float32)).astype(np.complex64)
