@@ -192,8 +192,9 @@ class _ReaderSource:
             # take the fallback branches below. Blocks ship in the file's
             # NATIVE dtype and are transposed/widened/flipped on device
             # (_ingest_tc): 4x less link traffic for 8-bit files.
-            for pos, block in iter_blocks(payload, overlap, raw=True):
-                yield pos, _ingest_tc(jnp.asarray(block), self._flip)
+            raw_blocks = iter_blocks(payload, overlap, raw=True)
+            for pos, dev in _ship_ahead(raw_blocks):
+                yield pos, _ingest_tc(dev, self._flip)
             return
         get_samples = getattr(self.reader, "get_samples", None)
         get_interval = getattr(self.reader, "get_sample_interval", None)
@@ -213,6 +214,73 @@ class _ReaderSource:
         """High-frequency-first channel rows (every yield goes through
         here so a future reader branch cannot forget the flip)."""
         return block[::-1] if self._flip else block
+
+
+def _ship_ahead(raw_blocks, depth: int = 2):
+    """Host->device ship of streamed blocks on a background thread.
+
+    Through the remote link a `jnp.asarray(block)` effectively blocks the
+    calling thread for the whole wire time, and the main sweep loop also
+    dispatches programs and drains results — so with everything on one
+    thread the wire serializes against all of it (measured 0% overlap,
+    BENCHNOTES r4). The link itself DOES move transfers concurrently with
+    device execution (measured: 2.0 s compute + 3.0 s ship = 2.4 s
+    combined), so shipping from a dedicated thread lets block N+1 ride
+    the wire while the main thread dispatches and drains block N.
+    In-flight device blocks peak at ``depth + 2`` (queue slots + one the
+    worker holds while parked on ``q.put`` + the one yielded to the
+    consumer) — ~536 MB of HBM at depth=2 for 134 MB north-star blocks;
+    size streaming budgets accordingly. Ordering is preserved (single
+    worker, FIFO queue); worker exceptions re-raise in the consumer.
+    Disable with PYPULSAR_TPU_SHIP_AHEAD=0 (falls back to inline ship,
+    e.g. for single-threaded debugging)."""
+    if os.environ.get("PYPULSAR_TPU_SHIP_AHEAD", "1") == "0":
+        for pos, block in raw_blocks:
+            yield pos, jnp.asarray(block)
+        return
+
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+    _done = object()
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for pos, block in raw_blocks:
+                if stop.is_set():  # consumer gone: don't ship the rest
+                    return
+                q.put((pos, jnp.asarray(block)))
+        except BaseException as e:  # noqa: BLE001 - re-raised in consumer
+            q.put(e)
+            return
+        q.put(_done)
+
+    t = threading.Thread(target=worker, name="pypulsar-ship-ahead",
+                         daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _done:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        # consumer abandoned mid-stream (error or early exit): signal the
+        # worker, then drain queue slots so a put-parked worker can see
+        # the signal and exit instead of shipping the rest of the file
+        stop.set()
+        while t.is_alive():
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                t.join(timeout=0.1)
+        close = getattr(raw_blocks, "close", None)
+        if close is not None:
+            close()
 
 
 class _MaskedSource:
