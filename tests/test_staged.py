@@ -89,6 +89,26 @@ def test_staged_chunked_consistency(tmp_path):
                                    rtol=1e-3, atol=5e-3)
 
 
+def test_ship_ahead_disabled_matches_enabled(tmp_path, monkeypatch):
+    """PYPULSAR_TPU_SHIP_AHEAD=0 (inline ship, single-threaded debugging
+    path) produces bit-identical sweep results to the default background
+    ship thread — threading must only move WHEN blocks ship, never what
+    arrives or in what order."""
+    from pypulsar_tpu.parallel.staged import sweep_flat
+
+    fn, freqs, _ = synth_fil(tmp_path, T=8192, name="ship.fil")
+    dms = np.linspace(0.0, 80.0, 16)
+    fil = filterbank.FilterbankFile(fn)
+    default = sweep_flat(fil, dms, nsub=16, group_size=8,
+                         chunk_payload=2048)
+    monkeypatch.setenv("PYPULSAR_TPU_SHIP_AHEAD", "0")
+    inline = sweep_flat(filterbank.FilterbankFile(fn), dms, nsub=16,
+                        group_size=8, chunk_payload=2048)
+    a, b = default.steps[0].result, inline.steps[0].result
+    np.testing.assert_array_equal(a.snr, b.snr)
+    np.testing.assert_array_equal(a.peak_sample, b.peak_sample)
+
+
 def test_sweep_cli_flat_writes_cands(tmp_path, capsys):
     from pypulsar_tpu.cli import sweep as sweep_cli
 
