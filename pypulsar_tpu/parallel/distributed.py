@@ -38,6 +38,7 @@ __all__ = [
     "shard_files",
     "allgather_candidates",
     "multi_host_sweep",
+    "time_sharded_sweep",
 ]
 
 # environment surface (set by a launcher / scheduler on every host)
@@ -137,6 +138,210 @@ def allgather_candidates(records: np.ndarray, pad_to: int) -> np.ndarray:
         gathered = np.asarray(multihost_utils.process_allgather(padded))
     flat = gathered.reshape(-1, F)
     return flat[~np.isnan(flat[:, 0])]
+
+
+def time_sharded_sweep(
+    path_or_reader,
+    dms,
+    nsub: int = 64,
+    group_size: int = 32,
+    chunk_payload: Optional[int] = None,
+    mesh=None,
+    widths=None,
+    engine: str = "auto",
+    rank: Optional[int] = None,
+    count: Optional[int] = None,
+    checkpoint_base: Optional[str] = None,
+    checkpoint_every: int = 16,
+):
+    """Sweep ONE file with its TIME axis sharded across hosts.
+
+    The wire between host and device is the streamed sweep's measured
+    ceiling (BENCHNOTES r4: 63 MB/s tunnel, compute fully hidden), and
+    DM-sharding cannot help it — every host still needs every sample.
+    Time-sharding does: host ``k`` of ``P`` streams only its contiguous
+    window of chunks (1/P of the bytes), windows overlap by the
+    dedispersion+boxcar reach exactly as chunks do (overlap-save; the
+    windowed `_ReaderSource` reads its seam PAST the window end), and
+    what crosses DCN afterwards is one accumulator per host: the f64
+    moment sums, f32 window-sum maxima and their positions
+    (``sweep.AccumParts``, ~KBs). Merging in window order
+    (``merge_accum_parts``) reproduces the sequential sweep exactly up
+    to f64 re-association of the moment sums — mb/ab (and therefore
+    every peak and its sample position) merge bit-identically, and the
+    per-channel baseline comes from the FILE's first block on every host
+    so window results share one reference.
+
+    ``rank``/``count`` default to the jax.distributed process grid (and
+    may be passed explicitly for in-process testing; see also
+    :func:`time_shard_local_accum` for the mergeable per-window piece).
+    Every host returns the same finalized ``SweepResult``.
+    """
+    from pypulsar_tpu.parallel.sweep import finalize_sweep, merge_accum_parts
+
+    if rank is None:
+        rank = process_index()
+    if count is None:
+        count = process_count()
+    plan, local = time_shard_local_accum(
+        path_or_reader, dms, rank, count, nsub=nsub, group_size=group_size,
+        chunk_payload=chunk_payload, mesh=mesh, widths=widths, engine=engine,
+        checkpoint_base=checkpoint_base, checkpoint_every=checkpoint_every)
+    parts = _allgather_accums(local, count)
+    merged = merge_accum_parts(parts)
+    return finalize_sweep(plan, merged.n, merged.s, merged.ss, merged.mb,
+                          merged.ab, merged.baseline_sum)
+
+
+def time_shard_local_accum(
+    path_or_reader,
+    dms,
+    rank: int,
+    count: int,
+    nsub: int = 64,
+    group_size: int = 32,
+    chunk_payload: Optional[int] = None,
+    mesh=None,
+    widths=None,
+    engine: str = "auto",
+    checkpoint_base: Optional[str] = None,
+    checkpoint_every: int = 16,
+):
+    """(plan, AccumParts) for rank's window of the file — the mergeable
+    half of :func:`time_sharded_sweep` (windows merge with
+    ``sweep.merge_accum_parts`` in rank order)."""
+    from pypulsar_tpu.parallel.sweep import DEFAULT_WIDTHS
+
+    if widths is None:
+        widths = DEFAULT_WIDTHS
+    reader = path_or_reader
+    opened = isinstance(path_or_reader, str)
+    if opened:
+        from pypulsar_tpu.io import filterbank
+
+        reader = filterbank.FilterbankFile(path_or_reader)
+    try:
+        return _time_shard_local_accum(
+            reader, dms, rank, count, nsub, group_size, chunk_payload,
+            mesh, widths, engine, checkpoint_base, checkpoint_every)
+    finally:
+        if opened:
+            close = getattr(reader, "close", None)
+            if close is not None:
+                close()
+
+
+def _time_shard_local_accum(reader, dms, rank, count, nsub, group_size,
+                            chunk_payload, mesh, widths, engine,
+                            checkpoint_base, checkpoint_every):
+    import jax.numpy as jnp
+
+    from pypulsar_tpu.parallel import make_sweep_plan
+    from pypulsar_tpu.parallel.staged import _ReaderSource
+    from pypulsar_tpu.parallel.sweep import (
+        AccumParts,
+        SweepCheckpoint,
+        sweep_stream,
+    )
+
+    probe = _ReaderSource(reader)  # full-file view for geometry
+    T = probe.nsamples
+    dms = np.asarray(dms, dtype=np.float64)
+    pad_groups_to = None
+    if mesh is not None:
+        # group padding so groups divide the mesh axis (same rule as
+        # staged._run_step; group_size<=0 resolves inside make_sweep_plan,
+        # so resolve it first for the ceiling arithmetic)
+        from pypulsar_tpu.parallel.sweep import choose_group_size
+
+        gs = group_size
+        if gs <= 0:
+            gs = choose_group_size(dms, probe.frequencies, probe.tsamp, nsub)
+        ndm = mesh.shape["dm"]
+        G = -(-len(dms) // gs)
+        pad_groups_to = -(-G // ndm) * ndm
+        group_size = gs
+    plan = make_sweep_plan(dms, probe.frequencies, probe.tsamp, nsub=nsub,
+                           group_size=group_size, widths=tuple(widths),
+                           pad_groups_to=pad_groups_to)
+    if chunk_payload is None:
+        n = 1 << 17
+        while plan.min_overlap >= n // 2:
+            n <<= 1
+        chunk_payload = n - plan.min_overlap
+    payload = min(chunk_payload, T)
+    if payload <= plan.min_overlap:
+        payload = min(T, 2 * plan.min_overlap + 1)
+
+    # common per-channel baseline: the FILE's first block, computed the
+    # same way sweep_stream would (f32 mean of the ingested block), so a
+    # 1-host run bit-matches plain sweep_flat
+    src0 = _ReaderSource(reader, 0, min(payload, T))
+    _, first = next(iter(src0.chan_major_blocks(payload, plan.min_overlap)))
+    baseline = jnp.mean(jnp.asarray(first, dtype=jnp.float32), axis=1,
+                        keepdims=True)
+
+    # contiguous whole-chunk windows, chunk-balanced across hosts
+    nchunks = -(-T // payload)
+    per = -(-nchunks // count)
+    s0 = min(rank * per * payload, T)
+    s1 = min((rank + 1) * per * payload, T)
+    if s0 >= s1:  # more hosts than chunks: identity contribution
+        D, W = plan.n_trials, len(plan.widths)
+        return plan, AccumParts(
+            0, np.zeros(D), np.zeros(D),
+            np.full((D, W), -np.inf, np.float32),
+            np.zeros((D, W), np.int64),
+            float(np.asarray(baseline, np.float64).sum()))
+    src = _ReaderSource(reader, s0, s1)
+    blocks = src.chan_major_blocks(payload, plan.min_overlap)
+    ckpt = (SweepCheckpoint(f"{checkpoint_base}.r{rank}",
+                            every=checkpoint_every)
+            if checkpoint_base else None)
+    return plan, sweep_stream(plan, blocks, payload, mesh=mesh,
+                              chan_major=True, baseline=baseline,
+                              engine=engine, checkpoint=ckpt,
+                              checkpoint_context=f"/window={s0}:{s1}",
+                              finalize=False)
+
+
+def _allgather_accums(local, count: int):
+    """All ranks' AccumParts, in rank order. Packs every field into one
+    f64 matrix so the collective is a single fixed-shape all-gather
+    (``ab`` int64 sample positions are exact in f64 below 2^53)."""
+    from pypulsar_tpu.parallel.sweep import AccumParts
+
+    if count == 1:
+        return [local]
+    actual = process_count()
+    if actual != count:
+        # gathering with a mismatched grid would silently drop whole
+        # windows (only `actual` rows come back) and finalize wrong SNRs
+        raise ValueError(
+            f"time-shard count {count} != jax process count {actual}; "
+            f"for in-process testing merge time_shard_local_accum parts "
+            f"with sweep.merge_accum_parts instead")
+    from jax.experimental import multihost_utils
+
+    D, W = local.mb.shape
+    packed = np.concatenate([
+        np.full(1, float(local.n)),
+        np.full(1, local.baseline_sum),
+        np.asarray(local.s, np.float64),
+        np.asarray(local.ss, np.float64),
+        np.asarray(local.mb, np.float64).ravel(),
+        np.asarray(local.ab, np.float64).ravel(),
+    ])
+    gathered = np.asarray(multihost_utils.process_allgather(packed))
+    parts = []
+    for row in gathered:
+        o = 2
+        s = row[o:o + D]; o += D
+        ss = row[o:o + D]; o += D
+        mb = row[o:o + D * W].reshape(D, W).astype(np.float32); o += D * W
+        ab = row[o:o + D * W].reshape(D, W).astype(np.int64)
+        parts.append(AccumParts(int(row[0]), s, ss, mb, ab, float(row[1])))
+    return parts
 
 
 def multi_host_sweep(

@@ -167,19 +167,31 @@ def _ingest_tc(raw_tc, flip: bool):
 class _ReaderSource:
     """Block source over a file reader (FilterbankFile / PsrfitsFile /
     FilterbankObs): anything with ``frequencies``, ``tsamp`` and either
-    ``get_samples(start, N) -> [time, chan]`` or ``get_spectra(start, N)``."""
+    ``get_samples(start, N) -> [time, chan]`` or ``get_spectra(start, N)``.
 
-    def __init__(self, reader):
+    ``start``/``end`` bound the source to a sample window whose blocks
+    still read their dedispersion overlap PAST ``end`` (into the
+    neighbouring window's data, clamped at the file tail) — the
+    overlap-save seam contract that lets time-sharded hosts each sweep a
+    window and merge accumulators exactly (parallel.distributed.
+    time_sharded_sweep). Positions stay file-absolute."""
+
+    def __init__(self, reader, start: int = 0, end: Optional[int] = None):
         self.reader = reader
         self.frequencies, self._flip = _band_orientation(reader.frequencies)
         self.tsamp = float(reader.tsamp)
         for attr in ("number_of_samples", "nspec", "nsamples"):
             n = getattr(reader, attr, None)
             if n is not None:
-                self.nsamples = int(n() if callable(n) else n)
+                self.total = int(n() if callable(n) else n)
                 break
         else:
             raise ValueError(f"cannot determine sample count of {reader!r}")
+        self.start = int(start)
+        self.end = self.total if end is None else min(int(end), self.total)
+        if not 0 <= self.start <= self.end:
+            raise ValueError(f"bad window [{start}, {end}) of {self.total}")
+        self.nsamples = self.end - self.start
 
     def chan_major_blocks(self, payload: int, overlap: int):
         iter_blocks = getattr(self.reader, "iter_blocks", None)
@@ -192,15 +204,22 @@ class _ReaderSource:
             # take the fallback branches below. Blocks ship in the file's
             # NATIVE dtype and are transposed/widened/flipped on device
             # (_ingest_tc): 4x less link traffic for 8-bit files.
-            raw_blocks = iter_blocks(payload, overlap, raw=True)
+            # read_end extends past the window so in-window blocks keep
+            # their full overlap; iteration stops at the window end (the
+            # iterator would otherwise yield overhang-only tail blocks).
+            read_end = min(self.end + overlap, self.total)
+            raw_blocks = iter_blocks(payload, overlap, start=self.start,
+                                     end=read_end, raw=True)
             for pos, dev in _ship_ahead(raw_blocks):
+                if pos >= self.end:
+                    break
                 yield pos, _ingest_tc(dev, self._flip)
             return
         get_samples = getattr(self.reader, "get_samples", None)
         get_interval = getattr(self.reader, "get_sample_interval", None)
-        pos = 0
-        while pos < self.nsamples:
-            n = min(payload + overlap, self.nsamples - pos)
+        pos = self.start
+        while pos < self.end:
+            n = min(payload + overlap, self.total - pos)
             if get_samples is not None:
                 block = np.ascontiguousarray(get_samples(pos, n).T)
             elif get_interval is not None:  # fbobs multi-file

@@ -38,7 +38,7 @@ import dataclasses
 import functools
 import os
 from functools import partial
-from typing import Optional, Sequence, Tuple
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -502,6 +502,46 @@ class SweepResult:
         ]
 
 
+class AccumParts(NamedTuple):
+    """Raw sweep accumulator state (``sweep_stream(finalize=False)``):
+    everything :func:`finalize_sweep` needs, in mergeable form. ``mb``
+    carries f32 window-sum maxima and ``ab`` their global sample
+    positions; ``s``/``ss`` are host-f64 moment sums over ``n`` payload
+    samples; ``baseline_sum`` restores original units."""
+
+    n: int
+    s: np.ndarray
+    ss: np.ndarray
+    mb: np.ndarray
+    ab: np.ndarray
+    baseline_sum: float
+
+
+def merge_accum_parts(parts: Sequence["AccumParts"]) -> "AccumParts":
+    """Merge per-window accumulators IN ORDER (earliest window first).
+
+    Addition order of the f64 moment sums is then deterministic, and max
+    tie-breaking keeps the earliest window's peak — the same choice the
+    sequential chunk loop makes (``_Accum.update`` keeps the incumbent on
+    ties), so a time-sharded sweep merges to the sequential result up to
+    f64 re-association of the moment sums (mb/ab exactly equal)."""
+    if not parts:
+        raise ValueError("no accumulator parts to merge")
+    n = parts[0].n
+    s = np.array(parts[0].s, dtype=np.float64)
+    ss = np.array(parts[0].ss, dtype=np.float64)
+    mb = np.array(parts[0].mb)
+    ab = np.array(parts[0].ab, dtype=np.int64)
+    for p in parts[1:]:
+        n += p.n
+        s += p.s
+        ss += p.ss
+        better = p.mb > mb
+        mb = np.where(better, p.mb, mb)
+        ab = np.where(better, p.ab, ab)
+    return AccumParts(n, s, ss, mb, ab, parts[0].baseline_sum)
+
+
 class _Accum:
     def __init__(self, D, W, keep_chunk_peaks: bool = False,
                  n_real: Optional[int] = None):
@@ -653,6 +693,7 @@ def sweep_stream(
     checkpoint: Optional[SweepCheckpoint] = None,
     keep_chunk_peaks: bool = False,
     checkpoint_context: str = "",
+    finalize: bool = True,
 ) -> SweepResult:
     """Run the sweep over a stream of (startsamp, block) chunks.
     ``checkpoint_context`` is appended to the checkpoint fingerprint
@@ -823,6 +864,12 @@ def sweep_stream(
         checkpoint.finish()
 
     B = float(np.asarray(baseline, dtype=np.float64).sum()) if baseline is not None else 0.0
+    if not finalize:
+        # raw accumulator parts, for callers that merge across hosts
+        # before the (single) finalize — parallel.distributed.
+        # time_sharded_sweep merges windows in time order so the f64
+        # accumulation grouping is deterministic
+        return AccumParts(acc.n, acc.s, acc.ss, acc.mb, acc.ab, B)
     return finalize_sweep(plan, acc.n, acc.s, acc.ss, acc.mb, acc.ab, B,
                           chunk_mb=acc.chunk_mb, chunk_ab=acc.chunk_ab)
 
