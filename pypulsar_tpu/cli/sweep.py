@@ -128,6 +128,16 @@ def _close(reader):
         close()
 
 
+def _load_mask(args):
+    """The --mask rfifind mask, or None (shared by all three sweep
+    entry paths)."""
+    if not args.maskfile:
+        return None
+    from pypulsar_tpu.io.rfimask import RfifindMask
+
+    return RfifindMask(args.maskfile)
+
+
 def _main_multi(args, ap, widths):
     """Multi-file / multi-host sweep (SURVEY.md §2.4 rows 4-5): this
     host's round-robin share of the file list is swept locally (flat or
@@ -142,11 +152,7 @@ def _main_multi(args, ap, widths):
     from pypulsar_tpu.parallel import make_mesh
 
     files = list(args.infile)
-    rfimask = None
-    if args.maskfile:
-        from pypulsar_tpu.io.rfimask import RfifindMask
-
-        rfimask = RfifindMask(args.maskfile)
+    rfimask = _load_mask(args)
     mesh = None
     if args.mesh:
         import jax
@@ -245,6 +251,7 @@ def _main_timeshard(args, ap, widths):
     if args.numdms is None:
         ap.error("flat mode requires --numdms")
     dms = args.lodm + args.dmstep * np.arange(args.numdms)
+    rfimask = _load_mask(args)
     mesh = None
     if args.mesh:
         import jax
@@ -260,7 +267,8 @@ def _main_timeshard(args, ap, widths):
         res = dist.time_sharded_sweep(
             reader, dms, nsub=args.nsub, group_size=args.group_size,
             chunk_payload=args.chunk, mesh=mesh, widths=widths,
-            engine=args.engine, checkpoint_base=args.checkpoint,
+            engine=args.engine, rfimask=rfimask,
+            checkpoint_base=args.checkpoint,
             checkpoint_every=args.checkpoint_every)
     finally:
         _close(reader)
@@ -403,8 +411,6 @@ def main(argv=None):
         if args.downsamp != 1 or args.all_events or args.write_dats:
             ap.error("--time-shard supports neither --downsamp nor "
                      "--all-events nor --write-dats yet")
-        if args.maskfile:
-            ap.error("--time-shard does not support --mask yet")
         return _main_timeshard(args, ap, widths)
     if len(args.infile) > 1 or dist.is_distributed():
         return _main_multi(args, ap, widths)
@@ -413,11 +419,7 @@ def main(argv=None):
     if args.checkpoint and not args.resume:
         _remove_stale_checkpoints(args.checkpoint)
     reader = _open_reader(args.infile)
-    rfimask = None
-    if args.maskfile:
-        from pypulsar_tpu.io.rfimask import RfifindMask
-
-        rfimask = RfifindMask(args.maskfile)
+    rfimask = _load_mask(args)
     mesh = None
     if args.mesh:
         import jax

@@ -149,6 +149,7 @@ def time_sharded_sweep(
     mesh=None,
     widths=None,
     engine: str = "auto",
+    rfimask=None,
     rank: Optional[int] = None,
     count: Optional[int] = None,
     checkpoint_base: Optional[str] = None,
@@ -186,7 +187,8 @@ def time_sharded_sweep(
     plan, local = time_shard_local_accum(
         path_or_reader, dms, rank, count, nsub=nsub, group_size=group_size,
         chunk_payload=chunk_payload, mesh=mesh, widths=widths, engine=engine,
-        checkpoint_base=checkpoint_base, checkpoint_every=checkpoint_every)
+        rfimask=rfimask, checkpoint_base=checkpoint_base,
+        checkpoint_every=checkpoint_every)
     parts = _allgather_accums(local, count)
     merged = merge_accum_parts(parts)
     return finalize_sweep(plan, merged.n, merged.s, merged.ss, merged.mb,
@@ -204,6 +206,7 @@ def time_shard_local_accum(
     mesh=None,
     widths=None,
     engine: str = "auto",
+    rfimask=None,
     checkpoint_base: Optional[str] = None,
     checkpoint_every: int = 16,
 ):
@@ -223,7 +226,8 @@ def time_shard_local_accum(
     try:
         return _time_shard_local_accum(
             reader, dms, rank, count, nsub, group_size, chunk_payload,
-            mesh, widths, engine, checkpoint_base, checkpoint_every)
+            mesh, widths, engine, rfimask, checkpoint_base,
+            checkpoint_every)
     finally:
         if opened:
             close = getattr(reader, "close", None)
@@ -232,12 +236,16 @@ def time_shard_local_accum(
 
 
 def _time_shard_local_accum(reader, dms, rank, count, nsub, group_size,
-                            chunk_payload, mesh, widths, engine,
+                            chunk_payload, mesh, widths, engine, rfimask,
                             checkpoint_base, checkpoint_every):
     import jax.numpy as jnp
 
     from pypulsar_tpu.parallel import make_sweep_plan
-    from pypulsar_tpu.parallel.staged import _ReaderSource
+    from pypulsar_tpu.parallel.staged import (
+        _MaskedSource,
+        _ReaderSource,
+        _mask_tag,
+    )
     from pypulsar_tpu.parallel.sweep import (
         AccumParts,
         SweepCheckpoint,
@@ -274,9 +282,12 @@ def _time_shard_local_accum(reader, dms, rank, count, nsub, group_size,
         payload = min(T, 2 * plan.min_overlap + 1)
 
     # common per-channel baseline: the FILE's first block, computed the
-    # same way sweep_stream would (f32 mean of the ingested block), so a
-    # 1-host run bit-matches plain sweep_flat
+    # same way sweep_stream would (f32 mean of the ingested block, mask
+    # fill applied first when masking), so a 1-host run bit-matches
+    # plain sweep_flat
     src0 = _ReaderSource(reader, 0, min(payload, T))
+    if rfimask is not None:
+        src0 = _MaskedSource(src0, rfimask)
     _, first = next(iter(src0.chan_major_blocks(payload, plan.min_overlap)))
     baseline = jnp.mean(jnp.asarray(first, dtype=jnp.float32), axis=1,
                         keepdims=True)
@@ -294,14 +305,17 @@ def _time_shard_local_accum(reader, dms, rank, count, nsub, group_size,
             np.zeros((D, W), np.int64),
             float(np.asarray(baseline, np.float64).sum()))
     src = _ReaderSource(reader, s0, s1)
+    if rfimask is not None:
+        src = _MaskedSource(src, rfimask)
     blocks = src.chan_major_blocks(payload, plan.min_overlap)
     ckpt = (SweepCheckpoint(f"{checkpoint_base}.r{rank}",
                             every=checkpoint_every)
             if checkpoint_base else None)
+    ctx = f"/window={s0}:{s1}" + _mask_tag(rfimask)
     return plan, sweep_stream(plan, blocks, payload, mesh=mesh,
                               chan_major=True, baseline=baseline,
                               engine=engine, checkpoint=ckpt,
-                              checkpoint_context=f"/window={s0}:{s1}",
+                              checkpoint_context=ctx,
                               finalize=False)
 
 

@@ -308,7 +308,13 @@ class _MaskedSource:
     the reference's waterfaller semantics (bin/waterfaller.py:67-100 via
     formats/spectra.py:190-227) applied at the sweep's streaming boundary.
     The wrapped source delivers high-frequency-first rows; .mask channel
-    indices are low-frequency-first, so get_chan_mask flips."""
+    indices are low-frequency-first, so the table flips on upload.
+
+    The [nint, nchan] zap table ships to the device ONCE (~KBs) and each
+    block's [C, L] mask expands from interval indices inside the fill
+    program — shipping per-block boolean masks would double the wire
+    traffic of an 8-bit streamed sweep (the measured bottleneck,
+    BENCHNOTES r4)."""
 
     def __init__(self, src, rfimask):
         self.frequencies = src.frequencies
@@ -316,15 +322,32 @@ class _MaskedSource:
         self.nsamples = src.nsamples
         self._src = src
         self._mask = rfimask
+        self._pts = int(rfimask.ptsperint)
+        self._host_table = np.asarray(rfimask._zap_table, dtype=bool)
+        self._table = jnp.asarray(
+            np.ascontiguousarray(self._host_table[:, ::-1]))  # hi-first
 
     def chan_major_blocks(self, payload: int, overlap: int):
+        nint = self._host_table.shape[0]
         for pos, block in self._src.chan_major_blocks(payload, overlap):
-            m = self._mask.get_chan_mask(pos, block.shape[1],
-                                         hifreq_first=True)
-            if m.any():
-                block = kernels.masked(
-                    jnp.asarray(block, dtype=jnp.float32), jnp.asarray(m))
+            L = int(block.shape[1])
+            i0 = min(pos // self._pts, nint - 1)
+            i1 = min((pos + L - 1) // self._pts, nint - 1)
+            if self._host_table[i0:i1 + 1].any():
+                block = _masked_block(
+                    jnp.asarray(block, dtype=jnp.float32), self._table,
+                    pos, self._pts)
             yield pos, block
+
+
+@functools.partial(jax.jit, static_argnames=("pts",))
+def _masked_block(data, table, pos, pts: int):
+    """Expand the device-resident [nint, C] zap table to this block's
+    [C, L] mask (interval = sample // pts, clamped like
+    io.rfimask.get_sample_mask) and apply the median-mid80 fill."""
+    L = data.shape[1]
+    iv = jnp.minimum((pos + jnp.arange(L)) // pts, table.shape[0] - 1)
+    return kernels.masked(data, table[iv].T)
 
 
 def _make_source(source, rfimask=None):

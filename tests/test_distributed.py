@@ -103,6 +103,47 @@ def test_time_shard_merge_matches_whole_sweep(tmp_path):
     assert abs(best["dm"] - 60.0) <= 10.0 and best["snr"] > 8.0
 
 
+def test_time_shard_masked_matches_flat(tmp_path):
+    """rfimask fill composes with time windows: the masked time-sharded
+    merge equals the masked sequential sweep (mask fill is per-block and
+    window blocks are the same blocks)."""
+    from pypulsar_tpu.io import filterbank
+    from pypulsar_tpu.io.rfimask import RfifindMask, write_mask
+    from pypulsar_tpu.parallel.staged import sweep_flat
+    from pypulsar_tpu.parallel.sweep import finalize_sweep, merge_accum_parts
+
+    fn = str(tmp_path / "tsm.fil")
+    _write_fil(fn, dm=60.0, t0=6000, seed=5, T=8192)
+    # DIFFERENT channels per interval: a window-relative (instead of
+    # file-absolute) interval lookup on rank 1 would fill the wrong
+    # channels and fail the parity below
+    maskfn = str(tmp_path / "tsm.mask")
+    nint = 4
+    write_mask(maskfn, nchan=32, nint=nint, ptsperint=8192 // nint,
+               zap_chans=np.array([], np.int64),
+               zap_ints=np.array([], np.int64),
+               zap_chans_per_int=[np.array([3]), np.array([5, 11]),
+                                  np.array([7]), np.array([9, 20])])
+    mask = RfifindMask(maskfn)
+
+    dms = np.linspace(0.0, 100.0, 12)
+    whole = sweep_flat(filterbank.FilterbankFile(fn), dms, nsub=8,
+                       group_size=4, chunk_payload=2048,
+                       rfimask=mask).steps[0].result
+    plan = None
+    parts = []
+    for rank in (0, 1):
+        plan, acc = distributed.time_shard_local_accum(
+            fn, dms, rank, 2, nsub=8, group_size=4, chunk_payload=2048,
+            rfimask=mask)
+        parts.append(acc)
+    merged = merge_accum_parts(parts)
+    res = finalize_sweep(plan, merged.n, merged.s, merged.ss, merged.mb,
+                         merged.ab, merged.baseline_sum)
+    np.testing.assert_array_equal(res.peak_sample, whole.peak_sample)
+    np.testing.assert_allclose(res.snr, whole.snr, rtol=1e-9, atol=1e-9)
+
+
 def test_time_shard_single_count_matches_flat(tmp_path):
     """count=1 time_sharded_sweep is exactly sweep_flat (the degenerate
     window is the whole file and no collective runs)."""
