@@ -109,6 +109,55 @@ def test_ship_ahead_disabled_matches_enabled(tmp_path, monkeypatch):
     np.testing.assert_array_equal(a.peak_sample, b.peak_sample)
 
 
+def test_ship_ahead_propagates_worker_errors():
+    """An exception in the block producer (disk error, bad header)
+    surfaces in the consumer instead of hanging or being swallowed by
+    the ship thread."""
+    import pytest
+
+    from pypulsar_tpu.parallel.staged import _ship_ahead
+
+    def bad_blocks():
+        yield 0, np.zeros((4, 16), np.float32)
+        raise OSError("disk pulled")
+
+    it = _ship_ahead(bad_blocks())
+    pos, _ = next(it)
+    assert pos == 0
+    with pytest.raises(OSError, match="disk pulled"):
+        for _ in it:
+            pass
+
+
+def test_ship_ahead_abandoned_consumer_stops_worker():
+    """Breaking out of the stream signals the ship thread to stop
+    instead of shipping the remaining blocks (review r4: an abandoned
+    57 GB sweep must not spend minutes shipping the rest of the file)."""
+    import threading
+    import time
+
+    from pypulsar_tpu.parallel.staged import _ship_ahead
+
+    produced = []
+
+    def blocks():
+        for i in range(1000):
+            produced.append(i)
+            yield i, np.zeros((4, 16), np.float32)
+
+    it = _ship_ahead(blocks(), depth=2)
+    next(it)
+    it.close()  # GeneratorExit -> stop event + drain
+    deadline = time.time() + 5.0
+    while time.time() < deadline and any(
+            t.name == "pypulsar-ship-ahead" and t.is_alive()
+            for t in threading.enumerate()):
+        time.sleep(0.05)
+    assert not any(t.name == "pypulsar-ship-ahead" and t.is_alive()
+                   for t in threading.enumerate())
+    assert len(produced) < 20  # worker stopped early, not after 1000
+
+
 def test_sweep_cli_flat_writes_cands(tmp_path, capsys):
     from pypulsar_tpu.cli import sweep as sweep_cli
 
