@@ -269,11 +269,13 @@ def _main_timeshard(args, ap, widths):
             chunk_payload=args.chunk, mesh=mesh, widths=widths,
             engine=args.engine, rfimask=rfimask,
             checkpoint_base=args.checkpoint,
-            checkpoint_every=args.checkpoint_every)
+            checkpoint_every=args.checkpoint_every,
+            downsamp=args.downsamp)
     finally:
         _close(reader)
     staged = StagedSweepResult(
-        steps=[StepResult(downsamp=1, dt=dt, result=res)])
+        steps=[StepResult(downsamp=args.downsamp, dt=dt * args.downsamp,
+                          result=res)])
     hits = staged.above_threshold(args.threshold)
     if dist.process_index() == 0:
         _write_cands(outbase + ".cands", hits)
@@ -408,9 +410,11 @@ def main(argv=None):
                      "default multi-file mode)")
         if args.ddplan:
             ap.error("--time-shard is a flat-mode option")
-        if args.downsamp != 1 or args.all_events or args.write_dats:
-            ap.error("--time-shard supports neither --downsamp nor "
-                     "--all-events nor --write-dats yet")
+        if args.all_events or args.write_dats:
+            ap.error("--time-shard supports neither --all-events nor "
+                     "--write-dats yet")
+        if args.downsamp < 1:
+            ap.error("--downsamp must be >= 1")
         return _main_timeshard(args, ap, widths)
     if len(args.infile) > 1 or dist.is_distributed():
         return _main_multi(args, ap, widths)

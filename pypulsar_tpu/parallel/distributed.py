@@ -154,6 +154,7 @@ def time_sharded_sweep(
     count: Optional[int] = None,
     checkpoint_base: Optional[str] = None,
     checkpoint_every: int = 16,
+    downsamp: int = 1,
 ):
     """Sweep ONE file with its TIME axis sharded across hosts.
 
@@ -188,7 +189,7 @@ def time_sharded_sweep(
         path_or_reader, dms, rank, count, nsub=nsub, group_size=group_size,
         chunk_payload=chunk_payload, mesh=mesh, widths=widths, engine=engine,
         rfimask=rfimask, checkpoint_base=checkpoint_base,
-        checkpoint_every=checkpoint_every)
+        checkpoint_every=checkpoint_every, downsamp=downsamp)
     parts = _allgather_accums(local, count)
     merged = merge_accum_parts(parts)
     return finalize_sweep(plan, merged.n, merged.s, merged.ss, merged.mb,
@@ -209,10 +210,12 @@ def time_shard_local_accum(
     rfimask=None,
     checkpoint_base: Optional[str] = None,
     checkpoint_every: int = 16,
+    downsamp: int = 1,
 ):
     """(plan, AccumParts) for rank's window of the file — the mergeable
     half of :func:`time_sharded_sweep` (windows merge with
-    ``sweep.merge_accum_parts`` in rank order)."""
+    ``sweep.merge_accum_parts`` in rank order). ``downsamp`` sweeps the
+    factor-downsampled series (windows align to whole raw bins)."""
     from pypulsar_tpu.parallel.sweep import DEFAULT_WIDTHS
 
     if widths is None:
@@ -227,7 +230,7 @@ def time_shard_local_accum(
         return _time_shard_local_accum(
             reader, dms, rank, count, nsub, group_size, chunk_payload,
             mesh, widths, engine, rfimask, checkpoint_base,
-            checkpoint_every)
+            checkpoint_every, downsamp=downsamp)
     finally:
         if opened:
             close = getattr(reader, "close", None)
@@ -237,13 +240,14 @@ def time_shard_local_accum(
 
 def _time_shard_local_accum(reader, dms, rank, count, nsub, group_size,
                             chunk_payload, mesh, widths, engine, rfimask,
-                            checkpoint_base, checkpoint_every):
+                            checkpoint_base, checkpoint_every, downsamp=1):
     import jax.numpy as jnp
 
     from pypulsar_tpu.parallel import make_sweep_plan
     from pypulsar_tpu.parallel.staged import (
         _MaskedSource,
         _ReaderSource,
+        _downsampled_blocks,
         _mask_tag,
     )
     from pypulsar_tpu.parallel.sweep import (
@@ -252,8 +256,9 @@ def _time_shard_local_accum(reader, dms, rank, count, nsub, group_size,
         sweep_stream,
     )
 
+    factor = max(1, int(downsamp))
     probe = _ReaderSource(reader)  # full-file view for geometry
-    T = probe.nsamples
+    T = probe.nsamples // factor   # downsampled samples (the sweep grid)
     dms = np.asarray(dms, dtype=np.float64)
     pad_groups_to = None
     if mesh is not None:
@@ -264,13 +269,15 @@ def _time_shard_local_accum(reader, dms, rank, count, nsub, group_size,
 
         gs = group_size
         if gs <= 0:
-            gs = choose_group_size(dms, probe.frequencies, probe.tsamp, nsub)
+            gs = choose_group_size(dms, probe.frequencies,
+                                   probe.tsamp * factor, nsub)
         ndm = mesh.shape["dm"]
         G = -(-len(dms) // gs)
         pad_groups_to = -(-G // ndm) * ndm
         group_size = gs
-    plan = make_sweep_plan(dms, probe.frequencies, probe.tsamp, nsub=nsub,
-                           group_size=group_size, widths=tuple(widths),
+    plan = make_sweep_plan(dms, probe.frequencies, probe.tsamp * factor,
+                           nsub=nsub, group_size=group_size,
+                           widths=tuple(widths),
                            pad_groups_to=pad_groups_to)
     if chunk_payload is None:
         n = 1 << 17
@@ -281,18 +288,21 @@ def _time_shard_local_accum(reader, dms, rank, count, nsub, group_size,
     if payload <= plan.min_overlap:
         payload = min(T, 2 * plan.min_overlap + 1)
 
-    # common per-channel baseline: the FILE's first block, computed the
-    # same way sweep_stream would (f32 mean of the ingested block, mask
-    # fill applied first when masking), so a 1-host run bit-matches
-    # plain sweep_flat
-    src0 = _ReaderSource(reader, 0, min(payload, T))
+    # common per-channel baseline: the FILE's first (downsampled) block,
+    # computed the same way sweep_stream would (f32 mean of the ingested
+    # block, mask fill applied first when masking), so a 1-host run
+    # bit-matches plain sweep_flat
+    src0 = _ReaderSource(reader, 0, min(payload, T) * factor)
     if rfimask is not None:
         src0 = _MaskedSource(src0, rfimask)
-    _, first = next(iter(src0.chan_major_blocks(payload, plan.min_overlap)))
+    _, first = next(iter(_downsampled_blocks(
+        src0, factor, payload, plan.min_overlap)))
     baseline = jnp.mean(jnp.asarray(first, dtype=jnp.float32), axis=1,
                         keepdims=True)
 
     # contiguous whole-chunk windows, chunk-balanced across hosts
+    # (coordinates below are DOWNSAMPLED samples; raw file offsets scale
+    # by the factor)
     nchunks = -(-T // payload)
     per = -(-nchunks // count)
     s0 = min(rank * per * payload, T)
@@ -304,14 +314,18 @@ def _time_shard_local_accum(reader, dms, rank, count, nsub, group_size,
             np.full((D, W), -np.inf, np.float32),
             np.zeros((D, W), np.int64),
             float(np.asarray(baseline, np.float64).sum()))
-    src = _ReaderSource(reader, s0, s1)
+    src = _ReaderSource(reader, s0 * factor, s1 * factor)
     if rfimask is not None:
         src = _MaskedSource(src, rfimask)
-    blocks = src.chan_major_blocks(payload, plan.min_overlap)
+    blocks = _downsampled_blocks(src, factor, payload, plan.min_overlap)
     ckpt = (SweepCheckpoint(f"{checkpoint_base}.r{rank}",
                             every=checkpoint_every)
             if checkpoint_base else None)
-    ctx = f"/window={s0}:{s1}" + _mask_tag(rfimask)
+    # ds tag only when downsampling: ds=1 results are bit-identical to
+    # the pre-downsamp format, and tagging them would spuriously
+    # invalidate every existing plain time-shard checkpoint on resume
+    ds_tag = f"/ds={factor}" if factor > 1 else ""
+    ctx = f"/window={s0}:{s1}{ds_tag}" + _mask_tag(rfimask)
     return plan, sweep_stream(plan, blocks, payload, mesh=mesh,
                               chan_major=True, baseline=baseline,
                               engine=engine, checkpoint=ckpt,

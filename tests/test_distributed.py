@@ -144,6 +144,35 @@ def test_time_shard_masked_matches_flat(tmp_path):
     np.testing.assert_allclose(res.snr, whole.snr, rtol=1e-9, atol=1e-9)
 
 
+def test_time_shard_downsampled_matches_flat(tmp_path):
+    """--downsamp composes with time windows: windows align to whole raw
+    bins, so the downsampled shard merge equals the downsampled
+    sequential sweep."""
+    from pypulsar_tpu.io import filterbank
+    from pypulsar_tpu.parallel.staged import sweep_flat
+    from pypulsar_tpu.parallel.sweep import finalize_sweep, merge_accum_parts
+
+    fn = str(tmp_path / "tsd.fil")
+    _write_fil(fn, dm=60.0, t0=6000, seed=6, T=8192)
+    dms = np.linspace(0.0, 100.0, 12)
+    whole = sweep_flat(filterbank.FilterbankFile(fn), dms, downsamp=2,
+                       nsub=8, group_size=4,
+                       chunk_payload=1024).steps[0].result
+    plan = None
+    parts = []
+    for rank in (0, 1):
+        plan, acc = distributed.time_shard_local_accum(
+            fn, dms, rank, 2, nsub=8, group_size=4, chunk_payload=1024,
+            downsamp=2)
+        parts.append(acc)
+    assert parts[0].n + parts[1].n == 4096  # downsampled sample count
+    merged = merge_accum_parts(parts)
+    res = finalize_sweep(plan, merged.n, merged.s, merged.ss, merged.mb,
+                         merged.ab, merged.baseline_sum)
+    np.testing.assert_array_equal(res.peak_sample, whole.peak_sample)
+    np.testing.assert_allclose(res.snr, whole.snr, rtol=1e-9, atol=1e-9)
+
+
 def test_time_shard_single_count_matches_flat(tmp_path):
     """count=1 time_sharded_sweep is exactly sweep_flat (the degenerate
     window is the whole file and no collective runs)."""
