@@ -128,6 +128,30 @@ def _close(reader):
         close()
 
 
+def _emit_events(staged, outbase, args):
+    """Write the --all-events artifacts (.events multi-event list +
+    .pulses friends-of-friends groups) — shared by the flat single-file
+    and time-shard paths so grouping defaults cannot diverge."""
+    from pypulsar_tpu.parallel.events import group_events
+
+    events = staged.events(args.threshold)
+    _write_cands(outbase + ".events", events)
+    # grouping tolerances follow the search grid unless overridden:
+    # one pulse spans adjacent trials (DM) and boxcar widths (time)
+    dm_tol = (args.group_dm_tol if args.group_dm_tol is not None
+              else max(3.0 * args.dmstep, 1.0))
+    time_tol = (args.group_time_tol if args.group_time_tol is not None
+                else 4.0 * max(e["width_sec"] for e in events)
+                if events else 0.02)
+    pulses = group_events(events, time_tol=time_tol, dm_tol=dm_tol)
+    _write_cands(outbase + ".pulses", pulses, extra_cols=(
+        ("n_hits", "n_hits", "%-7d"), ("dm_lo", "dm_lo", "%-8.3f"),
+        ("dm_hi", "dm_hi", "%-8.3f")))
+    print(f"# {len(events)} above-threshold events -> {outbase}.events; "
+          f"{len(pulses)} grouped pulses -> {outbase}.pulses "
+          f"(time_tol={time_tol:.4g}s, dm_tol={dm_tol:.4g})")
+
+
 def _load_mask(args):
     """The --mask rfifind mask, or None (shared by all three sweep
     entry paths)."""
@@ -270,7 +294,8 @@ def _main_timeshard(args, ap, widths):
             engine=args.engine, rfimask=rfimask,
             checkpoint_base=args.checkpoint,
             checkpoint_every=args.checkpoint_every,
-            downsamp=args.downsamp)
+            downsamp=args.downsamp,
+            keep_chunk_peaks=args.all_events)
     finally:
         _close(reader)
     staged = StagedSweepResult(
@@ -279,6 +304,8 @@ def _main_timeshard(args, ap, widths):
     hits = staged.above_threshold(args.threshold)
     if dist.process_index() == 0:
         _write_cands(outbase + ".cands", hits)
+        if args.all_events:
+            _emit_events(staged, outbase, args)
     print(f"# [host {dist.process_index()}/{dist.process_count()}] "
           f"time-sharded: {staged.n_trials} DM trials, {len(hits)} "
           f"detections >= {args.threshold} sigma -> {outbase}.cands")
@@ -410,9 +437,8 @@ def main(argv=None):
                      "default multi-file mode)")
         if args.ddplan:
             ap.error("--time-shard is a flat-mode option")
-        if args.all_events or args.write_dats:
-            ap.error("--time-shard supports neither --all-events nor "
-                     "--write-dats yet")
+        if args.write_dats:
+            ap.error("--time-shard does not support --write-dats yet")
         if args.downsamp < 1:
             ap.error("--downsamp must be >= 1")
         return _main_timeshard(args, ap, widths)
@@ -464,24 +490,7 @@ def main(argv=None):
     hits = staged.above_threshold(args.threshold)
     _write_cands(outbase + ".cands", hits)
     if args.all_events:
-        from pypulsar_tpu.parallel.events import group_events
-
-        events = staged.events(args.threshold)
-        _write_cands(outbase + ".events", events)
-        # grouping tolerances follow the search grid unless overridden:
-        # one pulse spans adjacent trials (DM) and boxcar widths (time)
-        dm_tol = (args.group_dm_tol if args.group_dm_tol is not None
-                  else max(3.0 * args.dmstep, 1.0))
-        time_tol = (args.group_time_tol if args.group_time_tol is not None
-                    else 4.0 * max(e["width_sec"] for e in events)
-                    if events else 0.02)
-        pulses = group_events(events, time_tol=time_tol, dm_tol=dm_tol)
-        _write_cands(outbase + ".pulses", pulses, extra_cols=(
-            ("n_hits", "n_hits", "%-7d"), ("dm_lo", "dm_lo", "%-8.3f"),
-            ("dm_hi", "dm_hi", "%-8.3f")))
-        print(f"# {len(events)} above-threshold events -> {outbase}.events; "
-              f"{len(pulses)} grouped pulses -> {outbase}.pulses "
-              f"(time_tol={time_tol:.4g}s, dm_tol={dm_tol:.4g})")
+        _emit_events(staged, outbase, args)
     print(f"# {staged.n_trials} DM trials swept; {len(hits)} detections "
           f">= {args.threshold} sigma -> {outbase}.cands")
     for c in staged.best(args.topk):

@@ -155,6 +155,7 @@ def time_sharded_sweep(
     checkpoint_base: Optional[str] = None,
     checkpoint_every: int = 16,
     downsamp: int = 1,
+    keep_chunk_peaks: bool = False,
 ):
     """Sweep ONE file with its TIME axis sharded across hosts.
 
@@ -189,11 +190,15 @@ def time_sharded_sweep(
         path_or_reader, dms, rank, count, nsub=nsub, group_size=group_size,
         chunk_payload=chunk_payload, mesh=mesh, widths=widths, engine=engine,
         rfimask=rfimask, checkpoint_base=checkpoint_base,
-        checkpoint_every=checkpoint_every, downsamp=downsamp)
-    parts = _allgather_accums(local, count)
+        checkpoint_every=checkpoint_every, downsamp=downsamp,
+        keep_chunk_peaks=keep_chunk_peaks)
+    parts = _allgather_accums(local, count, with_peaks=keep_chunk_peaks,
+                              nr=plan.n_real_trials)
     merged = merge_accum_parts(parts)
     return finalize_sweep(plan, merged.n, merged.s, merged.ss, merged.mb,
-                          merged.ab, merged.baseline_sum)
+                          merged.ab, merged.baseline_sum,
+                          chunk_mb=list(merged.chunk_mb) or None,
+                          chunk_ab=list(merged.chunk_ab) or None)
 
 
 def time_shard_local_accum(
@@ -211,11 +216,14 @@ def time_shard_local_accum(
     checkpoint_base: Optional[str] = None,
     checkpoint_every: int = 16,
     downsamp: int = 1,
+    keep_chunk_peaks: bool = False,
 ):
     """(plan, AccumParts) for rank's window of the file — the mergeable
     half of :func:`time_sharded_sweep` (windows merge with
     ``sweep.merge_accum_parts`` in rank order). ``downsamp`` sweeps the
-    factor-downsampled series (windows align to whole raw bins)."""
+    factor-downsampled series (windows align to whole raw bins);
+    ``keep_chunk_peaks`` carries per-chunk peak records for multi-event
+    single-pulse lists (--all-events)."""
     from pypulsar_tpu.parallel.sweep import DEFAULT_WIDTHS
 
     if widths is None:
@@ -230,7 +238,8 @@ def time_shard_local_accum(
         return _time_shard_local_accum(
             reader, dms, rank, count, nsub, group_size, chunk_payload,
             mesh, widths, engine, rfimask, checkpoint_base,
-            checkpoint_every, downsamp=downsamp)
+            checkpoint_every, downsamp=downsamp,
+            keep_chunk_peaks=keep_chunk_peaks)
     finally:
         if opened:
             close = getattr(reader, "close", None)
@@ -240,7 +249,8 @@ def time_shard_local_accum(
 
 def _time_shard_local_accum(reader, dms, rank, count, nsub, group_size,
                             chunk_payload, mesh, widths, engine, rfimask,
-                            checkpoint_base, checkpoint_every, downsamp=1):
+                            checkpoint_base, checkpoint_every, downsamp=1,
+                            keep_chunk_peaks=False):
     import jax.numpy as jnp
 
     from pypulsar_tpu.parallel import make_sweep_plan
@@ -330,13 +340,19 @@ def _time_shard_local_accum(reader, dms, rank, count, nsub, group_size,
                               chan_major=True, baseline=baseline,
                               engine=engine, checkpoint=ckpt,
                               checkpoint_context=ctx,
+                              keep_chunk_peaks=keep_chunk_peaks,
                               finalize=False)
 
 
-def _allgather_accums(local, count: int):
+def _allgather_accums(local, count: int, with_peaks: bool = False,
+                      nr: int = 0):
     """All ranks' AccumParts, in rank order. Packs every field into one
     f64 matrix so the collective is a single fixed-shape all-gather
-    (``ab`` int64 sample positions are exact in f64 below 2^53)."""
+    (``ab`` int64 sample positions are exact in f64 below 2^53).
+    ``with_peaks`` additionally gathers the per-chunk peak records
+    ([nr, W] per chunk; chunk counts differ per rank, so counts gather
+    first and arrays pad to the max — every rank must pass the same
+    ``with_peaks`` or the collectives deadlock)."""
     from pypulsar_tpu.parallel.sweep import AccumParts
 
     if count == 1:
@@ -369,6 +385,28 @@ def _allgather_accums(local, count: int):
         mb = row[o:o + D * W].reshape(D, W).astype(np.float32); o += D * W
         ab = row[o:o + D * W].reshape(D, W).astype(np.int64)
         parts.append(AccumParts(int(row[0]), s, ss, mb, ab, float(row[1])))
+    if with_peaks:
+        nloc = len(local.chunk_mb)
+        counts = np.asarray(multihost_utils.process_allgather(
+            np.asarray([nloc], np.int64))).reshape(-1)
+        m = int(counts.max())
+        if m:
+            # native dtypes (f32 peaks, i64 positions) in two gathers:
+            # a single f64 buffer would cost 16 B/cell vs these 12 — at
+            # survey scale (2700 chunks x 2000 trials x 6 widths) that
+            # is hundreds of MB of DCN per host
+            mb_buf = np.zeros((m, nr, W), np.float32)
+            ab_buf = np.zeros((m, nr, W), np.int64)
+            if nloc:
+                mb_buf[:nloc] = np.stack(local.chunk_mb)
+                ab_buf[:nloc] = np.stack(local.chunk_ab)
+            g_mb = np.asarray(multihost_utils.process_allgather(mb_buf))
+            g_ab = np.asarray(multihost_utils.process_allgather(ab_buf))
+            for r in range(count):
+                c = int(counts[r])
+                parts[r] = parts[r]._replace(
+                    chunk_mb=tuple(g_mb[r, i] for i in range(c)),
+                    chunk_ab=tuple(g_ab[r, i] for i in range(c)))
     return parts
 
 

@@ -507,7 +507,10 @@ class AccumParts(NamedTuple):
     everything :func:`finalize_sweep` needs, in mergeable form. ``mb``
     carries f32 window-sum maxima and ``ab`` their global sample
     positions; ``s``/``ss`` are host-f64 moment sums over ``n`` payload
-    samples; ``baseline_sum`` restores original units."""
+    samples; ``baseline_sum`` restores original units. ``chunk_mb``/
+    ``chunk_ab`` (with ``keep_chunk_peaks``) are the per-chunk peak
+    records in stream order — window-local slices of the sequential
+    sweep's chunk sequence, so cross-window merging is concatenation."""
 
     n: int
     s: np.ndarray
@@ -515,6 +518,8 @@ class AccumParts(NamedTuple):
     mb: np.ndarray
     ab: np.ndarray
     baseline_sum: float
+    chunk_mb: tuple = ()
+    chunk_ab: tuple = ()
 
 
 def merge_accum_parts(parts: Sequence["AccumParts"]) -> "AccumParts":
@@ -524,7 +529,9 @@ def merge_accum_parts(parts: Sequence["AccumParts"]) -> "AccumParts":
     tie-breaking keeps the earliest window's peak — the same choice the
     sequential chunk loop makes (``_Accum.update`` keeps the incumbent on
     ties), so a time-sharded sweep merges to the sequential result up to
-    f64 re-association of the moment sums (mb/ab exactly equal)."""
+    f64 re-association of the moment sums (mb/ab exactly equal). Chunk
+    peak records concatenate in window order (= the sequential chunk
+    order)."""
     if not parts:
         raise ValueError("no accumulator parts to merge")
     n = parts[0].n
@@ -532,6 +539,8 @@ def merge_accum_parts(parts: Sequence["AccumParts"]) -> "AccumParts":
     ss = np.array(parts[0].ss, dtype=np.float64)
     mb = np.array(parts[0].mb)
     ab = np.array(parts[0].ab, dtype=np.int64)
+    chunk_mb = tuple(parts[0].chunk_mb)
+    chunk_ab = tuple(parts[0].chunk_ab)
     for p in parts[1:]:
         n += p.n
         s += p.s
@@ -539,7 +548,10 @@ def merge_accum_parts(parts: Sequence["AccumParts"]) -> "AccumParts":
         better = p.mb > mb
         mb = np.where(better, p.mb, mb)
         ab = np.where(better, p.ab, ab)
-    return AccumParts(n, s, ss, mb, ab, parts[0].baseline_sum)
+        chunk_mb += tuple(p.chunk_mb)
+        chunk_ab += tuple(p.chunk_ab)
+    return AccumParts(n, s, ss, mb, ab, parts[0].baseline_sum,
+                      chunk_mb, chunk_ab)
 
 
 class _Accum:
@@ -869,7 +881,8 @@ def sweep_stream(
         # before the (single) finalize — parallel.distributed.
         # time_sharded_sweep merges windows in time order so the f64
         # accumulation grouping is deterministic
-        return AccumParts(acc.n, acc.s, acc.ss, acc.mb, acc.ab, B)
+        return AccumParts(acc.n, acc.s, acc.ss, acc.mb, acc.ab, B,
+                          tuple(acc.chunk_mb), tuple(acc.chunk_ab))
     return finalize_sweep(plan, acc.n, acc.s, acc.ss, acc.mb, acc.ab, B,
                           chunk_mb=acc.chunk_mb, chunk_ab=acc.chunk_ab)
 

@@ -282,6 +282,60 @@ def test_time_sharded_sweep_two_process(tmp_path):
     np.testing.assert_allclose(s0, whole.snr, rtol=1e-5, atol=1e-4)
 
 
+def test_time_shard_events_match_flat(tmp_path):
+    """--all-events composes with time sharding: window-local per-chunk
+    peak records concatenate in rank order to exactly the sequential
+    sweep's chunk sequence, so the multi-event list is identical."""
+    from pypulsar_tpu.io import filterbank
+    from pypulsar_tpu.parallel.staged import sweep_flat
+    from pypulsar_tpu.parallel.sweep import finalize_sweep, merge_accum_parts
+
+    fn = str(tmp_path / "tse.fil")
+    # one pulse per window: t0=2000 lands in rank 0's half, and a second
+    # injection at t=6.1 s in rank 1's half proves cross-window events
+    from pypulsar_tpu.io.filterbank import FilterbankFile
+    from pypulsar_tpu.io import filterbank as _fb_mod
+
+    _write_fil(fn, dm=60.0, t0=2000, seed=7, T=8192)
+    fb0 = FilterbankFile(fn)
+    data = fb0.get_samples(0, 8192)
+    freqs = 1500.0 - 2.0 * np.arange(32)
+    bins = numpy_ref.bin_delays(60.0, freqs, 1e-3)
+    for c in range(32):
+        idx = 6100 + bins[c]
+        if idx < 8192:
+            data[idx, c] += 10.0
+    hdr = dict(nchans=32, tsamp=1e-3, fch1=1500.0, foff=-2.0,
+               tstart=55000.0, nbits=32, nifs=1, source_name="DTEST")
+    _fb_mod.write_filterbank(fn, hdr, data)
+
+    dms = np.linspace(0.0, 100.0, 12)
+    whole_res = sweep_flat(FilterbankFile(fn), dms, nsub=8, group_size=4,
+                           chunk_payload=2048,
+                           keep_chunk_peaks=True).steps[0].result
+    plan = None
+    parts = []
+    for rank in (0, 1):
+        plan, acc = distributed.time_shard_local_accum(
+            fn, dms, rank, 2, nsub=8, group_size=4, chunk_payload=2048,
+            keep_chunk_peaks=True)
+        parts.append(acc)
+    assert len(parts[0].chunk_mb) + len(parts[1].chunk_mb) == 4
+    merged = merge_accum_parts(parts)
+    res = finalize_sweep(plan, merged.n, merged.s, merged.ss, merged.mb,
+                         merged.ab, merged.baseline_sum,
+                         chunk_mb=list(merged.chunk_mb),
+                         chunk_ab=list(merged.chunk_ab))
+    ev_whole = whole_res.events(6.0)
+    ev_shard = res.events(6.0)
+    assert len(ev_whole) == len(ev_shard) and ev_whole
+    for a, b in zip(ev_whole, ev_shard):
+        assert a == b
+    # events from BOTH windows made it through the merge
+    samples = [e["sample"] for e in ev_shard]
+    assert min(samples) < 4096 <= max(samples)
+
+
 def test_cli_time_shard_single_process(tmp_path, monkeypatch, capsys):
     """`sweep --time-shard` with no coordinator degenerates to the plain
     flat sweep and writes the same .cands."""
@@ -299,6 +353,20 @@ def test_cli_time_shard_single_process(tmp_path, monkeypatch, capsys):
     assert rc == 0
     assert (tmp_path / "one.cands").read_text() == plain
 
+    # --all-events parity through the CLI (chunk peaks ride AccumParts)
+    rc = main(["one.fil", "--numdms", "12", "--dmstep", "9.0", "-s", "8",
+               "--threshold", "7", "--chunk", "2048", "--all-events",
+               "-o", "ev_plain"])
+    assert rc == 0
+    rc = main(["one.fil", "--numdms", "12", "--dmstep", "9.0", "-s", "8",
+               "--threshold", "7", "--chunk", "2048", "--all-events",
+               "--time-shard", "-o", "ev_shard"])
+    assert rc == 0
+    assert ((tmp_path / "ev_shard.events").read_text()
+            == (tmp_path / "ev_plain.events").read_text())
+    assert ((tmp_path / "ev_shard.pulses").read_text()
+            == (tmp_path / "ev_plain.pulses").read_text())
+
 
 _TS_CLI_RANK_SCRIPT = textwrap.dedent("""
     import os, sys
@@ -309,7 +377,8 @@ _TS_CLI_RANK_SCRIPT = textwrap.dedent("""
     rank = os.environ["PYPULSAR_TPU_PROCESS_ID"]
     from pypulsar_tpu.cli.sweep import main
     rc = main([{fn!r}, "--time-shard", "--numdms", "12", "--dmstep", "9.0",
-               "-s", "8", "--threshold", "7", "--chunk", "2048"])
+               "-s", "8", "--threshold", "7", "--chunk", "2048",
+               "--all-events"])
     assert rc == 0
     print("RANK", rank, "OK")
 """)
@@ -354,6 +423,25 @@ def test_cli_time_shard_two_process(tmp_path):
     best = max(rows, key=lambda r: float(r[1]))
     assert abs(float(best[0]) - 60.0) <= 10.0
     assert float(best[1]) > 8.0
+    # --all-events rode the cross-rank peak gather: event rows from BOTH
+    # halves of the file made it into rank 0's artifact, and the plain
+    # single-process run reproduces them byte-for-byte
+    events = (tmp_path / "one.events").read_text()
+    ev_rows = [ln.split() for ln in events.splitlines()
+               if ln.strip() and not ln.startswith("#")]
+    assert ev_rows  # the injected pulse (t=6.0 s, rank 1's window)
+    assert any(abs(float(r[2]) - 6.0) < 0.1 for r in ev_rows)
+    from pypulsar_tpu.cli.sweep import main as sweep_main
+    import os as _os
+    _cwd = _os.getcwd()
+    _os.chdir(tmp_path)
+    try:
+        assert sweep_main([fn, "--numdms", "12", "--dmstep", "9.0",
+                           "-s", "8", "--threshold", "7", "--chunk",
+                           "2048", "--all-events", "-o", "seq"]) == 0
+    finally:
+        _os.chdir(_cwd)
+    assert (tmp_path / "seq.events").read_text() == events
 
 
 _CLI_RANK_SCRIPT = textwrap.dedent("""
