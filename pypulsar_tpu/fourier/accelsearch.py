@@ -661,9 +661,12 @@ def _stage_chunk_bytes(tfs, Z: int, Wn: int, segw: int) -> int:
     backend HARD-CRASHES the TPU worker on oversized allocations instead
     of raising RESOURCE_EXHAUSTED (observed at B=32, N=2^21, zmax=200),
     so the budget must be respected up front, not discovered via
-    retry."""
-    tot = sum(int(t.shape[1]) * int(t.shape[2]) * 20 for t in tfs)
-    return tot + Z * Wn * 2 * segw * 8
+    retry. The estimate carries a 1.25x safety factor because an
+    underestimate (XLA fusion holding an extra temporary) IS a worker
+    crash; if a batched search still crashes the worker, lowering
+    ``PYPULSAR_TPU_ACCEL_HBM`` is the first knob."""
+    tot = sum(int(t.shape[1]) * int(t.shape[2]) * 25 for t in tfs)
+    return tot + Z * Wn * 2 * segw * 10
 
 
 def accel_search_batch(

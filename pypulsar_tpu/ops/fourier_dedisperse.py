@@ -23,8 +23,9 @@ phase-generation-bound at ~92G cos-sin/s, and this lifted the measured
 chunk time from 323 ms to 146 ms on v5e (BENCHNOTES.md round-4 A/B). Phases compose
 additively, so the total integer shift per channel is EXACTLY the same
 ``s1 + s2`` the time-domain path applies: results agree to FFT f32
-rounding (~1e-6 relative), inside the sweep's SNR parity contract
-(parallel/sweep.py docstring; enforced in tests/test_sweep.py).
+rounding, inside the sweep's SNR parity contract of <=2e-6 relative SNR
+(measured worst case 5e-7; README "Golden parity"; enforced in
+tests/test_sweep.py::test_fourier_engine_snr_tolerance).
 
 Exactness of the phase table: with ``n`` a power of two, the index
 ``(k * s) mod n`` needs only the low ``log2(n)`` bits of the product, which

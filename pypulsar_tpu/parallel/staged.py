@@ -194,6 +194,16 @@ class _ReaderSource:
         self.nsamples = self.end - self.start
 
     def chan_major_blocks(self, payload: int, overlap: int):
+        # Seam contract: interior windows (end < total) must be whole
+        # payload multiples — the last in-window block otherwise extends
+        # its full payload past `end` into the neighbour's window and the
+        # merged moment sums double-count the seam. time_sharded_sweep
+        # constructs aligned windows; fail loudly for anyone else.
+        if self.end < self.total and (self.end - self.start) % payload:
+            raise ValueError(
+                f"windowed source [{self.start}, {self.end}) is not a "
+                f"whole multiple of payload={payload}; seam samples "
+                f"would be double-counted across window boundaries")
         iter_blocks = getattr(self.reader, "iter_blocks", None)
         if iter_blocks is not None and getattr(
                 self.reader, "BLOCK_ITER_ARRAYS", False):
@@ -334,19 +344,28 @@ class _MaskedSource:
             i0 = min(pos // self._pts, nint - 1)
             i1 = min((pos + L - 1) // self._pts, nint - 1)
             if self._host_table[i0:i1 + 1].any():
+                # split file-absolute pos into (interval base, remainder)
+                # on the host: inside jit the arithmetic is int32 (x64
+                # off), so pos + arange(L) would overflow for positions
+                # past 2^31 samples; base + (rem + arange(L)) // pts is
+                # exact for any file length (rem < pts, base < nint)
                 block = _masked_block(
                     jnp.asarray(block, dtype=jnp.float32), self._table,
-                    pos, self._pts)
+                    min(pos // self._pts, nint - 1), pos % self._pts,
+                    self._pts)
             yield pos, block
 
 
 @functools.partial(jax.jit, static_argnames=("pts",))
-def _masked_block(data, table, pos, pts: int):
+def _masked_block(data, table, base, rem, pts: int):
     """Expand the device-resident [nint, C] zap table to this block's
     [C, L] mask (interval = sample // pts, clamped like
-    io.rfimask.get_sample_mask) and apply the median-mid80 fill."""
+    io.rfimask.get_sample_mask) and apply the median-mid80 fill.
+    ``base``/``rem`` are the host-split interval index and in-interval
+    offset of the block start (int32-overflow-proof, ADVICE r4)."""
     L = data.shape[1]
-    iv = jnp.minimum((pos + jnp.arange(L)) // pts, table.shape[0] - 1)
+    iv = jnp.minimum(base + (rem + jnp.arange(L)) // pts,
+                     table.shape[0] - 1)
     return kernels.masked(data, table[iv].T)
 
 

@@ -256,3 +256,50 @@ def test_sweep_ddplan_2d_matches_1d(tmp_path):
                                    rtol=1e-4, atol=1e-4)
         np.testing.assert_array_equal(sa.result.peak_sample,
                                       sb.result.peak_sample)
+
+
+def test_windowed_source_rejects_unaligned_window(tmp_path):
+    """ADVICE r4: an interior window that is not a whole payload multiple
+    would double-count seam samples in merged statistics — the source must
+    fail loudly, not corrupt silently."""
+    from pypulsar_tpu.parallel.staged import _ReaderSource
+
+    fn, _, _ = synth_fil(tmp_path, T=8192)
+    fil = filterbank.FilterbankFile(fn)
+    src = _ReaderSource(fil, start=0, end=3000)  # interior, 3000 % 2048 != 0
+    with pytest.raises(ValueError, match="whole multiple of payload"):
+        next(src.chan_major_blocks(payload=2048, overlap=64))
+    # tail windows may be ragged: the file end is the natural boundary
+    src2 = _ReaderSource(fil, start=4096, end=8192)
+    tail = _ReaderSource(fil, start=6144)  # end defaults to total
+    assert sum(1 for _ in src2.chan_major_blocks(2048, 64)) == 2
+    assert sum(1 for _ in tail.chan_major_blocks(2048, 64)) == 1
+
+
+def test_masked_block_interval_lookup_past_int32(tmp_path):
+    """ADVICE r4: the zap-interval lookup must be exact for file-absolute
+    sample positions past 2^31 (int32 arange would overflow and index the
+    wrong intervals)."""
+    from pypulsar_tpu.parallel.staged import _masked_block
+
+    rng = np.random.RandomState(5)
+    C, L, pts = 8, 512, 1000
+    # past int32, constructed so rem=800 and the block crosses into the
+    # next interval at j=200
+    pos = (2**31 // pts + 1) * pts + 800
+    assert pos > 2**31 and pos % pts == 800
+    nint = pos // pts + 2
+    data = rng.randn(C, L).astype(np.float32)
+    table = np.zeros((nint, C), dtype=bool)
+    table[pos // pts + 1, 3] = True  # zap only the block's SECOND interval
+    import jax.numpy as jnp
+    base = min(pos // pts, nint - 1)
+    got = np.asarray(_masked_block(jnp.asarray(data), jnp.asarray(table),
+                                   base, pos % pts, pts))
+    assert not np.array_equal(got, data)  # the zap actually landed
+    # int64 host reference of the same clamped lookup + median-mid80 fill
+    iv = np.minimum((pos + np.arange(L, dtype=np.int64)) // pts, nint - 1)
+    mask = table[iv].T  # [C, L]
+    from pypulsar_tpu.ops import numpy_ref
+    ref = numpy_ref.masked(data, mask)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
