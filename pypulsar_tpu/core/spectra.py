@@ -103,13 +103,30 @@ class Spectra:
         return np.round(rel / self.dt).astype(np.int32)
 
     # --- ops (each returns a NEW Spectra) ---
+    def _shift_nfft(self, bins):
+        """Tight static FFT length for the TPU fourier shift backend:
+        host-known bins bound the wrap region exactly (kernels.
+        shift_channels n_fft contract), halving the default 2T pad.
+        Returns None (default padding) unless ``bins`` is already a host
+        array — concretizing a traced value would fail, and pulling a
+        device array pays a tunnel roundtrip per call."""
+        if not isinstance(bins, (np.ndarray, list, tuple)):
+            return None
+        from pypulsar_tpu.ops.fourier_dedisperse import fourier_chunk_len
+
+        return fourier_chunk_len(
+            self.data.shape[-1] + int(np.max(np.abs(np.asarray(bins)))))
+
     def shift_channels(self, bins, padval=0) -> "Spectra":
+        n_fft = self._shift_nfft(bins)
         bins = jnp.asarray(bins, dtype=jnp.int32)
-        return self._replace(data=kernels.shift_channels(self.data, bins, padval))
+        return self._replace(data=kernels.shift_channels(
+            self.data, bins, padval, n_fft=n_fft))
 
     def dedisperse(self, dm=0.0, padval=0, trim=False) -> "Spectra":
         bins = self._rel_bindelays(dm)
-        data = kernels.shift_channels(self.data, jnp.asarray(bins), padval)
+        data = kernels.shift_channels(self.data, jnp.asarray(bins), padval,
+                                      n_fft=self._shift_nfft(bins))
         ntrim = int(bins.max()) if trim else 0
         if ntrim > 0:
             data = data[:, :-ntrim]
@@ -129,7 +146,8 @@ class Spectra:
             delays = psrmath.delay_from_DM(subdm - self.dm, freqs)
             rel = delays - np.repeat(ref, per)
             bins = np.round(rel / self.dt).astype(np.int32)
-            data = kernels.shift_channels(data, jnp.asarray(bins), padval)
+            data = kernels.shift_channels(data, jnp.asarray(bins), padval,
+                                          n_fft=self._shift_nfft(bins))
         data = data.reshape(nsub, per, self.numspectra).sum(axis=1)
         return self._replace(data=data, freqs=jnp.asarray(ctr))
 

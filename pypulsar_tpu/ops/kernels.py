@@ -56,41 +56,108 @@ def rotate_rows(data, bins):
     return jnp.take_along_axis(data, idx, axis=-1)
 
 
-@partial(jax.jit, static_argnames=("padval",))
-def shift_channels(data, bins, padval=0):
+def shift_channels(data, bins, padval=0, backend="auto", n_fft=None):
     """Shift each channel left by bins[c]; pad vacated cells.
 
     padval: numeric, 'mean', 'median' (of the rotated channel — the reference
-    computes pad stats after rotation, formats/spectra.py:81-94), or 'rotate'
-    (pure circular shift).
-    """
-    shifted = rotate_rows(data, bins)
-    if padval == "rotate":
-        return shifted
+    computes pad stats after rotation, formats/spectra.py:81-94; a circular
+    rotation permutes the row, so these equal the stats of the ORIGINAL
+    row), or 'rotate' (pure circular shift).
+
+    backend: 'gather' (take_along_axis; bit-exact reference formulation),
+    'fourier' (pad to a power of two, integer phase multiply, irfft —
+    values agree to FFT f32 rounding), or 'auto': fourier on TPU, where
+    the generic row gather measures only ~70M elem/s (~670 ms for one
+    [256, 156k] dedispersion) while the FFT path runs at HBM speed
+    (BENCHNOTES round 5); gather elsewhere. 'rotate' padval always takes
+    the gather path (the FFT formulation is a LINEAR shift — circular
+    wrap-around of real data has period T, which is generally not a
+    power of two and would lower to a dense DFT matmul on this
+    platform).
+
+    n_fft: static power-of-two FFT length for the fourier path. Callers
+    with host-known bins can pass ``fourier_chunk_len(T + max|bins|)``
+    (Spectra does) to halve the default 2T padding; must satisfy
+    ``n_fft - T >= max|bins|`` or the wrap region overlaps real data."""
+    if backend == "auto":
+        import os
+
+        backend = os.environ.get("PYPULSAR_TPU_SHIFT_BACKEND") or (
+            "fourier" if padval != "rotate"
+            and jnp.issubdtype(jnp.asarray(data).dtype, jnp.floating)
+            and jax.default_backend() == "tpu" else "gather")
+    if backend == "fourier" and padval != "rotate":
+        return _shift_channels_fourier(data, bins, padval, n_fft)
+    return _shift_channels_gather(data, bins, padval)
+
+
+def _vacated_fill(shifted, stats_src, bins, padval):
+    """Overwrite the cells a left-shift by ``bins`` vacated with the pad
+    value. 'mean'/'median' stats come from ``stats_src`` — the gather
+    path passes the rotated row, the fourier path the original row; a
+    circular rotation permutes the row so the two are identical."""
     if padval == "mean":
-        pad = jnp.mean(shifted, axis=-1, keepdims=True)
+        pad = jnp.mean(stats_src, axis=-1, keepdims=True)
     elif padval == "median":
-        pad = jnp.median(shifted, axis=-1, keepdims=True)
+        pad = jnp.median(stats_src, axis=-1, keepdims=True)
     else:
-        pad = jnp.full((data.shape[0], 1), padval, dtype=data.dtype)
-    T = data.shape[-1]
+        pad = jnp.full((shifted.shape[0], 1), padval, dtype=shifted.dtype)
+    T = shifted.shape[-1]
     t = jnp.arange(T, dtype=jnp.int32)[None, :]
     b = bins[:, None].astype(jnp.int32)
     vacated = jnp.where(b > 0, t >= T - b, t < -b)
-    return jnp.where(vacated, pad.astype(data.dtype), shifted)
+    return jnp.where(vacated, pad.astype(shifted.dtype), shifted)
+
+
+@partial(jax.jit, static_argnames=("padval",))
+def _shift_channels_gather(data, bins, padval=0):
+    shifted = rotate_rows(data, bins)
+    if padval == "rotate":
+        return shifted
+    return _vacated_fill(shifted, shifted, bins, padval)
+
+
+@partial(jax.jit, static_argnames=("padval", "n_fft"))
+def _shift_channels_fourier(data, bins, padval=0, n_fft=None):
+    """Linear per-channel shift as a Fourier phase multiply.
+
+    Rows are zero-padded to ``n = 2^ceil(log2(2T))`` and rotated by the
+    exact integer phase ``W^(k*s)`` (index mod n via int32 wraparound —
+    ops/fourier_dedisperse._phase); with ``|s| <= n - T`` the wrap region
+    is all zeros, so ``out[:T]`` is the linear shift and the vacated-fill
+    logic is identical to the gather path. Rows with ``|s| >= T`` are
+    fully vacated and end up all-padval either way. Kept values carry FFT
+    f32 rounding (~1e-6 relative; inside the documented 2e-6 SNR parity
+    contract at detection level)."""
+    from pypulsar_tpu.ops.fourier_dedisperse import _phase, fourier_chunk_len
+
+    C, T = data.shape
+    n = n_fft if n_fft is not None else fourier_chunk_len(2 * T)
+    F = n // 2 + 1
+    k = jnp.arange(F, dtype=jnp.int32)
+    X = jnp.fft.rfft(data, n=n, axis=-1)
+    ph = _phase(bins.astype(jnp.int32), k, n)  # [C, F]
+    shifted = jnp.fft.irfft(X * ph, n=n, axis=-1)[:, :T].astype(data.dtype)
+    return _vacated_fill(shifted, data, bins, padval)
 
 
 @partial(jax.jit, static_argnames=("padval",))
 def dedisperse(data, freqs, dt, dm, in_dm=0.0, padval=0):
     """Dedisperse at ``dm`` given current dm ``in_dm`` (reference
-    formats/spectra.py:229-254, with the :37 dm-discard bug fixed)."""
+    formats/spectra.py:229-254, with the :37 dm-discard bug fixed).
+    Shift values follow the shift_channels backend contract: bit-exact
+    on CPU (gather); FFT f32 rounding on TPU unless
+    PYPULSAR_TPU_SHIFT_BACKEND=gather."""
     bins = bin_delays(dm - in_dm, freqs, dt)
     return shift_channels(data, bins, padval)
 
 
 @partial(jax.jit, static_argnames=("padval",))
 def dedisperse_with_bins(data, bins, padval=0):
-    """Dedisperse with host-precomputed integer bin delays (exact f64 path)."""
+    """Dedisperse with host-precomputed integer bin delays: the BIN MATH
+    is the exact f64 reference path; shifted values follow the
+    shift_channels backend contract (bit-exact gather on CPU, FFT f32
+    rounding on TPU unless PYPULSAR_TPU_SHIFT_BACKEND=gather)."""
     return shift_channels(data, bins, padval)
 
 
