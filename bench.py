@@ -246,27 +246,35 @@ def numpy_baseline(rep_fn, reps: int = 5, spread_limit: float = 1.3):
     all_reps = []
 
     def one_round():
-        for _ in range(reps):
-            wait_for_idle()
+        # full gate before the round; between reps only a short check —
+        # a multi-second rep pushes the 1-min loadavg over the gate with
+        # its OWN decaying footprint, and sleeping 180 s per rep to wait
+        # out ourselves would stall the bench for nothing
+        wait_for_idle()
+        for i in range(reps):
+            if i:
+                wait_for_idle(max_wait=15.0)
             all_reps.append(rep_fn())
 
     one_round()
     spread = max(all_reps) / min(all_reps)
     reran = False
+    used = all_reps
     if spread > spread_limit:
         print(f"# numpy baseline spread {spread:.2f}x > {spread_limit}x; "
               f"re-running the rep set", file=sys.stderr)
         reran = True
         one_round()
-        # judge the rerun by the SECOND round alone (the combined spread
-        # can never drop below the value that triggered the rerun); the
-        # median still pools every recorded rep
-        second = all_reps[reps:]
-        spread = max(second) / min(second)
+        # the rerun replaces the contended round: judge the spread AND
+        # take the median over the second round alone (pooling the two
+        # populations would skew the median while the spread field looks
+        # clean); every recorded rep still lands in the JSON
+        used = all_reps[reps:]
+        spread = max(used) / min(used)
         if spread > spread_limit:
             print(f"# WARNING: spread {spread:.2f}x persists after rerun "
-                  f"(load {_loadavg():.2f}); median of {len(all_reps)} "
-                  f"reps used", file=sys.stderr)
+                  f"(load {_loadavg():.2f}); median of the rerun used",
+                  file=sys.stderr)
     cal = min(_cal_workload() for _ in range(3))
     cal_ratio = (cal / NUMPY_CAL_SECONDS) if NUMPY_CAL_SECONDS else -1.0
     if cal_ratio > 1.3:
@@ -275,7 +283,7 @@ def numpy_baseline(rep_fn, reps: int = 5, spread_limit: float = 1.3):
               f"({NUMPY_CAL_SECONDS:.3f}s) - numpy baselines this run "
               f"are inflated by host contention", file=sys.stderr)
     return {
-        "seconds": float(np.median(all_reps)),
+        "seconds": float(np.median(used)),
         "numpy_seconds_reps": [round(r, 3) for r in all_reps],
         "numpy_rep_spread": round(spread, 3),
         "numpy_reps_reran": reran,

@@ -80,15 +80,25 @@ def shift_channels(data, bins, padval=0, backend="auto", n_fft=None):
     (Spectra does) to halve the default 2T padding; must satisfy
     ``n_fft - T >= max|bins|`` or the wrap region overlaps real data."""
     if backend == "auto":
-        import os
-
-        backend = os.environ.get("PYPULSAR_TPU_SHIFT_BACKEND") or (
-            "fourier" if padval != "rotate"
-            and jnp.issubdtype(jnp.asarray(data).dtype, jnp.floating)
-            and jax.default_backend() == "tpu" else "gather")
+        backend = _resolve_shift_backend(padval, jnp.asarray(data).dtype)
     if backend == "fourier" and padval != "rotate":
         return _shift_channels_fourier(data, bins, padval, n_fft)
     return _shift_channels_gather(data, bins, padval)
+
+
+def _resolve_shift_backend(padval, dtype) -> str:
+    """'auto' policy, resolved at CALL time (PYPULSAR_TPU_SHIFT_BACKEND
+    env override; else fourier on TPU for float data with a fillable
+    padval, gather everywhere else). Callers that jit around
+    shift_channels pass the resolved value as a static arg so the env
+    override lands in their jit key instead of being frozen into the
+    first-compiled executable."""
+    import os
+
+    return os.environ.get("PYPULSAR_TPU_SHIFT_BACKEND") or (
+        "fourier" if padval != "rotate"
+        and jnp.issubdtype(dtype, jnp.floating)
+        and jax.default_backend() == "tpu" else "gather")
 
 
 def _vacated_fill(shifted, stats_src, bins, padval):
@@ -141,24 +151,30 @@ def _shift_channels_fourier(data, bins, padval=0, n_fft=None):
     return _vacated_fill(shifted, data, bins, padval)
 
 
-@partial(jax.jit, static_argnames=("padval",))
 def dedisperse(data, freqs, dt, dm, in_dm=0.0, padval=0):
     """Dedisperse at ``dm`` given current dm ``in_dm`` (reference
     formats/spectra.py:229-254, with the :37 dm-discard bug fixed).
     Shift values follow the shift_channels backend contract: bit-exact
     on CPU (gather); FFT f32 rounding on TPU unless
-    PYPULSAR_TPU_SHIFT_BACKEND=gather."""
+    PYPULSAR_TPU_SHIFT_BACKEND=gather (resolved per call; inside a
+    user's enclosing jit it freezes at their trace time)."""
+    backend = _resolve_shift_backend(padval, jnp.asarray(data).dtype)
+    return _dedisperse_jit(data, freqs, dt, dm, in_dm, padval, backend)
+
+
+@partial(jax.jit, static_argnames=("padval", "backend"))
+def _dedisperse_jit(data, freqs, dt, dm, in_dm, padval, backend):
     bins = bin_delays(dm - in_dm, freqs, dt)
-    return shift_channels(data, bins, padval)
+    return shift_channels(data, bins, padval, backend=backend)
 
 
-@partial(jax.jit, static_argnames=("padval",))
-def dedisperse_with_bins(data, bins, padval=0):
+def dedisperse_with_bins(data, bins, padval=0, n_fft=None):
     """Dedisperse with host-precomputed integer bin delays: the BIN MATH
     is the exact f64 reference path; shifted values follow the
     shift_channels backend contract (bit-exact gather on CPU, FFT f32
-    rounding on TPU unless PYPULSAR_TPU_SHIFT_BACKEND=gather)."""
-    return shift_channels(data, bins, padval)
+    rounding on TPU unless PYPULSAR_TPU_SHIFT_BACKEND=gather, resolved
+    per call)."""
+    return shift_channels(data, bins, padval, n_fft=n_fft)
 
 
 def subband(data, freqs, dt, nsub, subdm=None, in_dm=0.0, padval=0):
@@ -171,7 +187,8 @@ def subband(data, freqs, dt, nsub, subdm=None, in_dm=0.0, padval=0):
     """
     if subdm is None:
         return _subband_nodm(data, freqs, nsub)
-    return _subband_dm(data, freqs, dt, nsub, subdm, in_dm, padval)
+    backend = _resolve_shift_backend(padval, jnp.asarray(data).dtype)
+    return _subband_dm(data, freqs, dt, nsub, subdm, in_dm, padval, backend)
 
 
 @partial(jax.jit, static_argnames=("nsub",))
@@ -185,8 +202,8 @@ def _subband_nodm(data, freqs, nsub):
     return data.reshape(nsub, per, T).sum(axis=1), ctr
 
 
-@partial(jax.jit, static_argnames=("nsub", "padval"))
-def _subband_dm(data, freqs, dt, nsub, subdm, in_dm, padval):
+@partial(jax.jit, static_argnames=("nsub", "padval", "backend"))
+def _subband_dm(data, freqs, dt, nsub, subdm, in_dm, padval, backend):
     C, T = data.shape
     assert C % nsub == 0
     per = C // nsub
@@ -197,7 +214,7 @@ def _subband_dm(data, freqs, dt, nsub, subdm, in_dm, padval):
     delays = delay_from_DM(subdm - in_dm, freqs)
     rel = delays - jnp.repeat(ref, per)
     bins = jnp.round(rel / dt).astype(jnp.int32)
-    data = shift_channels(data, bins, padval)
+    data = shift_channels(data, bins, padval, backend=backend)
     out = data.reshape(nsub, per, T).sum(axis=1)
     return out, ctr
 

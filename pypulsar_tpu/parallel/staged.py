@@ -150,8 +150,8 @@ class _SpectraSource:
             pos += payload
 
 
-@functools.partial(jax.jit, static_argnames=("flip",))
-def _ingest_tc(raw_tc, flip: bool):
+@functools.partial(jax.jit, static_argnames=("flip", "nbits"))
+def _ingest_tc(raw_tc, flip: bool, nbits: int = 8):
     """Device-side block ingest: [time, chan] native-dtype block ->
     [chan, time] float32, optionally band-flipped. Keeping the transpose,
     widening cast and flip INSIDE one program means an 8-bit file ships
@@ -159,7 +159,20 @@ def _ingest_tc(raw_tc, flip: bool):
     bottleneck through a remote-accelerator tunnel: ~60-80 MB/s measured,
     BENCHNOTES.md round 4) instead of 4, and no eager per-block ops pay
     dispatch latency. uint->f32 is exact, so results are bit-identical
-    to the host-side path."""
+    to the host-side path.
+
+    ``nbits`` < 8 means ``raw_tc`` is PACKED [time, nchans*nbits//8]
+    uint8 (io/filterbank.py sub-byte layout, low bits = lower channel)
+    and is unpacked HERE, on device — a 4-bit file ships half the bytes
+    of its 8-bit expansion and yields bit-identical f32 ingest (VERDICT
+    r4 item 2; parity: tests/test_io.py, tests/test_staged.py)."""
+    if nbits < 8:
+        spb = 8 // nbits
+        mask = jnp.uint8((1 << nbits) - 1)
+        parts = [(raw_tc >> jnp.uint8(nbits * i)) & mask
+                 for i in range(spb)]
+        raw_tc = jnp.stack(parts, axis=-1).reshape(
+            raw_tc.shape[0], raw_tc.shape[1] * spb)
     d = raw_tc.T.astype(jnp.float32)
     return jnp.flip(d, axis=0) if flip else d
 
@@ -220,10 +233,12 @@ class _ReaderSource:
             read_end = min(self.end + overlap, self.total)
             raw_blocks = iter_blocks(payload, overlap, start=self.start,
                                      end=read_end, raw=True)
+            nbits = int(getattr(self.reader, "nbits", 8) or 8)
+            nbits = nbits if nbits < 8 else 8  # >=8-bit ships unpacked
             for pos, dev in _ship_ahead(raw_blocks):
                 if pos >= self.end:
                     break
-                yield pos, _ingest_tc(dev, self._flip)
+                yield pos, _ingest_tc(dev, self._flip, nbits)
             return
         get_samples = getattr(self.reader, "get_samples", None)
         get_interval = getattr(self.reader, "get_sample_interval", None)

@@ -191,3 +191,41 @@ def test_datfile_pulses_generator(tmp_path):
 def test_datfile_rejects_bad_name(tmp_path):
     with pytest.raises(ValueError):
         Datfile(str(tmp_path / "nope.txt"))
+
+
+@pytest.mark.parametrize("nbits", [4, 2, 1])
+def test_filterbank_subbyte_roundtrip(tmp_path, nbits):
+    """4/2/1-bit .fil write -> read round-trip (VERDICT r4 item 2): values
+    survive packing exactly, get_spectra orientation matches, raw
+    iter_blocks yields PACKED rows of nchans*nbits//8 bytes while
+    unpacked blocks equal the 8-bit expansion."""
+    fn = tmp_path / f"t{nbits}.fil"
+    hdr = dict(HDR, nbits=nbits)
+    hi = 1 << nbits
+    data = RNG.randint(0, hi, size=(200, 64)).astype(np.uint8)
+    write_filterbank(str(fn), hdr, data)
+    assert (fn.stat().st_size - FilterbankFile(str(fn)).header_size
+            ) == 200 * 64 * nbits // 8
+    fil = FilterbankFile(str(fn))
+    assert fil.nbits == nbits
+    assert fil.number_of_samples == 200
+    np.testing.assert_array_equal(fil.get_samples(0, 200),
+                                  data.astype(np.float32))
+    np.testing.assert_array_equal(fil.get_spectra(13, 100).to_numpy(),
+                                  data[13:113].T.astype(np.float32))
+    # unpacked streaming equals the expansion; raw streaming stays packed
+    for start, block in fil.iter_blocks(64, overlap=16):
+        np.testing.assert_array_equal(
+            block, data[start:start + block.shape[0]].astype(np.float32))
+    for start, block in fil.iter_blocks(64, overlap=16, raw=True):
+        assert block.dtype == np.uint8
+        assert block.shape[1] == 64 * nbits // 8
+    fil.close()
+
+
+def test_filterbank_subbyte_rejects_ragged_channels(tmp_path):
+    fn = tmp_path / "t4r.fil"
+    hdr = dict(HDR, nbits=4, nchans=63)
+    data = np.zeros((16, 63), np.uint8)
+    with pytest.raises(ValueError, match="not divisible"):
+        write_filterbank(str(fn), hdr, data)  # refuses at pack time

@@ -46,9 +46,17 @@ def parse_args(argv=None):
     ap.add_argument("--period-samples", type=int, default=4096,
                     help="pulse period in samples (integer => tileable)")
     ap.add_argument("--width", type=int, default=8, help="pulse width, samples")
-    ap.add_argument("--amp", type=int, default=30, help="pulse amplitude, counts")
-    ap.add_argument("--noise-hi", type=int, default=200,
-                    help="noise ~ Uniform{0..noise_hi-1}")
+    ap.add_argument("--nbits", type=int, default=8, choices=(8, 4, 2),
+                    help="sample depth; 4/2 write PACKED sub-byte files "
+                         "(io/filterbank.py layout) at half/quarter the "
+                         "bytes. --amp/--noise-hi defaults scale to keep "
+                         "the per-sample SNR of the 8-bit defaults")
+    ap.add_argument("--amp", type=int, default=None,
+                    help="pulse amplitude, counts (default 30 at 8-bit, "
+                         "2 at 4-bit, 1 at 2-bit)")
+    ap.add_argument("--noise-hi", type=int, default=None,
+                    help="noise ~ Uniform{0..noise_hi-1} (default 200 at "
+                         "8-bit, 14 at 4-bit, 3 at 2-bit)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--blocks-per-write", type=int, default=32,
                     help="periods per written block")
@@ -57,9 +65,16 @@ def parse_args(argv=None):
 
 def main(argv=None):
     a = parse_args(argv)
+    if a.amp is None:
+        a.amp = {8: 30, 4: 2, 2: 1}[a.nbits]
+    if a.noise_hi is None:
+        a.noise_hi = {8: 200, 4: 14, 2: 3}[a.nbits]
     if not 1 <= a.noise_hi <= 256:
         raise SystemExit("--noise-hi must be in [1, 256] (uint8 data; the "
                          "multiply-shift map overflows uint16 beyond that)")
+    if a.noise_hi - 1 + a.amp >= (1 << a.nbits):
+        raise SystemExit(f"noise_hi-1 + amp = {a.noise_hi - 1 + a.amp} "
+                         f"overflows {a.nbits}-bit samples")
     C, P = a.nchan, a.period_samples
     nsamp = int(round(a.duration / a.tsamp))
     nsamp = max((nsamp // P) * P, P)  # whole periods; simplifies tiling only
@@ -76,13 +91,13 @@ def main(argv=None):
     hdr = {
         "source_name": f"SYNTH_DM{a.dm:g}_P{P}",
         "fch1": a.fch1, "foff": foff, "nchans": C, "tsamp": a.tsamp,
-        "nbits": 8, "nifs": 1, "tstart": 60000.0, "data_type": 1,
+        "nbits": a.nbits, "nifs": 1, "tstart": 60000.0, "data_type": 1,
         "telescope_id": 0, "machine_id": 0, "barycentric": 0,
         "src_raj": 0.0, "src_dej": 0.0, "az_start": 0.0, "za_start": 0.0,
     }
     rng = np.random.Generator(np.random.SFC64(a.seed))
     B = P * a.blocks_per_write
-    total_bytes = nsamp * C
+    total_bytes = nsamp * C * a.nbits // 8
     t0 = time.time()
     with open(a.out, "wb") as f:
         f.write(sigproc.pack_header(hdr))
@@ -97,16 +112,20 @@ def main(argv=None):
             block = ((raw.astype(np.uint16) * np.uint16(a.noise_hi))
                      >> np.uint16(8)).astype(np.uint8)
             block.reshape(n // P, P, C)[:] += pattern[None]
+            if a.nbits < 8:
+                from pypulsar_tpu.io.filterbank import pack_subbyte
+
+                block = pack_subbyte(block, a.nbits)
             block.tofile(f)
             written += n
             if (written // B) % 8 == 0 or written == nsamp:
                 el = time.time() - t0
-                done = written * C
+                done = written * C * a.nbits // 8
                 rate = done / el / 1e6 if el > 0 else 0.0
                 print(f"\r{done/1e9:7.1f}/{total_bytes/1e9:.1f} GB "
                       f"({rate:.0f} MB/s)", end="", file=sys.stderr)
     print(file=sys.stderr)
-    print(f"wrote {a.out}: {nsamp} samples x {C} chans, 8-bit, "
+    print(f"wrote {a.out}: {nsamp} samples x {C} chans, {a.nbits}-bit, "
           f"{total_bytes/1e9:.1f} GB in {time.time()-t0:.0f}s; injected "
           f"DM={a.dm} P={P*a.tsamp*1e3:.3f} ms ({P} samples) "
           f"width={a.width} amp={a.amp}")
