@@ -213,3 +213,63 @@ sweep_chunk_fourier = jax.jit(
                      "boxcar_backend", "phase_mode", "max_shift1",
                      "max_shift2"),
 )
+
+
+def dedisperse_series_fourier_impl(
+    data,
+    stage1_bins,
+    stage2_bins,
+    nsub: int,
+    out_len: int,
+    n_fft: int,
+    phase_mode: str = "factored",
+):
+    """Two-stage subband dedispersed SERIES for every trial: the same
+    phase math as :func:`sweep_chunk_fourier_impl` with the fused boxcar
+    detection swapped for the raw [D, out_len] time series — the chunk
+    kernel of the streamed .dat writer (cli sweep --write-dats on files
+    too large for a device-resident Spectra; PRESTO-prepsubband
+    semantics: subband dedispersion, not per-channel-exact)."""
+    C, L = data.shape
+    G, g, S = stage2_bins.shape
+    per = C // nsub
+    X = jnp.fft.rfft(data, n=n_fft, axis=1)  # [C, F]
+    F = X.shape[1]
+    k = jnp.arange(F, dtype=jnp.int32)
+
+    if phase_mode == "factored":
+        M = _fact_split(F)
+        Fh = -(-F // M)
+        k_hi = jnp.arange(Fh, dtype=jnp.int32)
+        k_lo = jnp.arange(M, dtype=jnp.int32)
+        Xp = jnp.pad(X, ((0, 0), (0, Fh * M - F))).reshape(C, Fh, M)
+
+        def body(carry, xs):
+            s1, s2 = xs
+            hi1 = _phase(s1 * jnp.int32(M), k_hi, n_fft)
+            lo1 = _phase(s1, k_lo, n_fft)
+            xsub = (Xp * hi1[:, :, None] * lo1[:, None, :]) \
+                .reshape(nsub, per, Fh, M).sum(axis=1)
+            hi2 = _phase(s2 * jnp.int32(M), k_hi, n_fft)
+            lo2 = _phase(s2, k_lo, n_fft)
+            xts = (xsub[None] * hi2[..., None] * lo2[..., None, :]) \
+                .sum(axis=1)
+            xts = xts.reshape(-1, Fh * M)[:, :F]
+            return carry, jnp.fft.irfft(xts, n=n_fft, axis=1)[:, :out_len]
+    else:
+        def body(carry, xs):
+            s1, s2 = xs
+            ph1 = _phase(s1, k, n_fft)
+            ph2 = _phase(s2, k, n_fft)
+            xsub = (X * ph1).reshape(nsub, per, F).sum(axis=1)
+            xts = (xsub[None, :, :] * ph2).sum(axis=1)
+            return carry, jnp.fft.irfft(xts, n=n_fft, axis=1)[:, :out_len]
+
+    _, ts = jax.lax.scan(body, 0, (stage1_bins, stage2_bins))
+    return ts.reshape(G * g, out_len)
+
+
+dedisperse_series_fourier = jax.jit(
+    dedisperse_series_fourier_impl,
+    static_argnames=("nsub", "out_len", "n_fft", "phase_mode"),
+)

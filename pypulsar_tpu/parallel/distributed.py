@@ -344,6 +344,76 @@ def _time_shard_local_accum(reader, dms, rank, count, nsub, group_size,
                               finalize=False)
 
 
+def barrier(name: str = "pypulsar_barrier"):
+    """Cross-host synchronization point (no-op single-process). Used by
+    the time-sharded --write-dats flow: every rank must finish writing
+    its segment files before rank 0 concatenates them."""
+    if process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def time_sharded_ddplan(
+    path_or_reader,
+    ddplan,
+    nsub: int = 64,
+    group_size: int = 32,
+    chunk_payload: Optional[int] = None,
+    mesh=None,
+    widths=None,
+    engine: str = "auto",
+    rfimask=None,
+    rank: Optional[int] = None,
+    count: Optional[int] = None,
+    checkpoint_base: Optional[str] = None,
+    checkpoint_every: int = 16,
+):
+    """DDplan-staged sweep of ONE file with the TIME axis sharded across
+    hosts (VERDICT r4 item 3 — the realistic production shape: a staged
+    plan over a single long file whose host->device wire is the
+    bottleneck).
+
+    Each DDstep is an independent flat sweep at its own downsampling, so
+    the step loop simply runs :func:`time_shard_local_accum` per step —
+    each host streams 1/P of the RAW bytes per step, and steps with
+    downsamp > 1 additionally downsample on the HOST before the wire
+    when that shrinks the shipped bytes further
+    (staged._host_downsample_wins: an 8-bit file at downsamp >= 4 ships
+    2/downsamp B per raw sample instead of 1 B) — so host k ships
+    ~1/(P*max(downsamp/2, 1)) of each step's bytes. Merged accumulators
+    cross DCN per step (~KBs). Every host returns the same
+    StagedSweepResult; checkpoints go to
+    ``{checkpoint_base}.step{i}.r{rank}``.
+    """
+    from pypulsar_tpu.parallel.staged import StagedSweepResult, StepResult
+    from pypulsar_tpu.parallel.sweep import finalize_sweep, merge_accum_parts
+
+    if rank is None:
+        rank = process_index()
+    if count is None:
+        count = process_count()
+    steps = []
+    for i, st in enumerate(ddplan.DDsteps):
+        dms = np.asarray(st.DMs, dtype=np.float64)
+        base = f"{checkpoint_base}.step{i}" if checkpoint_base else None
+        plan, local = time_shard_local_accum(
+            path_or_reader, dms, rank, count, nsub=nsub,
+            group_size=group_size, chunk_payload=chunk_payload, mesh=mesh,
+            widths=widths, engine=engine, rfimask=rfimask,
+            checkpoint_base=base, checkpoint_every=checkpoint_every,
+            downsamp=int(st.downsamp))
+        parts = _allgather_accums(local, count)
+        merged = merge_accum_parts(parts)
+        res = finalize_sweep(plan, merged.n, merged.s, merged.ss,
+                             merged.mb, merged.ab, merged.baseline_sum)
+        # the plan's dt already carries the step's downsampling factor
+        steps.append(StepResult(downsamp=int(st.downsamp),
+                                dt=float(plan.dt), result=res))
+    return StagedSweepResult(steps=steps)
+
+
 def _allgather_accums(local, count: int, with_peaks: bool = False,
                       nr: int = 0):
     """All ranks' AccumParts, in rank order. Packs every field into one

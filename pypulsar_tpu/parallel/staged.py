@@ -414,7 +414,20 @@ def _downsampled_blocks(src, factor: int, payload_ds: int, overlap_ds: int):
     Raw blocks are read at ``factor *`` the downsampled geometry so bin
     boundaries align exactly across chunks; a partial trailing bin is
     dropped (the reference's downsample drops the remainder,
-    formats/spectra.py:329-351 semantics)."""
+    formats/spectra.py:329-351 semantics).
+
+    When the reader is integer-sampled and the factor is large enough
+    that the exact integer bin sums are SMALLER on the wire than the
+    native samples, downsampling happens on the HOST before the ship
+    (_host_downsampled_blocks): a DDplan step at downsamp=8 over an
+    8-bit file then ships 2/8 = 1/4 of the native bytes (VERDICT r4
+    item 3 — the wire is the streamed sweep's measured ceiling).
+    Integer sums are exact in uint16/uint32 and in f32, so both paths
+    are bit-identical (tests/test_staged.py)."""
+    if factor > 1 and _host_downsample_wins(src, factor):
+        yield from _host_downsampled_blocks(src, factor, payload_ds,
+                                            overlap_ds)
+        return
     for pos, block in src.chan_major_blocks(payload_ds * factor,
                                             overlap_ds * factor):
         data = jnp.asarray(block, dtype=jnp.float32)
@@ -424,6 +437,82 @@ def _downsampled_blocks(src, factor: int, payload_ds: int, overlap_ds: int):
                 continue  # tail shorter than one output bin
             data = kernels.downsample(data[:, :nbin * factor], factor)
         yield pos // factor, data
+
+
+def _host_downsample_wins(src, factor: int) -> bool:
+    """True when host-side downsampling ships fewer bytes than the native
+    samples: integer readers only (exact sums; float sum order would
+    differ from the device path's), accumulator 2 B (nbits<=8) or 4 B
+    (16-bit) per downsampled sample vs nbits/8 per native sample.
+    PYPULSAR_TPU_HOST_DOWNSAMP=0/1 overrides the policy."""
+    if not isinstance(src, _ReaderSource):
+        return False  # masked sources zap at full rate, Spectra is resident
+    r = src.reader
+    if not (getattr(r, "BLOCK_ITER_ARRAYS", False)
+            and getattr(r, "iter_blocks", None)):
+        return False
+    nbits = int(getattr(r, "nbits", 32) or 32)
+    if nbits > 16:
+        return False
+    if nbits > 8 and factor > 256:
+        return False  # uint32 sums past f32's 2^24 integer exactness
+    env = os.environ.get("PYPULSAR_TPU_HOST_DOWNSAMP")
+    if env is not None:
+        return env != "0"
+    acc_bytes = _host_ds_acc_dtype(nbits, factor)().itemsize
+    return acc_bytes / factor < nbits / 8
+
+
+def _host_ds_acc_dtype(nbits: int, factor: int):
+    """Accumulator for exact host bin sums: uint16 only while the worst
+    case factor*255 fits (factor <= 257); uint32 beyond (and for 16-bit
+    samples), still exact in f32 for any factor the policy admits."""
+    return np.uint16 if (nbits <= 8 and factor <= 257) else np.uint32
+
+
+def _host_downsampled_blocks(rsrc, factor: int, payload_ds: int,
+                             overlap_ds: int):
+    """Raw full-rate blocks -> host unpack (sub-byte) + exact integer
+    downsample -> ship the SMALL accumulator blocks -> device ingest.
+    Sums of <=257 uint8 (uint16 acc) or <=257 uint16 (uint32 acc) values
+    are exact both in the accumulator and in the f32 cast, so results
+    are bit-identical to the device downsample path."""
+    reader = rsrc.reader
+    nbits = int(getattr(reader, "nbits", 8) or 8)
+    acc_dtype = _host_ds_acc_dtype(nbits, factor)
+    payload_raw = payload_ds * factor
+    # same seam contract as chan_major_blocks: interior windows must be
+    # whole (raw) payload multiples or merged statistics double-count
+    if rsrc.end < rsrc.total and (rsrc.end - rsrc.start) % payload_raw:
+        raise ValueError(
+            f"windowed source [{rsrc.start}, {rsrc.end}) is not a whole "
+            f"multiple of payload={payload_raw}; seam samples would be "
+            f"double-counted across window boundaries")
+    read_end = min(rsrc.end + overlap_ds * factor, rsrc.total)
+    raw_blocks = reader.iter_blocks(payload_raw, overlap_ds * factor,
+                                    start=rsrc.start, end=read_end,
+                                    raw=True)
+    unpack = None
+    if nbits < 8:
+        from pypulsar_tpu.io.psrfits import _UNPACKERS
+
+        unpack = _UNPACKERS[nbits]
+
+    def ds_blocks():
+        for pos, block in raw_blocks:
+            if pos >= rsrc.end:
+                break
+            if unpack is not None:
+                block = unpack(block.ravel()).reshape(block.shape[0], -1)
+            nbin = block.shape[0] // factor
+            if nbin == 0:
+                continue
+            acc = block[:nbin * factor].reshape(
+                nbin, factor, block.shape[1]).sum(axis=1, dtype=acc_dtype)
+            yield pos, acc
+
+    for pos, dev in _ship_ahead(ds_blocks()):
+        yield pos // factor, _ingest_tc(dev, rsrc._flip, 8)
 
 
 def _run_step(src, dms, factor: int, nsub: int, group_size: int,
@@ -718,3 +807,147 @@ def sweep_ddplan_2d(
                              baseline_sum=base_sum)
         steps.append(StepResult(downsamp=factor, dt=dt_eff, result=res))
     return StagedSweepResult(steps=steps)
+
+
+def write_dats_streamed(
+    outbase: str,
+    reader,
+    dms,
+    downsamp: int = 1,
+    nsub: int = 64,
+    group_size: int = 32,
+    rfimask=None,
+    engine: str = "auto",
+    chunk_payload: Optional[int] = None,
+    window: Optional[Tuple[int, int]] = None,
+    suffix: str = "",
+    write_inf: bool = True,
+    verbose: bool = False,
+) -> List[str]:
+    """Stream the file ONCE and write a dedispersed .dat per DM trial.
+
+    The in-memory writer (cli/sweep._write_dats) loads the whole
+    observation as a device-resident Spectra — infeasible past HBM for
+    the workloads --write-dats exists for (a 900 s x 1024-chan window is
+    57.6 GB as f32). This writer streams overlap-save chunks through the
+    sweep's own two-stage engine (sweep.dedisperse_series_chunk), so it
+    runs at sweep speed on any file length and the written series is
+    exactly what the sweep's detections saw. Semantics = PRESTO
+    prepsubband (subband dedispersion; reference defers this entire
+    stage to PRESTO, SURVEY.md §2.5): values differ from the exact
+    per-channel path by one subband smearing, and the file tail is
+    zero-padded (linear shifts) rather than wrapped.
+
+    ``window=(s0, s1)`` (DOWNSAMPLED sample coordinates, whole chunk
+    multiples — the time-shard seam contract) writes only that span of
+    each series; with ``suffix=f".w{rank}"`` each host of a time-sharded
+    sweep writes its own segment files, concatenated in rank order by
+    cli/sweep (the .dat byte stream is position-ordered, so
+    concatenation of whole-chunk windows reproduces the sequential
+    file). Returns the written .dat paths.
+    """
+    from pypulsar_tpu.ops.transfer import pull_host
+    from pypulsar_tpu.parallel.sweep import dedisperse_series_chunk
+
+    factor = max(1, int(downsamp))
+    dms = np.asarray(dms, dtype=np.float64)
+    probe = _ReaderSource(reader)
+    dt_eff = probe.tsamp * factor
+    plan, payload, T = dats_geometry(reader, dms, downsamp=factor,
+                                     nsub=nsub, group_size=group_size,
+                                     chunk_payload=chunk_payload)
+    s0, s1 = window if window is not None else (0, T)
+    if not 0 <= s0 <= s1 <= T:
+        raise ValueError(f"bad window [{s0}, {s1}) of {T}")
+    src = _ReaderSource(reader, s0 * factor,
+                        min(s1 * factor, probe.total) if s1 < T else None)
+    if rfimask is not None:
+        src = _MaskedSource(src, rfimask)
+    s1b = jnp.asarray(plan.stage1_bins)
+    s2b = jnp.asarray(plan.stage2_bins)
+    need = payload + plan.min_overlap
+
+    paths = [f"{outbase}_DM{dm:.2f}{suffix}.dat" for dm in dms]
+    # truncate once, then reopen per chunk in append mode: holding one
+    # descriptor per DM trial would hit the fd limit at prepsubband-
+    # scale grids (review r5: --numdms 2000 vs the common 1024 ulimit)
+    for p in paths:
+        open(p, "wb").close()
+    for pos, block in _downsampled_blocks(src, factor, payload,
+                                          plan.min_overlap):
+        L = int(block.shape[1])
+        if L < need:  # tail: zero-pad to the static chunk shape
+            block = jnp.pad(block, ((0, 0), (0, need - L)))
+        series = dedisperse_series_chunk(
+            block, s1b, s2b, plan.nsub, payload, plan.max_shift2,
+            engine)
+        valid = min(payload, s1 - pos)
+        (host,) = pull_host(series[:, :valid].astype(jnp.float32))
+        if verbose:
+            print(f"# dats chunk at {pos}: {valid} samples "
+                  f"x {len(dms)} DMs")
+        rows = np.asarray(host)
+        for p, row in zip(paths, rows):
+            with open(p, "ab") as f:
+                row.tofile(f)
+    if write_inf:
+        write_dat_infs(outbase, reader, dms, s1 - s0, dt_eff)
+    return paths
+
+
+def dats_geometry(reader, dms, downsamp: int = 1, nsub: int = 64,
+                  group_size: int = 32, chunk_payload: Optional[int] = None):
+    """(plan, payload, T_ds) the streamed .dat writer will use for these
+    parameters — time-sharding callers need the identical chunk size to
+    construct whole-chunk windows (the seam contract)."""
+    factor = max(1, int(downsamp))
+    probe = _ReaderSource(reader)
+    T = probe.nsamples // factor
+    plan = make_sweep_plan(np.asarray(dms, dtype=np.float64),
+                           probe.frequencies, probe.tsamp * factor,
+                           nsub=nsub, group_size=group_size, widths=(1,))
+    if chunk_payload is None:
+        n = 1 << 17
+        while plan.min_overlap >= n // 2:
+            n <<= 1
+        chunk_payload = n - plan.min_overlap
+    payload = min(chunk_payload, T)
+    if payload <= plan.min_overlap:
+        payload = min(T, 2 * plan.min_overlap + 1)
+    return plan, payload, T
+
+
+def write_dat_infs(outbase: str, reader, dms, N: int, dt: float):
+    """PRESTO .inf sidecars for a set of written .dat series (metadata
+    mirrors cli/sweep's in-memory writer; split out so a time-sharded
+    run's rank 0 can stamp the CONCATENATED length once)."""
+    probe = _ReaderSource(reader)
+    freqs = np.asarray(probe.frequencies)
+    for dm in np.asarray(dms, dtype=np.float64):
+        base = f"{outbase}_DM{dm:.2f}"
+        make_dat_inf(base, reader, float(dm), N, dt, freqs).to_file(
+            base + ".inf")
+
+
+def make_dat_inf(basenm: str, reader, dm: float, N: int, dt: float,
+                 freqs: np.ndarray):
+    """InfoData for a dedispersed series of this reader — the ONE place
+    .dat sidecar metadata is built (the in-memory writer in cli/sweep
+    and the streamed writer both use it)."""
+    from pypulsar_tpu.io.infodata import InfoData
+
+    inf = InfoData()
+    inf.basenm = os.path.basename(basenm)
+    inf.telescope = getattr(reader, "telescope", "unknown") or "unknown"
+    inf.object = getattr(reader, "source_name", "synthetic") or "synthetic"
+    inf.epoch = float(getattr(reader, "tstart", 0.0) or 0.0)
+    inf.N = int(N)
+    inf.dt = float(dt)
+    inf.DM = float(dm)
+    inf.numchan = len(freqs)
+    inf.lofreq = float(freqs.min())
+    inf.BW = float(abs(freqs.max() - freqs.min()))
+    inf.chan_width = float(inf.BW / max(inf.numchan - 1, 1))
+    inf.bary = 0
+    inf.analyzer = "pypulsar_tpu"
+    return inf

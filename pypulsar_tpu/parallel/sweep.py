@@ -362,6 +362,42 @@ def sweep_chunk(data, stage1_bins, stage2_bins, nsub, out_len, slack2, widths,
     )
 
 
+@partial(jax.jit, static_argnames=("nsub", "out_len", "slack2", "engine"))
+def dedisperse_series_chunk(data, stage1_bins, stage2_bins, nsub,
+                            out_len: int, slack2: int, engine="gather"):
+    """Two-stage subband dedispersed SERIES [D, out_len] for one chunk —
+    :func:`_sweep_chunk_impl` with the fused detection swapped for the
+    raw per-trial time series. The chunk kernel of the streamed .dat
+    writer (staged.write_dats_streamed): PRESTO-prepsubband semantics
+    (subband dedispersion with the sweep's own stage bins), so the
+    written series is exactly what the sweep's detections saw."""
+    engine = resolve_engine(engine)
+    if engine == "fourier":
+        from pypulsar_tpu.ops.fourier_dedisperse import (
+            dedisperse_series_fourier_impl,
+            fourier_chunk_len,
+        )
+
+        return dedisperse_series_fourier_impl(
+            data, stage1_bins, stage2_bins, nsub, out_len,
+            fourier_chunk_len(data.shape[1]))
+    C, L = data.shape
+    G, g, S = stage2_bins.shape
+    per = C // nsub
+    L1 = out_len + slack2
+
+    def per_group(carry, xs):
+        shift1, shift2 = xs
+        sliced = _slice_rows(data, shift1, L1)
+        sub = sliced.reshape(nsub, per, L1).sum(axis=1)
+        ts = jax.vmap(lambda sh: _slice_rows(sub, sh, out_len).sum(axis=0))(
+            shift2)
+        return carry, ts
+
+    _, ts = jax.lax.scan(per_group, 0, (stage1_bins, stage2_bins))
+    return ts.reshape(G * g, out_len)
+
+
 def make_sharded_sweep_chunk(mesh: Mesh, nsub, out_len, slack2, widths,
                              stat_len, engine="gather"):
     """Chunk sweep with trial groups sharded over the mesh 'dm' axis.
