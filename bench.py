@@ -134,10 +134,10 @@ def budget_shapes(C, T_req, plan, hbm_bytes):
     (padded to the FFT length); one executable workspace ~3 chunk buffers
     (rfft output + fused intermediates); 25% headroom for the allocator.
     """
-    n = 1 << 17
-    while plan.min_overlap >= n // 2:
-        n <<= 1
-    payload = n - plan.min_overlap
+    from pypulsar_tpu.parallel.sweep import default_chunk_payload
+
+    payload = default_chunk_payload(plan.min_overlap)
+    n = payload + plan.min_overlap  # round-5 chunk-length A/B, BENCHNOTES
     budget = 0.75 * hbm_bytes
     chunk_bytes = 4 * C * n
     workspace = 3 * chunk_bytes
@@ -623,6 +623,29 @@ def _full_stream_reference(windowed: bool, path: str, engine: str,
         return {}
 
 
+def _configs4_reference() -> dict:
+    """Inline the committed configs[4] end-to-end record (the measured
+    900-s-window sweep -> write-dats -> batched accelsearch -> sift
+    chain, BENCH_r05_configs4.json) so the driver's streamed JSON
+    carries the whole-pipeline evidence alongside the sweep number."""
+    ref = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_r05_configs4.json")
+    if not os.path.exists(ref):
+        return {}
+    try:
+        with open(ref) as f:
+            rec = json.load(f)
+        return {"configs4_end_to_end": {
+            k: rec.get(k) for k in (
+                "value", "unit", "trials", "wall_seconds", "stage_seconds",
+                "cells_per_sec", "vs_baseline", "injected_recovered")
+            if k in rec}}
+    except (OSError, ValueError) as e:
+        print(f"# note: unreadable configs4 reference {ref}: {e}",
+              file=sys.stderr)
+        return {}
+
+
 class _WindowedFilterbank:
     """FilterbankFile proxy bounded to the first ``nsamp`` samples, so an
     unattended bench run can measure the streamed path on a time window
@@ -685,10 +708,9 @@ def run_stream(args):
     nsub = 64
     group = choose_group_size(dms, freqs, dt, nsub)
     plan = make_sweep_plan(dms, freqs, dt, nsub=nsub, group_size=group)
-    n = 1 << 17
-    while plan.min_overlap >= n // 2:
-        n <<= 1
-    payload = n - plan.min_overlap
+    from pypulsar_tpu.parallel.sweep import default_chunk_payload
+
+    payload = default_chunk_payload(plan.min_overlap)
     file_gb = file_T * C * fb.nbits / 8 / 1e9
     streamed_gb = T * C * fb.nbits / 8 / 1e9
     nchunks = -(-T // payload)
@@ -829,6 +851,7 @@ def run_stream(args):
         "engine": engine,
         "path": "streamed",
         **_full_stream_reference(T < file_T, args.stream, engine, D),
+        **_configs4_reference(),
         **({"snr_parity": "gather=bit-exact reference; fourier toleranced",
             "fourier_snr_rel_tol": 2e-6} if engine == "fourier" else {}),
     }

@@ -47,6 +47,29 @@ def _write_cands(path, cands, extra_cols=()):
                     + "\n")
 
 
+def _write_dats_auto(outbase, reader, dms, args, rfimask=None):
+    """--write-dats dispatcher: the in-memory exact writer for data that
+    fits comfortably on device, the streamed two-stage writer
+    (staged.write_dats_streamed, prepsubband semantics) past that — a
+    900 s x 1024-chan window is 57.6 GB as resident f32, far beyond
+    HBM. PYPULSAR_TPU_DATS_RESIDENT_LIMIT (bytes, default 2e9) sets the
+    crossover."""
+    import numpy as _np
+
+    from pypulsar_tpu.parallel.staged import _make_source, write_dats_streamed
+
+    T = _make_source(reader).nsamples
+    C = len(_np.asarray(reader.frequencies))
+    limit = float(os.environ.get("PYPULSAR_TPU_DATS_RESIDENT_LIMIT", 2e9))
+    if 4.0 * C * T <= limit:
+        _write_dats(outbase, reader, dms, args.downsamp, rfimask=rfimask)
+    else:
+        write_dats_streamed(outbase, reader, dms, downsamp=args.downsamp,
+                            nsub=args.nsub, group_size=args.group_size,
+                            rfimask=rfimask, engine=args.engine,
+                            chunk_payload=args.chunk, verbose=True)
+
+
 def _write_dats(outbase, reader, dms, downsamp, rfimask=None):
     """Write per-DM dedispersed time series (.dat + .inf), flat mode only.
     ``rfimask`` applies the sweep's median-mid80 mask fill so the .dat
@@ -214,7 +237,7 @@ def _main_multi(args, ap, widths):
         if args.write_dats and not args.ddplan:
             reader = _open_reader(path)
             try:
-                _write_dats(base, reader, dms, args.downsamp,
+                _write_dats_auto(base, reader, dms, args,
                             rfimask=rfimask)
             finally:
                 _close(reader)
@@ -544,8 +567,7 @@ def main(argv=None):
                             keep_chunk_peaks=args.all_events,
                             rfimask=rfimask)
         if args.write_dats:
-            _write_dats(outbase, reader, dms, args.downsamp,
-                        rfimask=rfimask)
+            _write_dats_auto(outbase, reader, dms, args, rfimask=rfimask)
 
     hits = staged.above_threshold(args.threshold)
     _write_cands(outbase + ".cands", hits)

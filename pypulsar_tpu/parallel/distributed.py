@@ -290,10 +290,9 @@ def _time_shard_local_accum(reader, dms, rank, count, nsub, group_size,
                            widths=tuple(widths),
                            pad_groups_to=pad_groups_to)
     if chunk_payload is None:
-        n = 1 << 17
-        while plan.min_overlap >= n // 2:
-            n <<= 1
-        chunk_payload = n - plan.min_overlap
+        from pypulsar_tpu.parallel.sweep import default_chunk_payload
+
+        chunk_payload = default_chunk_payload(plan.min_overlap)
     payload = min(chunk_payload, T)
     if payload <= plan.min_overlap:
         payload = min(T, 2 * plan.min_overlap + 1)

@@ -692,6 +692,11 @@ def _step_fingerprint(src, dms, factor, nsub, group_size, widths,
     for part in (np.asarray(dms, dtype=np.float64).tobytes(),
                  src.frequencies.tobytes(),
                  np.float64([src.tsamp]).tobytes(),
+                 # the None sentinel is safe: _run_step resolves None to
+                 # n_ds (the whole file), and every input of that
+                 # resolution (nsamples, factor, nsub, group_size, dms,
+                 # widths) is hashed here — DEFAULT_CHUNK_FFT_LEN plays
+                 # no part in the staged path's payload
                  np.int64([src.nsamples, factor, nsub, group_size,
                            -1 if chunk_payload is None else chunk_payload]
                           ).tobytes(),
@@ -907,10 +912,9 @@ def dats_geometry(reader, dms, downsamp: int = 1, nsub: int = 64,
                            probe.frequencies, probe.tsamp * factor,
                            nsub=nsub, group_size=group_size, widths=(1,))
     if chunk_payload is None:
-        n = 1 << 17
-        while plan.min_overlap >= n // 2:
-            n <<= 1
-        chunk_payload = n - plan.min_overlap
+        from pypulsar_tpu.parallel.sweep import default_chunk_payload
+
+        chunk_payload = default_chunk_payload(plan.min_overlap)
     payload = min(chunk_payload, T)
     if payload <= plan.min_overlap:
         payload = min(T, 2 * plan.min_overlap + 1)

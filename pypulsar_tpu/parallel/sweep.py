@@ -239,6 +239,24 @@ def make_sweep_plan(
 # ---------------------------------------------------------------------------
 
 
+DEFAULT_CHUNK_FFT_LEN = 1 << 18
+# Round-5 chunk-length A/B on v5e (BENCHNOTES): at the bench geometry
+# (1024 chans, 1024 trials) the fourier chunk measures 0.67 G
+# trial-samples/s at n=2^17, 0.95 G at 2^18 (+41%), 0.87 G at 2^19 —
+# the FFT amortizes and the overlap fraction shrinks up to 2^18, then
+# working-set growth wins. 2^18 is the default everywhere a chunk
+# length is not explicitly given.
+
+
+def default_chunk_payload(min_overlap: int) -> int:
+    """Default streaming chunk payload: DEFAULT_CHUNK_FFT_LEN grown (by
+    doubling) until the dedispersion overlap fits in half the FFT."""
+    n = DEFAULT_CHUNK_FFT_LEN
+    while min_overlap >= n // 2:
+        n <<= 1
+    return n - min_overlap
+
+
 def _slice_rows(rows, starts, length):
     """rows[N, L] -> [N, length], row i starting at starts[i] (static length)."""
     return jax.vmap(lambda r, s: jax.lax.dynamic_slice(r, (s,), (length,)))(

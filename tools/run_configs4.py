@@ -1,0 +1,237 @@
+"""configs[4] END-TO-END on chip (VERDICT r4 item 1): retire the last
+BASELINE projection by MEASURING the chain
+
+    900-s window of the north-star file
+      -> cli sweep --write-dats  (streamed two-stage writer, 512 DMs)
+      -> cli accelsearch --batch (shared template banks, batched stages)
+      -> cli sift
+
+as one timed run with the per-stage wall split, and verify the injected
+pulsar (P=262.144 ms => f0=3.814697 Hz at DM 70) comes out of the sift.
+Writes BENCH_r05_configs4.json, which bench.py inlines into the driver's
+streamed record (_configs4_reference).
+
+Reference surface: formats/prestofft.py:76-195 + bin/plot_accelcands.py:
+50-104 (the reference defers the search itself to PRESTO accelsearch on
+one core; BASELINE configs[4]).
+
+Usage: python tools/run_configs4.py [--trials 512] [--duration 900]
+           [--downsamp 4] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--fil", default=os.path.join(REPO, "data",
+                                                  "northstar_1hr.fil"))
+    ap.add_argument("--trials", type=int, default=512)
+    ap.add_argument("--duration", type=float, default=900.0)
+    ap.add_argument("--dm-max", type=float, default=500.0)
+    ap.add_argument("--downsamp", type=int, default=4,
+                    help="dedispersed-series downsampling before the "
+                         "accel search (256 us at the north-star's 64 us "
+                         "raw rate: the benched N=2^21-scale spectrum)")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--zmax", type=float, default=200.0)
+    ap.add_argument("--workdir", default=os.path.join(REPO, "data",
+                                                      "configs4"))
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the .dat/.cand intermediates")
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "BENCH_r05_configs4.json"))
+    ap.add_argument("--allow-miss", action="store_true",
+                    help="exit 0 even when the injected pulsar is not "
+                         "recovered (toy-scale rehearsals on other files)")
+    return ap.parse_args(argv)
+
+
+def slice_window(fil: str, out: str, seconds: float) -> int:
+    """First ``seconds`` of a .fil as a standalone file (byte copy:
+    header + whole spectra)."""
+    from pypulsar_tpu.io.filterbank import FilterbankFile
+
+    fb = FilterbankFile(fil)
+    nsamp = min(int(round(seconds / fb.tsamp)), fb.number_of_samples)
+    nbytes = nsamp * fb.bytes_per_spectrum
+    with open(fil, "rb") as src, open(out, "wb") as dst:
+        dst.write(src.read(fb.header_size))
+        copied = 0
+        while copied < nbytes:
+            buf = src.read(min(1 << 24, nbytes - copied))
+            if not buf:
+                break
+            dst.write(buf)
+            copied += len(buf)
+    fb.close()
+    return nsamp
+
+
+def run_stage(name, argv, log):
+    print(f"## stage {name}: {' '.join(argv)}", flush=True)
+    t0 = time.perf_counter()
+    with open(log, "w") as lf:
+        rc = subprocess.call(argv, stdout=lf, stderr=subprocess.STDOUT)
+    el = time.perf_counter() - t0
+    if rc != 0:
+        tail = open(log).read()[-3000:]
+        raise RuntimeError(f"stage {name} failed rc={rc}:\n{tail}")
+    print(f"## stage {name}: {el:.1f}s", flush=True)
+    return el
+
+
+def main(argv=None):
+    a = parse_args(argv)
+    os.makedirs(a.workdir, exist_ok=True)
+    base = os.path.join(a.workdir, "c4")
+    win_fil = os.path.join(a.workdir, "window.fil")
+    stages = {}
+
+    t_all = time.perf_counter()
+    t0 = time.perf_counter()
+    nsamp = slice_window(a.fil, win_fil, a.duration)
+    stages["slice_window"] = round(time.perf_counter() - t0, 1)
+    from pypulsar_tpu.io.filterbank import FilterbankFile
+
+    _fb = FilterbankFile(win_fil)
+    nchan, nbits = _fb.nchans, _fb.nbits
+    _fb.close()
+    print(f"## window: {nsamp} samples ({a.duration:.0f}s), {nchan} chans "
+          f"{nbits}-bit -> {win_fil}")
+
+    dmstep = a.dm_max / max(a.trials - 1, 1)
+    stages["sweep_write_dats"] = round(run_stage(
+        "sweep+dats",
+        [sys.executable, "-m", "pypulsar_tpu.cli.sweep", win_fil,
+         "-o", base, "--lodm", "0", "--dmstep", f"{dmstep:.6f}",
+         "--numdms", str(a.trials), "--downsamp", str(a.downsamp),
+         "-s", "64", "--group-size", "32", "--threshold", "8",
+         "--write-dats"],
+        os.path.join(a.workdir, "sweep.log")), 1)
+
+    dats = sorted(glob.glob(f"{base}_DM*.dat"))
+    assert len(dats) == a.trials, (len(dats), a.trials)
+    stages["accelsearch_batch"] = round(run_stage(
+        "accelsearch",
+        [sys.executable, "-m", "pypulsar_tpu.cli.accelsearch", *dats,
+         "--batch", str(a.batch), "-z", str(int(a.zmax)), "--dz", "2",
+         "-n", "8", "-s", "2"],
+        os.path.join(a.workdir, "accel.log")), 1)
+
+    cands = sorted(glob.glob(f"{base}_DM*_ACCEL_{int(a.zmax)}.cand"))
+    assert cands, "no .cand outputs"
+    sifted = base + ".sifted"
+    stages["sift"] = round(run_stage(
+        "sift",
+        [sys.executable, "-m", "pypulsar_tpu.cli.sift", *cands,
+         "-o", sifted, "-s", "4"],
+        os.path.join(a.workdir, "sift.log")), 1)
+    wall = time.perf_counter() - t_all
+
+    # --- recovery check: the injected pulsar (or a harmonic) in the sift
+    from pypulsar_tpu.io.accelcands import parse_candlist
+
+    p0 = 4096 * 64e-6  # injected period 262.144 ms
+    best = None
+    for c in parse_candlist(sifted):
+        for h in (1, 2, 3, 4, 8):
+            if (abs(c.period * h - p0) < 0.01 * p0
+                    and abs(c.dm - 70.0) < 5.0):
+                if best is None or c.sigma > best["sigma"]:
+                    best = {"dm": c.dm, "sigma": c.sigma,
+                            "period_s": c.period, "harmonic": h,
+                            "snr": c.snr}
+    print(f"## injected pulsar recovery: {best}")
+
+    # --- (r, z) cell accounting at the searched geometry (bench run_accel
+    # formula) x trials / accel wall
+    from pypulsar_tpu.fourier.accelsearch import AccelSearchConfig
+    from pypulsar_tpu.fourier.zresponse import template_bank
+    from pypulsar_tpu.io.infodata import InfoData
+
+    inf = InfoData(dats[0][:-4] + ".inf")
+    N = int(inf.N) // 2
+    T = int(inf.N) * float(inf.dt)
+    cfg = AccelSearchConfig(zmax=a.zmax, dz=2.0, numharm=8, sigma_min=2.0)
+    Z = len(cfg.zs)
+    rlo = max(int(np.ceil(cfg.flo * T)), 1)
+    cells = sum(2 * Z * max((N - 1) - H * rlo, 0) for H in cfg.stages)
+    cells_per_sec = cells * a.trials / stages["accelsearch_batch"]
+
+    # single-core NumPy baseline for the search stage: one stage-1
+    # segment's correlations with np.fft (the same generous baseline
+    # bench.py run_accel measures), scaled linearly to the full count
+    segw = cfg.seg_width
+    tb, hw = template_bank(cfg.zs, numbetween=2)
+    L = 1
+    while L < segw + 4 * hw:
+        L <<= 1
+    padded = np.zeros((tb.shape[0], L), np.complex128)
+    padded[:, : tb.shape[1]] = tb
+    rev = np.zeros_like(padded)
+    rev[:, 0] = padded[:, 0]
+    rev[:, 1:] = padded[:, :0:-1]
+    tf = np.fft.fft(rev, axis=1).astype(np.complex64)
+    rng = np.random.RandomState(0)
+    seg = (rng.standard_normal(L) + 1j * rng.standard_normal(L)) \
+        .astype(np.complex64)
+    tb0 = time.perf_counter()
+    sl = np.fft.fft(seg)
+    corr = np.fft.ifft(sl[None, :] * tf, axis=1)
+    _ = (np.abs(corr) ** 2).astype(np.float32)
+    bl_seconds = time.perf_counter() - tb0
+    bl_cells_per_sec = (2 * Z * segw) / bl_seconds
+    vs_baseline = cells_per_sec / bl_cells_per_sec
+
+    rec = {
+        "metric": "configs4_end_to_end_seconds",
+        "value": round(wall, 1),
+        "unit": (f"wall seconds, {a.duration:.0f}s x {nchan}-chan "
+                 f"{nbits}-bit "
+                 f"window -> sweep(+streamed .dats, ds={a.downsamp}) -> "
+                 f"accelsearch --batch {a.batch} (zmax={a.zmax:.0f}, "
+                 f"dz=2, H<=8, N={N} bins x {a.trials} trials) -> sift; "
+                 f"measured on one v5e through the axon tunnel"),
+        "vs_baseline": round(vs_baseline, 2),
+        "numpy_cells_per_sec": round(bl_cells_per_sec, 1),
+        "trials": a.trials,
+        "wall_seconds": round(wall, 1),
+        "stage_seconds": stages,
+        "spectrum_bins": N,
+        "cells_per_spectrum": cells,
+        "cells_per_sec": round(cells_per_sec, 1),
+        "injected_recovered": best,
+        "per_spectrum_seconds": round(
+            stages["accelsearch_batch"] / a.trials, 2),
+        "projection_4096_trials_hours": round(
+            4096 * stages["accelsearch_batch"] / a.trials / 3600.0, 2),
+    }
+    with open(a.out, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+    if not a.keep:
+        shutil.rmtree(a.workdir, ignore_errors=True)
+    if best is None and not a.allow_miss:
+        print("## FAIL: injected pulsar NOT recovered by the sift",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
