@@ -335,12 +335,28 @@ def _time_shard_local_accum(reader, dms, rank, count, nsub, group_size,
     # invalidate every existing plain time-shard checkpoint on resume
     ds_tag = f"/ds={factor}" if factor > 1 else ""
     ctx = f"/window={s0}:{s1}{ds_tag}" + _mask_tag(rfimask)
+
+    def block_factory(cursor_ds: int):
+        """Seek-resume within this rank's window (round 5): re-root the
+        stream at the checkpoint cursor instead of re-shipping the
+        window's pre-cursor bytes. The cursor sits on a payload
+        boundary, so the re-rooted window keeps the seam alignment."""
+        from pypulsar_tpu.parallel.staged import _reroot_source
+
+        seeked = _reroot_source(src, cursor_ds * factor)
+        if seeked is None:
+            return _downsampled_blocks(src, factor, payload,
+                                       plan.min_overlap)
+        return _downsampled_blocks(seeked, factor, payload,
+                                   plan.min_overlap)
+
     return plan, sweep_stream(plan, blocks, payload, mesh=mesh,
                               chan_major=True, baseline=baseline,
                               engine=engine, checkpoint=ckpt,
                               checkpoint_context=ctx,
                               keep_chunk_peaks=keep_chunk_peaks,
-                              finalize=False)
+                              finalize=False,
+                              block_factory=block_factory)
 
 
 def barrier(name: str = "pypulsar_barrier"):

@@ -541,13 +541,31 @@ def _run_step(src, dms, factor: int, nsub: int, group_size: int,
     plan = make_sweep_plan(dms, src.frequencies, dt_eff, nsub=nsub,
                            group_size=group_size, widths=widths,
                            pad_groups_to=pad_groups_to)
-    payload = n_ds if chunk_payload is None else min(chunk_payload, n_ds)
+    # default payload is BOUNDED (round 5): the previous whole-file
+    # default made a --chunk-less CLI sweep of an hour-scale file try to
+    # build one 2^26-sample chunk (a ~275 GB device buffer) — small data
+    # still runs single-chunk via the min()
+    if chunk_payload is None:
+        from pypulsar_tpu.parallel.sweep import default_chunk_payload
+
+        chunk_payload = default_chunk_payload(plan.min_overlap)
+    payload = min(chunk_payload, n_ds)
     if payload <= plan.min_overlap:
         payload = min(n_ds, 2 * plan.min_overlap + 1)
     if verbose:
         print(f"# {label}downsamp={factor} dt={dt_eff:.3e}s "
               f"DMs {dms[0]:.2f}..{dms[-1]:.2f} "
               f"({len(dms)} trials) payload={payload}")
+
+    def block_factory(cursor_ds: int):
+        """Re-root the block stream at a checkpoint cursor (seek-resume:
+        the cursor always sits on a payload boundary, so the re-rooted
+        window honors the seam contract). Falls back to the full stream
+        (skip-based replay) for sources that cannot seek."""
+        seeked = _reroot_source(src, cursor_ds * factor)
+        return _downsampled_blocks(seeked if seeked is not None else src,
+                                   factor, payload, plan.min_overlap)
+
     res = sweep_stream(
         plan,
         _downsampled_blocks(src, factor, payload, plan.min_overlap),
@@ -558,8 +576,23 @@ def _run_step(src, dms, factor: int, nsub: int, group_size: int,
         engine=engine,
         keep_chunk_peaks=keep_chunk_peaks,
         checkpoint_context=ckpt_extra,
+        block_factory=block_factory,
     )
     return StepResult(downsamp=factor, dt=dt_eff, result=res)
+
+
+def _reroot_source(src, start_raw: int):
+    """A view of ``src`` whose blocks begin at raw sample ``start_raw``
+    (same end bound), or None when the source cannot seek. Positions stay
+    file-absolute, so the resumed stream's chunks carry the same
+    coordinates they had in the original run."""
+    if isinstance(src, _MaskedSource):
+        inner = _reroot_source(src._src, start_raw)
+        return None if inner is None else _MaskedSource(inner, src._mask)
+    if isinstance(src, _ReaderSource):
+        end = src.end if src.end < src.total else None
+        return _ReaderSource(src.reader, start_raw, end)
+    return None
 
 
 def sweep_flat(
@@ -680,6 +713,12 @@ def _source_probe(src) -> bytes:
         return b""
 
 
+def _default_fft_len() -> int:
+    from pypulsar_tpu.parallel.sweep import DEFAULT_CHUNK_FFT_LEN
+
+    return DEFAULT_CHUNK_FFT_LEN
+
+
 def _step_fingerprint(src, dms, factor, nsub, group_size, widths,
                       chunk_payload, context, probe) -> str:
     """Hash of everything that determines a step's result — a done marker
@@ -692,14 +731,15 @@ def _step_fingerprint(src, dms, factor, nsub, group_size, widths,
     for part in (np.asarray(dms, dtype=np.float64).tobytes(),
                  src.frequencies.tobytes(),
                  np.float64([src.tsamp]).tobytes(),
-                 # the None sentinel is safe: _run_step resolves None to
-                 # n_ds (the whole file), and every input of that
-                 # resolution (nsamples, factor, nsub, group_size, dms,
-                 # widths) is hashed here — DEFAULT_CHUNK_FFT_LEN plays
-                 # no part in the staged path's payload
+                 # None resolves through default_chunk_payload, so the
+                 # sentinel is the (negated) DEFAULT_CHUNK_FFT_LEN:
+                 # retuning the library default invalidates only markers
+                 # that actually USED the default (fourier chunk rounding
+                 # is chunk-length-dependent); explicit --chunk runs are
+                 # untouched by the constant and keep their markers
                  np.int64([src.nsamples, factor, nsub, group_size,
-                           -1 if chunk_payload is None else chunk_payload]
-                          ).tobytes(),
+                           -_default_fft_len() if chunk_payload is None
+                           else chunk_payload]).tobytes(),
                  np.int64(widths).tobytes(),
                  context.encode(), probe):
         h.update(part)

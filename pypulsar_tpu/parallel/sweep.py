@@ -758,6 +758,7 @@ def sweep_stream(
     max_pending: Optional[int] = None,
     checkpoint: Optional[SweepCheckpoint] = None,
     keep_chunk_peaks: bool = False,
+    block_factory=None,
     checkpoint_context: str = "",
     finalize: bool = True,
 ) -> SweepResult:
@@ -820,6 +821,14 @@ def sweep_stream(
             acc, cursor, ckpt_baseline = state
             if baseline is None:
                 baseline = ckpt_baseline  # bit-identical resume needs it
+            if cursor > 0 and block_factory is not None:
+                # seek-resume (round 5): without this, a resumed sweep
+                # re-produces (reads AND ships) every pre-cursor block
+                # only for the `start < cursor` guard below to drop it —
+                # a resume at 65% of the 28.8 GB north star replayed the
+                # whole wire. The factory re-roots the stream at the
+                # cursor; the guard stays as the correctness backstop.
+                blocks = block_factory(cursor)
 
     s1 = jnp.asarray(plan.stage1_bins)
     s2 = jnp.asarray(plan.stage2_bins)

@@ -776,3 +776,24 @@ def test_cli_time_shard_write_dats_two_process(tmp_path):
         assert not (tmp_path / f"tswd_DM{dm:.2f}.w0.dat").exists()
         inf = InfoData(str(tmp_path / f"tswd_DM{dm:.2f}.inf"))
         assert int(inf.N) == 8192
+
+
+def test_reroot_source_windowed_and_masked(tmp_path):
+    """_reroot_source (seek-resume) preserves a window's end bound and
+    the mask wrapper, and the re-rooted stream yields the same blocks
+    the original stream yields past the cursor."""
+    from pypulsar_tpu.parallel.staged import _ReaderSource, _reroot_source
+    from pypulsar_tpu.io import filterbank
+
+    fn = str(tmp_path / "rr.fil")
+    _write_fil8(fn, dm=60.0, t0=6000, seed=2)
+    src = _ReaderSource(filterbank.FilterbankFile(fn), 0, 6144)
+    seeked = _reroot_source(src, 2048)
+    assert (seeked.start, seeked.end) == (2048, 6144)
+    orig = [(p, np.asarray(b)) for p, b in
+            src.chan_major_blocks(2048, 64)]
+    re = [(p, np.asarray(b)) for p, b in
+          seeked.chan_major_blocks(2048, 64)]
+    assert [p for p, _ in re] == [p for p, _ in orig if p >= 2048]
+    for (p1, b1), (p2, b2) in zip(re, [o for o in orig if o[0] >= 2048]):
+        np.testing.assert_array_equal(b1, b2)

@@ -390,3 +390,57 @@ def test_write_dats_streamed_basic_and_windows(tmp_path):
     from pypulsar_tpu.io.infodata import InfoData
     inf = InfoData(f"{out2}_DM60.00.inf")
     assert int(inf.N) == 8192
+
+
+def test_sweep_flat_seek_resume_bit_exact(tmp_path, monkeypatch):
+    """Kill-and-resume through sweep_flat's SEEK path (round 5): the
+    resumed run re-roots the block stream at the checkpoint cursor
+    instead of replaying (and re-shipping) the whole file, and the final
+    result is bit-identical to the uninterrupted sweep."""
+    from pypulsar_tpu.parallel import staged as staged_mod
+    from pypulsar_tpu.parallel.staged import sweep_flat
+    from pypulsar_tpu.parallel.sweep import SweepCheckpoint
+
+    fn, freqs, _ = synth_fil(tmp_path, T=16384, name="seek.fil")
+    dms = np.linspace(0.0, 80.0, 16)
+    ckpt = str(tmp_path / "seek.ckpt")
+
+    whole = sweep_flat(filterbank.FilterbankFile(fn), dms, nsub=16,
+                       group_size=8, chunk_payload=2048).steps[0].result
+
+    # crash after the 4th drained chunk (checkpoint saved every chunk)
+    real = SweepCheckpoint.on_drained
+    calls = {"n": 0}
+
+    def dying(self, *a, **k):
+        real(self, *a, **k)
+        calls["n"] += 1
+        if calls["n"] >= 4:
+            raise KeyboardInterrupt("simulated SIGKILL")
+
+    monkeypatch.setattr(SweepCheckpoint, "on_drained", dying)
+    with pytest.raises(KeyboardInterrupt):
+        sweep_flat(filterbank.FilterbankFile(fn), dms, nsub=16,
+                   group_size=8, chunk_payload=2048,
+                   checkpoint_path=ckpt, checkpoint_every=1)
+    monkeypatch.setattr(SweepCheckpoint, "on_drained", real)
+    assert os.path.exists(ckpt)
+
+    # resume: the re-rooted source must start AT the cursor, not 0
+    seeks = []
+    real_reroot = staged_mod._reroot_source
+
+    def spying(src, start_raw):
+        seeks.append(start_raw)
+        return real_reroot(src, start_raw)
+
+    monkeypatch.setattr(staged_mod, "_reroot_source", spying)
+    resumed = sweep_flat(filterbank.FilterbankFile(fn), dms, nsub=16,
+                         group_size=8, chunk_payload=2048,
+                         checkpoint_path=ckpt,
+                         checkpoint_every=1).steps[0].result
+    assert seeks == [4 * 2048]
+    np.testing.assert_array_equal(resumed.snr, whole.snr)
+    np.testing.assert_array_equal(resumed.peak_sample, whole.peak_sample)
+    np.testing.assert_array_equal(resumed.mean, whole.mean)
+    assert not os.path.exists(ckpt)  # cleaned up on completion

@@ -190,12 +190,20 @@ def main(argv=None):
     rng = np.random.RandomState(0)
     seg = (rng.standard_normal(L) + 1j * rng.standard_normal(L)) \
         .astype(np.complex64)
-    tb0 = time.perf_counter()
-    sl = np.fft.fft(seg)
-    corr = np.fft.ifft(sl[None, :] * tf, axis=1)
-    _ = (np.abs(corr) ** 2).astype(np.float32)
-    bl_seconds = time.perf_counter() - tb0
-    bl_cells_per_sec = (2 * Z * segw) / bl_seconds
+
+    def one_rep():
+        tb0 = time.perf_counter()
+        sl = np.fft.fft(seg)
+        corr = np.fft.ifft(sl[None, :] * tf, axis=1)
+        _ = (np.abs(corr) ** 2).astype(np.float32)
+        return time.perf_counter() - tb0
+
+    # the round-5 baseline protocol (bench.numpy_baseline): >=5
+    # loadavg-gated reps + pinned-calibration cross-check
+    import bench as bench_mod
+
+    bl = bench_mod.numpy_baseline(one_rep)
+    bl_cells_per_sec = (2 * Z * segw) / bl["seconds"]
     vs_baseline = cells_per_sec / bl_cells_per_sec
 
     rec = {
@@ -209,6 +217,7 @@ def main(argv=None):
                  f"measured on one v5e through the axon tunnel"),
         "vs_baseline": round(vs_baseline, 2),
         "numpy_cells_per_sec": round(bl_cells_per_sec, 1),
+        **{k: v for k, v in bl.items() if k != "seconds"},
         "trials": a.trials,
         "wall_seconds": round(wall, 1),
         "stage_seconds": stages,
