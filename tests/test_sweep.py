@@ -673,3 +673,19 @@ def test_multi_event_chunk_peaks():
                         baseline=baseline)
     with pytest.raises(ValueError):
         res2.events(8.0)
+
+
+def test_default_chunk_payload_bounds():
+    """Round-5 regression: the streaming default payload is BOUNDED
+    (DEFAULT_CHUNK_FFT_LEN-derived) — the old whole-file default made a
+    --chunk-less sweep of an hour-scale file try to build one ~2^26-
+    sample chunk (a ~275 GB device buffer). The helper must also grow
+    past overlaps that don't fit half the FFT."""
+    from pypulsar_tpu.parallel.sweep import (DEFAULT_CHUNK_FFT_LEN,
+                                             default_chunk_payload)
+
+    p = default_chunk_payload(8122)
+    assert p == DEFAULT_CHUNK_FFT_LEN - 8122
+    big = default_chunk_payload(DEFAULT_CHUNK_FFT_LEN)  # overlap >= n/2
+    assert big > 0 and (big + DEFAULT_CHUNK_FFT_LEN
+                        ) & (big + DEFAULT_CHUNK_FFT_LEN - 1) == 0
