@@ -862,21 +862,34 @@ def sweep_stream(
     # of HBM each) can be freed. Callers with an HBM budget (bench.py) pass
     # ``max_pending`` explicitly; each pending chunk holds one input buffer.
     MAX_PENDING = 4 if max_pending is None else max(1, int(max_pending))
+    DRAIN_BATCH = min(4, MAX_PENDING)
     pending = []  # (start, stat_len, device outputs)
 
     def drain(limit):
         nonlocal cursor
+        if len(pending) <= limit:
+            return
+        # pull EVERY due chunk's outputs in ONE device_get, then
+        # accumulate host-side in stream order (bit-identical to
+        # per-chunk pulls). Through the axon tunnel each pull waits for
+        # whatever put is on the wire (~0.5 s average at streamed block
+        # sizes, BENCHNOTES r4) — the 4-bit full-file run spent ~108 s
+        # of its 632 s wall in that trap, so batching the pulls divides
+        # the per-chunk toll by the batch size (round 5). Outputs are
+        # KBs per chunk; the batch adds no meaningful HBM.
+        due = []
         while len(pending) > limit:
-            start, stat_len, (s, ss, mb, ab) = pending.pop(0)
-            with profiling.stage("device_wait+accumulate"):
-                # one batched pull: per-array np.asarray would pay four
-                # tunnel roundtrips per chunk (ops/transfer.pull_host)
-                s, ss, mb, ab = transfer.pull_host(s, ss, mb, ab)
+            due.append(pending.pop(0))
+        with profiling.stage("device_wait+accumulate"):
+            flat = transfer.pull_host(
+                *(arr for _, _, outs in due for arr in outs))
+            for i, (start, stat_len, _) in enumerate(due):
+                s, ss, mb, ab = flat[4 * i: 4 * i + 4]
                 acc.update(start, stat_len, s, ss, mb, ab)
-            cursor = start + stat_len
-            if checkpoint is not None:
-                checkpoint.on_drained(plan, chunk_payload, acc, cursor,
-                                      baseline, ckpt_context)
+                cursor = start + stat_len
+                if checkpoint is not None:
+                    checkpoint.on_drained(plan, chunk_payload, acc,
+                                          cursor, baseline, ckpt_context)
 
     need = out_len + slack2 + plan.max_shift1
 
@@ -930,7 +943,11 @@ def sweep_stream(
                     f"plan.min_overlap"
                 )
             process(pstart, pdata, pL)
-            drain(MAX_PENDING)
+            # burst drain: let MAX_PENDING chunks queue, then pull them
+            # all in one roundtrip (see drain) — a per-block drain would
+            # pay the trapped-pull toll once per chunk
+            if len(pending) > MAX_PENDING:
+                drain(max(MAX_PENDING - DRAIN_BATCH, 0))
         prev = (start, data, L)
     if prev is not None:
         process(*prev)
