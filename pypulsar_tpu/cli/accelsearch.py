@@ -92,6 +92,18 @@ def build_parser():
                         "(default 200)")
     p.add_argument("--dz", type=float, default=2.0,
                    help="drift step in bins (default 2)")
+    p.add_argument("--coarse-dz", type=float, default=0.0,
+                   help="coarse-to-fine z search: first scan every stage "
+                        "at this z step with the power threshold scaled "
+                        "by --coarse-frac, then re-search only the "
+                        "segments with coarse hits at the fine --dz "
+                        "(2*dz keeps >=~84%% of matched power at the "
+                        "nearest coarse template, so the preselection "
+                        "loses nothing above threshold). 0 = single pass")
+    p.add_argument("--coarse-frac", type=float, default=0.7,
+                   help="coarse-pass power-threshold fraction "
+                        "(default 0.7; lower = safer recall, more "
+                        "refine work)")
     p.add_argument("-w", "--wmax", type=float, default=0.0,
                    help="max jerk in bins over T^3 (0 = no w search; "
                         "cost scales with the w grid size)")
@@ -193,6 +205,7 @@ def main(argv=None):
         zmax=args.zmax, dz=args.dz, numharm=args.numharm,
         sigma_min=args.sigma, flo=args.flo, fhi=args.fhi,
         wmax=args.wmax, dw=args.dw,
+        coarse_dz=args.coarse_dz, coarse_power_frac=args.coarse_frac,
     )
     # template banks (fourier.accelsearch._build_ratio_bank), deredden
     # schedules and compiled stage programs are process-cached: searching

@@ -158,6 +158,38 @@ class AccelSearchConfig:
     # bank to a (z, w) product grid — cost scales by len(ws)
     wmax: float = 0.0
     dw: float = 20.0
+    # coarse-to-fine z search (VERDICT r4 item 1 stretch): > dz runs every
+    # stage first on a coarse z grid at this spacing with the power
+    # threshold scaled by coarse_power_frac, then re-searches ONLY the
+    # segments with coarse hits at the fine dz. Candidates are identical
+    # to the full search as long as a fine-grid detection keeps at least
+    # coarse_power_frac of its power at the nearest coarse template —
+    # measured worst-case retention at coarse_dz = 2*dz is ~0.84
+    # (z-mismatch dz loses 5.4% of matched power, 2*dz loses 20%,
+    # z-independent; tests/test_accelsearch.py::test_coarse_grid_power_
+    # retention), so the 0.7 default leaves margin. 0 = single-pass.
+    coarse_dz: float = 0.0
+    coarse_power_frac: float = 0.7
+
+    def __post_init__(self):
+        import warnings
+
+        if not 0.0 < self.coarse_power_frac <= 1.0:
+            raise ValueError(f"coarse_power_frac must be in (0, 1]; got "
+                             f"{self.coarse_power_frac}")
+        if self.coarse_dz != 0.0 and self.coarse_dz <= self.dz:
+            warnings.warn(
+                f"coarse_dz={self.coarse_dz} <= dz={self.dz} has no "
+                f"effect: the coarse-to-fine prepass only runs when "
+                f"coarse_dz > dz", stacklevel=2)
+        elif self.coarse_dz > 2.0 * self.dz:
+            warnings.warn(
+                f"coarse_dz={self.coarse_dz} > 2*dz: worst-case matched-"
+                f"power retention at the coarse grid falls below the "
+                f"calibrated ~0.80 (it is ~0.60 at a 3-bin z mismatch), "
+                f"so coarse_power_frac={self.coarse_power_frac} may drop "
+                f"near-threshold candidates the fine-only search would "
+                f"keep", stacklevel=2)
 
     @property
     def zs(self) -> np.ndarray:
@@ -263,11 +295,14 @@ def _make_stage_runner(segw: int, Z: int, Wn: int, topk: int,
     top-k records per (segment, w), so a stage is ONE dispatch.
 
     ``bank_meta[b-1] = (off0, step, hw, L)``; the returned callable takes
-    (spec_pad, tfs, idxs, top_lo, top_hi, thresh, n_seg) with tfs/idxs
-    matching bank_meta order.
+    (spec_pad, tfs, idxs, top_lo, top_hi, thresh, seg_ids) with tfs/idxs
+    matching bank_meta order. ``seg_ids`` is the int32 array of segment
+    indices to scan — ``arange(n_seg)`` for a full pass, or the coarse
+    pass's hit segments for a coarse-to-fine refine (results land in
+    seg_ids order; only its LENGTH keys compilation).
     """
 
-    def run(spec_pad2, tfs, idxs, top_lo, top_hi, thresh, n_seg):
+    def run(spec_pad2, tfs, idxs, top_lo, top_hi, thresh, seg_ids):
         # complex never crosses the jit boundary (axon cannot move
         # complex buffers between programs, ops/transfer.py): the padded
         # spectrum and the template banks arrive as [2, ...] float planes
@@ -298,10 +333,10 @@ def _make_stage_runner(segw: int, Z: int, Wn: int, topk: int,
             neigh = jnp.stack([o[3] for o in outs])
             return carry, (vals, zi, ri, neigh)
 
-        _, res = jax.lax.scan(body, 0, jnp.arange(n_seg))
+        _, res = jax.lax.scan(body, 0, seg_ids)
         return res
 
-    return jax.jit(run, static_argnames=("n_seg",))
+    return jax.jit(run)
 
 
 @functools.lru_cache(maxsize=64)
@@ -325,7 +360,7 @@ def _make_stage_runner_batch(segw: int, Z: int, Wn: int, topk: int,
     gather on host), the same layout the sweep uses.
     """
 
-    def run(spec_pad2, tfs, idxs, top_lo, top_hi, thresh, n_seg):
+    def run(spec_pad2, tfs, idxs, top_lo, top_hi, thresh, seg_ids):
         spec_pad = join_planes(spec_pad2[:, 0], spec_pad2[:, 1])  # [B, Np]
         B = spec_pad.shape[0]
 
@@ -355,11 +390,11 @@ def _make_stage_runner_batch(segw: int, Z: int, Wn: int, topk: int,
             neigh = jnp.stack([o[3] for o in outs], axis=1)
             return carry, (vals, zi, ri, neigh)
 
-        _, res = jax.lax.scan(body, 0, jnp.arange(n_seg))
+        _, res = jax.lax.scan(body, 0, seg_ids)
         return res  # each [n_seg, B, Wn, ...]
 
     if not mesh_batch:
-        return jax.jit(run, static_argnames=("n_seg",))
+        return jax.jit(run)
 
     from jax.experimental.shard_map import shard_map
     from jax.sharding import Mesh
@@ -371,18 +406,17 @@ def _make_stage_runner_batch(segw: int, Z: int, Wn: int, topk: int,
                          f"{len(devs)} available devices")
     mesh = Mesh(np.array(devs[:mesh_batch]), ("dm",))
 
-    def run_sharded(spec_pad2, tfs, idxs, top_lo, top_hi, thresh, n_seg):
-        fn = partial(run, n_seg=n_seg)
+    def run_sharded(spec_pad2, tfs, idxs, top_lo, top_hi, thresh, seg_ids):
         shd = shard_map(
-            fn, mesh=mesh,
-            in_specs=(P("dm"), P(), P(), P(), P(), P()),
+            run, mesh=mesh,
+            in_specs=(P("dm"), P(), P(), P(), P(), P(), P()),
             out_specs=P(None, "dm"),
             check_rep=False,
         )
         return shd(spec_pad2, tfs, idxs,
-                   jnp.int32(top_lo), jnp.int32(top_hi), thresh)
+                   jnp.int32(top_lo), jnp.int32(top_hi), thresh, seg_ids)
 
-    return jax.jit(run_sharded, static_argnames=("n_seg",))
+    return jax.jit(run_sharded)
 
 
 def _detect_impl(accum, thresh, k: int):
@@ -454,21 +488,76 @@ def _build_ratio_bank(rho_num: int, rho_den: int, zs: tuple, ws: tuple,
 def _cached_ratio_bank(rho_num, rho_den, zs, ws, segw, min_halfwidth):
     """Byte-bounded memo of :func:`_build_ratio_bank` — repeated searches
     with one configuration (the 4096-trial batch) reuse banks, while a
-    parameter sweep cannot pin unbounded host RAM (the cache clears when
-    it would exceed ~4 GB)."""
+    parameter sweep cannot pin unbounded host RAM. Eviction is
+    oldest-first (dict insertion order), not clear-all: a coarse-to-fine
+    search holds TWO grids' banks per configuration, and a clear-all
+    policy would thrash the whole cache once the combined set crossed
+    the limit — rebuilding every bank (the setup-dominating host FFT
+    synthesis) per spectrum of a survey loop."""
     key = (rho_num, rho_den, zs, ws, segw, min_halfwidth)
-    hit = _BANK_CACHE.get(key)
+    hit = _BANK_CACHE.pop(key, None)
     if hit is not None:
+        _BANK_CACHE[key] = hit  # move-to-end: eviction is LRU, not FIFO
         return hit
     bank = _build_ratio_bank(rho_num, rho_den, zs, ws, segw, min_halfwidth)
     size = bank[0].nbytes + bank[3].nbytes
-    if _BANK_CACHE_BYTES[0] + size > _BANK_CACHE_LIMIT:
-        _BANK_CACHE.clear()
-        _BANK_CACHE_BYTES[0] = 0
+    while _BANK_CACHE and _BANK_CACHE_BYTES[0] + size > _BANK_CACHE_LIMIT:
+        old_key = next(iter(_BANK_CACHE))
+        old = _BANK_CACHE.pop(old_key)
+        _BANK_CACHE_BYTES[0] -= old[0].nbytes + old[3].nbytes
     if size <= _BANK_CACHE_LIMIT:
         _BANK_CACHE[key] = bank
         _BANK_CACHE_BYTES[0] += size
     return bank
+
+
+def _stage_range(H: int, rlo: int, rhi: int, N: int, segw: int):
+    """(top_lo, top_hi, n_seg) of harmonic stage ``H``'s segment grid
+    (shared by the serial and batched drivers and their coarse passes —
+    segment indices must map one-to-one between passes)."""
+    top_lo = H * rlo
+    top_hi = min(H * rhi, N - 1)
+    n_seg = -(-(top_hi - top_lo) // segw) if top_hi > top_lo else 0
+    return top_lo, top_hi, n_seg
+
+
+def _coarse_segment_sel(N, T, cfg: AccelSearchConfig, stages, rlo, rhi,
+                        segw, front, Np, thresh, hit_fn):
+    """Coarse-pass segment preselection shared by both drivers: rerun
+    :func:`_search_setup` on the coarse z grid (identical padding
+    geometry — asserted — so segment indices map one-to-one), then ask
+    ``hit_fn(H, banks_coarse, n_z_rows, thresh_val, seg_ids)`` — the
+    driver's own stage executor — for a per-segment hit mask at the
+    reduced threshold. Returns {H: hit segment ids}."""
+    ccfg = dataclasses.replace(cfg, dz=cfg.coarse_dz, coarse_dz=0.0)
+    (zs_c, _wc, _sc, _gc, _rl, _rh, banks_c, front_c, Np_c,
+     _nc, _tc) = _search_setup(N, T, ccfg)
+    if (front_c, Np_c) != (front, Np):
+        raise AssertionError("coarse/fine padding geometry diverged")
+    sel = {}
+    for H in stages:
+        _lo, _hi, n_seg = _stage_range(H, rlo, rhi, N, segw)
+        if not n_seg:
+            continue
+        hits = hit_fn(H, banks_c, len(zs_c),
+                      cfg.coarse_power_frac * thresh[H], np.arange(n_seg))
+        sel[H] = np.nonzero(hits)[0]
+    return sel
+
+
+def _pad_pow2(ids: np.ndarray) -> np.ndarray:
+    """Pad a segment-id list to the next power-of-two length by repeating
+    the last id. Refine-pass hit counts vary per spectrum, and every
+    distinct ``seg_ids`` LENGTH is one XLA compile (20-40 s through the
+    axon tunnel) — pow2 padding bounds the compile count at log2(n_seg)
+    shapes per stage geometry. Duplicate positions produce duplicate raw
+    hits, which the final sift already collapses; callers additionally
+    unpack only the first len(ids) positions."""
+    n = int(len(ids))
+    m = 1 << max(n - 1, 0).bit_length()
+    if m <= n:
+        return ids
+    return np.concatenate([ids, np.full(m - n, ids[-1], dtype=ids.dtype)])
 
 
 def _parabola_peak(ym, y0, yp):
@@ -621,32 +710,56 @@ def accel_search(
     spec_pad2 = _build_spec_pad(jnp.asarray(f_re), jnp.asarray(f_im),
                                 front, int(max(Np - N, 8)))
 
+    def run_stage(H, banks_src, Zrows, thresh_val, seg_ids):
+        """One harmonic stage over ``seg_ids``; device residency bounded
+        per stage: only this stage's <= H ratio banks live in HBM at once
+        (a full jerk bank set across all stages would be tens of GB at
+        survey parameters). Slice starts are affine in the segment index
+        — start = off0 + si*step, exact because H divides both top_lo and
+        segw — so the whole pass runs as one compiled lax.scan (one
+        dispatch; see _make_stage_runner); the stage's tfs/idxs device
+        buffers free on return, before the next stage allocates."""
+        top_lo, top_hi, _ = _stage_range(H, rlo, rhi, N, segw)
+        bank_meta, tfs, idxs = _stage_banks(banks_src, H, top_lo, segw,
+                                            front)
+        runner = _make_stage_runner(segw, Zrows, Wn, cfg.topk,
+                                    tuple(bank_meta))
+        with profiling.stage("accel_stage"):
+            return pull_host(*runner(
+                spec_pad2, tuple(tfs), tuple(idxs), top_lo, top_hi,
+                jnp.float32(thresh_val),
+                jnp.asarray(seg_ids, dtype=jnp.int32)))
+
+    def coarse_hits(H, banks_c, Zc, thresh_val, seg_ids):
+        vals, _zi, _ri, _ne = run_stage(H, banks_c, Zc, thresh_val, seg_ids)
+        return np.isfinite(vals).any(axis=(1, 2))
+
+    # optional coarse pass (cfg.coarse_dz): the same stages on a coarse z
+    # grid at a reduced power threshold select which segments the fine
+    # pass scans
+    seg_sel = None
+    if cfg.coarse_dz > cfg.dz:
+        seg_sel = _coarse_segment_sel(N, T, cfg, stages, rlo, rhi, segw,
+                                      front, Np, thresh, coarse_hits)
+
     raw_hits = []  # (stage, w idx, seg r0, vals, zidx, colidx, neigh, width)
     for H in stages:
-        top_lo = H * rlo
-        top_hi = min(H * rhi, N - 1)
-        if top_hi <= top_lo:
+        top_lo, top_hi, n_seg = _stage_range(H, rlo, rhi, N, segw)
+        if not n_seg:
             continue
-        n_seg = -(-(top_hi - top_lo) // segw)
-        # device residency bounded per stage: only this stage's <= H ratio
-        # banks live in HBM at once (a full jerk bank set across all
-        # stages would be tens of GB at survey parameters). Slice starts
-        # are affine in the segment index — start = off0 + si*step, exact
-        # because H divides both top_lo and segw — so the WHOLE stage runs
-        # as one compiled lax.scan (one dispatch; see _make_stage_runner).
-        bank_meta, tfs, idxs = _stage_banks(banks, H, top_lo, segw, front)
-        runner = _make_stage_runner(segw, Z, Wn, cfg.topk, tuple(bank_meta))
-        with profiling.stage("accel_stage"):
-            vals, zi, ri, neigh = pull_host(*runner(
-                spec_pad2, tuple(tfs), tuple(idxs), top_lo, top_hi,
-                jnp.float32(thresh[H]), n_seg))
-        del tfs, idxs  # free this stage's HBM before the next
-        for si in range(n_seg):
+        ids = np.arange(n_seg) if seg_sel is None else seg_sel[H]
+        if not len(ids):
+            continue
+        vals, zi, ri, neigh = run_stage(
+            H, banks, Z, thresh[H],
+            ids if seg_sel is None else _pad_pow2(ids))
+        for pos in range(len(ids)):
+            si = int(ids[pos])
             r0 = top_lo + si * segw
             width = min(segw, top_hi - r0)
             for wi in range(Wn):
-                raw_hits.append((H, wi, r0, vals[si, wi], zi[si, wi],
-                                 ri[si, wi], neigh[si, wi], width))
+                raw_hits.append((H, wi, r0, vals[pos, wi], zi[pos, wi],
+                                 ri[pos, wi], neigh[pos, wi], width))
 
     return _refine_hits(raw_hits, zs, ws, cfg, numindep, thresh)
 
@@ -736,43 +849,73 @@ def accel_search_batch(
     spec_pad2 = _build_spec_pad_batch(jnp.asarray(re), jnp.asarray(im),
                                       front, int(max(Np - N, 8)))
 
-    raw_per_b: List[list] = [[] for _ in range(B)]
-    for H in stages:
-        top_lo = H * rlo
-        top_hi = min(H * rhi, N - 1)
-        if top_hi <= top_lo:
-            continue
-        n_seg = -(-(top_hi - top_lo) // segw)
-        bank_meta, tfs, idxs = _stage_banks(banks, H, top_lo, segw, front)
+    def run_stage_chunks(H, banks_src, Zrows, thresh_val, seg_ids):
+        """Yield (c0, nb, vals, zi, ri, neigh) per batch chunk for one
+        harmonic stage scanned over ``seg_ids``; the chunk size respects
+        the per-device HBM budget and the stage's bank buffers free when
+        the generator is exhausted."""
+        top_lo, top_hi, _ = _stage_range(H, rlo, rhi, N, segw)
+        bank_meta, tfs, idxs = _stage_banks(banks_src, H, top_lo, segw,
+                                            front)
         # the budget is per device: a sharded chunk splits across the
         # mesh, so the whole chunk may hold mesh_devices x the budget
         per_dev = max(1, hbm_budget_bytes
-                      // _stage_chunk_bytes(tfs, Z, Wn, segw))
+                      // _stage_chunk_bytes(tfs, Zrows, Wn, segw))
         chunk = max(1, min(B, per_dev * max(1, mesh_devices)))
         if mesh_devices:
             chunk = max(mesh_devices, (chunk // mesh_devices) * mesh_devices)
-        runner = _make_stage_runner_batch(segw, Z, Wn, cfg.topk,
+        runner = _make_stage_runner_batch(segw, Zrows, Wn, cfg.topk,
                                           tuple(bank_meta),
                                           mesh_batch=mesh_devices)
+        ids_dev = jnp.asarray(seg_ids, dtype=jnp.int32)
         for c0 in range(0, B, chunk):
             # slice (not pad): a short tail chunk costs one extra compile
             # for its shape but never ships dead spectra through the scan
             sl = spec_pad2[c0:c0 + chunk]
             nb = int(sl.shape[0])
             with profiling.stage("accel_stage_batch"):
-                # [n_seg, nb, Wn, k] each; one batched pull (pull_host)
+                # [len(seg_ids), nb, Wn, k] each; one batched pull
                 vals, zi, ri, neigh = pull_host(*runner(
                     sl, tuple(tfs), tuple(idxs), top_lo, top_hi,
-                    jnp.float32(thresh[H]), n_seg))
-            for si in range(n_seg):
+                    jnp.float32(thresh_val), ids_dev))
+            yield c0, nb, vals, zi, ri, neigh
+
+    def coarse_hits(H, banks_c, Zc, thresh_val, seg_ids):
+        hit = np.zeros(len(seg_ids), bool)
+        for _c0, _nb, vals, _zi, _ri, _ne in run_stage_chunks(
+                H, banks_c, Zc, thresh_val, seg_ids):
+            hit |= np.isfinite(vals).any(axis=(1, 2, 3))
+        return hit
+
+    # optional coarse pass (cfg.coarse_dz): stage segments are selected by
+    # the UNION of coarse hits over the whole batch — the per-DM spectra
+    # of one observation concentrate their signal in the same segments,
+    # which is also why the bank sharing works
+    seg_sel = None
+    if cfg.coarse_dz > cfg.dz:
+        seg_sel = _coarse_segment_sel(N, T, cfg, stages, rlo, rhi, segw,
+                                      front, Np, thresh, coarse_hits)
+
+    raw_per_b: List[list] = [[] for _ in range(B)]
+    for H in stages:
+        top_lo, top_hi, n_seg = _stage_range(H, rlo, rhi, N, segw)
+        if not n_seg:
+            continue
+        ids = np.arange(n_seg) if seg_sel is None else seg_sel[H]
+        if not len(ids):
+            continue
+        for c0, nb, vals, zi, ri, neigh in run_stage_chunks(
+                H, banks, Z, thresh[H],
+                ids if seg_sel is None else _pad_pow2(ids)):
+            for pos in range(len(ids)):
+                si = int(ids[pos])
                 r0 = top_lo + si * segw
                 width = min(segw, top_hi - r0)
                 for bl in range(nb):
                     for wi in range(Wn):
                         raw_per_b[c0 + bl].append(
-                            (H, wi, r0, vals[si, bl, wi], zi[si, bl, wi],
-                             ri[si, bl, wi], neigh[si, bl, wi], width))
-        del tfs, idxs
+                            (H, wi, r0, vals[pos, bl, wi], zi[pos, bl, wi],
+                             ri[pos, bl, wi], neigh[pos, bl, wi], width))
 
     return [_refine_hits(raw, zs, ws, cfg, numindep, thresh)
             for raw in raw_per_b]

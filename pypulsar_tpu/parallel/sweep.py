@@ -735,9 +735,15 @@ class SweepCheckpoint:
         os.replace(tmp, self.path)
 
     def on_drained(self, plan, chunk_payload, acc, cursor, baseline,
-                   context: str = "") -> None:
-        self._drained += 1
-        if self._drained % self.every == 0:
+                   context: str = "", n: int = 1) -> None:
+        """Account ``n`` newly drained chunks; save when the count crosses
+        an ``every`` boundary. Burst draining accounts a whole batch in
+        one call with the batch-end (acc, cursor) — the only state pair
+        that is consistent (acc already holds every drained chunk, so a
+        mid-batch cursor would double-accumulate on resume)."""
+        fire = (self._drained + n) // self.every > self._drained // self.every
+        self._drained += n
+        if fire:
             with profiling.stage("checkpoint_save"):
                 self.save(plan, chunk_payload, acc, cursor, baseline,
                           context)
@@ -887,9 +893,12 @@ def sweep_stream(
                 s, ss, mb, ab = flat[4 * i: 4 * i + 4]
                 acc.update(start, stat_len, s, ss, mb, ab)
                 cursor = start + stat_len
-                if checkpoint is not None:
-                    checkpoint.on_drained(plan, chunk_payload, acc,
-                                          cursor, baseline, ckpt_context)
+        # outside the stage: checkpoint_save has its own profiling stage
+        # and nested stages both record wall time (utils/profiling.py),
+        # so saving inside would double-count in the overlap accounting
+        if checkpoint is not None:
+            checkpoint.on_drained(plan, chunk_payload, acc, cursor,
+                                  baseline, ckpt_context, n=len(due))
 
     need = out_len + slack2 + plan.max_shift1
 

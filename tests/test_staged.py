@@ -408,14 +408,13 @@ def test_sweep_flat_seek_resume_bit_exact(tmp_path, monkeypatch):
     whole = sweep_flat(filterbank.FilterbankFile(fn), dms, nsub=16,
                        group_size=8, chunk_payload=2048).steps[0].result
 
-    # crash after the 4th drained chunk (checkpoint saved every chunk)
+    # crash once >= 4 chunks have drained (burst draining accounts whole
+    # batches per on_drained call, so the count lives on the checkpoint)
     real = SweepCheckpoint.on_drained
-    calls = {"n": 0}
 
     def dying(self, *a, **k):
         real(self, *a, **k)
-        calls["n"] += 1
-        if calls["n"] >= 4:
+        if self._drained >= 4:
             raise KeyboardInterrupt("simulated SIGKILL")
 
     monkeypatch.setattr(SweepCheckpoint, "on_drained", dying)
@@ -425,6 +424,9 @@ def test_sweep_flat_seek_resume_bit_exact(tmp_path, monkeypatch):
                    checkpoint_path=ckpt, checkpoint_every=1)
     monkeypatch.setattr(SweepCheckpoint, "on_drained", real)
     assert os.path.exists(ckpt)
+    with np.load(ckpt) as z:
+        saved_cursor = int(z["cursor"])
+    assert saved_cursor >= 4 * 2048  # the crash point's drained coverage
 
     # resume: the re-rooted source must start AT the cursor, not 0
     seeks = []
@@ -439,7 +441,7 @@ def test_sweep_flat_seek_resume_bit_exact(tmp_path, monkeypatch):
                          group_size=8, chunk_payload=2048,
                          checkpoint_path=ckpt,
                          checkpoint_every=1).steps[0].result
-    assert seeks == [4 * 2048]
+    assert seeks == [saved_cursor]
     np.testing.assert_array_equal(resumed.snr, whole.snr)
     np.testing.assert_array_equal(resumed.peak_sample, whole.peak_sample)
     np.testing.assert_array_equal(resumed.mean, whole.mean)

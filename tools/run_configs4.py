@@ -49,6 +49,10 @@ def parse_args(argv=None):
                          "raw rate: the benched N=2^21-scale spectrum)")
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--zmax", type=float, default=200.0)
+    ap.add_argument("--coarse-dz", type=float, default=0.0,
+                    help="coarse-to-fine z preselection step for the "
+                         "accelsearch stage (cli accelsearch --coarse-dz; "
+                         "0 = single pass). Used for the A/B record")
     ap.add_argument("--workdir", default=os.path.join(REPO, "data",
                                                       "configs4"))
     ap.add_argument("--keep", action="store_true",
@@ -126,11 +130,13 @@ def main(argv=None):
 
     dats = sorted(glob.glob(f"{base}_DM*.dat"))
     assert len(dats) == a.trials, (len(dats), a.trials)
+    accel_argv = [sys.executable, "-m", "pypulsar_tpu.cli.accelsearch",
+                  *dats, "--batch", str(a.batch), "-z", str(int(a.zmax)),
+                  "--dz", "2", "-n", "8", "-s", "2"]
+    if a.coarse_dz > 0:
+        accel_argv += ["--coarse-dz", str(a.coarse_dz)]
     stages["accelsearch_batch"] = round(run_stage(
-        "accelsearch",
-        [sys.executable, "-m", "pypulsar_tpu.cli.accelsearch", *dats,
-         "--batch", str(a.batch), "-z", str(int(a.zmax)), "--dz", "2",
-         "-n", "8", "-s", "2"],
+        "accelsearch", accel_argv,
         os.path.join(a.workdir, "accel.log")), 1)
 
     cands = sorted(glob.glob(f"{base}_DM*_ACCEL_{int(a.zmax)}.cand"))
@@ -213,12 +219,16 @@ def main(argv=None):
                  f"{nbits}-bit "
                  f"window -> sweep(+streamed .dats, ds={a.downsamp}) -> "
                  f"accelsearch --batch {a.batch} (zmax={a.zmax:.0f}, "
-                 f"dz=2, H<=8, N={N} bins x {a.trials} trials) -> sift; "
-                 f"measured on one v5e through the axon tunnel"),
+                 f"dz=2, H<=8, N={N} bins x {a.trials} trials"
+                 + (f", coarse-dz={a.coarse_dz:g} prepass"
+                    if a.coarse_dz > 0 else "")
+                 + ") -> sift; measured on one v5e through the axon "
+                   "tunnel"),
         "vs_baseline": round(vs_baseline, 2),
         "numpy_cells_per_sec": round(bl_cells_per_sec, 1),
         **{k: v for k, v in bl.items() if k != "seconds"},
         "trials": a.trials,
+        "coarse_dz": a.coarse_dz,
         "wall_seconds": round(wall, 1),
         "stage_seconds": stages,
         "spectrum_bins": N,

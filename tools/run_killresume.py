@@ -38,6 +38,10 @@ def parse_args(argv=None):
     ap.add_argument("--trials", type=int, default=4096)
     ap.add_argument("--dm-max", type=float, default=500.0)
     ap.add_argument("--kill-frac", type=float, default=0.45)
+    ap.add_argument("--checkpoint-every", type=int, default=None,
+                    help="chunks between checkpoint saves (default: the "
+                         "CLI's 16; toy rehearsals with fewer total "
+                         "chunks need 1-2 or no checkpoint ever lands)")
     ap.add_argument("--workdir", default=os.path.join(REPO, "data",
                                                       "killresume"))
     ap.add_argument("--skip-seq", action="store_true",
@@ -55,6 +59,8 @@ def sweep_argv(a, outbase, ckpt=None, resume=False):
             "--threshold", "10", "-o", outbase]
     if ckpt:
         argv += ["--checkpoint", ckpt]
+        if a.checkpoint_every is not None:
+            argv += ["--checkpoint-every", str(a.checkpoint_every)]
     if resume:
         argv += ["--resume"]
     return argv
