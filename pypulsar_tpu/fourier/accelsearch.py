@@ -501,13 +501,14 @@ def _cached_ratio_bank(rho_num, rho_den, zs, ws, segw, min_halfwidth):
         return hit
     bank = _build_ratio_bank(rho_num, rho_den, zs, ws, segw, min_halfwidth)
     size = bank[0].nbytes + bank[3].nbytes
+    if size > _BANK_CACHE_LIMIT:
+        return bank  # uncacheable; evicting everything for it helps nobody
     while _BANK_CACHE and _BANK_CACHE_BYTES[0] + size > _BANK_CACHE_LIMIT:
         old_key = next(iter(_BANK_CACHE))
         old = _BANK_CACHE.pop(old_key)
         _BANK_CACHE_BYTES[0] -= old[0].nbytes + old[3].nbytes
-    if size <= _BANK_CACHE_LIMIT:
-        _BANK_CACHE[key] = bank
-        _BANK_CACHE_BYTES[0] += size
+    _BANK_CACHE[key] = bank
+    _BANK_CACHE_BYTES[0] += size
     return bank
 
 
@@ -545,16 +546,19 @@ def _coarse_segment_sel(N, T, cfg: AccelSearchConfig, stages, rlo, rhi,
     return sel
 
 
-def _pad_pow2(ids: np.ndarray) -> np.ndarray:
-    """Pad a segment-id list to the next power-of-two length by repeating
-    the last id. Refine-pass hit counts vary per spectrum, and every
-    distinct ``seg_ids`` LENGTH is one XLA compile (20-40 s through the
-    axon tunnel) — pow2 padding bounds the compile count at log2(n_seg)
-    shapes per stage geometry. Duplicate positions produce duplicate raw
+def _pad_pow2(ids: np.ndarray, n_seg: int) -> np.ndarray:
+    """Pad a segment-id list to the next power-of-two length (capped at
+    the stage's ``n_seg``) by repeating the last id. Refine-pass hit
+    counts vary per spectrum, and every distinct ``seg_ids`` LENGTH is
+    one XLA compile (20-40 s through the axon tunnel) — pow2 padding
+    bounds the compile count at log2(n_seg) shapes per stage geometry.
+    The cap keeps a near-full selection from scanning MORE segments than
+    the single-pass search would (and its length is the shape a full
+    pass compiles anyway). Duplicate positions produce duplicate raw
     hits, which the final sift already collapses; callers additionally
     unpack only the first len(ids) positions."""
     n = int(len(ids))
-    m = 1 << max(n - 1, 0).bit_length()
+    m = min(1 << max(n - 1, 0).bit_length(), n_seg)
     if m <= n:
         return ids
     return np.concatenate([ids, np.full(m - n, ids[-1], dtype=ids.dtype)])
@@ -752,7 +756,7 @@ def accel_search(
             continue
         vals, zi, ri, neigh = run_stage(
             H, banks, Z, thresh[H],
-            ids if seg_sel is None else _pad_pow2(ids))
+            ids if seg_sel is None else _pad_pow2(ids, n_seg))
         for pos in range(len(ids)):
             si = int(ids[pos])
             r0 = top_lo + si * segw
@@ -906,7 +910,7 @@ def accel_search_batch(
             continue
         for c0, nb, vals, zi, ri, neigh in run_stage_chunks(
                 H, banks, Z, thresh[H],
-                ids if seg_sel is None else _pad_pow2(ids)):
+                ids if seg_sel is None else _pad_pow2(ids, n_seg)):
             for pos in range(len(ids)):
                 si = int(ids[pos])
                 r0 = top_lo + si * segw

@@ -53,6 +53,13 @@ def parse_args(argv=None):
                     help="coarse-to-fine z preselection step for the "
                          "accelsearch stage (cli accelsearch --coarse-dz; "
                          "0 = single pass). Used for the A/B record")
+    ap.add_argument("--ab-coarse", type=float, default=0.0, metavar="DZ",
+                    help="after the primary accelsearch stage, re-run "
+                         "JUST that stage on the same .dats with "
+                         "--coarse-dz DZ and record the A/B walls plus "
+                         "whether the re-sifted candidates match "
+                         "(VERDICT r4 item 1 stretch evidence at zero "
+                         "extra sweep cost)")
     ap.add_argument("--workdir", default=os.path.join(REPO, "data",
                                                       "configs4"))
     ap.add_argument("--keep", action="store_true",
@@ -164,6 +171,36 @@ def main(argv=None):
                             "snr": c.snr}
     print(f"## injected pulsar recovery: {best}")
 
+    # --- optional A/B: the coarse-to-fine accel stage on the SAME .dats
+    ab = None
+    if a.ab_coarse > 0:
+        if a.coarse_dz > 0:
+            raise SystemExit("--ab-coarse needs a single-pass primary run "
+                             "(drop --coarse-dz)")
+        for fn in cands + [sifted]:
+            shutil.move(fn, fn + ".single")
+        stages["accelsearch_batch_coarse"] = round(run_stage(
+            "accelsearch-coarse",
+            accel_argv + ["--coarse-dz", str(a.ab_coarse)],
+            os.path.join(a.workdir, "accel_coarse.log")), 1)
+        stages["sift_coarse"] = round(run_stage(
+            "sift-coarse",
+            [sys.executable, "-m", "pypulsar_tpu.cli.sift", *cands,
+             "-o", sifted, "-s", "4"],
+            os.path.join(a.workdir, "sift_coarse.log")), 1)
+        with open(sifted + ".single", "rb") as f1, open(sifted, "rb") as f2:
+            identical = f1.read() == f2.read()
+        ab = {
+            "coarse_dz": a.ab_coarse,
+            "accel_wall_single": stages["accelsearch_batch"],
+            "accel_wall_coarse": stages["accelsearch_batch_coarse"],
+            "speedup": round(stages["accelsearch_batch"]
+                             / max(stages["accelsearch_batch_coarse"],
+                                   1e-9), 2),
+            "sift_identical": identical,
+        }
+        print(f"## coarse-to-fine A/B: {ab}")
+
     # --- (r, z) cell accounting at the searched geometry (bench run_accel
     # formula) x trials / accel wall
     from pypulsar_tpu.fourier.accelsearch import AccelSearchConfig
@@ -235,6 +272,7 @@ def main(argv=None):
         "cells_per_spectrum": cells,
         "cells_per_sec": round(cells_per_sec, 1),
         "injected_recovered": best,
+        **({"ab_coarse": ab} if ab else {}),
         "per_spectrum_seconds": round(
             stages["accelsearch_batch"] / a.trials, 2),
         "projection_4096_trials_hours": round(
