@@ -637,12 +637,16 @@ def _refine_hits(raw_hits, zs, ws, cfg: AccelSearchConfig,
     sigma, then greedy duplicate removal by fundamental proximity."""
     cands: List[AccelCandidate] = []
     for H, wi, r0, vals, zi, ri, neigh, width in raw_hits:
-        for j in range(len(vals)):
+        # vectorized pre-filter: most top-k slots are -inf (below the
+        # detection threshold) and the Python loop below runs per
+        # (spectrum, stage, segment, k) — 10^7-scale at survey batch
+        # sizes if every slot is visited. float64 so the threshold
+        # compare matches the old per-element float(p) <= thresh exactly
+        vals = np.asarray(vals, dtype=np.float64)
+        keep = np.isfinite(vals) & (vals > thresh[H]) \
+            & (np.asarray(ri) < 2 * width)
+        for j in np.nonzero(keep)[0]:
             p = float(vals[j])
-            if not np.isfinite(p) or p <= thresh[H]:
-                continue
-            if ri[j] >= 2 * width:  # padding region of a short last segment
-                continue
             nb = neigh[j].astype(np.float64)
             dr, _ = _parabola_peak(nb[1, 0], nb[1, 1], nb[1, 2])
             dzo, _ = _parabola_peak(nb[0, 1], nb[1, 1], nb[2, 1])
