@@ -305,31 +305,34 @@ def test_masked_block_interval_lookup_past_int32(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
 
 
-def test_sweep_packed_4bit_matches_expanded_8bit(tmp_path):
-    """VERDICT r4 item 2: a 4-bit PACKED file swept through the streamed
-    path (device-side unpack in _ingest_tc) produces bit-identical
-    results to the same values pre-expanded into an 8-bit file — while
-    shipping half the bytes."""
+@pytest.mark.parametrize("nbits", [4, 2])
+def test_sweep_packed_subbyte_matches_expanded_8bit(tmp_path, nbits):
+    """VERDICT r4 item 2: a 4-bit (or 2-bit) PACKED file swept through
+    the streamed path (device-side unpack in _ingest_tc) produces
+    bit-identical results to the same values pre-expanded into an 8-bit
+    file — while shipping 1/2 (1/4) of the bytes."""
     from pypulsar_tpu.parallel.staged import sweep_flat
 
     rng = np.random.RandomState(17)
     C, T, dt, dm_true = 64, 16384, 1e-3, 60.0
     freqs = (1500.0 - 2.0 * np.arange(C)).astype(np.float64)
-    vals = rng.randint(0, 14, size=(T, C)).astype(np.uint8)
+    noise_hi, amp = (14, 2) if nbits == 4 else (3, 1)
+    vals = rng.randint(0, noise_hi, size=(T, C)).astype(np.uint8)
     bins = numpy_ref.bin_delays(dm_true, freqs, dt)
     for c in range(C):
         for k in range(8):
             i = 900 + k + bins[c]
             if i < T:
-                vals[i, c] += 2
+                vals[i, c] += amp
     hdr = dict(filterbank.DEFAULT_HEADER)
     hdr.update(nchans=C, fch1=freqs[0], foff=-2.0, tsamp=dt)
     fn4 = str(tmp_path / "p4.fil")
     fn8 = str(tmp_path / "p8.fil")
-    filterbank.write_filterbank(fn4, dict(hdr, nbits=4), vals)
+    filterbank.write_filterbank(fn4, dict(hdr, nbits=nbits), vals)
     filterbank.write_filterbank(fn8, dict(hdr, nbits=8), vals)
     assert (os.stat(fn4).st_size - FilterbankFileHeaderSize(fn4)
-            ) * 2 == os.stat(fn8).st_size - FilterbankFileHeaderSize(fn8)
+            ) * (8 // nbits) == (os.stat(fn8).st_size
+                                 - FilterbankFileHeaderSize(fn8))
     dms = np.linspace(0.0, 120.0, 16)
     r4 = sweep_flat(filterbank.FilterbankFile(fn4), dms, nsub=16,
                     group_size=8, chunk_payload=4096)
