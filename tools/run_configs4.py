@@ -74,11 +74,28 @@ def parse_args(argv=None):
 
 def slice_window(fil: str, out: str, seconds: float) -> int:
     """First ``seconds`` of a .fil as a standalone file (byte copy:
-    header + whole spectra)."""
+    header + whole spectra). When the source already IS the window
+    (a file generated at exactly --duration), reuse it in place —
+    no 14-GB copy, no double disk footprint."""
     from pypulsar_tpu.io.filterbank import FilterbankFile
 
     fb = FilterbankFile(fil)
     nsamp = min(int(round(seconds / fb.tsamp)), fb.number_of_samples)
+    total = fb.number_of_samples
+    if os.path.abspath(out) == os.path.abspath(fil):
+        # the source IS the window artifact (re-run against a kept
+        # window.fil): never remove it; slicing onto itself is a user error
+        fb.close()
+        if nsamp < total:
+            raise ValueError(f"--fil and the window path are the same file "
+                             f"({out}); cannot slice it onto itself")
+        return nsamp
+    if os.path.lexists(out):
+        os.remove(out)  # never open through a stale symlink from a prior run
+    if nsamp == fb.number_of_samples:
+        fb.close()
+        os.symlink(os.path.abspath(fil), out)
+        return nsamp
     nbytes = nsamp * fb.bytes_per_spectrum
     with open(fil, "rb") as src, open(out, "wb") as dst:
         dst.write(src.read(fb.header_size))
