@@ -275,15 +275,27 @@ def main(argv=None):
             names = [g[0] for g in group]
             T = group[0][2]
             try:
-                stacked = np.stack([g[1] for g in group])
                 if group[0][3] == "series":
                     from pypulsar_tpu.fourier.kernels import \
                         prep_spectra_batch
 
-                    all_cands = accel_search_batch(
-                        prep_spectra_batch(stacked), T, cfg)
+                    # bound prep residency by the same knob that chunks
+                    # the search: series + plane + rfft workspace is
+                    # ~24 bytes/sample per spectrum, and the whole
+                    # prepped slice lives in HBM until its search ends
+                    n1 = len(group[0][1])
+                    budget = int(float(
+                        os.environ.get("PYPULSAR_TPU_ACCEL_HBM", 5e9)))
+                    cap = max(1, budget // (24 * n1))
+                    all_cands = []
+                    for c0 in range(0, len(group), cap):
+                        stacked = np.stack(
+                            [g[1] for g in group[c0:c0 + cap]])
+                        all_cands.extend(accel_search_batch(
+                            prep_spectra_batch(stacked), T, cfg))
                 else:
-                    all_cands = accel_search_batch(stacked, T, cfg)
+                    all_cands = accel_search_batch(
+                        np.stack([g[1] for g in group]), T, cfg)
             except Exception as e:  # noqa: BLE001 - fall back to serial:
                 # one poison spectrum must fail alone, not take down (and,
                 # under --skip-existing restarts, permanently wedge) its
@@ -294,7 +306,10 @@ def main(argv=None):
                 for fn, payload, T1, kind in group:
                     try:
                         if kind == "series":
-                            norm1, T1 = prepare_one(fn, args)
+                            prep1 = prepare_one(fn, args)
+                            if prep1 is None:  # e.g. --skip-existing saw
+                                continue       # a .cand written meanwhile
+                            norm1, T1 = prep1
                         else:
                             norm1 = payload
                         write_results(fn, accel_search(norm1, T1, cfg),
