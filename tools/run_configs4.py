@@ -48,6 +48,10 @@ def parse_args(argv=None):
                          "accel search (256 us at the north-star's 64 us "
                          "raw rate: the benched N=2^21-scale spectrum)")
     ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--device-prep", action="store_true",
+                    help="pass --device-prep to the accelsearch stage "
+                         "(device-side rfft + deredden; see "
+                         "tools/run_accelprep_ab.py for the measured A/B)")
     ap.add_argument("--zmax", type=float, default=200.0)
     ap.add_argument("--coarse-dz", type=float, default=0.0,
                     help="coarse-to-fine z preselection step for the "
@@ -125,6 +129,9 @@ def run_stage(name, argv, log):
 
 def main(argv=None):
     a = parse_args(argv)
+    if a.device_prep and a.batch < 2:
+        raise SystemExit("--device-prep only takes effect on the batched "
+                         "accelsearch path; use --batch >= 2")
     os.makedirs(a.workdir, exist_ok=True)
     base = os.path.join(a.workdir, "c4")
     win_fil = os.path.join(a.workdir, "window.fil")
@@ -159,6 +166,8 @@ def main(argv=None):
                   "--dz", "2", "-n", "8", "-s", "2"]
     if a.coarse_dz > 0:
         accel_argv += ["--coarse-dz", str(a.coarse_dz)]
+    if a.device_prep:
+        accel_argv += ["--device-prep"]
     stages["accelsearch_batch"] = round(run_stage(
         "accelsearch", accel_argv,
         os.path.join(a.workdir, "accel.log")), 1)
@@ -276,6 +285,7 @@ def main(argv=None):
                  f"dz=2, H<=8, N={N} bins x {a.trials} trials"
                  + (f", coarse-dz={a.coarse_dz:g} prepass"
                     if a.coarse_dz > 0 else "")
+                 + (", device-prep" if a.device_prep else "")
                  + ") -> sift; measured on one v5e through the axon "
                    "tunnel"),
         "vs_baseline": round(vs_baseline, 2),
@@ -283,6 +293,7 @@ def main(argv=None):
         **{k: v for k, v in bl.items() if k != "seconds"},
         "trials": a.trials,
         "coarse_dz": a.coarse_dz,
+        "device_prep": a.device_prep,
         "wall_seconds": round(wall, 1),
         "stage_seconds": stages,
         "spectrum_bins": N,
