@@ -36,6 +36,11 @@ def parse_args(argv=None):
     ap.add_argument("--zmax", type=float, default=200.0)
     ap.add_argument("--numharm", type=int, default=8)
     ap.add_argument("--sigma", type=float, default=2.0)
+    ap.add_argument("--coarse-dz", type=float, default=0.0,
+                    help="also time coarse-to-fine legs at this coarse "
+                         "step (single-pass vs --coarse-dz, each with "
+                         "and without --device-prep): a clean-host "
+                         "re-measurement of the configs[4] in-run A/B")
     ap.add_argument("--out", default=os.path.join(
         REPO, "BENCH_r05_accelprep.json"))
     return ap.parse_args(argv)
@@ -67,6 +72,10 @@ def cand_sets(dats, a):
 
 def main(argv=None):
     a = parse_args(argv)
+    if a.batch < 2:
+        raise SystemExit("--batch >= 2 required: the CLI only honors "
+                         "--device-prep on its batched path, so a batch-1 "
+                         "A/B would time identical host-prep legs")
     src = sorted(glob.glob(a.dats))
     if not src:
         raise SystemExit(f"no dats match {a.dats!r}")
@@ -80,34 +89,45 @@ def main(argv=None):
                         os.path.splitext(d)[0] + ".inf")
         dats.append(d)
 
-    host_wall = run_cli(dats, a, [],
-                        os.path.join(a.workdir, "host.log"))
-    host = cand_sets(dats, a)
-    dev_wall = run_cli(dats, a, ["--device-prep"],
-                       os.path.join(a.workdir, "device.log"))
-    dev = cand_sets(dats, a)
+    legs = [("host", []), ("device", ["--device-prep"])]
+    if a.coarse_dz > 0:
+        cd = ["--coarse-dz", str(a.coarse_dz)]
+        legs += [("coarse", cd), ("coarse_device", cd + ["--device-prep"])]
 
-    same = sum(host[k] == dev[k] for k in host)
+    walls, sets = {}, {}
+    for name, extra in legs:
+        walls[name] = run_cli(dats, a, extra,
+                              os.path.join(a.workdir, f"{name}.log"))
+        sets[name] = cand_sets(dats, a)
+        print(f"# leg {name}: {walls[name]:.1f}s", flush=True)
+
+    ref = sets["host"]
+    parity = {name: sum(ref[k] == s[k] for k in ref)
+              for name, s in sets.items() if name != "host"}
+    all_same = all(v == len(dats) for v in parity.values())
     rec = {
         "metric": "accel_device_prep_speedup",
-        "value": round(host_wall / dev_wall, 2),
+        "value": round(walls["host"] / walls["device"], 2),
         "unit": (f"host-prep wall / device-prep wall, cli accelsearch "
                  f"--batch {a.batch} over {len(dats)} x "
                  f"900-s .dats (zmax={a.zmax:.0f}, dz=2, "
                  f"H<={a.numharm}); candidate sets (r,z rounded to 0.1) "
-                 f"identical on {same}/{len(dats)} files"),
+                 f"vs host leg: "
+                 + ", ".join(f"{n}={v}/{len(dats)}"
+                             for n, v in parity.items())),
         "vs_baseline": 0.0,
-        "host_prep_wall_seconds": round(host_wall, 1),
-        "device_prep_wall_seconds": round(dev_wall, 1),
+        "wall_seconds_by_leg": {n: round(w, 1) for n, w in walls.items()},
+        "per_spectrum_seconds_by_leg": {
+            n: round(w / len(dats), 2) for n, w in walls.items()},
         "n_dats": len(dats),
-        "per_spectrum_host_s": round(host_wall / len(dats), 2),
-        "per_spectrum_device_s": round(dev_wall / len(dats), 2),
-        "cand_sets_identical": same == len(dats),
+        "coarse_dz": a.coarse_dz,
+        "cand_parity_vs_host": parity,
+        "cand_sets_identical": all_same,
     }
     print(json.dumps(rec))
     with open(a.out, "w") as f:
         f.write(json.dumps(rec) + "\n")
-    return 0 if same == len(dats) else 1
+    return 0 if all_same else 1
 
 
 if __name__ == "__main__":
