@@ -99,6 +99,11 @@ def main(argv=None):
         walls[name] = run_cli(dats, a, extra,
                               os.path.join(a.workdir, f"{name}.log"))
         sets[name] = cand_sets(dats, a)
+        legdir = os.path.join(a.workdir, name)
+        os.makedirs(legdir, exist_ok=True)
+        for d in dats:  # exactly this run's outputs: no stale-file bleed
+            fn = os.path.splitext(d)[0] + f"_ACCEL_{int(a.zmax)}.cand"
+            shutil.copy(fn, legdir)
         print(f"# leg {name}: {walls[name]:.1f}s", flush=True)
 
     ref = sets["host"]
