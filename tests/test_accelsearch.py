@@ -738,3 +738,72 @@ def test_cli_device_prep_matches_host_prep(tmp_path, monkeypatch):
             assert abs(ch.r - cd.r) < 1e-3
             assert abs(ch.z - cd.z) < 1e-3
             assert abs(ch.sig - cd.sig) < 1e-3
+
+
+def test_cli_device_prep_hbm_cap_chunks_prep(tmp_path, monkeypatch):
+    """A tiny PYPULSAR_TPU_ACCEL_HBM forces the device-prep flush to prep
+    the group in budget-bounded slices (cap = budget // (24 * n)); the
+    candidates must not change. Guards the review fix that stops a large
+    --batch from out-allocating the search's own HBM budget during prep."""
+    from pypulsar_tpu.cli import accelsearch as cli_accel
+    from pypulsar_tpu.io.datfile import write_dat
+    from pypulsar_tpu.io.infodata import InfoData
+    from pypulsar_tpu.io.prestocand import read_rzwcands
+
+    monkeypatch.chdir(tmp_path)
+    rng = np.random.RandomState(13)
+    N = 1 << 14
+    dt = 5e-4
+    bases = []
+    for ii in range(4):
+        ts = rng.standard_normal(N).astype(np.float32)
+        ts += 0.25 * np.cos(2 * np.pi * (29.0 + 5.0 * ii)
+                            * np.arange(N) * dt).astype(np.float32)
+        inf = InfoData()
+        inf.epoch = 55000.0
+        inf.dt = dt
+        inf.N = N
+        inf.telescope = "Fake"
+        inf.lofreq = 1400.0
+        inf.BW = 100.0
+        inf.numchan = 1
+        inf.chan_width = 100.0
+        inf.object = "FAKE"
+        base = str(tmp_path / f"cap{ii}")
+        write_dat(base, ts, inf)
+        bases.append(base)
+    dats = [b + ".dat" for b in bases]
+    argv = dats + ["--batch", "4", "-z", "10", "-n", "1", "-s", "3",
+                   "--device-prep"]
+
+    # count prep dispatches through the symbol the CLI resolves at call
+    # time, so the test FAILS if the cap slicing is removed
+    from pypulsar_tpu.fourier import kernels as _k
+
+    calls = []
+    real_prep = _k.prep_spectra_batch
+
+    def spy(series, *a, **kw):
+        calls.append(np.asarray(series).shape[0])
+        return real_prep(series, *a, **kw)
+
+    monkeypatch.setattr(_k, "prep_spectra_batch", spy)
+
+    monkeypatch.delenv("PYPULSAR_TPU_ACCEL_HBM", raising=False)
+    assert cli_accel.main(argv) == 0
+    assert calls == [4], calls  # unbounded budget: one whole-group prep
+    whole = {b: [(round(c.r, 3), round(c.z, 3))
+                 for c in read_rzwcands(b + "_ACCEL_10.cand")]
+             for b in bases}
+    for b in bases:
+        os.remove(b + "_ACCEL_10.cand")
+    # budget small enough that cap = max(1, budget // (24 * N)) == 1:
+    # every spectrum preps in its own slice
+    calls.clear()
+    monkeypatch.setenv("PYPULSAR_TPU_ACCEL_HBM", str(24 * N))
+    assert cli_accel.main(argv) == 0
+    assert calls == [1, 1, 1, 1], calls
+    for b in bases:
+        got = [(round(c.r, 3), round(c.z, 3))
+               for c in read_rzwcands(b + "_ACCEL_10.cand")]
+        assert got == whole[b]
