@@ -316,14 +316,34 @@ def test_recover_binary_p_and_pdot():
 # ---------------------------------------------------------------------------
 
 
+def _write_fake_dat(base, ts, dt, obj="FAKE", dm=None):
+    """One .dat + .inf pair with the standard fake-observatory header —
+    the single place the CLI tests' fixture schema lives."""
+    from pypulsar_tpu.io.datfile import write_dat
+    from pypulsar_tpu.io.infodata import InfoData
+
+    inf = InfoData()
+    inf.epoch = 55000.0
+    inf.dt = dt
+    inf.N = len(ts)
+    if dm is not None:
+        inf.DM = dm
+    inf.telescope = "Fake"
+    inf.lofreq = 1400.0
+    inf.BW = 100.0
+    inf.numchan = 1
+    inf.chan_width = 100.0
+    inf.object = obj
+    write_dat(base, ts, inf)
+    return base
+
+
 def test_cli_accelsearch_to_plot_accelcands(tmp_path, monkeypatch):
     import matplotlib
 
     matplotlib.use("Agg", force=True)
     from pypulsar_tpu.cli import accelsearch as cli_accel
     from pypulsar_tpu.cli import plot_accelcands as cli_plot
-    from pypulsar_tpu.io.datfile import write_dat
-    from pypulsar_tpu.io.infodata import InfoData
     from pypulsar_tpu.io.prestocand import read_rzwcands
 
     monkeypatch.chdir(tmp_path)
@@ -337,18 +357,7 @@ def test_cli_accelsearch_to_plot_accelcands(tmp_path, monkeypatch):
     for ii in range(3):
         ts = rng.standard_normal(N).astype(np.float32)
         ts += 0.15 * np.cos(2 * np.pi * f0 * t).astype(np.float32)
-        inf = InfoData()
-        inf.epoch = 55000.0
-        inf.dt = dt
-        inf.N = N
-        inf.telescope = "Fake"
-        inf.lofreq = 1400.0
-        inf.BW = 100.0
-        inf.numchan = 1
-        inf.chan_width = 100.0
-        inf.object = "FAKE"
-        base = str(tmp_path / f"beam{ii}")
-        write_dat(base, ts, inf)
+        base = _write_fake_dat(str(tmp_path / f"beam{ii}"), ts, dt)
         inffns.append(base + ".inf")
         rc = cli_accel.main([base + ".dat", "-z", "0", "-n", "1",
                              "-s", "4"])
@@ -422,8 +431,6 @@ def test_cli_sift_clusters_across_dms(tmp_path, monkeypatch):
     from pypulsar_tpu.cli import accelsearch as cli_accel
     from pypulsar_tpu.cli import sift as cli_sift
     from pypulsar_tpu.io.accelcands import parse_candlist
-    from pypulsar_tpu.io.datfile import write_dat
-    from pypulsar_tpu.io.infodata import InfoData
 
     monkeypatch.chdir(tmp_path)
     rng = np.random.RandomState(17)
@@ -436,19 +443,8 @@ def test_cli_sift_clusters_across_dms(tmp_path, monkeypatch):
     for dm, amp in ((38.0, 0.12), (40.0, 0.3), (42.0, 0.12)):
         ts = rng.standard_normal(N).astype(np.float32)
         ts += amp * np.cos(2 * np.pi * f0 * t).astype(np.float32)
-        inf = InfoData()
-        inf.epoch = 55000.0
-        inf.dt = dt
-        inf.N = N
-        inf.DM = dm
-        inf.telescope = "Fake"
-        inf.lofreq = 1400.0
-        inf.BW = 100.0
-        inf.numchan = 1
-        inf.chan_width = 100.0
-        inf.object = "SIFT"
-        base = str(tmp_path / f"s_DM{dm:.2f}")
-        write_dat(base, ts, inf)
+        base = _write_fake_dat(str(tmp_path / f"s_DM{dm:.2f}"), ts, dt,
+                               obj="SIFT", dm=dm)
         rc = cli_accel.main([base + ".dat", "-z", "0", "-n", "1", "-s", "4"])
         assert rc == 0
         candfns.append(base + "_ACCEL_0.cand")
@@ -692,8 +688,6 @@ def test_cli_device_prep_matches_host_prep(tmp_path, monkeypatch):
     """cli accelsearch --batch --device-prep finds the same candidates
     as the default host-prep batch path on the same .dats."""
     from pypulsar_tpu.cli import accelsearch as cli_accel
-    from pypulsar_tpu.io.datfile import write_dat
-    from pypulsar_tpu.io.infodata import InfoData
     from pypulsar_tpu.io.prestocand import read_rzwcands
 
     monkeypatch.chdir(tmp_path)
@@ -705,19 +699,7 @@ def test_cli_device_prep_matches_host_prep(tmp_path, monkeypatch):
         ts = rng.standard_normal(N).astype(np.float32)
         ts += 0.2 * np.cos(2 * np.pi * (41.0 + 7.0 * ii)
                            * np.arange(N) * dt).astype(np.float32)
-        inf = InfoData()
-        inf.epoch = 55000.0
-        inf.dt = dt
-        inf.N = N
-        inf.telescope = "Fake"
-        inf.lofreq = 1400.0
-        inf.BW = 100.0
-        inf.numchan = 1
-        inf.chan_width = 100.0
-        inf.object = "FAKE"
-        base = str(tmp_path / f"dp{ii}")
-        write_dat(base, ts, inf)
-        bases.append(base)
+        bases.append(_write_fake_dat(str(tmp_path / f"dp{ii}"), ts, dt))
 
     dats = [b + ".dat" for b in bases]
     rc = cli_accel.main(dats + ["--batch", "3", "-z", "20", "-n", "2",
@@ -746,8 +728,6 @@ def test_cli_device_prep_hbm_cap_chunks_prep(tmp_path, monkeypatch):
     candidates must not change. Guards the review fix that stops a large
     --batch from out-allocating the search's own HBM budget during prep."""
     from pypulsar_tpu.cli import accelsearch as cli_accel
-    from pypulsar_tpu.io.datfile import write_dat
-    from pypulsar_tpu.io.infodata import InfoData
     from pypulsar_tpu.io.prestocand import read_rzwcands
 
     monkeypatch.chdir(tmp_path)
@@ -759,19 +739,7 @@ def test_cli_device_prep_hbm_cap_chunks_prep(tmp_path, monkeypatch):
         ts = rng.standard_normal(N).astype(np.float32)
         ts += 0.25 * np.cos(2 * np.pi * (29.0 + 5.0 * ii)
                             * np.arange(N) * dt).astype(np.float32)
-        inf = InfoData()
-        inf.epoch = 55000.0
-        inf.dt = dt
-        inf.N = N
-        inf.telescope = "Fake"
-        inf.lofreq = 1400.0
-        inf.BW = 100.0
-        inf.numchan = 1
-        inf.chan_width = 100.0
-        inf.object = "FAKE"
-        base = str(tmp_path / f"cap{ii}")
-        write_dat(base, ts, inf)
-        bases.append(base)
+        bases.append(_write_fake_dat(str(tmp_path / f"cap{ii}"), ts, dt))
     dats = [b + ".dat" for b in bases]
     argv = dats + ["--batch", "4", "-z", "10", "-n", "1", "-s", "3",
                    "--device-prep"]
@@ -807,3 +775,56 @@ def test_cli_device_prep_hbm_cap_chunks_prep(tmp_path, monkeypatch):
         got = [(round(c.r, 3), round(c.z, 3))
                for c in read_rzwcands(b + "_ACCEL_10.cand")]
         assert got == whole[b]
+
+
+def test_cli_device_prep_batch_failure_falls_back_serial(tmp_path,
+                                                         monkeypatch):
+    """A failing device-prep batched dispatch degrades to per-file serial
+    HOST-prep searches (re-reading each .dat) instead of failing the
+    group — the poison-spectrum contract of the batched CLI, extended to
+    series-kind groups."""
+    from pypulsar_tpu.cli import accelsearch as cli_accel
+    from pypulsar_tpu.io.prestocand import read_rzwcands
+
+    monkeypatch.chdir(tmp_path)
+    rng = np.random.RandomState(14)
+    N = 1 << 14
+    dt = 5e-4
+    bases = []
+    for ii in range(3):
+        ts = rng.standard_normal(N).astype(np.float32)
+        ts += 0.25 * np.cos(2 * np.pi * (31.0 + 4.0 * ii)
+                            * np.arange(N) * dt).astype(np.float32)
+        bases.append(_write_fake_dat(str(tmp_path / f"pf{ii}"), ts, dt))
+    dats = [b + ".dat" for b in bases]
+
+    from pypulsar_tpu.fourier import accelsearch as _accel_mod
+
+    real_batch = _accel_mod.accel_search_batch
+    boom = {"n": 0}
+
+    def failing_batch(*a, **kw):
+        boom["n"] += 1
+        raise RuntimeError("synthetic batch failure")
+
+    # the CLI imports accel_search_batch into its main() closure at call
+    # time via `from ... import`, so patch the module attribute BEFORE
+    # main() runs
+    monkeypatch.setattr(_accel_mod, "accel_search_batch", failing_batch)
+    rc = cli_accel.main(dats + ["--batch", "3", "-z", "10", "-n", "1",
+                                "-s", "3", "--device-prep"])
+    monkeypatch.setattr(_accel_mod, "accel_search_batch", real_batch)
+    assert rc == 0 and boom["n"] >= 1
+    fallback = {b: [(round(c.r, 3), round(c.z, 3))
+                    for c in read_rzwcands(b + "_ACCEL_10.cand")]
+                for b in bases}
+    for b in bases:
+        os.remove(b + "_ACCEL_10.cand")
+
+    # reference: the healthy serial path on the same inputs
+    rc = cli_accel.main(dats + ["-z", "10", "-n", "1", "-s", "3"])
+    assert rc == 0
+    for b in bases:
+        got = [(round(c.r, 3), round(c.z, 3))
+               for c in read_rzwcands(b + "_ACCEL_10.cand")]
+        assert got == fallback[b], b
