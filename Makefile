@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier dryrun smoke probe bench bench-quick bench-ab bench-accel bench-fold native clean
+.PHONY: test test-fourier dryrun smoke probe bench bench-quick bench-ab bench-accel bench-fold bench-telemetry native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -29,6 +29,12 @@ bench:
 
 bench-quick:
 	$(PY) bench.py --quick
+
+# quick bench with a JSONL telemetry trace, then its tlmsum breakdown
+# (stage wall %, H2D/D2H byte totals, chunk counts, device snapshot)
+bench-telemetry:
+	$(PY) bench.py --quick --telemetry bench_telemetry.jsonl
+	$(PY) -m pypulsar_tpu.cli tlmsum bench_telemetry.jsonl
 
 bench-ab:
 	$(PY) bench.py --ab

@@ -90,6 +90,11 @@ def parse_args(argv=None):
                          "~15 min; an explicit --stream defaults to the "
                          "whole file. The full-hour measured run is in "
                          "BENCHNOTES.md / BENCH_r04_full_stream.json")
+    from pypulsar_tpu.obs.telemetry import add_telemetry_flag
+
+    add_telemetry_flag(
+        ap, what="spans + counters of the measured run; the final totals "
+                 "also land in the JSON record's extras")
     ap.add_argument("--cpu-fallback", action="store_true",
                     help="(internal) run on the CPU backend with reduced shapes")
     ap.add_argument("--child", action="store_true",
@@ -1336,9 +1341,16 @@ def run_child(args, cpu: bool, timeout: float):
         for var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"):
             env.pop(var, None)
         argv.append("--cpu-fallback")
+    # the CPU fallback writes its trace NEXT TO the primary's, never over
+    # it: the primary child may have died mid-run, and its partial trace
+    # (flushed per record) is exactly the forensic artifact to preserve
+    tlm_path = args.telemetry
+    if cpu and tlm_path:
+        tlm_path += ".cpufallback.jsonl"
     for flag, val in (("--trials", args.trials), ("--nchan", args.nchan),
                       ("--nsamp", args.nsamp), ("--batch", args.batch),
-                      ("--baseline-trials", args.baseline_trials)):
+                      ("--baseline-trials", args.baseline_trials),
+                      ("--telemetry", tlm_path)):
         if val is not None:
             argv += [flag, str(val)]
     argv += ["--dm-max", str(args.dm_max), "--engine", args.engine]
@@ -1381,27 +1393,43 @@ def main():
             args.stream_window = float(
                 os.environ.get("BENCH_STREAM_WINDOW_S", 900.0))
     if args.child:
-        # measurement mode: run in this interpreter, print JSON, propagate rc
-        if args.ab:
-            record = run_ab(args)
-        elif args.accel:
-            record = run_accel(args)
-        elif args.fold:
-            record = run_fold(args)
-        elif args.waterfall:
-            record = run_waterfall(args)
-        elif args.prepass:
-            record = run_prepass(args)
-        elif args.stream:
-            try:
-                record = run_stream(args)
-            except Exception as e:  # noqa: BLE001 - resident still measures
-                print(f"# streamed bench failed ({type(e).__name__}: "
-                      f"{str(e)[:300]}); falling back to the resident "
-                      f"workload", file=sys.stderr)
+        # measurement mode: run in this interpreter, print JSON, propagate
+        # rc. With --telemetry the whole measured run records an obs trace
+        # whose final counter totals (H2D/D2H bytes, chunks dispatched,
+        # pipeline depth) land in the JSON extras — byte-level evidence
+        # alongside the wall-clock metric.
+        from pypulsar_tpu.obs import telemetry
+
+        with telemetry.session_from_flag(args.telemetry,
+                                         tool="bench") as tlm:
+            if args.ab:
+                record = run_ab(args)
+            elif args.accel:
+                record = run_accel(args)
+            elif args.fold:
+                record = run_fold(args)
+            elif args.waterfall:
+                record = run_waterfall(args)
+            elif args.prepass:
+                record = run_prepass(args)
+            elif args.stream:
+                try:
+                    record = run_stream(args)
+                except Exception as e:  # noqa: BLE001 - resident measures
+                    print(f"# streamed bench failed ({type(e).__name__}: "
+                          f"{str(e)[:300]}); falling back to the resident "
+                          f"workload", file=sys.stderr)
+                    record = run_benchmark(args)
+            else:
                 record = run_benchmark(args)
-        else:
-            record = run_benchmark(args)
+            if tlm is not None:
+                record["telemetry_jsonl"] = args.telemetry
+                record["telemetry_counters"] = {
+                    k: round(v, 1) for k, v in
+                    sorted(tlm.counter_totals().items())}
+                gauges = tlm.gauge_values()
+                if gauges:
+                    record["telemetry_gauges"] = gauges
         print(json.dumps(record))
         return
     record = None

@@ -21,6 +21,9 @@ for JAX/XLA/Pallas on TPU:
                  (FFTFIT equivalent in jnp.fft).
 - ``astro``    : (planned) coordinates, time, sky temperature, radiometer SNR.
 - ``cli``      : (planned) command-line tools mirroring reference bin/ scripts.
+- ``obs``      : structured telemetry (spans / counters / device stats) with a
+                 JSONL sink and the ``tlmsum`` summarizer; ``utils.profiling``
+                 is a shim over it.
 """
 
 __version__ = "0.1.0"

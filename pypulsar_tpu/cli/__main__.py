@@ -12,7 +12,7 @@ TOOLS = [
     "autozap", "plot_accelcands", "combinefil", "stitchdat",
     "mockspecfil2subbands", "demodulate", "pfd_snr", "pfdinfo",
     "gridding", "fitkepler", "shapiro", "pbdot", "massfunc",
-    "pyppdot", "pyplotres", "coordconv",
+    "pyppdot", "pyplotres", "coordconv", "tlmsum",
 ]
 
 

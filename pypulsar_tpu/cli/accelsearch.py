@@ -25,6 +25,12 @@ import numpy as np
 from pypulsar_tpu.fourier.accelsearch import AccelSearchConfig, accel_search
 from pypulsar_tpu.fourier.kernels import deredden, deredden_schedule
 from pypulsar_tpu.io.infodata import InfoData
+from pypulsar_tpu.obs import telemetry
+
+# sentinel: "this input must take the host prep path" — distinct from None
+# ("skipped") so the batch dispatch below cannot confuse the two (the old
+# string-compare dispatch was fragile, ADVICE r5)
+_HOST = object()
 
 
 def load_spectrum(fn: str):
@@ -138,6 +144,8 @@ def build_parser():
                    help="cap on written candidates (default 200)")
     p.add_argument("-o", "--outbase", default=None,
                    help="output base name (default: input base)")
+    telemetry.add_telemetry_flag(
+        p, what="prep/search/write spans, batch counters, fallbacks")
     return p
 
 
@@ -208,13 +216,13 @@ def _skip_existing(infile, args) -> bool:
 def prepare_one_series(infile, args):
     """(raw float32 time series, T) for one .dat input — the device-prep
     batch path defers rfft + deredden to the grouped device dispatch.
-    Returns None when skipped, or the string "host" when this input
+    Returns None when skipped, or the ``_HOST`` sentinel when this input
     cannot use device prep (.fft input, --zapfile, --no-deredden)."""
     if _skip_existing(infile, args):
         return None
     if (os.path.splitext(infile)[1] != ".dat" or args.zapfile
             or args.no_deredden):
-        return "host"
+        return _HOST
     from pypulsar_tpu.io.datfile import Datfile
 
     base = os.path.splitext(infile)[0]
@@ -229,12 +237,15 @@ def prepare_one_series(infile, args):
 def search_one(infile, cfg, args):
     """Search one input; returns the written .cand path (or None if
     skipped)."""
-    prep = prepare_one(infile, args)
+    with telemetry.span("accel_prep_host", infile=infile):
+        prep = prepare_one(infile, args)
     if prep is None:
         return None
     norm, T = prep
-    cands = accel_search(norm, T, cfg)
-    return write_results(infile, cands, T, args)
+    with telemetry.span("accel_search", aggregate=False, batch=1):
+        cands = accel_search(norm, T, cfg)
+    with telemetry.span("accel_write"):
+        return write_results(infile, cands, T, args)
 
 
 def main(argv=None):
@@ -242,12 +253,22 @@ def main(argv=None):
     args = parser.parse_args(argv)
     if args.outbase and len(args.infiles) > 1:
         parser.error("-o/--outbase only applies to a single input file")
+    if args.device_prep and args.batch < 2:
+        # silently ignoring the flag hid a 2-3x perf knob (ADVICE r5):
+        # device prep only exists on the grouped batch dispatch
+        parser.error("--device-prep only takes effect with --batch >= 2 "
+                     "(device prep is the grouped-dispatch path)")
     cfg = AccelSearchConfig(
         zmax=args.zmax, dz=args.dz, numharm=args.numharm,
         sigma_min=args.sigma, flo=args.flo, fhi=args.fhi,
         wmax=args.wmax, dw=args.dw,
         coarse_dz=args.coarse_dz, coarse_power_frac=args.coarse_frac,
     )
+    with telemetry.session_from_flag(args.telemetry, tool="accelsearch"):
+        return _run(args, cfg)
+
+
+def _run(args, cfg):
     # template banks (fourier.accelsearch._build_ratio_bank), deredden
     # schedules and compiled stage programs are process-cached: searching
     # many per-DM files in one invocation pays setup once
@@ -289,17 +310,28 @@ def main(argv=None):
                     cap = max(1, budget // (24 * n1))
                     all_cands = []
                     for c0 in range(0, len(group), cap):
-                        stacked = np.stack(
-                            [g[1] for g in group[c0:c0 + cap]])
-                        all_cands.extend(accel_search_batch(
-                            prep_spectra_batch(stacked), T, cfg))
+                        with telemetry.span("accel_prep_device",
+                                            batch=len(group[c0:c0 + cap])):
+                            stacked = np.stack(
+                                [g[1] for g in group[c0:c0 + cap]])
+                            planes = prep_spectra_batch(stacked)
+                        with telemetry.span("accel_search", aggregate=False,
+                                            batch=len(group[c0:c0 + cap])):
+                            all_cands.extend(accel_search_batch(
+                                planes, T, cfg))
                 else:
-                    all_cands = accel_search_batch(
-                        np.stack([g[1] for g in group]), T, cfg)
+                    with telemetry.span("accel_search", aggregate=False,
+                                        batch=len(group)):
+                        all_cands = accel_search_batch(
+                            np.stack([g[1] for g in group]), T, cfg)
             except Exception as e:  # noqa: BLE001 - fall back to serial:
                 # one poison spectrum must fail alone, not take down (and,
                 # under --skip-existing restarts, permanently wedge) its
                 # whole group
+                telemetry.counter("accel.serial_fallbacks")
+                telemetry.event("accel.batch_serial_fallback",
+                                n=len(group), kind=group[0][3],
+                                error=type(e).__name__)
                 print(f"# batch of {len(group)} failed "
                       f"({type(e).__name__}: {e}); retrying serially",
                       file=sys.stderr)
@@ -321,7 +353,8 @@ def main(argv=None):
                 return
             for fn, cands in zip(names, all_cands):
                 try:
-                    write_results(fn, cands, T, args)
+                    with telemetry.span("accel_write"):
+                        write_results(fn, cands, T, args)
                     done += 1
                 except Exception as e:  # noqa: BLE001
                     fail(fn, e)
@@ -329,17 +362,18 @@ def main(argv=None):
 
         for infile in args.infiles:
             try:
-                prep = (prepare_one_series(infile, args)
-                        if args.device_prep else None)
-                if prep == "host" or prep is None and not args.device_prep:
-                    prep = prepare_one(infile, args)
-                    kind = "norm"
-                else:
-                    kind = "series"
+                with telemetry.span("accel_prep_host", infile=infile):
+                    prep = (prepare_one_series(infile, args)
+                            if args.device_prep else _HOST)
+                    if prep is _HOST:  # explicit host-path sentinel
+                        prep = prepare_one(infile, args)
+                        kind = "norm"
+                    else:
+                        kind = "series"
             except Exception as e:  # noqa: BLE001
                 fail(infile, e)
                 continue
-            if prep is None:
+            if prep is None:  # skipped (--skip-existing)
                 continue
             payload, T = prep
             if group and (kind != group[0][3]

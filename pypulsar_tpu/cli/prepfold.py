@@ -109,6 +109,10 @@ def build_parser():
                    help="frequency subbands (default 32; 1 for .dat)")
     p.add_argument("-o", "--outfile", default=None,
                    help="output .pfd path (default <base>_<P-ms>ms.pfd)")
+    from pypulsar_tpu.obs import telemetry
+
+    telemetry.add_telemetry_flag(
+        p, what="fold spans + counters, device stats")
     return p
 
 
@@ -119,6 +123,13 @@ def main(argv=None):
         parser.error("give exactly one of -p/--period or --par")
     if args.par is not None and (args.pd or args.pdd):
         parser.error("--pd/--pdd come from the parfile when --par is given")
+    from pypulsar_tpu.obs import telemetry
+
+    with telemetry.session_from_flag(args.telemetry, tool="prepfold"):
+        return _run(args)
+
+
+def _run(args):
     base, ext = os.path.splitext(args.infile)
 
     if ext == ".dat":

@@ -65,6 +65,10 @@ def build_parser():
                              "reverse of on-disk order for foff<0 files)")
     parser.add_argument("--zapints", type=parse_int_list, default=[],
                         help="extra intervals to zap")
+    from pypulsar_tpu.obs import telemetry
+
+    telemetry.add_telemetry_flag(
+        parser, what="block-stats spans, D2H counters, device stats")
     return parser
 
 
@@ -79,16 +83,18 @@ def open_data_file(fn: str):
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    from pypulsar_tpu.obs import telemetry
     from pypulsar_tpu.ops.rfifind import rfifind
 
     reader = open_data_file(args.infile)
     try:
-        stats, flags, maskfn = rfifind(
-            reader, time=args.time, time_sigma=args.timesig,
-            freq_sigma=args.freqsig, chanfrac=args.chanfrac,
-            intfrac=args.intfrac, zap_chans=args.zapchan,
-            zap_ints=args.zapints, outbase=args.outbase,
-        )
+        with telemetry.session_from_flag(args.telemetry, tool="rfifind"):
+            stats, flags, maskfn = rfifind(
+                reader, time=args.time, time_sigma=args.timesig,
+                freq_sigma=args.freqsig, chanfrac=args.chanfrac,
+                intfrac=args.intfrac, zap_chans=args.zapchan,
+                zap_ints=args.zapints, outbase=args.outbase,
+            )
     finally:
         reader.close()
     print(f"wrote {maskfn}: {stats.nint} intervals x {stats.nchan} "

@@ -496,8 +496,17 @@ def main(argv=None):
     ap.add_argument("--process-id", type=int, default=None,
                     help="multi-host mode: this host's rank "
                          "($PYPULSAR_TPU_PROCESS_ID)")
+    from pypulsar_tpu.obs import telemetry
+
+    telemetry.add_telemetry_flag(
+        ap, what="per-chunk spans, H2D/D2H byte counters, device stats")
     args = ap.parse_args(argv)
 
+    with telemetry.session_from_flag(args.telemetry, tool="sweep"):
+        return _main_parsed(args, ap)
+
+
+def _main_parsed(args, ap):
     from pypulsar_tpu.parallel import distributed as dist
     from pypulsar_tpu.parallel import make_mesh
     from pypulsar_tpu.parallel.staged import sweep_ddplan, sweep_flat

@@ -1,0 +1,10 @@
+"""Summarize a ``--telemetry`` JSONL trace: per-stage wall breakdown,
+H2D/D2H byte totals, chunk/batch counters, device snapshots. Thin CLI
+front for obs/summarize.py."""
+
+from __future__ import annotations
+
+from pypulsar_tpu.obs.summarize import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

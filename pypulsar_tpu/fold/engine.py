@@ -30,23 +30,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from pypulsar_tpu.core.psrmath import SECPERDAY
+from pypulsar_tpu.obs import telemetry
 
 
 # ---------------------------------------------------------------------------
 # kernels
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("nbins",))
-def fold_bins(data, bin_idx, nbins: int):
-    """Scatter-add ``data`` (1-D [time] or 2-D [chan, time]) into ``nbins``
-    phase bins given per-sample bin indices.  Returns (profile, counts).
-
-    The 2-D path is formulated as ``data @ one_hot(bin_idx)`` — a phase-
-    bin scatter is a matmul with a 0/1 selection matrix, which runs on
-    the MXU instead of XLA's serialized scatter-add (the vmapped
-    segment_sum formulation measured ~7 s for a 1024x2^20 fold on v5e;
-    the matmul is bandwidth-bound). Counts stay integer (float32 would
-    saturate at 2^24 samples/bin)."""
+def _fold_bins_impl(data, bin_idx, nbins: int):
     data = jnp.asarray(data)
     bin_idx = jnp.asarray(bin_idx, jnp.int32)
     counts = jax.ops.segment_sum(
@@ -57,6 +48,25 @@ def fold_bins(data, bin_idx, nbins: int):
     else:
         prof, _ = _onehot_fold_2d(data, bin_idx, nbins)
     return prof, counts
+
+
+_fold_bins_jit = partial(jax.jit, static_argnames=("nbins",))(_fold_bins_impl)
+
+
+def fold_bins(data, bin_idx, nbins: int):
+    """Scatter-add ``data`` (1-D [time] or 2-D [chan, time]) into ``nbins``
+    phase bins given per-sample bin indices.  Returns (profile, counts).
+
+    The 2-D path is formulated as ``data @ one_hot(bin_idx)`` — a phase-
+    bin scatter is a matmul with a 0/1 selection matrix, which runs on
+    the MXU instead of XLA's serialized scatter-add (the vmapped
+    segment_sum formulation measured ~7 s for a 1024x2^20 fold on v5e;
+    the matmul is bandwidth-bound). Counts stay integer (float32 would
+    saturate at 2^24 samples/bin)."""
+    if telemetry.is_active():
+        telemetry.counter("fold.samples", int(np.size(data)))
+    with telemetry.span("fold_bins", nbins=nbins):
+        return _fold_bins_jit(data, bin_idx, nbins)
 
 
 _FOLD_BLOCK = 1 << 17  # bounds the live one-hot to ~64 MB at 128 bins
@@ -103,24 +113,9 @@ def _onehot_fold_2d(data, bin_idx, nbins: int):
     return prof, cnt
 
 
-@partial(jax.jit, static_argnames=("nbins", "npart"))
-def fold_parts(data, bin_idx, nbins: int, npart: int):
-    """Fold into a ``[npart, nchan, nbins]`` sub-integration archive cube
-    (the .pfd product) in ONE compiled program.
-
-    ``data[C, T]`` is cut into ``npart`` equal partitions (a trailing
-    remainder is dropped, as the reference's whole-rotation cuts drop the
-    tail); a lax.scan folds each via the one-hot matmul, holding only one
-    partition's selection matrix live. One dispatch for the whole cube —
-    the per-partition dispatch loop it replaces paid ~60 ms of remote-
-    tunnel latency per partition (bench r3, BENCHNOTES.md).
-
-    Two measured costs are engineered out (v5e A/B, BENCHNOTES): the
-    per-partition ``segment_sum`` count scatters (counts come from
-    column sums of the SAME one-hot matrix — exact in f32 while
-    part_len < 2^24, asserted host-side) and a whole-array pre-transpose
-    (partitions slice out of the original layout inside the scan).
-    Returns (profiles[npart, C, nbins], counts[npart, nbins])."""
+def _fold_parts_impl(data, bin_idx, nbins: int, npart: int):
+    """Traceable body of :func:`fold_parts` (shared with the fused
+    :func:`fold_stats` program, which inlines it in its own trace)."""
     data = jnp.asarray(data)
     bin_idx = jnp.asarray(bin_idx, jnp.int32)
     C, T = data.shape
@@ -141,8 +136,35 @@ def fold_parts(data, bin_idx, nbins: int, npart: int):
     return profs, counts
 
 
+_fold_parts_jit = partial(jax.jit, static_argnames=("nbins", "npart"))(
+    _fold_parts_impl)
+
+
+def fold_parts(data, bin_idx, nbins: int, npart: int):
+    """Fold into a ``[npart, nchan, nbins]`` sub-integration archive cube
+    (the .pfd product) in ONE compiled program.
+
+    ``data[C, T]`` is cut into ``npart`` equal partitions (a trailing
+    remainder is dropped, as the reference's whole-rotation cuts drop the
+    tail); a lax.scan folds each via the one-hot matmul, holding only one
+    partition's selection matrix live. One dispatch for the whole cube —
+    the per-partition dispatch loop it replaces paid ~60 ms of remote-
+    tunnel latency per partition (bench r3, BENCHNOTES.md).
+
+    Two measured costs are engineered out (v5e A/B, BENCHNOTES): the
+    per-partition ``segment_sum`` count scatters (counts come from
+    column sums of the SAME one-hot matrix — exact in f32 while
+    part_len < 2^24, asserted host-side) and a whole-array pre-transpose
+    (partitions slice out of the original layout inside the scan).
+    Returns (profiles[npart, C, nbins], counts[npart, nbins])."""
+    if telemetry.is_active():
+        telemetry.counter("fold.samples", int(np.size(data)))
+    with telemetry.span("fold_parts", nbins=nbins, npart=npart):
+        return _fold_parts_jit(data, bin_idx, nbins, npart)
+
+
 @partial(jax.jit, static_argnames=("nbins", "npart"))
-def fold_stats(data, bin_idx, nbins: int, npart: int, dp_offsets):
+def _fold_stats_jit(data, bin_idx, nbins: int, npart: int, dp_offsets):
     """One-dispatch fold + ON-DEVICE profile statistics (VERDICT r3
     item 4): everything pfd_snr-style analysis needs leaves the device as
     KILOBYTES instead of the [npart, C, nbins] archive cube (33 MB at
@@ -168,7 +190,7 @@ def fold_stats(data, bin_idx, nbins: int, npart: int, dp_offsets):
     ``dp_offsets[J, npart]`` float32 cycles. The cube itself never
     leaves the device and is freed with the program.
     """
-    profs, counts = fold_parts(data, bin_idx, nbins, npart)  # traced inline
+    profs, counts = _fold_parts_impl(data, bin_idx, nbins, npart)
     part_profs = profs.sum(axis=1)  # [npart, nbins]
     chan_profs = profs.sum(axis=0)  # [C, nbins]
     C, T = data.shape
@@ -189,6 +211,16 @@ def fold_stats(data, bin_idx, nbins: int, npart: int, dp_offsets):
                       precision=jax.lax.Precision.HIGHEST)
     dp_profs = jnp.fft.irfft(dp_f, n=nbins, axis=1)  # [J, nbins]
     return part_profs, chan_profs, counts, dsum, dsumsq, dp_profs
+
+
+def fold_stats(data, bin_idx, nbins: int, npart: int, dp_offsets):
+    """See :func:`_fold_stats_jit` — this wrapper only adds telemetry
+    (folded-sample counter + dispatch span) around the one-dispatch
+    program, behind the inactive-is-one-branch check."""
+    if telemetry.is_active():
+        telemetry.counter("fold.samples", int(np.size(data)))
+    with telemetry.span("fold_stats", nbins=nbins, npart=npart):
+        return _fold_stats_jit(data, bin_idx, nbins, npart, dp_offsets)
 
 
 def fold_stats_numpy(data, bin_idx, nbins: int, npart: int, dp_offsets):

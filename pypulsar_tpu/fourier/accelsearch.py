@@ -54,9 +54,9 @@ import numpy as np
 from scipy.special import gammaincc, gammainccinv, gammaln, log_ndtr, ndtri
 
 from pypulsar_tpu.fourier.zresponse import template_bank_zw
+from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.ops.fourier_dedisperse import fourier_chunk_len
 from pypulsar_tpu.ops.transfer import join_planes, pull_host, split_complex
-from pypulsar_tpu.utils import profiling
 
 __all__ = [
     "AccelSearchConfig",
@@ -732,7 +732,9 @@ def accel_search(
                                             front)
         runner = _make_stage_runner(segw, Zrows, Wn, cfg.topk,
                                     tuple(bank_meta))
-        with profiling.stage("accel_stage"):
+        telemetry.counter("accel.stage_dispatches")
+        with telemetry.span("accel_stage", H=int(H),
+                            n_seg=int(len(seg_ids))):
             return pull_host(*runner(
                 spec_pad2, tuple(tfs), tuple(idxs), top_lo, top_hi,
                 jnp.float32(thresh_val),
@@ -769,7 +771,11 @@ def accel_search(
                 raw_hits.append((H, wi, r0, vals[pos, wi], zi[pos, wi],
                                  ri[pos, wi], neigh[pos, wi], width))
 
-    return _refine_hits(raw_hits, zs, ws, cfg, numindep, thresh)
+    cands = _refine_hits(raw_hits, zs, ws, cfg, numindep, thresh)
+    # counted on completion: a failed search that the CLI retries
+    # serially must not inflate the searched-spectra total
+    telemetry.counter("accel.spectra_searched")
+    return cands
 
 
 def _stage_chunk_bytes(tfs, Z: int, Wn: int, segw: int) -> int:
@@ -899,7 +905,9 @@ def accel_search_batch(
             # for its shape but never ships dead spectra through the scan
             sl = spec_pad2[c0:c0 + chunk]
             nb = int(sl.shape[0])
-            with profiling.stage("accel_stage_batch"):
+            telemetry.counter("accel.stage_dispatches")
+            with telemetry.span("accel_stage_batch", H=int(H), batch=nb,
+                                n_seg=int(len(seg_ids))):
                 # [len(seg_ids), nb, Wn, k] each; one batched pull
                 vals, zi, ri, neigh = pull_host(*runner(
                     sl, tuple(tfs), tuple(idxs), top_lo, top_hi,
@@ -943,5 +951,10 @@ def accel_search_batch(
                             (H, wi, r0, vals[pos, bl, wi], zi[pos, bl, wi],
                              ri[pos, bl, wi], neigh[pos, bl, wi], width))
 
-    return [_refine_hits(raw, zs, ws, cfg, numindep, thresh)
-            for raw in raw_per_b]
+    out = [_refine_hits(raw, zs, ws, cfg, numindep, thresh)
+           for raw in raw_per_b]
+    # counted on completion (see accel_search): a batch that raised and
+    # fell back to the serial path must not double-count its spectra
+    telemetry.counter("accel.spectra_searched", B)
+    telemetry.counter("accel.batches")
+    return out

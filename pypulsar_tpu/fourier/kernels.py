@@ -232,7 +232,15 @@ _deredden_apply = partial(jax.jit, static_argnames=("maxlen",))(
 
 @partial(jax.jit, static_argnames=("maxlen",))
 def _prep_spectra_kernel(series, starts, lens, elem_block, elem_off, maxlen):
-    fft = jnp.fft.rfft(series.astype(jnp.float32), axis=1)
+    # subtract the per-series mean before the f32 rfft: deredden overwrites
+    # bin 0 anyway, so this changes nothing in exact arithmetic, but a
+    # large DC offset (8-bit data sits ~100x sigma above zero) otherwise
+    # leaks into the low bins through f32 rounding of the butterflies —
+    # the same fluctuation-scale argument as the sweep's baseline
+    # subtraction (ADVICE r5)
+    s32 = series.astype(jnp.float32)
+    s32 = s32 - jnp.mean(s32, axis=1, keepdims=True)
+    fft = jnp.fft.rfft(s32, axis=1)
     re = fft.real.astype(jnp.float32)
     im = fft.imag.astype(jnp.float32)
     powers = re * re + im * im

@@ -1,0 +1,17 @@
+"""Observability: structured telemetry (spans/counters/device stats) with
+a JSONL sink, plus the ``tlmsum`` trace summarizer. See obs/telemetry.py
+for the collection layer and obs/summarize.py for the renderer;
+``utils.profiling`` is a back-compat shim over this package."""
+
+from pypulsar_tpu.obs import telemetry  # noqa: F401
+from pypulsar_tpu.obs.telemetry import (  # noqa: F401
+    counter,
+    current,
+    device_snapshot,
+    event,
+    gauge,
+    is_active,
+    session,
+    session_from_flag,
+    span,
+)

@@ -1,0 +1,191 @@
+"""Summarize a recorded telemetry JSONL trace (``tlmsum``).
+
+Renders the run a ``--telemetry PATH.jsonl`` flag recorded back into the
+operator-facing questions: where did the wall time go (per-stage seconds
+and percentages, from the span records), where did the bytes go (H2D/D2H
+wire totals from the ``*.bytes`` counters), how much work was done
+(chunk/batch/trial counters, pipeline-depth gauges, fallback events), and
+what the devices looked like (last memory snapshot per device).
+
+Usage::
+
+    python -m pypulsar_tpu.cli tlmsum run.jsonl
+    python -m pypulsar_tpu.cli tlmsum run.jsonl --top 30
+
+Robust to truncated traces (a killed run stops mid-file): span records are
+aggregated line by line, and the final ``counters``/``stages`` flush is
+used only when present.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Iterable, List, Optional, TextIO
+
+
+def load_records(path: str) -> Iterable[dict]:
+    """Yield parsed records, skipping unparseable (truncated) lines."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                yield rec
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit, div in (("GB", 1e9), ("MB", 1e6), ("kB", 1e3)):
+        if abs(n) >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n:.0f} B"
+
+
+def _fmt_count(n: float) -> str:
+    return f"{n:.0f}" if float(n) == int(n) else f"{n:g}"
+
+
+class TraceSummary:
+    """Aggregated view of one trace — the data ``main`` renders."""
+
+    def __init__(self):
+        self.meta: Optional[dict] = None
+        self.stages: Dict[str, List] = {}  # name -> [seconds, count]
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, dict] = {}
+        self.events: Dict[str, int] = {}
+        self.wall: Optional[float] = None
+        self.last_device: Optional[dict] = None
+        self.n_events = 0
+        self.n_spans = 0
+        self._span_stages: Dict[str, List] = {}
+        self._t_max = 0.0
+
+    def feed(self, rec: dict) -> None:
+        t = rec.get("type")
+        if t == "meta":
+            self.meta = rec
+        elif t == "span":
+            self.n_spans += 1
+            if not rec.get("noagg"):
+                # sink-only wrapper spans (e.g. sweep_step) enclose
+                # aggregated stages; folding them into the flat fallback
+                # table would double-count the nested wall time
+                ent = self._span_stages.setdefault(rec.get("name", "?"),
+                                                   [0.0, 0])
+                ent[0] += float(rec.get("dur", 0.0))
+                ent[1] += 1
+            self._t_max = max(self._t_max,
+                              float(rec.get("t", 0.0))
+                              + float(rec.get("dur", 0.0)))
+        elif t == "event":
+            self.n_events += 1
+            name = rec.get("name", "?")
+            self.events[name] = self.events.get(name, 0) + 1
+            self._t_max = max(self._t_max, float(rec.get("t", 0.0)))
+        elif t == "counters":
+            self.counters.update(rec.get("counters", {}))
+            self.gauges.update(rec.get("gauges", {}))
+            self.events.update(rec.get("events", {}))
+        elif t == "stages":
+            self.stages = rec.get("stages", {})
+        elif t == "device":
+            if rec.get("devices"):
+                self.last_device = rec
+        elif t == "end":
+            self.wall = float(rec.get("wall", 0.0))
+
+    def finish(self) -> None:
+        # spans aggregated live beat the end-of-run flush only when the
+        # flush is missing (truncated trace)
+        if not self.stages:
+            self.stages = self._span_stages
+        if self.wall is None:
+            self.wall = self._t_max
+
+
+def summarize(records: Iterable[dict]) -> TraceSummary:
+    s = TraceSummary()
+    for rec in records:
+        s.feed(rec)
+    s.finish()
+    return s
+
+
+def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
+    p = lambda *a: print(*a, file=file)  # noqa: E731
+    if s.meta is not None:
+        tool = s.meta.get("tool", "?")
+        p(f"# telemetry trace: tool={tool}"
+          + (f"  argv={' '.join(s.meta.get('argv', []))}"
+             if s.meta.get("argv") else ""))
+    wall = s.wall or 0.0
+    p(f"# wall {wall:.3f}s, {s.n_spans} spans, {s.n_events} events")
+
+    if s.stages:
+        p("#\n# stage breakdown:")
+        for name, (secs, count) in sorted(
+                s.stages.items(), key=lambda kv: -kv[1][0])[:top]:
+            pct = 100.0 * secs / max(wall, 1e-12)
+            p(f"#   {name:<28s} {secs:10.3f}s  {pct:5.1f}%  "
+              f"({count} calls)")
+
+    byte_counters = {k: v for k, v in s.counters.items()
+                     if k.endswith(".bytes")}
+    other_counters = {k: v for k, v in s.counters.items()
+                      if not k.endswith(".bytes")}
+    if byte_counters:
+        p("#\n# transfer totals:")
+        for name, v in sorted(byte_counters.items()):
+            rate = (f"  ({_fmt_bytes(v / wall)}/s)" if wall > 0 else "")
+            p(f"#   {name:<28s} {_fmt_bytes(v):>12s}{rate}")
+    if other_counters:
+        p("#\n# counters:")
+        for name, v in sorted(other_counters.items()):
+            p(f"#   {name:<28s} {_fmt_count(v):>12s}")
+    if s.gauges:
+        p("#\n# gauges (last / max):")
+        for name, g in sorted(s.gauges.items()):
+            p(f"#   {name:<28s} {_fmt_count(g.get('last', 0)):>8s} / "
+              f"{_fmt_count(g.get('max', 0))}")
+    if s.events:
+        p("#\n# events:")
+        for name, n in sorted(s.events.items()):
+            p(f"#   {name:<28s} {n:>8d}")
+    if s.last_device is not None:
+        p(f"#\n# device snapshot ({s.last_device.get('tag', '?')}):")
+        for d in s.last_device.get("devices", []):
+            bits = [f"device {d.get('id')}", str(d.get("platform", "?"))]
+            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                      "live_buffer_bytes_total"):
+                if k in d:
+                    bits.append(f"{k}={_fmt_bytes(d[k])}")
+            p("#   " + "  ".join(bits))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tlmsum",
+        description="Summarize a pypulsar_tpu telemetry JSONL trace "
+                    "(recorded with --telemetry PATH.jsonl).")
+    ap.add_argument("jsonl", help="telemetry trace file")
+    ap.add_argument("--top", type=int, default=20,
+                    help="stages to show (default 20)")
+    args = ap.parse_args(argv)
+    try:
+        s = summarize(load_records(args.jsonl))
+    except OSError as e:
+        print(f"tlmsum: cannot read {args.jsonl}: {e}", file=sys.stderr)
+        return 1
+    render(s, sys.stdout, top=args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

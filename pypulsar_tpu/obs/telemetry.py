@@ -1,0 +1,426 @@
+"""Structured runtime telemetry: spans, counters, device stats, JSONL sink.
+
+The 112-line stage timer in ``utils/profiling.py`` answered "where did the
+wall time go" for one process run; the production pipeline the north star
+names (hour-long observations x thousands of DM trials) also needs to know
+where the *bytes* went (H2D/D2H wire traffic is the measured streamed-sweep
+ceiling, BENCHNOTES r4), how deep the dispatch pipeline ran, which batches
+degraded to the serial fallback, and what HBM looked like — and it needs
+all of that ON DISK, per run, so a stall or OOM leaves a replayable trace.
+
+This module is that layer. One process-global session (``session(path)``)
+collects:
+
+- **spans**: nested, named, wall-timed regions with JSON-serializable
+  attributes. Thread-safe (the ship-ahead worker records from its own
+  thread); nesting is tracked per thread.
+- **counters / gauges / events**: monotonic totals (``h2d.bytes``,
+  ``sweep.chunks``), last+max watermarks (``sweep.pending_depth``), and
+  one-shot records (``accel.batch_serial_fallback``).
+- **device snapshots**: per-device ``memory_stats()`` where the backend
+  provides them, guarded so CPU-only and jax-less runs work.
+- a **JSONL sink**: when the session has a path, every span/event/device
+  record appends one self-describing line; counter and stage totals flush
+  at session close. ``python -m pypulsar_tpu.cli tlmsum run.jsonl``
+  (obs/summarize.py) renders the breakdown back out.
+
+Zero-overhead contract (inherited from profiling.py): with no session
+active every entry point is one module-global ``is None`` branch — hot
+loops (per-chunk, per-batch; never per-sample) may call these
+unconditionally. ``utils.profiling`` is now a thin shim over this module,
+so the pre-existing ``stage``/``stage_report`` call sites and ``--profile``
+flags feed the same collector.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "Telemetry",
+    "counter",
+    "current",
+    "device_snapshot",
+    "event",
+    "gauge",
+    "is_active",
+    "record_span",
+    "session",
+    "session_from_flag",
+    "span",
+]
+
+_session: Optional["Telemetry"] = None  # None = inactive (the one branch)
+
+SCHEMA_VERSION = 1
+
+# seconds between incremental counter flushes to the sink (piggybacked on
+# event records): a killed/OOM'd run must leave its byte/chunk totals on
+# disk, not just its spans — close() never runs for the runs that matter
+# most. tlmsum merges counters records last-wins, so partials compose.
+COUNTER_FLUSH_INTERVAL = 5.0
+
+
+def is_active() -> bool:
+    return _session is not None
+
+
+def current() -> Optional["Telemetry"]:
+    """The active session, or None."""
+    return _session
+
+
+class _Span:
+    """Live handle yielded by :func:`span` — lets the block attach
+    attributes discovered mid-flight (``sp.set(rows=n)``)."""
+
+    __slots__ = ("name", "attrs")
+
+    def __init__(self, name: str, attrs: Dict[str, Any]):
+        self.name = name
+        self.attrs = attrs
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+
+class Telemetry:
+    """One run's collector. Create via :func:`session`, not directly."""
+
+    def __init__(self, path: Optional[str] = None,
+                 meta: Optional[Dict[str, Any]] = None):
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        # name -> [total_seconds, count] — the aggregate profiling.py kept
+        self.stages: Dict[str, List] = {}
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, Dict[str, float]] = {}  # name -> last/max
+        self.event_counts: Dict[str, int] = {}
+        self.path = path
+        self._last_counter_flush = 0.0
+        self._fh = open(path, "w") if path else None
+        if self._fh is not None:
+            rec = {"type": "meta", "version": SCHEMA_VERSION,
+                   "t_unix": time.time(), "argv": list(sys.argv)}
+            if meta:
+                rec.update(meta)
+            self._write(rec)
+
+    # -- record plumbing ---------------------------------------------------
+
+    def _now(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def _write(self, rec: Dict[str, Any]) -> None:
+        if self._fh is None:
+            return
+        line = json.dumps(rec, default=str) + "\n"
+        with self._lock:
+            self._fh.write(line)
+            # flush per record: a killed/OOM'd run keeps its trace —
+            # records are span/chunk granularity, never per-sample
+            self._fh.flush()
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _finish_span(self, name: str, t_start: float, dur: float,
+                     parent: Optional[str], depth: int,
+                     attrs: Dict[str, Any], aggregate: bool = True) -> None:
+        if aggregate:
+            with self._lock:
+                ent = self.stages.setdefault(name, [0.0, 0])
+                ent[0] += dur
+                ent[1] += 1
+        if self._fh is not None:
+            rec = {"type": "span", "name": name,
+                   "t": round(t_start, 6), "dur": round(dur, 6)}
+            if depth:
+                rec["depth"] = depth
+            if parent is not None:
+                rec["parent"] = parent
+            if not aggregate:
+                rec["noagg"] = True
+            if attrs:
+                rec["attrs"] = attrs
+            self._write(rec)
+
+    # -- read-side accessors -----------------------------------------------
+
+    def stage_snapshot(self) -> Dict[str, tuple]:
+        with self._lock:
+            return {k: (v[0], v[1]) for k, v in self.stages.items()}
+
+    def stage_pairs_since(self, baseline: Dict[str, tuple]) -> Dict[str, list]:
+        """name -> [seconds, count] accumulated since ``baseline`` (a
+        :meth:`stage_snapshot`) — how profiling.stage_report scopes its
+        view of the shared collector to its own block."""
+        out = {}
+        with self._lock:
+            for k, (tot, cnt) in self.stages.items():
+                b_tot, b_cnt = baseline.get(k, (0.0, 0))
+                if cnt > b_cnt:
+                    out[k] = [tot - b_tot, cnt - b_cnt]
+        return out
+
+    def counter_totals(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self.counters)
+
+    def _counters_record(self, partial: bool = False) -> Dict[str, Any]:
+        with self._lock:
+            rec = {"type": "counters", "counters": dict(self.counters),
+                   "gauges": {k: dict(v) for k, v in self.gauges.items()},
+                   "events": dict(self.event_counts)}
+        if partial:
+            rec["partial"] = True
+        return rec
+
+    def _maybe_flush_counters(self) -> None:
+        """Throttled incremental counters record (see
+        COUNTER_FLUSH_INTERVAL); callers hold no locks."""
+        if self._fh is None:
+            return
+        now = self._now()
+        if now - self._last_counter_flush < COUNTER_FLUSH_INTERVAL:
+            return
+        self._last_counter_flush = now
+        self._write(self._counters_record(partial=True))
+
+    def gauge_values(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self.gauges.items()}
+
+    # -- teardown ----------------------------------------------------------
+
+    def close(self) -> None:
+        if self._fh is None:
+            return
+        self._write({"type": "device", "tag": "session_end",
+                     "t": round(self._now(), 6),
+                     "devices": _collect_devices()})
+        with self._lock:
+            stages = {k: [round(v[0], 6), v[1]]
+                      for k, v in self.stages.items()}
+        self._write(self._counters_record())
+        self._write({"type": "stages", "stages": stages})
+        self._write({"type": "end", "wall": round(self._now(), 6)})
+        with self._lock:
+            self._fh.close()
+            self._fh = None
+
+
+@contextlib.contextmanager
+def session(path: Optional[str] = None, **meta):
+    """Activate telemetry for the block; yields the :class:`Telemetry`.
+
+    ``path`` (optional) appends JSONL records there; without it the
+    session collects in memory only (counters/stages still queryable —
+    what bench.py and profiling.stage_report use). Nested sessions reuse
+    the outer collector: one trace per process, the same convention
+    profiling.stage_report always had."""
+    global _session
+    outer = _session
+    if outer is not None:
+        yield outer
+        return
+    tlm = Telemetry(path, meta or None)
+    _session = tlm
+    try:
+        yield tlm
+    finally:
+        _session = None
+        tlm.close()
+
+
+def add_telemetry_flag(parser, what: str = "spans, counters, device stats"):
+    """Install the shared ``--telemetry PATH.jsonl`` option on an argparse
+    parser — ONE definition of the flag name/metavar/help for every CLI
+    (``what`` names the tool-specific payload); the value feeds
+    :func:`session_from_flag`."""
+    parser.add_argument(
+        "--telemetry", default=None, metavar="PATH.jsonl",
+        help=f"record a structured telemetry trace ({what}) to this "
+             "JSONL file; summarize with `python -m pypulsar_tpu.cli "
+             "tlmsum PATH.jsonl`")
+    return parser
+
+
+def session_from_flag(path: Optional[str], **meta):
+    """CLI helper: a real session when ``--telemetry PATH`` was given, a
+    no-op nullcontext (yielding None — telemetry stays INACTIVE, keeping
+    the hot paths on the one-branch path) otherwise."""
+    if not path:
+        return contextlib.nullcontext()
+    return session(path, **meta)
+
+
+class _NullSpan:
+    """Stateless inactive-path context manager: entering costs one
+    attribute load and no generator allocation (the zero-overhead
+    contract's hot-loop side)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def span(name: str, *, aggregate: bool = True, **attrs):
+    """Time a (possibly nested) region under ``name``. No-op (one
+    branch, shared null context) when no session is active; yields a
+    :class:`_Span` handle otherwise. ``attrs`` must be
+    JSON-serializable.
+
+    ``aggregate=False`` records the span to the JSONL sink only,
+    keeping it OUT of the flat per-stage totals — for outer wrapper
+    spans (``sweep_step``, the CLI's ``accel_search``) that enclose
+    already-aggregated stages: folding both into one flat table would
+    double-count the nested wall time and break the non-overlapping
+    accounting ``stage_report``'s ``(untracked)`` line and tlmsum's
+    percentages rely on."""
+    if _session is None:
+        return _NULL_SPAN
+    return _live_span(name, attrs, aggregate)
+
+
+@contextlib.contextmanager
+def _live_span(name: str, attrs, aggregate: bool = True):
+    s = _session
+    if s is None:  # session ended between the check and entry
+        yield None
+        return
+    stack = s._stack()
+    parent = stack[-1].name if stack else None
+    depth = len(stack)
+    handle = _Span(name, attrs)
+    stack.append(handle)
+    t_start = s._now()
+    t0 = time.perf_counter()
+    try:
+        yield handle
+    finally:
+        dur = time.perf_counter() - t0
+        stack.pop()
+        s._finish_span(name, t_start, dur, parent, depth, handle.attrs,
+                       aggregate)
+
+
+def record_span(name: str, seconds: float) -> None:
+    """Directly account ``seconds`` to span ``name`` (profiling.record
+    back-compat; no nesting info)."""
+    s = _session
+    if s is None:
+        return
+    s._finish_span(name, s._now() - seconds, float(seconds), None, 0, {})
+
+
+def counter(name: str, inc: float = 1) -> None:
+    """Add ``inc`` to the monotonic counter ``name`` (no-op inactive)."""
+    s = _session
+    if s is None:
+        return
+    with s._lock:
+        s.counters[name] = s.counters.get(name, 0) + inc
+
+
+def gauge(name: str, value: float) -> None:
+    """Record an instantaneous level; the session keeps last and max."""
+    s = _session
+    if s is None:
+        return
+    with s._lock:
+        g = s.gauges.get(name)
+        if g is None:
+            s.gauges[name] = {"last": value, "max": value}
+        else:
+            g["last"] = value
+            if value > g["max"]:
+                g["max"] = value
+
+
+def event(name: str, **attrs) -> None:
+    """One-shot record (e.g. a serial-fallback, a per-chunk milestone):
+    counted in the session and appended to the sink with attributes."""
+    s = _session
+    if s is None:
+        return
+    with s._lock:
+        s.event_counts[name] = s.event_counts.get(name, 0) + 1
+    if s._fh is not None:
+        rec = {"type": "event", "name": name, "t": round(s._now(), 6)}
+        if attrs:
+            rec["attrs"] = attrs
+        s._write(rec)
+        # events fire at chunk/batch cadence — the right hook for the
+        # incremental counter flush that keeps killed runs summarizable
+        s._maybe_flush_counters()
+
+
+def _collect_devices() -> list:
+    """Per-device memory statistics, fully guarded: if jax was never
+    imported (``sys.modules`` check — a snapshot must not be the thing
+    that initializes a wedged backend), has no devices, or the backend
+    exposes no ``memory_stats()`` (CPU), the list degrades to whatever
+    is available instead of raising."""
+    devices: list = []
+    if "jax" not in sys.modules:
+        return devices
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            ent = {"id": int(getattr(d, "id", -1)),
+                   "platform": str(getattr(d, "platform", "?"))}
+            try:
+                ms = d.memory_stats()
+            except Exception:  # noqa: BLE001 - stats are best-effort
+                ms = None
+            if ms:
+                for k in ("bytes_in_use", "peak_bytes_in_use",
+                          "bytes_limit", "largest_alloc_size",
+                          "num_allocs", "bytes_reserved"):
+                    if k in ms:
+                        ent[k] = int(ms[k])
+            devices.append(ent)
+        try:
+            live = int(sum(a.nbytes for a in jax.live_arrays()))
+        except Exception:  # noqa: BLE001 - not on every jax version
+            live = None
+        if live is not None and devices:
+            devices[0]["live_buffer_bytes_total"] = live
+    except Exception:  # noqa: BLE001 - never fail the instrumented run
+        pass
+    return devices
+
+
+def device_snapshot(tag: str = "snapshot"):
+    """Record per-device memory statistics to the active session (and
+    its sink) and return them; None when inactive. See
+    :func:`_collect_devices` for the CPU-only / jax-less guarding."""
+    s = _session
+    if s is None:
+        return None
+    devices = _collect_devices()
+    for ent in devices:
+        if "bytes_in_use" in ent:
+            gauge(f"device{ent['id']}.bytes_in_use", ent["bytes_in_use"])
+    if s._fh is not None:
+        s._write({"type": "device", "tag": tag, "t": round(s._now(), 6),
+                  "devices": devices})
+    return devices

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.ops import transfer
 from pypulsar_tpu.ops.fourier_dedisperse import fourier_chunk_len
 
@@ -354,9 +355,11 @@ def rfifind(
                 buf = np.concatenate([buf, pad], axis=1)
                 nint += 1
         if nint:
+            telemetry.counter("rfifind.intervals", int(nint))
             # one batched pull per block (3 tunnel roundtrips otherwise)
-            m, s, p = transfer.pull_host(*block_stats(buf[:, : nint * pts],
-                                                      pts))
+            with telemetry.span("rfifind_block_stats", nint=int(nint)):
+                m, s, p = transfer.pull_host(
+                    *block_stats(buf[:, : nint * pts], pts))
             means.append(m)
             stds.append(s)
             maxpows.append(p)

@@ -20,6 +20,8 @@ from __future__ import annotations
 import jax
 import numpy as np
 
+from pypulsar_tpu.obs import telemetry
+
 __all__ = ["split_complex", "to_host_complex", "join_planes", "pull_host"]
 
 
@@ -32,7 +34,12 @@ def pull_host(*arrays):
     262 ms per-array vs 70 ms batched — BENCHNOTES.md round 4). Use this
     for every multi-output pull on a hot path. Always returns a tuple
     (same arity as the arguments), so star-splatted call sites unpack
-    predictably even for one output."""
+    predictably even for one output. Under an active telemetry session
+    the pull is accounted to the ``d2h.bytes``/``d2h.pulls`` counters."""
+    if telemetry.is_active():
+        telemetry.counter("d2h.bytes", sum(
+            int(getattr(a, "nbytes", 0) or 0) for a in arrays))
+        telemetry.counter("d2h.pulls")
     return jax.device_get(arrays)
 
 

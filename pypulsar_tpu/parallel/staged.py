@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.ops import kernels
 from pypulsar_tpu.parallel.sweep import (
     DEFAULT_WIDTHS,
@@ -280,6 +281,9 @@ def _ship_ahead(raw_blocks, depth: int = 2):
     e.g. for single-threaded debugging)."""
     if os.environ.get("PYPULSAR_TPU_SHIP_AHEAD", "1") == "0":
         for pos, block in raw_blocks:
+            if telemetry.is_active():
+                telemetry.counter("h2d.bytes",
+                                  int(getattr(block, "nbytes", 0) or 0))
             yield pos, jnp.asarray(block)
         return
 
@@ -295,6 +299,9 @@ def _ship_ahead(raw_blocks, depth: int = 2):
             for pos, block in raw_blocks:
                 if stop.is_set():  # consumer gone: don't ship the rest
                     return
+                if telemetry.is_active():  # counters are thread-safe
+                    telemetry.counter("h2d.bytes",
+                                      int(getattr(block, "nbytes", 0) or 0))
                 q.put((pos, jnp.asarray(block)))
         except BaseException as e:  # noqa: BLE001 - re-raised in consumer
             q.put(e)
@@ -430,6 +437,8 @@ def _downsampled_blocks(src, factor: int, payload_ds: int, overlap_ds: int):
         return
     for pos, block in src.chan_major_blocks(payload_ds * factor,
                                             overlap_ds * factor):
+        if telemetry.is_active() and not isinstance(block, jax.Array):
+            telemetry.counter("h2d.bytes", 4 * int(np.size(block)))
         data = jnp.asarray(block, dtype=jnp.float32)
         if factor > 1:
             nbin = data.shape[1] // factor
@@ -566,18 +575,22 @@ def _run_step(src, dms, factor: int, nsub: int, group_size: int,
         return _downsampled_blocks(seeked if seeked is not None else src,
                                    factor, payload, plan.min_overlap)
 
-    res = sweep_stream(
-        plan,
-        _downsampled_blocks(src, factor, payload, plan.min_overlap),
-        payload,
-        mesh=mesh,
-        chan_major=True,
-        checkpoint=checkpoint,
-        engine=engine,
-        keep_chunk_peaks=keep_chunk_peaks,
-        checkpoint_context=ckpt_extra,
-        block_factory=block_factory,
-    )
+    # sink-only span (aggregate=False): it encloses the sweep loop's
+    # aggregated stages, which must stay non-overlapping in the flat table
+    with telemetry.span("sweep_step", aggregate=False, downsamp=factor,
+                        n_trials=len(dms), payload=int(payload)):
+        res = sweep_stream(
+            plan,
+            _downsampled_blocks(src, factor, payload, plan.min_overlap),
+            payload,
+            mesh=mesh,
+            chan_major=True,
+            checkpoint=checkpoint,
+            engine=engine,
+            keep_chunk_peaks=keep_chunk_peaks,
+            checkpoint_context=ckpt_extra,
+            block_factory=block_factory,
+        )
     return StepResult(downsamp=factor, dt=dt_eff, result=res)
 
 

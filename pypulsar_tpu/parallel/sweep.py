@@ -48,6 +48,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from pypulsar_tpu.core import psrmath
 from pypulsar_tpu.ops import transfer
 from pypulsar_tpu.ops.pallas_kernels import boxcar_stats
+from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.utils import profiling
 
 DEFAULT_WIDTHS = (1, 2, 4, 8, 16, 32)
@@ -744,6 +745,7 @@ class SweepCheckpoint:
         fire = (self._drained + n) // self.every > self._drained // self.every
         self._drained += n
         if fire:
+            telemetry.counter("sweep.checkpoint_saves")
             with profiling.stage("checkpoint_save"):
                 self.save(plan, chunk_payload, acc, cursor, baseline,
                           context)
@@ -908,6 +910,14 @@ def sweep_stream(
         stat_len = min(chunk_payload, L)
         with profiling.stage("dispatch_sweep_chunk"):
             pending.append((start, stat_len, run_chunk(data, stat_len)))
+        if telemetry.is_active():
+            # one record per streamed chunk: position, payload and the
+            # dispatch-pipeline depth at this moment (how far device work
+            # ran ahead of the host accumulate)
+            telemetry.counter("sweep.chunks")
+            telemetry.gauge("sweep.pending_depth", len(pending))
+            telemetry.event("sweep.chunk", start=int(start),
+                            stat_len=int(stat_len), pending=len(pending))
 
     # A short block is only legal at end-of-data: hold one block back so we
     # can tell whether the stream continues past its end. A block that is
@@ -930,10 +940,13 @@ def sweep_stream(
         if start < cursor:  # chunk already accumulated (checkpoint resume)
             continue
         with profiling.stage("host_to_device"):
+            was_host = not isinstance(block, jax.Array)
             if chan_major:
                 data = jnp.asarray(block, dtype=jnp.float32)
             else:
                 data = jnp.asarray(np.ascontiguousarray(block.T), dtype=jnp.float32)
+            if was_host and telemetry.is_active():
+                telemetry.counter("h2d.bytes", int(data.nbytes))
         if baseline is None:
             # per-channel baseline from the first block (see the SNR
             # accumulation-order contract in the docstring)
@@ -963,6 +976,10 @@ def sweep_stream(
     drain(0)
     if checkpoint is not None:
         checkpoint.finish()
+    if telemetry.is_active():
+        telemetry.counter("sweep.trials_completed", plan.n_real_trials)
+        telemetry.counter("sweep.payload_samples", int(acc.n))
+        telemetry.device_snapshot(tag="sweep_stream_end")
 
     B = float(np.asarray(baseline, dtype=np.float64).sum()) if baseline is not None else 0.0
     if not finalize:
@@ -1134,7 +1151,15 @@ def sweep_resident(spectra, dms, nsub=64, group_size=32, widths=DEFAULT_WIDTHS,
         _series_baseline(np.asarray(spectra.data)[:, :T_used]
                          if isinstance(spectra.data, np.ndarray)
                          else data))
-    s, ss, mb, ab = transfer.pull_host(*run(data, s1, s2, baseline, n_chunks))
+    with telemetry.span("sweep_resident_run", n_chunks=n_chunks,
+                        payload=int(payload)):
+        s, ss, mb, ab = transfer.pull_host(
+            *run(data, s1, s2, baseline, n_chunks))
+    if telemetry.is_active():
+        telemetry.counter("sweep.chunks", n_chunks)
+        telemetry.counter("sweep.trials_completed", plan.n_real_trials)
+        telemetry.counter("sweep.payload_samples", int(n_chunks * payload))
+        telemetry.device_snapshot(tag="sweep_resident_end")
     s = np.asarray(s, dtype=np.float64)
     ss = np.asarray(ss, dtype=np.float64)
     mb = np.asarray(mb)

@@ -1,26 +1,23 @@
-"""Per-stage timing + optional ``jax.profiler`` tracing.
+"""Per-stage timing + optional ``jax.profiler`` tracing — now a thin shim
+over the structured telemetry subsystem (``pypulsar_tpu.obs.telemetry``).
 
-The reference has no profiling subsystem (SURVEY.md §5: only the
-``show_progress`` percent bar, reference utils/__init__.py:6-44); TPU perf
-work needs attribution, so this is new surface. Design goals: zero overhead
-when inactive (one module-global check), no hard jax dependency at import
-time, and usable both as a library API and from ``bench.py --profile``.
-
-Usage::
-
-    from pypulsar_tpu.utils import profiling
+The original module kept its own name -> [seconds, count] aggregate; that
+collector now lives in the obs session so the SAME ``stage(...)`` call
+sites feed both ``--profile`` breakdowns and ``--telemetry`` JSONL traces
+(obs records each stage as a nested span with attributes alongside
+counters and device stats). The public API here is unchanged:
 
     with profiling.stage_report():          # activates collection; prints
         run_sweep(...)                      # breakdown on exit
 
-    # inside instrumented code:
-    with profiling.stage("dedisperse"):
+    with profiling.stage("dedisperse"):     # inside instrumented code
         out = kernel(x)
 
-    # optional XLA-level trace viewable in TensorBoard/Perfetto:
-    with profiling.trace("/tmp/jax-trace"):
+    with profiling.trace("/tmp/jax-trace"): # XLA op-level timeline
         run_sweep(...)
-"""
+
+Zero overhead when inactive (one module-global check, inherited from the
+obs layer); no hard jax dependency at import time."""
 
 from __future__ import annotations
 
@@ -29,33 +26,27 @@ import sys
 import time
 from typing import Dict, Optional, TextIO
 
-_active: Optional[Dict[str, list]] = None  # name -> [total_seconds, count]
+from pypulsar_tpu.obs import telemetry as _telemetry
+
+_report_depth = 0  # stage_report nesting; only the outermost prints
 
 
 def is_active() -> bool:
-    return _active is not None
+    """True while any collection is active — a stage_report block or an
+    obs telemetry session (``--telemetry``)."""
+    return _telemetry.is_active()
 
 
 def record(name: str, seconds: float) -> None:
-    """Add ``seconds`` to stage ``name`` (no-op unless a report is active)."""
-    if _active is None:
-        return
-    ent = _active.setdefault(name, [0.0, 0])
-    ent[0] += seconds
-    ent[1] += 1
+    """Add ``seconds`` to stage ``name`` (no-op unless collection is
+    active)."""
+    _telemetry.record_span(name, seconds)
 
 
-@contextlib.contextmanager
 def stage(name: str):
-    """Time a block under ``name``. Near-zero cost when no report is active."""
-    if _active is None:
-        yield
-        return
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        record(name, time.perf_counter() - t0)
+    """Time a block under ``name``. Near-zero cost when inactive; under
+    an obs session the block is also recorded as a nested JSONL span."""
+    return _telemetry.span(name)
 
 
 @contextlib.contextmanager
@@ -63,24 +54,36 @@ def stage_report(file: TextIO = None):
     """Collect stage timings inside the block; print a breakdown on exit.
 
     Nesting reuses the outer collector (one report is printed, by the
-    outermost context)."""
-    global _active
-    outer = _active
-    if outer is None:
-        _active = {}
-    t0 = time.perf_counter()
-    try:
-        yield _Report(_active)
-    finally:
-        total = time.perf_counter() - t0
-        stages, _active = _active, outer
-        if outer is None:
-            _print_report(stages, total, file or sys.stderr)
+    outermost context). Piggybacks on an already-active obs telemetry
+    session — the report then scopes itself to the stages accumulated
+    inside this block (snapshot diff) while the session keeps the full
+    trace."""
+    global _report_depth
+    with contextlib.ExitStack() as es:
+        es.enter_context(_telemetry.session())  # reuses any outer session
+        tlm = _telemetry.current()
+        rep = _Report(tlm, tlm.stage_snapshot())
+        t0 = time.perf_counter()
+        _report_depth += 1
+        try:
+            yield rep
+        finally:
+            _report_depth -= 1
+            total = time.perf_counter() - t0
+            if _report_depth == 0:
+                _print_report(rep.stages, total, file or sys.stderr)
 
 
 class _Report:
-    def __init__(self, stages):
-        self.stages = stages
+    """Live view of the stages accumulated since this report started."""
+
+    def __init__(self, tlm, baseline):
+        self._tlm = tlm
+        self._baseline = baseline
+
+    @property
+    def stages(self) -> Dict[str, list]:
+        return self._tlm.stage_pairs_since(self._baseline)
 
     def totals(self) -> Dict[str, float]:
         return {k: v[0] for k, v in self.stages.items()}

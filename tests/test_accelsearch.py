@@ -684,6 +684,55 @@ def test_prep_spectra_batch_matches_host_prep():
             assert abs(ch.sigma - cd.sigma) <= 1e-3
 
 
+def test_prep_spectra_batch_large_mean_parity():
+    """A +1000-count DC offset (8-bit data sits far above zero) must not
+    degrade the device prep: the per-series mean is subtracted on device
+    before the f32 rfft (deredden overwrites bin 0 anyway, so the exact
+    result is unchanged), keeping the f32 butterflies at fluctuation
+    scale — same tolerance as the zero-mean parity test (ADVICE r5)."""
+    from pypulsar_tpu.fourier.kernels import deredden, prep_spectra_batch
+
+    rng = np.random.RandomState(17)
+    n = 1 << 14
+    dt = 2.5e-4
+    series = []
+    for b in range(2):
+        ts = rng.standard_normal(n).astype(np.float32)
+        ts += 0.2 * np.sin(2 * np.pi * (23.0 + 11.0 * b)
+                           * np.arange(n) * dt).astype(np.float32)
+        ts += 1000.0  # the large-mean regime the fix targets
+        series.append(ts)
+    series = np.stack(series)
+
+    re, im = prep_spectra_batch(series)
+    dev = np.asarray(re) + 1j * np.asarray(im)
+    # host reference: f64 rfft (no DC-rounding problem) -> deredden
+    host = np.stack([
+        np.asarray(deredden(np.fft.rfft(s.astype(np.float64))
+                            .astype(np.complex64)))
+        for s in series])
+    assert dev.shape == host.shape == (2, n // 2 + 1)
+    scale = np.abs(host[:, 1:]).max()
+    assert np.abs(dev[:, 1:] - host[:, 1:]).max() / scale < 2e-5
+    assert np.allclose(dev[:, 0], 1.0)  # deredden's unit DC bin
+
+
+def test_cli_device_prep_requires_batch(tmp_path):
+    """--device-prep with --batch < 2 is a hard CLI error instead of a
+    silent no-op (device prep only exists on the grouped batch path)."""
+    import pytest
+
+    from pypulsar_tpu.cli import accelsearch as cli_accel
+
+    with pytest.raises(SystemExit) as exc:
+        cli_accel.main([str(tmp_path / "x.dat"), "--device-prep"])
+    assert exc.value.code == 2  # argparse error exit
+    with pytest.raises(SystemExit) as exc:
+        cli_accel.main([str(tmp_path / "x.dat"), "--device-prep",
+                        "--batch", "1"])
+    assert exc.value.code == 2
+
+
 def test_cli_device_prep_matches_host_prep(tmp_path, monkeypatch):
     """cli accelsearch --batch --device-prep finds the same candidates
     as the default host-prep batch path on the same .dats."""

@@ -47,6 +47,43 @@ def test_nested_report_uses_outer_collector():
     assert buf.getvalue().count("stage breakdown") == 1
 
 
+def test_stage_report_inside_telemetry_session_scopes_to_block(tmp_path):
+    """The shim contract: a stage_report inside an obs telemetry session
+    piggybacks on the session (no second collector), scopes its totals to
+    its own block, still prints, and leaves the session running."""
+    import json
+
+    from pypulsar_tpu.obs import telemetry
+
+    path = str(tmp_path / "t.jsonl")
+    buf = io.StringIO()
+    with telemetry.session(path) as tlm:
+        with profiling.stage("before_report"):
+            pass
+        with profiling.stage_report(file=buf) as rep:
+            with profiling.stage("inside_report"):
+                pass
+        assert telemetry.is_active()  # report exit must not close it
+        totals = rep.totals()
+        assert set(totals) == {"inside_report"}  # scoped to the block
+        # the session saw BOTH stages
+        assert set(tlm.stages) == {"before_report", "inside_report"}
+    assert buf.getvalue().count("stage breakdown") == 1
+    # profiling.stage call sites landed in the JSONL trace as spans
+    names = [json.loads(l)["name"] for l in open(path)
+             if '"span"' in l]
+    assert "before_report" in names and "inside_report" in names
+
+
+def test_record_feeds_active_session():
+    from pypulsar_tpu.obs import telemetry
+
+    with telemetry.session() as tlm:
+        profiling.record("manual", 0.25)
+        assert abs(tlm.stages["manual"][0] - 0.25) < 1e-9
+        assert tlm.stages["manual"][1] == 1
+
+
 def test_sweep_emits_stages():
     import numpy as np
 

@@ -144,10 +144,19 @@ def main(argv=None):
     from pypulsar_tpu.io.filterbank import FilterbankFile
 
     _fb = FilterbankFile(win_fil)
-    nchan, nbits = _fb.nchans, _fb.nbits
+    nchan, nbits, tsamp = _fb.nchans, _fb.nbits, float(_fb.tsamp)
     _fb.close()
-    print(f"## window: {nsamp} samples ({a.duration:.0f}s), {nchan} chans "
-          f"{nbits}-bit -> {win_fil}")
+    # actual covered span: the input can be SHORTER than the requested
+    # window (slice_window clamps to the file), and every derived number
+    # (trials/s, projections) must be read against the real coverage
+    covered = nsamp * tsamp
+    if covered < a.duration - 0.5 * tsamp:
+        print(f"## WARNING: input covers only {covered:.1f}s of the "
+              f"requested --duration {a.duration:.0f}s window; the "
+              f"recorded metrics describe the shorter span",
+              file=sys.stderr)
+    print(f"## window: {nsamp} samples ({covered:.1f}s of the requested "
+          f"{a.duration:.0f}s), {nchan} chans {nbits}-bit -> {win_fil}")
 
     dmstep = a.dm_max / max(a.trials - 1, 1)
     stages["sweep_write_dats"] = round(run_stage(
@@ -292,6 +301,8 @@ def main(argv=None):
         "numpy_cells_per_sec": round(bl_cells_per_sec, 1),
         **{k: v for k, v in bl.items() if k != "seconds"},
         "trials": a.trials,
+        "covered_seconds": round(covered, 1),
+        "requested_seconds": round(a.duration, 1),
         "coarse_dz": a.coarse_dz,
         "device_prep": a.device_prep,
         "wall_seconds": round(wall, 1),
