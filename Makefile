@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier dryrun smoke probe bench bench-quick bench-ab bench-accel bench-fold bench-telemetry native clean
+.PHONY: test test-fourier dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-telemetry native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -41,6 +41,13 @@ bench-ab:
 
 bench-accel:
 	$(PY) bench.py --accel
+
+# the round-6 A/B in one command: configs[4] through the streamed
+# sweep->accel handoff vs the classic .dat chain (walls + sift parity ->
+# BENCH_r06_configs4.json), then the committed (r,z) roofline
+bench-accel-pipeline:
+	$(PY) tools/run_configs4.py --stream --ab-stream --keep
+	$(PY) tools/accel_roofline.py
 
 bench-fold:
 	$(PY) bench.py --fold

@@ -110,18 +110,33 @@ def build_parser():
                    help="coarse-pass power-threshold fraction "
                         "(default 0.7; lower = safer recall, more "
                         "refine work)")
-    p.add_argument("--device-prep", action="store_true",
+    p.add_argument("--device-prep", action=argparse.BooleanOptionalAction,
+                   default=None,
                    help="with --batch: rfft + deredden each group on "
                         "DEVICE in one fused dispatch (kernels."
                         "prep_spectra_batch) and hand the spectra to the "
                         "search without leaving HBM, instead of "
                         "np.fft.rfft per file on the host plus a "
                         "deredden round trip. 2-3x the end-to-end rate "
-                        "on a 1-core host; sigmas match host prep to "
-                        "~1e-6 relative (f32 rfft vs f64), not bitwise "
-                        "— the committed byte-parity contract is the "
-                        "default host path. Ignored for .fft inputs, "
-                        "--zapfile, or --no-deredden (host prep used)")
+                        "on a 1-core host; DEFAULT ON for --batch >= 2 "
+                        "under the matched-candidate contract (every "
+                        "candidate above the floor matches host prep "
+                        "within (dr, dz, dsig) bounds — enforced by "
+                        "tests/test_accelsearch.py::test_device_prep_"
+                        "candidate_contract; see README). "
+                        "--no-device-prep restores the byte-parity host "
+                        "path. Ignored for .fft inputs, --zapfile, or "
+                        "--no-deredden (host prep used)")
+    p.add_argument("--prefetch", type=int, default=4, metavar="N",
+                   help="with --batch: read + prep up to N inputs AHEAD "
+                        "of the device search on a background thread "
+                        "(parallel.prefetch), overlapping the .dat read/"
+                        "host prep of batch N+1 with the device search "
+                        "of batch N — the round-5 A/B measured 6.4 of "
+                        "8.7 s/spectrum of serial host time without "
+                        "this. Queue fill lands on the accel.prep."
+                        "pending_depth telemetry gauge. 0 = inline "
+                        "(single-threaded debugging). Default 4")
     p.add_argument("-w", "--wmax", type=float, default=0.0,
                    help="max jerk in bins over T^3 (0 = no w search; "
                         "cost scales with the w grid size)")
@@ -150,12 +165,13 @@ def build_parser():
 
 
 def _out_names(infile, args):
-    """(candfn, txtfn) for one input under the current flags."""
-    ztag = int(round(args.zmax))
-    if args.wmax > 0:
-        ztag = f"{ztag}_JERK_{int(round(args.wmax))}"
+    """(candfn, txtfn) for one input under the current flags (the naming
+    itself lives in parallel.accelpipe, shared with the streamed
+    sweep->accel handoff so the two paths' artifacts cannot diverge)."""
+    from pypulsar_tpu.parallel.accelpipe import accel_out_names
+
     outbase = args.outbase or os.path.splitext(infile)[0]
-    return f"{outbase}_ACCEL_{ztag}.cand", f"{outbase}_ACCEL_{ztag}.txtcand"
+    return accel_out_names(outbase, args.zmax, args.wmax)
 
 
 def prepare_one(infile, args):
@@ -179,27 +195,15 @@ def prepare_one(infile, args):
 
 
 def write_results(infile, cands, T, args):
-    """Write the per-input .txtcand + .cand pair; returns the .cand path."""
+    """Write the per-input .txtcand + .cand pair; returns the .cand path.
+    The format lives in parallel.accelpipe.write_candfiles, shared with
+    the streamed sweep->accel handoff (one definition of the artifact)."""
+    from pypulsar_tpu.parallel.accelpipe import write_candfiles
+
     candfn, txtfn = _out_names(infile, args)
-    cands = cands[: args.max_cands]
-
-    from pypulsar_tpu.io.prestocand import write_rzwcands
-
-    # .txtcand first, .cand (atomically) last: the .cand's existence is
-    # the batch-restart completeness marker
-    with open(txtfn, "w") as f:
-        f.write("# cand   sigma    power  numharm          r          z"
-                "        freq(Hz)       fdot(Hz/s)      period(s)\n")
-        for i, c in enumerate(cands):
-            freq = c.freq(T)
-            f.write(
-                f"{i + 1:6d} {c.sigma:7.2f} {c.power:8.2f} {c.numharm:8d} "
-                f"{c.r:10.2f} {c.z:10.2f} {freq:15.8f} "
-                f"{c.fdot(T):16.6e} {1.0 / freq:14.10f}\n"
-            )
-    write_rzwcands(candfn, [c.as_fourierprops() for c in cands])
-    print(f"# wrote {len(cands)} candidates to {candfn} and {txtfn}",
-          file=sys.stderr)
+    write_candfiles(candfn, txtfn, cands, T, args.max_cands)
+    print(f"# wrote {len(cands[:args.max_cands])} candidates to {candfn} "
+          f"and {txtfn}", file=sys.stderr)
     return candfn
 
 
@@ -258,6 +262,11 @@ def main(argv=None):
         # device prep only exists on the grouped batch dispatch
         parser.error("--device-prep only takes effect with --batch >= 2 "
                      "(device prep is the grouped-dispatch path)")
+    if args.device_prep is None:
+        # default-on for the grouped path (VERDICT r5 item 2): the
+        # matched-candidate contract is test-enforced, so the faster
+        # prep is the path of record; --no-device-prep opts out
+        args.device_prep = args.batch >= 2
     cfg = AccelSearchConfig(
         zmax=args.zmax, dz=args.dz, numharm=args.numharm,
         sigma_min=args.sigma, flo=args.flo, fhi=args.fhi,
@@ -360,22 +369,46 @@ def _run(args, cfg):
                     fail(fn, e)
             group.clear()
 
-        for infile in args.infiles:
-            try:
-                with telemetry.span("accel_prep_host", infile=infile):
-                    prep = (prepare_one_series(infile, args)
-                            if args.device_prep else _HOST)
-                    if prep is _HOST:  # explicit host-path sentinel
-                        prep = prepare_one(infile, args)
-                        kind = "norm"
-                    else:
-                        kind = "series"
-            except Exception as e:  # noqa: BLE001
-                fail(infile, e)
+        def prepped_inputs():
+            """Per-file host prep as a stream: each yield is either a
+            ready (infile, payload, T, kind, None) record or the file's
+            prep error (infile, None, None, None, exc) — errors travel
+            as values so the per-file failure policy stays with the
+            consumer even when prep runs on the prefetch thread."""
+            for infile in args.infiles:
+                try:
+                    with telemetry.span("accel_prep_host", infile=infile):
+                        prep = (prepare_one_series(infile, args)
+                                if args.device_prep else _HOST)
+                        if prep is _HOST:  # explicit host-path sentinel
+                            prep = prepare_one(infile, args)
+                            kind = "norm"
+                        else:
+                            kind = "series"
+                except Exception as e:  # noqa: BLE001 - consumer decides
+                    yield infile, None, None, None, e
+                    continue
+                if prep is None:  # skipped (--skip-existing)
+                    continue
+                payload, T = prep
+                yield infile, payload, T, kind, None
+
+        # the pipeline (tentpole of VERDICT r5 item 1b): prep of input
+        # N+k rides a background thread while the device searches the
+        # current group — the .dat read + rfft/deredden host time that
+        # measured 6.4 of 8.7 s/spectrum serial overlaps the search.
+        # Queue fill -> accel.prep.pending_depth gauge (tlmsum shows it)
+        if args.prefetch > 0:
+            from pypulsar_tpu.parallel.prefetch import prefetch
+
+            source = prefetch(prepped_inputs(), depth=args.prefetch,
+                              name="accel.prep")
+        else:
+            source = prepped_inputs()
+        for infile, payload, T, kind, err in source:
+            if err is not None:
+                fail(infile, err)
                 continue
-            if prep is None:  # skipped (--skip-existing)
-                continue
-            payload, T = prep
             if group and (kind != group[0][3]
                           or len(payload) != len(group[0][1])
                           or abs(T - group[0][2]) > 1e-9):

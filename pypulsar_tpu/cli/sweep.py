@@ -456,7 +456,60 @@ def main(argv=None):
                     help="rfifind .mask file (ours or PRESTO's) applied "
                          "per block with median-mid80 fill")
     ap.add_argument("--write-dats", action="store_true",
-                    help="flat mode: also write per-DM .dat/.inf series")
+                    help="flat mode: also write per-DM .dat/.inf series "
+                         "(with --accel-search this becomes an optional "
+                         "TEE of the handoff's own stream — always the "
+                         "STREAMED two-stage writer's bytes, i.e. "
+                         "prepsubband semantics, even below the "
+                         "PYPULSAR_TPU_DATS_RESIDENT_LIMIT crossover "
+                         "where plain --write-dats picks the exact "
+                         "in-memory writer)")
+    ap.add_argument("--accel-search", action="store_true",
+                    help="flat single-file mode: after the sweep, stream "
+                         "every DM trial's dedispersed series DIRECTLY "
+                         "into the batched acceleration search "
+                         "(parallel.accelpipe.sweep_accel_stream) and "
+                         "write {outbase}_DM*_ACCEL_*.cand files — no "
+                         ".dat write + re-read between the stages "
+                         "(745.9 s of the round-5 configs[4] chain); "
+                         "candidate tables are bit-identical to the "
+                         ".dat round trip (parity-tested)")
+    ap.add_argument("--accel-only", action="store_true",
+                    help="with --accel-search: skip the single-pulse "
+                         "sweep pass and its .cands, running only the "
+                         "dedisperse->accel handoff")
+    ap.add_argument("--accel-zmax", type=float, default=200.0,
+                    help="accel handoff: max drift in Fourier bins "
+                         "(default 200)")
+    ap.add_argument("--accel-dz", type=float, default=2.0,
+                    help="accel handoff: drift step in bins (default 2)")
+    ap.add_argument("--accel-numharm", type=int, default=8,
+                    choices=(1, 2, 4, 8),
+                    help="accel handoff: max harmonics summed (default 8)")
+    ap.add_argument("--accel-sigma", type=float, default=2.0,
+                    help="accel handoff: candidate significance floor "
+                         "(default 2)")
+    ap.add_argument("--accel-batch", type=int, default=32,
+                    help="accel handoff: spectra per device dispatch "
+                         "against the shared template banks (default 32)")
+    ap.add_argument("--accel-max-cands", type=int, default=200,
+                    help="accel handoff: cap on written candidates per "
+                         "trial (default 200)")
+    ap.add_argument("--accel-device-prep", default=True,
+                    action=argparse.BooleanOptionalAction,
+                    help="accel handoff: rfft + deredden each batch on "
+                         "device (default on, the matched-candidate "
+                         "contract path; --no-accel-device-prep uses "
+                         "the byte-parity host prep)")
+    ap.add_argument("--accel-skip-existing", action="store_true",
+                    help="accel handoff: skip trials whose .cand already "
+                         "exists (restart a killed run without "
+                         "re-searching finished trials; tables stay "
+                         "bit-identical to an uninterrupted run)")
+    ap.add_argument("--accel-prefetch", type=int, default=1,
+                    help="accel handoff: batches prepped ahead of the "
+                         "device search (accel.pipe.pending_depth "
+                         "gauge; 0 = inline). Default 1")
     ap.add_argument("--group-time-tol", type=float, default=None,
                     help="event-grouping time tolerance in seconds "
                          "(default: 4x the widest boxcar)")
@@ -525,6 +578,14 @@ def _main_parsed(args, ap):
         args.chunk = 16384
     if args.resume and not args.checkpoint:
         ap.error("--resume requires --checkpoint PATH")
+    if args.accel_search:
+        if args.ddplan:
+            ap.error("--accel-search is a flat-mode option (the handoff "
+                     "searches one fixed time resolution)")
+        if args.time_shard or len(args.infile) > 1:
+            ap.error("--accel-search streams ONE file on this host")
+    if args.accel_only and not args.accel_search:
+        ap.error("--accel-only requires --accel-search")
     widths = tuple(int(w) for w in args.widths.split(","))
     dist.initialize(args.coordinator, args.num_processes, args.process_id)
     if args.time_shard:
@@ -535,6 +596,12 @@ def _main_parsed(args, ap):
             ap.error("--downsamp must be >= 1")
         return _main_timeshard(args, ap, widths)
     if len(args.infile) > 1 or dist.is_distributed():
+        if args.accel_search:
+            # the multi-host path never reaches the handoff branch;
+            # exiting 0 with no .cand files would be a silent no-op
+            ap.error("--accel-search is a single-host option (the "
+                     "handoff runs on this host's flat single-file "
+                     "path)")
         return _main_multi(args, ap, widths)
     args.infile = args.infile[0]
     outbase = args.outbase or os.path.splitext(args.infile)[0]
@@ -549,6 +616,7 @@ def _main_parsed(args, ap):
         mesh = make_mesh([args.mesh], ("dm",),
                          devices=jax.devices()[: args.mesh])
 
+    rc = 0
     if args.ddplan:
         if args.hidm is None:
             ap.error("--ddplan requires --hidm")
@@ -566,29 +634,70 @@ def _main_parsed(args, ap):
         if args.numdms is None:
             ap.error("flat mode requires --numdms (or use --ddplan)")
         dms = args.lodm + args.dmstep * np.arange(args.numdms)
-        staged = sweep_flat(reader, dms, downsamp=args.downsamp,
-                            nsub=args.nsub, group_size=args.group_size,
-                            widths=widths, chunk_payload=args.chunk,
-                            mesh=mesh,
-                            checkpoint_path=args.checkpoint,
-                            checkpoint_every=args.checkpoint_every,
-                            engine=args.engine,
-                            keep_chunk_peaks=args.all_events,
-                            rfimask=rfimask)
-        if args.write_dats:
+        staged = None
+        if not args.accel_only:
+            staged = sweep_flat(reader, dms, downsamp=args.downsamp,
+                                nsub=args.nsub, group_size=args.group_size,
+                                widths=widths, chunk_payload=args.chunk,
+                                mesh=mesh,
+                                checkpoint_path=args.checkpoint,
+                                checkpoint_every=args.checkpoint_every,
+                                engine=args.engine,
+                                keep_chunk_peaks=args.all_events,
+                                rfimask=rfimask)
+        if args.accel_search:
+            # streamed sweep->accel handoff: the dedispersed series feed
+            # prep_spectra_batch/accel_search_batch in RAM; --write-dats
+            # tees the identical bytes to disk instead of gating on them
+            from pypulsar_tpu.fourier.accelsearch import AccelSearchConfig
+            from pypulsar_tpu.parallel.accelpipe import sweep_accel_stream
+
+            acfg = AccelSearchConfig(
+                zmax=args.accel_zmax, dz=args.accel_dz,
+                numharm=args.accel_numharm, sigma_min=args.accel_sigma)
+            summary = sweep_accel_stream(
+                reader, dms, acfg, outbase,
+                batch=args.accel_batch, downsamp=args.downsamp,
+                nsub=args.nsub,
+                # pass the flag through unchanged (0 = auto resolves
+                # inside make_sweep_plan): the .dat round trip resolves
+                # it the same way, which the bit-parity contract needs —
+                # stage-1 groups dedisperse at the GROUP mean DM, so a
+                # different group size is a different series
+                group_size=args.group_size,
+                rfimask=rfimask, engine=args.engine,
+                chunk_payload=args.chunk, write_dats=args.write_dats,
+                max_cands=args.accel_max_cands,
+                device_prep=args.accel_device_prep,
+                skip_existing=args.accel_skip_existing,
+                prefetch_depth=args.accel_prefetch, verbose=True)
+            print(f"# accel handoff: {summary['n_searched']} trials "
+                  f"searched, {summary['n_skipped']} skipped"
+                  + (f", {summary['serial_fallbacks']} serial fallbacks"
+                     if summary["serial_fallbacks"] else "")
+                  + (f", {summary['n_failed']} FAILED"
+                     if summary["n_failed"] else ""))
+            if summary["n_failed"]:
+                # match cli/accelsearch: a partially-failed run must not
+                # exit 0 (drivers gate bench records on the return code)
+                # — but the completed single-pulse sweep's artifacts
+                # below must still be written first
+                rc = 1
+        elif args.write_dats:
             _write_dats_auto(outbase, reader, dms, args, rfimask=rfimask)
 
-    hits = staged.above_threshold(args.threshold)
-    _write_cands(outbase + ".cands", hits)
-    if args.all_events:
-        _emit_events(staged, outbase, args)
-    print(f"# {staged.n_trials} DM trials swept; {len(hits)} detections "
-          f">= {args.threshold} sigma -> {outbase}.cands")
-    for c in staged.best(args.topk):
-        print(f"DM {c['dm']:8.3f}  SNR {c['snr']:7.2f}  t {c['time_sec']:10.4f}s"
-              f"  width {c['width_bins']:3d} bins ({c['width_sec']*1e3:.2f} ms)"
-              f"  ds {c['downsamp']}")
-    return 0
+    if staged is not None:
+        hits = staged.above_threshold(args.threshold)
+        _write_cands(outbase + ".cands", hits)
+        if args.all_events:
+            _emit_events(staged, outbase, args)
+        print(f"# {staged.n_trials} DM trials swept; {len(hits)} detections "
+              f">= {args.threshold} sigma -> {outbase}.cands")
+        for c in staged.best(args.topk):
+            print(f"DM {c['dm']:8.3f}  SNR {c['snr']:7.2f}  t "
+                  f"{c['time_sec']:10.4f}s  width {c['width_bins']:3d} bins "
+                  f"({c['width_sec']*1e3:.2f} ms)  ds {c['downsamp']}")
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,356 @@
+"""Pipelined sweep->accel handoff: dedispersed series stream straight
+into the batched acceleration search, no .dat round trip.
+
+The round-5 configs[4] measurement (BENCH_r05.json) put 745.9 s of the
+4364.8 s chain into writing per-DM .dat files to disk only to re-read
+them for the accel stage, and the per-spectrum A/B showed 6.4 of
+8.7 s/spectrum of *serial host time* even with ``--device-prep`` — the
+classic pipeline-bubble pair the GPU dedispersion literature solves by
+streaming transfers behind compute (Barsdell et al. 2012; Sclocco et
+al. 2016), and that the sweep engine already solved with its ship-ahead
+pattern (parallel/staged.py, io_overlap_frac = 1.0). This module gives
+the accel stage the same treatment:
+
+- :func:`sweep_accel_stream` streams the observation ONCE through the
+  sweep's own two-stage chunk kernel (staged.iter_dedispersed_chunks —
+  the values are bit-identical to what the .dat writer puts on disk,
+  parity-tested), accumulates every trial's series in a host buffer,
+  and hands batches to ``prep_spectra_batch`` + ``accel_search_batch``.
+  ``--write-dats`` survives as an optional tee of the identical bytes.
+- The host half of each accel batch (row gather + device prep dispatch)
+  runs one batch AHEAD of the device search on the shared prefetch core
+  (parallel/prefetch.py): batch N+1 preps while batch N searches, with
+  the queue fill on the ``accel.pipe.pending_depth`` gauge so tlmsum
+  shows the overlap that was actually achieved.
+- Host RAM for the series buffer is budgeted
+  (``PYPULSAR_TPU_ACCEL_STREAM_RAM``, default 12 GB — the same bytes the
+  .dat files used to occupy on disk, now never written): a trial set too
+  large for the budget is processed in DM slices, each slice one more
+  pass over the raw file. The log says when that trade is being made.
+
+Restartability mirrors the batched CLI: ``skip_existing`` skips trials
+whose .cand already exists (the .cand is written atomically last, so a
+killed run resumes without re-searching finished trials and the final
+candidate tables are bit-identical to an uninterrupted run), and a
+failed batched dispatch degrades to per-spectrum serial host-prep
+searches instead of failing its whole batch.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from pypulsar_tpu.obs import telemetry
+
+__all__ = [
+    "accel_out_names",
+    "stream_series",
+    "sweep_accel_stream",
+    "write_candfiles",
+]
+
+
+def accel_out_names(outbase: str, zmax: float, wmax: float = 0.0
+                    ) -> Tuple[str, str]:
+    """(candfn, txtfn) for one spectrum under the PRESTO naming scheme —
+    the ONE definition shared by cli/accelsearch and the streamed
+    handoff, so the two paths' artifacts can never diverge in name."""
+    ztag = int(round(zmax))
+    if wmax > 0:
+        ztag = f"{ztag}_JERK_{int(round(wmax))}"
+    return f"{outbase}_ACCEL_{ztag}.cand", f"{outbase}_ACCEL_{ztag}.txtcand"
+
+
+def write_candfiles(candfn: str, txtfn: str, cands, T: float,
+                    max_cands: int = 200) -> str:
+    """Write one spectrum's .txtcand + .cand pair (shared by the .dat CLI
+    and the streamed handoff). .txtcand first, .cand last: the .cand's
+    existence is the restart completeness marker."""
+    from pypulsar_tpu.io.prestocand import write_rzwcands
+
+    cands = cands[:max_cands]
+    with open(txtfn, "w") as f:
+        f.write("# cand   sigma    power  numharm          r          z"
+                "        freq(Hz)       fdot(Hz/s)      period(s)\n")
+        for i, c in enumerate(cands):
+            freq = c.freq(T)
+            f.write(
+                f"{i + 1:6d} {c.sigma:7.2f} {c.power:8.2f} {c.numharm:8d} "
+                f"{c.r:10.2f} {c.z:10.2f} {freq:15.8f} "
+                f"{c.fdot(T):16.6e} {1.0 / freq:14.10f}\n"
+            )
+    write_rzwcands(candfn, [c.as_fourierprops() for c in cands])
+    return candfn
+
+
+def stream_series(
+    reader,
+    dms,
+    downsamp: int = 1,
+    nsub: int = 64,
+    group_size: int = 32,
+    rfimask=None,
+    engine: str = "auto",
+    chunk_payload: Optional[int] = None,
+    dat_outbase: Optional[str] = None,
+    verbose: bool = False,
+) -> Tuple[np.ndarray, float]:
+    """One pass over ``reader``: every DM trial's full dedispersed series
+    as a host ``[D, T_ds]`` float32 buffer, plus the effective sampling
+    time. ``dat_outbase`` tees the IDENTICAL bytes to ``.dat``/``.inf``
+    files as they stream (the optional --write-dats path)."""
+    from pypulsar_tpu.parallel.staged import (
+        _ReaderSource,
+        dat_append_rows,
+        dat_truncate_paths,
+        dats_geometry,
+        iter_dedispersed_chunks,
+        write_dat_infs,
+    )
+
+    factor = max(1, int(downsamp))
+    dms = np.asarray(dms, dtype=np.float64)
+    dt_eff = _ReaderSource(reader).tsamp * factor
+    _plan, _payload, T = dats_geometry(reader, dms, downsamp=factor,
+                                       nsub=nsub, group_size=group_size,
+                                       chunk_payload=chunk_payload)
+    buf = np.empty((len(dms), T), dtype=np.float32)
+    paths = None
+    if dat_outbase is not None:
+        # the tee shares write_dats_streamed's writer helpers, so the
+        # two paths' .dat byte streams have ONE definition
+        paths = dat_truncate_paths(dat_outbase, dms)
+    with telemetry.span("accel_stream_sweep", aggregate=False,
+                        n_trials=len(dms), n_samples=int(T)):
+        for pos, rows in iter_dedispersed_chunks(
+                reader, dms, downsamp=factor, nsub=nsub,
+                group_size=group_size, rfimask=rfimask, engine=engine,
+                chunk_payload=chunk_payload, verbose=verbose):
+            buf[:, pos:pos + rows.shape[1]] = rows
+            if paths is not None:
+                dat_append_rows(paths, rows)
+    if dat_outbase is not None:
+        write_dat_infs(dat_outbase, reader, dms, T, dt_eff)
+    return buf, dt_eff
+
+
+def _host_prep_rows(rows: np.ndarray, schedule) -> np.ndarray:
+    """The CLI host-prep path (f64-capable np.fft.rfft + device deredden)
+    applied to in-RAM series rows — byte-for-byte what prepare_one would
+    compute from the corresponding .dat file."""
+    from pypulsar_tpu.fourier.kernels import deredden
+
+    return np.stack([
+        np.asarray(deredden(np.fft.rfft(r).astype(np.complex64),
+                            schedule=schedule))
+        for r in rows])
+
+
+def sweep_accel_stream(
+    reader,
+    dms,
+    config,
+    outbase: str,
+    batch: int = 32,
+    downsamp: int = 1,
+    nsub: int = 64,
+    group_size: int = 32,
+    rfimask=None,
+    engine: str = "auto",
+    chunk_payload: Optional[int] = None,
+    write_dats: bool = False,
+    max_cands: int = 200,
+    device_prep: bool = True,
+    skip_existing: bool = False,
+    prefetch_depth: int = 1,
+    verbose: bool = False,
+) -> dict:
+    """Dedisperse ``dms`` over ``reader`` and accel-search every trial,
+    writing ``{outbase}_DM{dm:.2f}_ACCEL_{zmax}.cand/.txtcand`` exactly
+    as ``cli accelsearch`` would for the corresponding .dat files — but
+    with the series handed over in RAM (see module docstring). Returns a
+    summary dict (searched/skipped counts, serial fallbacks, paths)."""
+    from pypulsar_tpu.fourier.accelsearch import (
+        accel_search,
+        accel_search_batch,
+    )
+    from pypulsar_tpu.fourier.kernels import (
+        deredden_schedule,
+        prep_spectra_batch,
+    )
+
+    dms = np.asarray(dms, dtype=np.float64)
+    D = len(dms)
+    bases = [f"{outbase}_DM{dm:.2f}" for dm in dms]
+    names = [accel_out_names(b, config.zmax, config.wmax) for b in bases]
+    todo = [i for i in range(D)
+            if not (skip_existing and os.path.exists(names[i][0]))]
+    n_skipped = D - len(todo)
+    if n_skipped and verbose:
+        print(f"# {n_skipped}/{D} trials already have .cands, skipping")
+    if not todo and not write_dats:
+        return {"n_searched": 0, "n_skipped": n_skipped, "n_failed": 0,
+                "serial_fallbacks": 0,
+                "cand_paths": [n[0] for n in names]}
+
+    # host-RAM budget for the series buffer: past it, the trial set is
+    # processed in DM slices of one extra raw-file pass each (wire/IO
+    # traded for RAM; the .dat path paid the same bytes to disk instead)
+    from pypulsar_tpu.parallel.staged import (
+        _ReaderSource,
+        dats_geometry,
+        write_dat_infs,
+    )
+
+    if group_size <= 0:
+        # resolve the auto group size ONCE over the FULL grid: the .dat
+        # round trip resolves it that way, and a RAM-sliced run must not
+        # let a slice's spacing pick a different (series-changing) group
+        from pypulsar_tpu.parallel.sweep import choose_group_size
+
+        src0 = _ReaderSource(reader)
+        group_size = choose_group_size(dms, src0.frequencies,
+                                       src0.tsamp * max(1, downsamp),
+                                       nsub)
+    _plan, _payload, T = dats_geometry(reader, dms, downsamp=downsamp,
+                                       nsub=nsub, group_size=group_size,
+                                       chunk_payload=chunk_payload)
+    # .inf sidecars are written EVEN without the .dat payloads: cli/sift
+    # and the plotting tools resolve each trial's DM and T from
+    # {base}.inf, and the sidecars are KBs against the 745.9 s of payload
+    # IO the handoff exists to kill (the tee rewrites them, harmlessly)
+    write_dat_infs(outbase, reader, dms, T,
+                   _ReaderSource(reader).tsamp * max(1, downsamp))
+    budget = int(float(os.environ.get("PYPULSAR_TPU_ACCEL_STREAM_RAM",
+                                      12e9)))
+    slice_dms = max(batch, int(budget // (4 * max(T, 1))))
+    # slices MUST align to stage-1 group boundaries: make_sweep_plan
+    # regroups each slice's consecutive DMs from its own start, and a
+    # misaligned slice shifts every later trial into a group with a
+    # different mean DM — silently different series, broken .dat parity
+    # (caught by review: 4/8 tables diverged at slice=6, group=4)
+    slice_dms = max(group_size, (slice_dms // group_size) * group_size)
+    if slice_dms < D and verbose:
+        print(f"# series buffer {4 * D * T / 1e9:.1f} GB exceeds the "
+              f"{budget / 1e9:.1f} GB budget; streaming in "
+              f"{-(-D // slice_dms)} DM slices of {slice_dms} "
+              f"(one raw-file pass each)")
+
+    # device-prep residency cap (the same knob the batched CLI uses):
+    # series + planes + rfft workspace is ~24 bytes/sample per spectrum.
+    # Unlike the sequential CLI, the pipeline holds several prepped
+    # batches in HBM at once — the one searching, the queued ones, and
+    # the one the parked worker holds (prefetch_depth + 2 in flight) —
+    # so each batch gets only its share of the budget
+    hbm = int(float(os.environ.get("PYPULSAR_TPU_ACCEL_HBM", 5e9)))
+    inflight = prefetch_depth + 2 if prefetch_depth > 0 else 1
+    unit = (min(batch, max(1, (hbm // inflight) // (24 * T)))
+            if device_prep else batch)
+    schedule = deredden_schedule(T // 2 + 1)
+    n_searched = 0
+    n_failed = 0
+    fallbacks = 0
+
+    for d0 in range(0, D, slice_dms):
+        dsl = slice(d0, min(d0 + slice_dms, D))
+        sl_todo = [i for i in todo if dsl.start <= i < dsl.stop]
+        if not sl_todo and not write_dats:
+            continue
+        series, dt_eff = stream_series(
+            reader, dms[dsl], downsamp=downsamp, nsub=nsub,
+            group_size=group_size, rfimask=rfimask, engine=engine,
+            chunk_payload=chunk_payload,
+            dat_outbase=outbase if write_dats else None,
+            verbose=verbose)
+        T_sec = T * dt_eff
+
+        def groups():
+            for g0 in range(0, len(sl_todo), unit):
+                yield sl_todo[g0:g0 + unit]
+
+        def prep(idxs):
+            """Worker-side half of the pipeline: gather the batch rows
+            and dispatch the device prep while the PREVIOUS batch is
+            still searching (its result a device-resident plane tuple
+            the search consumes without a host round trip). Exceptions
+            (a failed device dispatch) travel as values — raised on the
+            worker they would abort the whole run instead of degrading
+            this one batch to the serial fallback."""
+            try:
+                rows = np.ascontiguousarray(series[[i - d0 for i in idxs]])
+                with telemetry.span("accel_prep_device" if device_prep
+                                    else "accel_prep_host",
+                                    batch=len(idxs)):
+                    payload = (prep_spectra_batch(rows, schedule)
+                               if device_prep
+                               else _host_prep_rows(rows, schedule))
+            except Exception as e:  # noqa: BLE001 - consumer decides
+                return idxs, None, e
+            return idxs, payload, None
+
+        if prefetch_depth > 0:
+            from pypulsar_tpu.parallel.prefetch import prefetch
+
+            source = prefetch(groups(), depth=prefetch_depth,
+                              name="accel.pipe", transform=prep)
+        else:  # --accel-prefetch 0: inline, single-threaded debugging
+            source = (prep(g) for g in groups())
+        for idxs, payload, prep_err in source:
+            try:
+                if prep_err is not None:
+                    raise prep_err
+                with telemetry.span("accel_search", aggregate=False,
+                                    batch=len(idxs)):
+                    all_cands = accel_search_batch(payload, T_sec, config)
+            except Exception as e:  # noqa: BLE001 - poison-spectrum
+                # contract of the batched CLI: degrade to per-spectrum
+                # serial host-prep searches, never fail the whole batch
+                fallbacks += 1
+                telemetry.counter("accel.serial_fallbacks")
+                telemetry.event("accel.batch_serial_fallback",
+                                n=len(idxs), kind="stream",
+                                error=type(e).__name__)
+                print(f"# streamed batch of {len(idxs)} failed "
+                      f"({type(e).__name__}: {e}); retrying serially")
+                all_cands = []
+                # still recorded as accel_search time: the bench derives
+                # cells/s from this span's total, and an unspanned
+                # fallback would make a degraded run look faster
+                with telemetry.span("accel_search", aggregate=False,
+                                    batch=len(idxs), fallback=True):
+                    for i in idxs:
+                        # one poison spectrum fails ALONE (no .cand
+                        # written, so a skip_existing restart retries
+                        # it), never the rest of the run — the batched
+                        # CLI's contract
+                        try:
+                            all_cands.append(accel_search(
+                                _host_prep_rows(
+                                    series[i - d0:i - d0 + 1],
+                                    schedule)[0],
+                                T_sec, config))
+                        except Exception as e1:  # noqa: BLE001
+                            all_cands.append(None)
+                            n_failed += 1
+                            print(f"# trial DM{dms[i]:.2f} FAILED "
+                                  f"serially ({type(e1).__name__}: "
+                                  f"{e1})")
+            for i, cands in zip(idxs, all_cands):
+                if cands is None:
+                    continue
+                with telemetry.span("accel_write"):
+                    write_candfiles(names[i][0], names[i][1], cands,
+                                    T_sec, max_cands)
+                n_searched += 1
+            telemetry.counter("accel.stream_batches")
+            if verbose:
+                print(f"# searched trials {idxs[0]}..{idxs[-1]} "
+                      f"({n_searched}/{len(todo)})")
+        del series  # free the slice buffer before the next pass
+
+    return {"n_searched": n_searched, "n_skipped": n_skipped,
+            "n_failed": n_failed, "serial_fallbacks": fallbacks,
+            "cand_paths": [n[0] for n in names]}

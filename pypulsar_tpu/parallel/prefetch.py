@@ -1,0 +1,102 @@
+"""Bounded background prefetch of an iterator — the shared ship-ahead core.
+
+Three hot paths in the framework have the same shape: a producer whose
+per-item latency is wire or disk time (host->device block ships, .dat
+reads + host prep, batch stacking + device prep) feeding a consumer whose
+latency is device time (the sweep chunk kernel, the accel stage scans).
+Run on one thread they serialize — the round-4 streamed sweep measured 0%
+overlap until the ship moved to its own thread, and the round-5 accel A/B
+still showed 6.4 of 8.7 s/spectrum of *serial host time* for exactly this
+reason. The fix is always the same bounded producer/consumer pattern, so
+it lives here once:
+
+- a single worker thread pulls ``items``, applies ``transform`` (the
+  expensive half — e.g. ``jnp.asarray`` riding the wire, or a .dat read),
+  and parks results in a FIFO queue of ``depth`` slots;
+- the consumer sees items in order; worker exceptions re-raise at the
+  consumer's next pull (never swallowed in the thread);
+- an abandoned consumer (error or early exit) signals the worker and
+  drains the queue so a put-parked worker exits instead of producing the
+  rest of a 57 GB stream; a ``close()`` on ``items`` is honored;
+- under an active telemetry session the queue fill is recorded to the
+  ``{name}.pending_depth`` gauge on every put — tlmsum's gauges table
+  then shows how deep the pipeline actually ran. The worker records
+  BEFORE parking on a full queue, so the gauge counts its in-hand item
+  too: max == depth+1 means the producer kept fully ahead; max 0-1
+  means the consumer starved.
+
+``PYPULSAR_TPU_SHIP_AHEAD=0`` disables the thread globally (inline
+transform, e.g. for single-threaded debugging); ordering and values are
+identical either way — threading only moves WHEN work happens.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Callable, Iterable, Optional
+
+from pypulsar_tpu.obs import telemetry
+
+__all__ = ["prefetch"]
+
+
+def prefetch(items: Iterable, depth: int = 2, name: str = "prefetch",
+             transform: Optional[Callable] = None,
+             thread_name: Optional[str] = None):
+    """Yield ``transform(item)`` for each item, produced ``depth`` ahead
+    on a background thread (see module docstring for the contract)."""
+    xf = transform if transform is not None else (lambda it: it)
+    gauge_name = f"{name}.pending_depth"
+
+    if os.environ.get("PYPULSAR_TPU_SHIP_AHEAD", "1") == "0":
+        for item in items:
+            yield xf(item)
+        return
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+    _done = object()
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for item in items:
+                if stop.is_set():  # consumer gone: don't produce the rest
+                    return
+                out = xf(item)
+                if telemetry.is_active():  # gauges are thread-safe
+                    telemetry.gauge(gauge_name, q.qsize() + 1)
+                q.put(out)
+        except BaseException as e:  # noqa: BLE001 - re-raised in consumer
+            q.put(e)
+            return
+        q.put(_done)
+
+    t = threading.Thread(target=worker,
+                         name=thread_name or f"pypulsar-{name}",
+                         daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _done:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            if telemetry.is_active():
+                telemetry.gauge(gauge_name, q.qsize())
+            yield item
+    finally:
+        # consumer abandoned mid-stream (error or early exit): signal the
+        # worker, then drain queue slots so a put-parked worker can see
+        # the signal and exit instead of producing the rest of the stream
+        stop.set()
+        while t.is_alive():
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                t.join(timeout=0.1)
+        close = getattr(items, "close", None)
+        if close is not None:
+            close()

@@ -275,63 +275,24 @@ def _ship_ahead(raw_blocks, depth: int = 2):
     In-flight device blocks peak at ``depth + 2`` (queue slots + one the
     worker holds while parked on ``q.put`` + the one yielded to the
     consumer) — ~536 MB of HBM at depth=2 for 134 MB north-star blocks;
-    size streaming budgets accordingly. Ordering is preserved (single
-    worker, FIFO queue); worker exceptions re-raise in the consumer.
-    Disable with PYPULSAR_TPU_SHIP_AHEAD=0 (falls back to inline ship,
-    e.g. for single-threaded debugging)."""
-    if os.environ.get("PYPULSAR_TPU_SHIP_AHEAD", "1") == "0":
-        for pos, block in raw_blocks:
-            if telemetry.is_active():
-                telemetry.counter("h2d.bytes",
-                                  int(getattr(block, "nbytes", 0) or 0))
-            yield pos, jnp.asarray(block)
-        return
+    size streaming budgets accordingly.
 
-    import queue
-    import threading
+    This is the shared :func:`parallel.prefetch.prefetch` core (ordering
+    preserved, worker errors re-raise in the consumer, abandoned
+    consumers stop the worker, PYPULSAR_TPU_SHIP_AHEAD=0 runs inline)
+    with the ship as the worker-side transform; queue fill lands on the
+    ``sweep.ship.pending_depth`` gauge."""
+    from pypulsar_tpu.parallel.prefetch import prefetch
 
-    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
-    _done = object()
-    stop = threading.Event()
+    def ship(item):
+        pos, block = item
+        if telemetry.is_active():  # counters are thread-safe
+            telemetry.counter("h2d.bytes",
+                              int(getattr(block, "nbytes", 0) or 0))
+        return pos, jnp.asarray(block)
 
-    def worker():
-        try:
-            for pos, block in raw_blocks:
-                if stop.is_set():  # consumer gone: don't ship the rest
-                    return
-                if telemetry.is_active():  # counters are thread-safe
-                    telemetry.counter("h2d.bytes",
-                                      int(getattr(block, "nbytes", 0) or 0))
-                q.put((pos, jnp.asarray(block)))
-        except BaseException as e:  # noqa: BLE001 - re-raised in consumer
-            q.put(e)
-            return
-        q.put(_done)
-
-    t = threading.Thread(target=worker, name="pypulsar-ship-ahead",
-                         daemon=True)
-    t.start()
-    try:
-        while True:
-            item = q.get()
-            if item is _done:
-                break
-            if isinstance(item, BaseException):
-                raise item
-            yield item
-    finally:
-        # consumer abandoned mid-stream (error or early exit): signal the
-        # worker, then drain queue slots so a put-parked worker can see
-        # the signal and exit instead of shipping the rest of the file
-        stop.set()
-        while t.is_alive():
-            try:
-                q.get_nowait()
-            except queue.Empty:
-                t.join(timeout=0.1)
-        close = getattr(raw_blocks, "close", None)
-        if close is not None:
-            close()
+    return prefetch(raw_blocks, depth=depth, name="sweep.ship",
+                    transform=ship, thread_name="pypulsar-ship-ahead")
 
 
 class _MaskedSource:
@@ -904,13 +865,76 @@ def write_dats_streamed(
     concatenation of whole-chunk windows reproduces the sequential
     file). Returns the written .dat paths.
     """
+    factor = max(1, int(downsamp))
+    dms = np.asarray(dms, dtype=np.float64)
+    dt_eff = _ReaderSource(reader).tsamp * factor
+    _plan, _payload, T = dats_geometry(reader, dms, downsamp=factor,
+                                       nsub=nsub, group_size=group_size,
+                                       chunk_payload=chunk_payload)
+    s0, s1 = window if window is not None else (0, T)
+
+    paths = dat_truncate_paths(outbase, dms, suffix)
+    for pos, rows in iter_dedispersed_chunks(
+            reader, dms, downsamp=factor, nsub=nsub, group_size=group_size,
+            rfimask=rfimask, engine=engine, chunk_payload=chunk_payload,
+            window=window, verbose=verbose):
+        dat_append_rows(paths, rows)
+    if write_inf:
+        write_dat_infs(outbase, reader, dms, s1 - s0, dt_eff)
+    return paths
+
+
+def dat_truncate_paths(outbase: str, dms, suffix: str = "") -> List[str]:
+    """Create (truncated) the per-DM .dat paths — the ONE definition of
+    the .dat byte-emitting side, shared with the accel handoff's
+    --write-dats tee so the tee-identical contract has a single writer."""
+    paths = [f"{outbase}_DM{dm:.2f}{suffix}.dat" for dm in dms]
+    # truncate once, then reopen per chunk in append mode: holding one
+    # descriptor per DM trial would hit the fd limit at prepsubband-
+    # scale grids (review r5: --numdms 2000 vs the common 1024 ulimit)
+    for p in paths:
+        open(p, "wb").close()
+    return paths
+
+
+def dat_append_rows(paths: List[str], rows) -> None:
+    """Append one chunk's [D, valid] float32 rows to the per-DM .dat
+    byte streams (other half of :func:`dat_truncate_paths`)."""
+    for p, row in zip(paths, rows):
+        with open(p, "ab") as f:
+            row.tofile(f)
+
+
+def iter_dedispersed_chunks(
+    reader,
+    dms,
+    downsamp: int = 1,
+    nsub: int = 64,
+    group_size: int = 32,
+    rfimask=None,
+    engine: str = "auto",
+    chunk_payload: Optional[int] = None,
+    window: Optional[Tuple[int, int]] = None,
+    verbose: bool = False,
+):
+    """Stream the file ONCE and yield ``(pos, rows[D, valid] float32)``
+    host chunks of every DM trial's two-stage dedispersed series — the
+    chunk engine of :func:`write_dats_streamed`, factored out so the
+    sweep->accel handoff (parallel.accelpipe) consumes the IDENTICAL
+    values the .dat writer would have put on disk without the write +
+    re-read round trip (745.9 s of the round-5 configs[4] chain). ``pos``
+    is the file-absolute downsampled sample position of the chunk start
+    (``window`` bounds which chunks stream); chunk geometry comes from
+    :func:`dats_geometry`, so windows must be whole-payload multiples
+    (the seam contract). Every value a consumer sees is the f32 the .dat
+    byte stream would contain — the paths are bit-identical by
+    construction, which the candidate-table parity test pins down."""
     from pypulsar_tpu.ops.transfer import pull_host
     from pypulsar_tpu.parallel.sweep import dedisperse_series_chunk
 
     factor = max(1, int(downsamp))
     dms = np.asarray(dms, dtype=np.float64)
     probe = _ReaderSource(reader)
-    dt_eff = probe.tsamp * factor
     plan, payload, T = dats_geometry(reader, dms, downsamp=factor,
                                      nsub=nsub, group_size=group_size,
                                      chunk_payload=chunk_payload)
@@ -925,32 +949,25 @@ def write_dats_streamed(
     s2b = jnp.asarray(plan.stage2_bins)
     need = payload + plan.min_overlap
 
-    paths = [f"{outbase}_DM{dm:.2f}{suffix}.dat" for dm in dms]
-    # truncate once, then reopen per chunk in append mode: holding one
-    # descriptor per DM trial would hit the fd limit at prepsubband-
-    # scale grids (review r5: --numdms 2000 vs the common 1024 ulimit)
-    for p in paths:
-        open(p, "wb").close()
     for pos, block in _downsampled_blocks(src, factor, payload,
                                           plan.min_overlap):
         L = int(block.shape[1])
         if L < need:  # tail: zero-pad to the static chunk shape
             block = jnp.pad(block, ((0, 0), (0, need - L)))
-        series = dedisperse_series_chunk(
-            block, s1b, s2b, plan.nsub, payload, plan.max_shift2,
-            engine)
         valid = min(payload, s1 - pos)
-        (host,) = pull_host(series[:, :valid].astype(jnp.float32))
+        with telemetry.span("dedisperse_chunk", n_trials=len(dms),
+                            valid=int(valid)):
+            series = dedisperse_series_chunk(
+                block, s1b, s2b, plan.nsub, payload, plan.max_shift2,
+                engine)
+            (host,) = pull_host(series[:, :valid].astype(jnp.float32))
         if verbose:
             print(f"# dats chunk at {pos}: {valid} samples "
                   f"x {len(dms)} DMs")
-        rows = np.asarray(host)
-        for p, row in zip(paths, rows):
-            with open(p, "ab") as f:
-                row.tofile(f)
-    if write_inf:
-        write_dat_infs(outbase, reader, dms, s1 - s0, dt_eff)
-    return paths
+        telemetry.counter("dedisperse.chunks")
+        # the plan pads trial groups to the group size; only the real
+        # trials leave this generator
+        yield pos, np.asarray(host)[:len(dms)]
 
 
 def dats_geometry(reader, dms, downsamp: int = 1, nsub: int = 64,

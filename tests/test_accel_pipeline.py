@@ -1,0 +1,422 @@
+"""Pipelined sweep->accel handoff tests (round 6): the streamed path's
+candidate tables must be bit-identical to the .dat round trip, the
+--write-dats tee must write the identical bytes, kill/resume through
+--accel-skip-existing must reproduce the uninterrupted tables, and the
+shared prefetch core must move only WHEN work happens, never values or
+order."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from pypulsar_tpu.io import filterbank
+from pypulsar_tpu.ops import numpy_ref
+
+
+def _pulsar_fil(tmp_path, name="psr.fil", C=32, T=16384, dt=5e-4,
+                dm=40.0, period=0.1024, amp=10.0, seed=5):
+    """A .fil with an injected dispersed pulse train (P=102.4 ms at
+    DM 40) — strong enough that the accel search recovers it at the
+    fundamental through every prep path."""
+    rng = np.random.RandomState(seed)
+    freqs = 1500.0 - 4.0 * np.arange(C)
+    data = rng.randn(T, C).astype(np.float32) * 2.0 + 30.0
+    bins = numpy_ref.bin_delays(dm, freqs, dt)
+    for t0 in np.arange(0.01, T * dt, period):
+        s = int(t0 / dt)
+        for c in range(C):
+            idx = s + bins[c]
+            if idx < T:
+                data[idx, c] += amp
+    fn = str(tmp_path / name)
+    hdr = dict(nchans=C, tsamp=dt, fch1=float(freqs[0]),
+               foff=float(freqs[1] - freqs[0]), tstart=55000.0, nbits=32,
+               nifs=1, source_name="PSR")
+    filterbank.write_filterbank(fn, hdr, data)
+    return fn
+
+
+SWEEP_ARGS = ["--lodm", "0", "--dmstep", "10", "--numdms", "8",
+              "-s", "8", "--group-size", "4", "--threshold", "8"]
+ACCEL_ARGS = ["-z", "20", "-n", "2", "-s", "3"]
+HANDOFF_ARGS = ["--accel-search", "--accel-zmax", "20",
+                "--accel-numharm", "2", "--accel-sigma", "3",
+                "--accel-batch", "4"]
+
+
+def _run_dat_roundtrip(fil, outbase, monkeypatch, extra_accel=()):
+    """Reference chain: sweep --write-dats (streamed writer) ->
+    accelsearch --batch over the .dats."""
+    from pypulsar_tpu.cli import accelsearch as cli_accel
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    monkeypatch.setenv("PYPULSAR_TPU_DATS_RESIDENT_LIMIT", "0")
+    assert cli_sweep.main([fil, "-o", outbase, *SWEEP_ARGS,
+                           "--write-dats"]) == 0
+    dats = sorted(glob.glob(f"{outbase}_DM*.dat"))
+    assert len(dats) == 8
+    assert cli_accel.main([*dats, "--batch", "4", *ACCEL_ARGS,
+                           *extra_accel]) == 0
+    return sorted(glob.glob(f"{outbase}_DM*_ACCEL_20.cand"))
+
+
+@pytest.mark.parametrize("device_prep", [True, False])
+def test_stream_handoff_bit_identical_to_dat_roundtrip(tmp_path,
+                                                       monkeypatch,
+                                                       device_prep):
+    """The acceptance contract of the round-6 tentpole: the streamed
+    sweep->accel path produces candidate tables BIT-IDENTICAL to the
+    .dat write + re-read chain, for both prep paths, and recovers the
+    injected pulsar."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    prep_flags = ([] if device_prep else ["--no-device-prep"])
+    a_cands = _run_dat_roundtrip(fil, "a", monkeypatch,
+                                 extra_accel=prep_flags)
+    assert a_cands
+
+    handoff_prep = ([] if device_prep else ["--no-accel-device-prep"])
+    assert cli_sweep.main([fil, "-o", "b", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--accel-only", *handoff_prep]) == 0
+    for fa in a_cands:
+        fb = "b" + os.path.basename(fa)[1:]
+        assert os.path.exists(fb), fb
+        assert open(fa, "rb").read() == open(fb, "rb").read(), fa
+        ta, tb = fa[:-5] + ".txtcand", fb[:-5] + ".txtcand"
+        assert open(ta).read() == open(tb).read(), ta
+
+    # the injected pulsar (f0 = 1/0.1024 Hz) is in the DM-40 table — a
+    # delta-like pulse train puts its power across MANY harmonics, so
+    # accept any harmonic k*f0 (k integer) among the top candidates
+    from pypulsar_tpu.io.prestocand import read_rzwcands
+
+    T = 16384 * 5e-4
+    cands = read_rzwcands("b_DM40.00_ACCEL_20.cand")
+    f0 = 1.0 / 0.1024
+
+    def is_harmonic(c):
+        k = (c.r / T) / f0
+        return k > 0.5 and abs(k - round(k)) < 0.02
+
+    assert any(is_harmonic(c) and c.sig > 10 for c in cands[:10]), \
+        "injected pulsar not recovered"
+
+
+def test_stream_handoff_write_dats_tee_identical(tmp_path, monkeypatch):
+    """--accel-search --write-dats tees the IDENTICAL .dat bytes the
+    streamed writer would have produced (the tee is the same chunk
+    stream, not a second implementation)."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("PYPULSAR_TPU_DATS_RESIDENT_LIMIT", "0")
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    assert cli_sweep.main([fil, "-o", "w", *SWEEP_ARGS,
+                           "--write-dats"]) == 0
+    assert cli_sweep.main([fil, "-o", "t", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--accel-only", "--write-dats"]) == 0
+    dats = sorted(glob.glob("w_DM*.dat"))
+    assert len(dats) == 8
+    for fw in dats:
+        ft = "t" + os.path.basename(fw)[1:]
+        assert open(fw, "rb").read() == open(ft, "rb").read(), fw
+        iw, it = fw[:-4] + ".inf", ft[:-4] + ".inf"
+        # .inf sidecars agree apart from the basename line
+        lw = [l for l in open(iw) if "Data file name" not in l]
+        lt = [l for l in open(it) if "Data file name" not in l]
+        assert lw == lt
+
+
+def test_stream_handoff_kill_resume_bit_identical(tmp_path, monkeypatch):
+    """A run killed mid-search (BaseException after the first batch — the
+    serial fallback must NOT swallow it) resumes with
+    --accel-skip-existing: finished trials are skipped, the rest are
+    searched, and every final table is bit-identical to an uninterrupted
+    run's."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+    from pypulsar_tpu.fourier import accelsearch as accel_mod
+
+    # uninterrupted reference
+    assert cli_sweep.main([fil, "-o", "r", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--accel-only"]) == 0
+    ref = {os.path.basename(f)[1:]: open(f, "rb").read()
+           for f in sorted(glob.glob("r_DM*_ACCEL_20.cand"))}
+    assert len(ref) == 8
+
+    real_batch = accel_mod.accel_search_batch
+    calls = {"n": 0}
+
+    def dying_batch(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise KeyboardInterrupt("simulated SIGINT mid-run")
+        return real_batch(*a, **kw)
+
+    monkeypatch.setattr(accel_mod, "accel_search_batch", dying_batch)
+    with pytest.raises(KeyboardInterrupt):
+        cli_sweep.main([fil, "-o", "k", *SWEEP_ARGS, *HANDOFF_ARGS,
+                        "--accel-only"])
+    monkeypatch.setattr(accel_mod, "accel_search_batch", real_batch)
+    done = sorted(glob.glob("k_DM*_ACCEL_20.cand"))
+    assert 0 < len(done) < 8  # the kill landed mid-run
+
+    # resume: finished trials skipped, the rest searched
+    assert cli_sweep.main([fil, "-o", "k", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--accel-only", "--accel-skip-existing"]) == 0
+    got = {os.path.basename(f)[1:]: open(f, "rb").read()
+           for f in sorted(glob.glob("k_DM*_ACCEL_20.cand"))}
+    assert got == ref
+
+
+def test_stream_handoff_ram_budget_slices(tmp_path, monkeypatch):
+    """A series buffer over PYPULSAR_TPU_ACCEL_STREAM_RAM streams in DM
+    slices (extra raw-file passes) with unchanged candidate tables —
+    including a budget whose raw slice size (6) is NOT a multiple of the
+    stage-1 group size (4): slices must align to group boundaries or the
+    regrouped trials dedisperse at different group-mean DMs (review
+    repro: 4/8 tables diverged before the alignment fix)."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    assert cli_sweep.main([fil, "-o", "f", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--accel-only"]) == 0
+    fulls = sorted(glob.glob("f_DM*_ACCEL_20.cand"))
+    assert len(fulls) == 8
+    # budgets for a raw slice of 4 (aligned) and 6 (MISALIGNED vs the
+    # --group-size 4 in SWEEP_ARGS; must round down to 4)
+    for tag, trials_per_slice in (("s", 2), ("m", 6)):
+        monkeypatch.setenv("PYPULSAR_TPU_ACCEL_STREAM_RAM",
+                           str(4 * 16384 * trials_per_slice))
+        assert cli_sweep.main([fil, "-o", tag, *SWEEP_ARGS,
+                               *HANDOFF_ARGS, "--accel-only"]) == 0
+        for ff in fulls:
+            fs = tag + os.path.basename(ff)[1:]
+            assert open(ff, "rb").read() == open(fs, "rb").read(), \
+                (tag, ff)
+
+
+def test_cli_accelsearch_prefetch_matches_inline(tmp_path, monkeypatch):
+    """--prefetch 0 (inline prep) and the default background prefetch
+    produce identical candidate files — the pipeline moves WHEN prep
+    happens, never what the search sees."""
+    monkeypatch.chdir(tmp_path)
+    from pypulsar_tpu.cli import accelsearch as cli_accel
+    from tests.test_accelsearch import _write_fake_dat
+
+    rng = np.random.RandomState(21)
+    N, dt = 1 << 14, 5e-4
+    bases = []
+    for ii in range(5):
+        ts = rng.standard_normal(N).astype(np.float32)
+        ts += 0.25 * np.cos(2 * np.pi * (33.0 + 6.0 * ii)
+                            * np.arange(N) * dt).astype(np.float32)
+        bases.append(_write_fake_dat(str(tmp_path / f"pp{ii}"), ts, dt))
+    dats = [b + ".dat" for b in bases]
+    argv = dats + ["--batch", "2", "-z", "10", "-n", "2", "-s", "3"]
+    assert cli_accel.main(argv + ["--prefetch", "0"]) == 0
+    inline = {b: open(b + "_ACCEL_10.cand", "rb").read() for b in bases}
+    for b in bases:
+        os.remove(b + "_ACCEL_10.cand")
+    assert cli_accel.main(argv) == 0  # default --prefetch 4
+    for b in bases:
+        assert open(b + "_ACCEL_10.cand", "rb").read() == inline[b], b
+
+
+def test_cli_accelsearch_device_prep_default_on(tmp_path, monkeypatch):
+    """--batch >= 2 engages device prep by DEFAULT (round 6 flip under
+    the matched-candidate contract); --no-device-prep opts out; --batch 1
+    stays on the serial host path."""
+    monkeypatch.chdir(tmp_path)
+    from pypulsar_tpu.cli import accelsearch as cli_accel
+    from pypulsar_tpu.fourier import kernels as _k
+    from tests.test_accelsearch import _write_fake_dat
+
+    rng = np.random.RandomState(22)
+    N, dt = 1 << 13, 5e-4
+    bases = []
+    for ii in range(2):
+        ts = rng.standard_normal(N).astype(np.float32)
+        bases.append(_write_fake_dat(str(tmp_path / f"dd{ii}"), ts, dt))
+    dats = [b + ".dat" for b in bases]
+
+    calls = []
+    real_prep = _k.prep_spectra_batch
+
+    def spy(series, *a, **kw):
+        calls.append(np.asarray(series).shape[0])
+        return real_prep(series, *a, **kw)
+
+    monkeypatch.setattr(_k, "prep_spectra_batch", spy)
+    assert cli_accel.main(dats + ["--batch", "2", "-z", "8", "-n", "1",
+                                  "-s", "4"]) == 0
+    assert calls == [2], calls  # default-on for the grouped path
+    calls.clear()
+    for b in bases:
+        os.remove(b + "_ACCEL_8.cand")
+    assert cli_accel.main(dats + ["--batch", "2", "-z", "8", "-n", "1",
+                                  "-s", "4", "--no-device-prep"]) == 0
+    assert calls == [], calls
+    for b in bases:
+        os.remove(b + "_ACCEL_8.cand")
+    assert cli_accel.main(dats + ["-z", "8", "-n", "1", "-s", "4"]) == 0
+    assert calls == [], calls  # serial path never device-preps
+
+
+def test_cli_sweep_accel_flag_validation(tmp_path, monkeypatch):
+    """--accel-search composes only with the flat single-file mode."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path, name="v.fil", T=4096)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    with pytest.raises(SystemExit):
+        cli_sweep.main([fil, "--ddplan", "--hidm", "100",
+                        "--accel-search"])
+    with pytest.raises(SystemExit):
+        cli_sweep.main([fil, "--numdms", "4", "--accel-only"])
+    with pytest.raises(SystemExit):
+        cli_sweep.main([fil, fil, "--numdms", "4", "--accel-search"])
+
+
+def test_prefetch_values_order_and_errors():
+    """parallel.prefetch: values and order are identical to inline
+    iteration; transform runs on the worker; producer errors re-raise at
+    the consumer; an abandoned consumer stops the worker."""
+    import threading
+    import time
+
+    from pypulsar_tpu.parallel.prefetch import prefetch
+
+    seen_threads = set()
+
+    def xf(x):
+        seen_threads.add(threading.current_thread().name)
+        return x * 2
+
+    out = list(prefetch(iter(range(20)), depth=3, name="t", transform=xf))
+    assert out == [2 * i for i in range(20)]
+    assert seen_threads == {"pypulsar-t"}
+
+    def bad():
+        yield 1
+        raise OSError("producer died")
+
+    it = prefetch(bad(), depth=2, name="t2")
+    assert next(it) == 1
+    with pytest.raises(OSError, match="producer died"):
+        list(it)
+
+    produced = []
+
+    def many():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    it = prefetch(many(), depth=2, name="t3")
+    next(it)
+    it.close()
+    deadline = time.time() + 5.0
+    while time.time() < deadline and any(
+            t.name == "pypulsar-t3" and t.is_alive()
+            for t in threading.enumerate()):
+        time.sleep(0.05)
+    assert len(produced) < 20
+
+
+def test_prefetch_pending_depth_gauge(tmp_path):
+    """Under an active telemetry session the prefetch queue fill lands on
+    the {name}.pending_depth gauge — the acceptance evidence that the
+    pipeline actually ran ahead."""
+    import time
+
+    from pypulsar_tpu.obs import telemetry
+    from pypulsar_tpu.parallel.prefetch import prefetch
+
+    with telemetry.session() as tlm:
+        src = prefetch(iter(range(8)), depth=2, name="gtest")
+        first = next(src)
+        time.sleep(0.2)  # let the worker fill the queue behind us
+        rest = list(src)
+        assert [first] + rest == list(range(8))
+        gauges = tlm.gauge_values()
+    assert "gtest.pending_depth" in gauges
+    assert gauges["gtest.pending_depth"]["max"] >= 1
+
+
+def test_stream_handoff_prefetch_zero_inline_identical(tmp_path,
+                                                       monkeypatch):
+    """--accel-prefetch 0 runs prep inline (no worker thread) with
+    identical candidate tables."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    assert cli_sweep.main([fil, "-o", "p", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--accel-only"]) == 0
+    assert cli_sweep.main([fil, "-o", "q", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--accel-only", "--accel-prefetch", "0"]) == 0
+    fulls = sorted(glob.glob("p_DM*_ACCEL_20.cand"))
+    assert len(fulls) == 8
+    for fp in fulls:
+        fq = "q" + os.path.basename(fp)[1:]
+        assert open(fp, "rb").read() == open(fq, "rb").read(), fp
+
+
+def test_stream_handoff_prep_failure_falls_back_serial(tmp_path,
+                                                       monkeypatch):
+    """A device-prep dispatch failing ON THE PREFETCH WORKER degrades
+    that batch to the per-spectrum serial host-prep fallback instead of
+    aborting the run (the error travels as a value through the queue)."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+    from pypulsar_tpu.fourier import kernels as _k
+
+    # reference: the host-prep handoff (what the fallback computes)
+    assert cli_sweep.main([fil, "-o", "h", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--accel-only", "--no-accel-device-prep"]) == 0
+    ref = {os.path.basename(f)[1:]: open(f, "rb").read()
+           for f in sorted(glob.glob("h_DM*_ACCEL_20.cand"))}
+    assert len(ref) == 8
+
+    def boom(series, *a, **kw):
+        raise RuntimeError("synthetic device-prep failure")
+
+    monkeypatch.setattr(_k, "prep_spectra_batch", boom)
+    assert cli_sweep.main([fil, "-o", "x", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--accel-only"]) == 0
+    got = {os.path.basename(f)[1:]: open(f, "rb").read()
+           for f in sorted(glob.glob("x_DM*_ACCEL_20.cand"))}
+    assert got == ref
+
+
+def test_stream_handoff_auto_group_size_parity(tmp_path, monkeypatch):
+    """With --group-size left at its auto default (0), the handoff
+    resolves the SAME group size as the .dat chain (stage-1 groups
+    dedisperse at the group mean DM, so a different group is a different
+    series) — tables stay bit-identical without the explicit flag."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import accelsearch as cli_accel
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    args = ["--lodm", "0", "--dmstep", "10", "--numdms", "8", "-s", "8",
+            "--threshold", "8"]
+    monkeypatch.setenv("PYPULSAR_TPU_DATS_RESIDENT_LIMIT", "0")
+    assert cli_sweep.main([fil, "-o", "g", *args, "--write-dats"]) == 0
+    dats = sorted(glob.glob("g_DM*.dat"))
+    assert cli_accel.main([*dats, "--batch", "4", *ACCEL_ARGS]) == 0
+    assert cli_sweep.main([fil, "-o", "n", *args, *HANDOFF_ARGS,
+                           "--accel-only"]) == 0
+    fulls = sorted(glob.glob("g_DM*_ACCEL_20.cand"))
+    assert len(fulls) == 8
+    for fg in fulls:
+        fn = "n" + os.path.basename(fg)[1:]
+        assert open(fg, "rb").read() == open(fn, "rb").read(), fg

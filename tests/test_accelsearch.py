@@ -751,8 +751,10 @@ def test_cli_device_prep_matches_host_prep(tmp_path, monkeypatch):
         bases.append(_write_fake_dat(str(tmp_path / f"dp{ii}"), ts, dt))
 
     dats = [b + ".dat" for b in bases]
+    # --no-device-prep: device prep is DEFAULT-ON for --batch >= 2 since
+    # round 6, so the host-prep reference side must opt out explicitly
     rc = cli_accel.main(dats + ["--batch", "3", "-z", "20", "-n", "2",
-                                "-s", "3"])
+                                "-s", "3", "--no-device-prep"])
     assert rc == 0
     host_cands = {b: read_rzwcands(b + "_ACCEL_20.cand") for b in bases}
     for b in bases:
@@ -877,3 +879,87 @@ def test_cli_device_prep_batch_failure_falls_back_serial(tmp_path,
         got = [(round(c.r, 3), round(c.z, 3))
                for c in read_rzwcands(b + "_ACCEL_10.cand")]
         assert got == fallback[b], b
+
+
+# ---------------------------------------------------------------------------
+# the device-prep matched-candidate contract (VERDICT r5 item 2)
+# ---------------------------------------------------------------------------
+
+
+def _assert_candidate_contract(host_cands, dev_cands, floor, margin,
+                               dr, dz, dsig):
+    """The matched-candidate contract, as BENCHNOTES round-5 states it in
+    prose for 53/64 files: every candidate above ``floor + margin`` on
+    EITHER side has a partner on the other within (dr, dz, dsig), and no
+    unpartnered candidate on either side exceeds ``floor + margin`` —
+    i.e. device prep may flicker threshold-floor candidates but can
+    neither gain nor lose an above-floor detection."""
+    def matches(c, pool):
+        return any(abs(c.r - o.r) < dr and abs(c.z - o.z) < dz
+                   and abs(c.sigma - o.sigma) < dsig for o in pool)
+
+    for a, b, side in ((host_cands, dev_cands, "host"),
+                       (dev_cands, host_cands, "device")):
+        for c in a:
+            if not matches(c, b):
+                assert c.sigma <= floor + margin, (
+                    f"unmatched {side}-prep candidate above the "
+                    f"floor+margin contract bound: r={c.r:.2f} "
+                    f"z={c.z:.2f} sigma={c.sigma:.2f} "
+                    f"(bound {floor + margin:.2f})")
+
+
+def test_device_prep_candidate_contract():
+    """Device-prep vs host-prep accel over a battery of synthetic
+    spectra — constant tones, drifting tones, strong/weak/near-threshold
+    amplitudes — asserting the matched-candidate contract that justifies
+    flipping --device-prep default-on (VERDICT r5 item 2; documented in
+    README next to the 2e-6 SNR contract)."""
+    from pypulsar_tpu.fourier.accelsearch import accel_search_batch
+    from pypulsar_tpu.fourier.kernels import (deredden, deredden_schedule,
+                                              prep_spectra_batch)
+
+    rng = np.random.RandomState(42)
+    n = 1 << 15
+    dt = 2.5e-4
+    T = n * dt
+    floor, margin = 3.0, 0.5
+    cfg = AccelSearchConfig(zmax=20.0, dz=2.0, numharm=4, sigma_min=floor,
+                            seg_width=1 << 12)
+    t = np.arange(n) * dt
+    battery = []
+    # (f0 Hz, z bins over T, amplitude): strong, moderate, drifting both
+    # ways, WEAK near the detection floor, and pure noise
+    specs = [(37.0, 0.0, 0.30), (61.0, 0.0, 0.18),
+             (43.0, 8.0, 0.25), (29.0, -12.0, 0.25),
+             (53.0, 4.0, 0.10), (71.0, 0.0, 0.07),
+             (47.0, 0.0, 0.0)]
+    for f0, z, amp in specs:
+        ts = rng.standard_normal(n).astype(np.float32)
+        if amp > 0:
+            fdot = z / (T * T)
+            ts += amp * np.cos(2 * np.pi * (f0 * t
+                                            + 0.5 * fdot * t * t)
+                               ).astype(np.float32)
+        battery.append(ts)
+    series = np.stack(battery)
+
+    schedule = deredden_schedule(n // 2 + 1)
+    host = np.stack([
+        np.asarray(deredden(np.fft.rfft(s).astype(np.complex64),
+                            schedule=schedule))
+        for s in series])
+    host_out = accel_search_batch(host, T, cfg)
+    dev_out = accel_search_batch(prep_spectra_batch(series, schedule),
+                                 T, cfg)
+
+    n_detecting = 0
+    for hs, ds in zip(host_out, dev_out):
+        _assert_candidate_contract(hs, ds, floor, margin,
+                                   dr=0.5, dz=1.0, dsig=0.5)
+        # count SPECTRA with an above-floor detection, not candidates:
+        # one strong tone's harmonics must not mask the drifting/weak
+        # spectra all going dark
+        n_detecting += any(c.sigma > floor + margin for c in hs)
+    assert n_detecting >= len(specs) - 2, \
+        "battery too weak to exercise the contract"

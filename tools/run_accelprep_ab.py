@@ -89,10 +89,13 @@ def main(argv=None):
                         os.path.splitext(d)[0] + ".inf")
         dats.append(d)
 
-    legs = [("host", []), ("device", ["--device-prep"])]
+    # device prep is default-on for the grouped path since round 6, so
+    # the host leg must opt out explicitly
+    legs = [("host", ["--no-device-prep"]), ("device", ["--device-prep"])]
     if a.coarse_dz > 0:
         cd = ["--coarse-dz", str(a.coarse_dz)]
-        legs += [("coarse", cd), ("coarse_device", cd + ["--device-prep"])]
+        legs += [("coarse", cd + ["--no-device-prep"]),
+                 ("coarse_device", cd + ["--device-prep"])]
 
     walls, sets = {}, {}
     for name, extra in legs:

@@ -144,3 +144,37 @@ for nsub2, group2 in ((64, 32), (32, 32), (64, 64)):
     t0 = time.perf_counter(); force(fn()); el = time.perf_counter() - t0
     print(f"chunk-fourier s{nsub2} g{group2}  {el*1e3:8.1f} ms "
           f"({D/el:7.1f} trials/s/chunk)", file=sys.stderr)
+
+# accelsearch subharmonic stretch-gather at the batched stage geometry
+# (VERDICT r5 item 5, accel slice): the stage runner's plane build ends in
+# `jnp.take(p, idx, axis=2)` with a STATIC index vector shared by every
+# (spectrum, z-row) — unlike the per-element generic gather that measured
+# ~70M elem/s on this chip (the shift_channels 'rotate' cliff, BENCHNOTES
+# r5), a shared last-axis index can lower as a vectorizable copy pattern.
+# This measures which lowering the real shape actually gets; the verdict
+# lands in the BENCHNOTES gather-audit table.
+segw_a, La, Za, Ba = 1 << 14, 1 << 15, 201, 8
+p_planes = jax.random.normal(key, (Ba, Za, 2 * La), dtype=jnp.float32)
+for rho_num, rho_den in ((1, 2), (7, 8)):
+    rf = rho_num / rho_den
+    rel = np.floor(rf * np.arange(2 * segw_a) + 0.5).astype(np.int64)
+    idx_a = jnp.asarray(((rel % 2) * La + rel // 2).astype(np.int32))
+    force(p_planes[:1, :1, :1])
+    t = timeit(jax.jit(lambda p, i: jnp.take(p, i, axis=2)),
+               p_planes, idx_a) - overhead
+    elems = Ba * Za * 2 * segw_a
+    print(f"accel stretch-gather rho={rho_num}/{rho_den} "
+          f"[{Ba},{Za},2x{segw_a} of {2*La}] {t*1e3:8.1f} ms  "
+          f"{elems/t/1e6:8.1f}M elem/s", file=sys.stderr)
+# reference point: the generic per-element gather formulation of the same
+# stretch (index varies per row -> the cliff lowering), for the A/B
+idx_rows = jnp.asarray(np.stack([
+    ((np.floor(0.5 * (np.arange(2 * segw_a) + rr % 3) + 0.5)
+      .astype(np.int64) % 2) * La
+     + np.floor(0.5 * (np.arange(2 * segw_a) + rr % 3) + 0.5)
+     .astype(np.int64) // 2).astype(np.int32)
+    for rr in range(Za)]))
+t = timeit(jax.jit(lambda p, i: jnp.take_along_axis(p, i[None], axis=2)),
+           p_planes, idx_rows) - overhead
+print(f"accel stretch per-row gather (cliff formulation)  {t*1e3:8.1f} ms  "
+      f"{Ba*Za*2*segw_a/t/1e6:8.1f}M elem/s", file=sys.stderr)
