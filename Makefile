@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-telemetry native clean
+.PHONY: test test-fourier test-faults dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-telemetry native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -20,6 +20,12 @@ test:
 # the whole suite with the TPU-default engine forced (cross-engine check)
 test-fourier:
 	PYPULSAR_TPU_SWEEP_ENGINE=fourier $(CPU_ENV) $(PY) -m pytest tests/ -q
+
+# the resilience suite: injected OOM / IO errors / kill+resume at every
+# journal kill-point, candidate tables proven bit-identical to unfaulted
+# runs (docs/ARCHITECTURE.md "Failure model & recovery")
+test-faults:
+	$(CPU_ENV) $(PY) -m pytest tests/test_resilience.py -q
 
 dryrun:
 	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -24,6 +24,10 @@ for JAX/XLA/Pallas on TPU:
 - ``obs``      : structured telemetry (spans / counters / device stats) with a
                  JSONL sink and the ``tlmsum`` summarizer; ``utils.profiling``
                  is a shim over it.
+- ``resilience``: failure-handling substrate — OOM-adaptive dispatch
+                 halving, journaled size/sha256-validated resume, atomic
+                 outputs, deterministic fault injection
+                 (docs/ARCHITECTURE.md "Failure model & recovery").
 """
 
 __version__ = "0.1.0"

@@ -161,6 +161,9 @@ def build_parser():
                    help="output base name (default: input base)")
     telemetry.add_telemetry_flag(
         p, what="prep/search/write spans, batch counters, fallbacks")
+    from pypulsar_tpu.resilience import faultinject
+
+    faultinject.add_fault_flag(p)
     return p
 
 
@@ -209,11 +212,24 @@ def write_results(infile, cands, T, args):
 
 def _skip_existing(infile, args) -> bool:
     """True when --skip-existing says this input's .cand is already done
-    (shared by both prep paths so skip semantics can't diverge)."""
-    candfn, _ = _out_names(infile, args)
-    if args.skip_existing and os.path.exists(candfn):
+    (shared by both prep paths so skip semantics can't diverge).
+
+    Existence is not completion: the .cand must VALIDATE (whole
+    fourierprops records, .txtcand twin with matching row count —
+    resilience.candfile_complete) or the input is re-searched. A
+    zero-byte .cand from a killed run used to be treated as done, which
+    permanently wedged that trial out of every restarted batch."""
+    if not args.skip_existing:
+        return False
+    from pypulsar_tpu.resilience.journal import candfile_complete
+
+    candfn, txtfn = _out_names(infile, args)
+    if candfile_complete(candfn, txtfn):
         print(f"# {infile}: {candfn} exists, skipping", file=sys.stderr)
         return True
+    if os.path.exists(candfn):
+        print(f"# {infile}: {candfn} exists but FAILS validation "
+              f"(truncated or killed run?); re-searching", file=sys.stderr)
     return False
 
 
@@ -273,6 +289,11 @@ def main(argv=None):
         wmax=args.wmax, dw=args.dw,
         coarse_dz=args.coarse_dz, coarse_power_frac=args.coarse_frac,
     )
+    from pypulsar_tpu.resilience import faultinject
+
+    faultinject.configure_from_env()
+    if args.fault_inject:
+        faultinject.configure(args.fault_inject)
     with telemetry.session_from_flag(args.telemetry, tool="accelsearch"):
         return _run(args, cfg)
 
@@ -374,17 +395,24 @@ def _run(args, cfg):
             ready (infile, payload, T, kind, None) record or the file's
             prep error (infile, None, None, None, exc) — errors travel
             as values so the per-file failure policy stays with the
-            consumer even when prep runs on the prefetch thread."""
+            consumer even when prep runs on the prefetch thread. The
+            prep (the actual .dat/.fft read) runs under the transient-IO
+            retry policy: one NFS hiccup must not mark the file failed
+            for the whole restartable batch."""
+            from pypulsar_tpu.resilience.retry import retry_transient
+
             for infile in args.infiles:
                 try:
                     with telemetry.span("accel_prep_host", infile=infile):
-                        prep = (prepare_one_series(infile, args)
-                                if args.device_prep else _HOST)
-                        if prep is _HOST:  # explicit host-path sentinel
-                            prep = prepare_one(infile, args)
-                            kind = "norm"
-                        else:
-                            kind = "series"
+                        def attempt(infile=infile):
+                            p = (prepare_one_series(infile, args)
+                                 if args.device_prep else _HOST)
+                            if p is _HOST:  # explicit host-path sentinel
+                                return prepare_one(infile, args), "norm"
+                            return p, "series"
+
+                        prep, kind = retry_transient(attempt, retries=2,
+                                                     what="accel.read")
                 except Exception as e:  # noqa: BLE001 - consumer decides
                     yield infile, None, None, None, e
                     continue
@@ -402,7 +430,7 @@ def _run(args, cfg):
             from pypulsar_tpu.parallel.prefetch import prefetch
 
             source = prefetch(prepped_inputs(), depth=args.prefetch,
-                              name="accel.prep")
+                              name="accel.prep", retries=2)
         else:
             source = prepped_inputs()
         for infile, payload, T, kind, err in source:

@@ -29,7 +29,7 @@ import numpy as np
 
 from pypulsar_tpu.io.accelcands import Candidate, write_candlist
 from pypulsar_tpu.io.infodata import InfoData
-from pypulsar_tpu.io.prestocand import read_rzwcands
+from pypulsar_tpu.io.prestocand import FOURIERPROPS_DTYPE, read_rzwcands
 
 _DM_RE = re.compile(r"DM(\d+(?:\.\d+)?)")
 
@@ -47,7 +47,15 @@ def infer_dm(path: str, inf) -> float:
 
 
 def collect(candfns: List[str]):
-    """[(candfn, dm, T, cands)] for every readable candidate file."""
+    """[(candfn, dm, T, cands)] for every readable candidate file.
+
+    Integrity-checked: a .cand whose size is not a whole number of
+    fourierprops records (truncation debris from a killed writer) is
+    SKIPPED WITH A WARNING rather than silently read short —
+    np.fromfile would otherwise drop the torn tail record and poison
+    the sift with a partial trial."""
+    from pypulsar_tpu.resilience.journal import candfile_complete
+
     out = []
     for fn in sorted(candfns):
         base = fn.split("_ACCEL_")[0]
@@ -55,6 +63,23 @@ def collect(candfns: List[str]):
         if not os.path.exists(inffn):
             print(f"# skipping {fn}: no {inffn}", file=sys.stderr)
             continue
+        # validate against the .txtcand twin when it exists: the pair's
+        # header/row-count agreement is what tells a legitimately EMPTY
+        # result (0 records + header-only txt) from truncation debris.
+        # A foreign .cand without a twin only gets the record-alignment
+        # check (an empty one is simply zero candidates)
+        txtfn = fn[:-5] + ".txtcand" if fn.endswith(".cand") else None
+        if txtfn is not None and not os.path.exists(txtfn):
+            txtfn = None
+        if os.path.exists(fn):
+            rec_bytes = FOURIERPROPS_DTYPE.itemsize
+            ok = (candfile_complete(fn, txtfn) if txtfn is not None
+                  else os.path.getsize(fn) % rec_bytes == 0)
+            if not ok:
+                print(f"# skipping {fn}: fails integrity validation "
+                      f"(truncated .cand? re-run its search)",
+                      file=sys.stderr)
+                continue
         try:
             inf = InfoData(inffn)
             T = float(inf.dt) * int(inf.N)
@@ -139,11 +164,53 @@ def build_parser():
                    help="min DM trials a cluster must appear in (default 2)")
     p.add_argument("--min-dm", type=float, default=None,
                    help="drop clusters whose best DM is below this")
+    p.add_argument("--journal", default=None, metavar="PATH.jsonl",
+                   help="record the sifted .accelcands artifact in this "
+                        "work-unit journal (resilience.RunJournal; with "
+                        "-o only): a rerun whose output unit validates "
+                        "(size+sha256) is a no-op — the sift end of the "
+                        "sweep->accel->sift chain manifest")
     return p
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    journal = None
+    unit = None
+    if args.journal:
+        if not args.outfile:
+            build_parser().error("--journal requires -o/--outfile "
+                                 "(stdout cannot be validated on resume)")
+        import hashlib
+
+        from pypulsar_tpu.resilience.journal import RunJournal, file_digest
+
+        # the fingerprint hashes input CONTENT (size + sha256), not just
+        # names: a re-searched trial whose .cand changed must re-sift,
+        # not no-op against the stale output. Inputs are <=200 records
+        # (~17 KB) each, so digesting the set is cheap.
+        h = hashlib.sha256()
+        for fn in sorted(args.candfiles):
+            h.update(fn.encode() + b"\0")
+            try:
+                size, digest = file_digest(fn)
+                h.update(np.int64([size]).tobytes() + digest.encode())
+            except OSError:
+                h.update(b"missing")
+        h.update(np.float64([args.min_sigma,
+                             args.min_dm if args.min_dm is not None
+                             else -1.0]).tobytes())
+        h.update(np.int64([args.min_hits]).tobytes())
+        h.update(args.outfile.encode())
+        # tool="sift": pointing this flag at the sweep->accel chain's
+        # journal raises instead of silently truncating that manifest
+        journal = RunJournal(args.journal, h.hexdigest(), tool="sift")
+        unit = f"sift:{os.path.basename(args.outfile)}"
+        if unit in journal.completed():
+            print(f"# journal: {args.outfile} validated complete, "
+                  f"skipping", file=sys.stderr)
+            journal.close()
+            return 0
     files = collect(args.candfiles)
     cands = sift(files, min_sigma=args.min_sigma, min_hits=args.min_hits)
     if args.min_dm is not None:
@@ -152,6 +219,9 @@ def main(argv=None):
     if args.outfile:
         print(f"# {len(cands)} sifted candidates -> {args.outfile}",
               file=sys.stderr)
+    if journal is not None:
+        journal.done(unit, [args.outfile])
+        journal.close()
     return 0
 
 

@@ -33,18 +33,22 @@ def _open_reader(fn: str):
 
 
 def _write_cands(path, cands, extra_cols=()):
-    """Write candidate/event/pulse rows; ``extra_cols`` appends
-    (header, key, fmt) columns after the shared six."""
-    with open(path, "w") as f:
-        f.write("# DM      SNR      time_s       sample    width_bins  "
-                "downsamp" + "".join("  " + h for h, _, _ in extra_cols)
-                + "\n")
-        for c in cands:
-            f.write(f"{c['dm']:<9.4f} {c['snr']:<8.3f} {c['time_sec']:<12.6f} "
-                    f"{c['sample']:<9d} {c['width_bins']:<11d} "
-                    f"{c['downsamp']:<8d}"
-                    + "".join("  " + fmt % c[k] for _, k, fmt in extra_cols)
-                    + "\n")
+    """Write candidate/event/pulse rows atomically (tmp + os.replace —
+    downstream consumers must never see a truncated table); ``extra_cols``
+    appends (header, key, fmt) columns after the shared six."""
+    from pypulsar_tpu.resilience.journal import atomic_write_text
+
+    lines = ["# DM      SNR      time_s       sample    width_bins  "
+             "downsamp" + "".join("  " + h for h, _, _ in extra_cols)
+             + "\n"]
+    for c in cands:
+        lines.append(
+            f"{c['dm']:<9.4f} {c['snr']:<8.3f} {c['time_sec']:<12.6f} "
+            f"{c['sample']:<9d} {c['width_bins']:<11d} "
+            f"{c['downsamp']:<8d}"
+            + "".join("  " + fmt % c[k] for _, k, fmt in extra_cols)
+            + "\n")
+    atomic_write_text(path, "".join(lines))
 
 
 def _write_dats_auto(outbase, reader, dms, args, rfimask=None):
@@ -530,6 +534,14 @@ def main(argv=None):
     ap.add_argument("--resume", action="store_true",
                     help="resume from an existing --checkpoint file "
                          "(without this flag stale checkpoints are removed)")
+    ap.add_argument("--journal", default=None, metavar="PATH.jsonl",
+                    help="flat single-file mode: keep a per-run JSONL "
+                         "work-unit journal (resilience.RunJournal) of "
+                         "completed artifacts across the sweep->accel "
+                         "chain, with per-output size/sha256 validation "
+                         "on resume — a truncated artifact is redone, "
+                         "never trusted; rerunning with the same journal "
+                         "skips validated-complete units")
     ap.add_argument("--time-shard", action="store_true",
                     help="multi-host mode for ONE file: each host streams "
                          "its own contiguous window of the time axis "
@@ -550,11 +562,16 @@ def main(argv=None):
                     help="multi-host mode: this host's rank "
                          "($PYPULSAR_TPU_PROCESS_ID)")
     from pypulsar_tpu.obs import telemetry
+    from pypulsar_tpu.resilience import faultinject
 
     telemetry.add_telemetry_flag(
         ap, what="per-chunk spans, H2D/D2H byte counters, device stats")
+    faultinject.add_fault_flag(ap)
     args = ap.parse_args(argv)
 
+    faultinject.configure_from_env()
+    if args.fault_inject:
+        faultinject.configure(args.fault_inject)
     with telemetry.session_from_flag(args.telemetry, tool="sweep"):
         return _main_parsed(args, ap)
 
@@ -586,6 +603,11 @@ def _main_parsed(args, ap):
             ap.error("--accel-search streams ONE file on this host")
     if args.accel_only and not args.accel_search:
         ap.error("--accel-only requires --accel-search")
+    if args.journal and (args.ddplan or args.time_shard
+                         or len(args.infile) > 1):
+        ap.error("--journal is a flat single-file option (the journal "
+                 "manifests one sweep->accel chain; DDplan/multi-host "
+                 "runs have their own checkpoint machinery)")
     widths = tuple(int(w) for w in args.widths.split(","))
     dist.initialize(args.coordinator, args.num_processes, args.process_id)
     if args.time_shard:
@@ -634,17 +656,41 @@ def _main_parsed(args, ap):
         if args.numdms is None:
             ap.error("flat mode requires --numdms (or use --ddplan)")
         dms = args.lodm + args.dmstep * np.arange(args.numdms)
+        journal = None
+        journal_done = set()
+        if args.journal:
+            from pypulsar_tpu.resilience.journal import RunJournal
+
+            journal = RunJournal(
+                args.journal,
+                _journal_fingerprint(args, dms, widths, outbase),
+                tool="sweep-accel")
+            journal_done = journal.completed()
+        _remove_stale_output_tmps(outbase, dms, args)
         staged = None
         if not args.accel_only:
-            staged = sweep_flat(reader, dms, downsamp=args.downsamp,
-                                nsub=args.nsub, group_size=args.group_size,
-                                widths=widths, chunk_payload=args.chunk,
-                                mesh=mesh,
-                                checkpoint_path=args.checkpoint,
-                                checkpoint_every=args.checkpoint_every,
-                                engine=args.engine,
-                                keep_chunk_peaks=args.all_events,
-                                rfimask=rfimask)
+            if journal is not None and "sweep:cands" in journal_done:
+                # the manifest says the single-pulse pass's artifacts are
+                # on disk, complete and checksum-valid — resume straight
+                # into the accel chain instead of re-sweeping
+                print(f"# journal: {outbase}.cands validated complete; "
+                      f"skipping the single-pulse sweep pass")
+            else:
+                staged = sweep_flat(reader, dms, downsamp=args.downsamp,
+                                    nsub=args.nsub,
+                                    group_size=args.group_size,
+                                    widths=widths, chunk_payload=args.chunk,
+                                    mesh=mesh,
+                                    checkpoint_path=args.checkpoint,
+                                    checkpoint_every=args.checkpoint_every,
+                                    engine=args.engine,
+                                    keep_chunk_peaks=args.all_events,
+                                    rfimask=rfimask)
+                # publish (and journal) the sweep artifacts BEFORE the
+                # accel stage: a kill during the (long) accel chain must
+                # not force a resumed run to re-sweep
+                _emit_sweep_artifacts(staged, outbase, args, journal)
+                staged = None
         if args.accel_search:
             # streamed sweep->accel handoff: the dedispersed series feed
             # prep_spectra_batch/accel_search_batch in RAM; --write-dats
@@ -670,7 +716,8 @@ def _main_parsed(args, ap):
                 max_cands=args.accel_max_cands,
                 device_prep=args.accel_device_prep,
                 skip_existing=args.accel_skip_existing,
-                prefetch_depth=args.accel_prefetch, verbose=True)
+                prefetch_depth=args.accel_prefetch,
+                journal=journal, verbose=True)
             print(f"# accel handoff: {summary['n_searched']} trials "
                   f"searched, {summary['n_skipped']} skipped"
                   + (f", {summary['serial_fallbacks']} serial fallbacks"
@@ -685,19 +732,74 @@ def _main_parsed(args, ap):
                 rc = 1
         elif args.write_dats:
             _write_dats_auto(outbase, reader, dms, args, rfimask=rfimask)
+        if journal is not None:
+            journal.close()
 
-    if staged is not None:
-        hits = staged.above_threshold(args.threshold)
-        _write_cands(outbase + ".cands", hits)
-        if args.all_events:
-            _emit_events(staged, outbase, args)
-        print(f"# {staged.n_trials} DM trials swept; {len(hits)} detections "
-              f">= {args.threshold} sigma -> {outbase}.cands")
-        for c in staged.best(args.topk):
-            print(f"DM {c['dm']:8.3f}  SNR {c['snr']:7.2f}  t "
-                  f"{c['time_sec']:10.4f}s  width {c['width_bins']:3d} bins "
-                  f"({c['width_sec']*1e3:.2f} ms)  ds {c['downsamp']}")
+    if staged is not None:  # the DDplan path emits at the end
+        _emit_sweep_artifacts(staged, outbase, args, None)
     return rc
+
+
+def _journal_fingerprint(args, dms, widths, outbase) -> str:
+    """Hash of everything that determines the flat chain's artifacts —
+    including ``outbase``, which names them: a rerun under a different -o
+    must produce its own artifacts, not skip against the old ones. A
+    journal written under different parameters must not be resumed."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(np.asarray(dms, dtype=np.float64).tobytes())
+    h.update(np.int64(widths).tobytes())
+    h.update(np.float64([args.threshold, args.accel_zmax, args.accel_dz,
+                         args.accel_sigma]).tobytes())
+    h.update(np.int64([args.downsamp, args.nsub, args.group_size,
+                       args.accel_numharm, int(bool(args.accel_search)),
+                       int(bool(args.all_events)),
+                       args.accel_max_cands,
+                       # device- and host-prep candidates only match
+                       # within tolerance, not bit-identically: a resume
+                       # must not mix prep provenances in one run
+                       int(bool(args.accel_device_prep))]).tobytes())
+    h.update((args.infile + "|" + (args.maskfile or "")
+              + "|" + outbase).encode())
+    return h.hexdigest()
+
+
+def _remove_stale_output_tmps(outbase, dms, args):
+    """Remove tmp debris a killed run's atomic writers can leave — the
+    EXACT derived names only (never a glob: a prefix pattern could match
+    unrelated user files): per-DM .dat/.inf staging tmps plus the accel
+    handoff's .cand/.txtcand tmps."""
+    from pypulsar_tpu.parallel.accelpipe import accel_out_names
+
+    for dm in dms:
+        base = f"{outbase}_DM{dm:.2f}"
+        stale = [base + ".dat.tmp", base + ".inf.tmp"]
+        candfn, txtfn = accel_out_names(base, args.accel_zmax, 0.0)
+        stale += [candfn + ".tmp", txtfn + ".tmp"]
+        for fn in stale:
+            if os.path.exists(fn):
+                os.remove(fn)
+
+
+def _emit_sweep_artifacts(staged, outbase, args, journal):
+    """Write the single-pulse artifacts (.cands + optional .events/
+    .pulses), record them in the run journal, and print the summary —
+    one definition for the flat and DDplan paths."""
+    hits = staged.above_threshold(args.threshold)
+    _write_cands(outbase + ".cands", hits)
+    outputs = [outbase + ".cands"]
+    if args.all_events:
+        _emit_events(staged, outbase, args)
+        outputs += [outbase + ".events", outbase + ".pulses"]
+    if journal is not None:
+        journal.done("sweep:cands", outputs)
+    print(f"# {staged.n_trials} DM trials swept; {len(hits)} detections "
+          f">= {args.threshold} sigma -> {outbase}.cands")
+    for c in staged.best(args.topk):
+        print(f"DM {c['dm']:8.3f}  SNR {c['snr']:7.2f}  t "
+              f"{c['time_sec']:10.4f}s  width {c['width_bins']:3d} bins "
+              f"({c['width_sec']*1e3:.2f} ms)  ds {c['downsamp']}")
 
 
 if __name__ == "__main__":

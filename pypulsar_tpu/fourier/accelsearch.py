@@ -900,19 +900,36 @@ def accel_search_batch(
                                           tuple(bank_meta),
                                           mesh_batch=mesh_devices)
         ids_dev = jnp.asarray(seg_ids, dtype=jnp.int32)
+        from pypulsar_tpu.resilience import faultinject
+        from pypulsar_tpu.resilience.retry import halving_dispatch
+
         for c0 in range(0, B, chunk):
             # slice (not pad): a short tail chunk costs one extra compile
             # for its shape but never ships dead spectra through the scan
-            sl = spec_pad2[c0:c0 + chunk]
-            nb = int(sl.shape[0])
-            telemetry.counter("accel.stage_dispatches")
-            with telemetry.span("accel_stage_batch", H=int(H), batch=nb,
-                                n_seg=int(len(seg_ids))):
-                # [len(seg_ids), nb, Wn, k] each; one batched pull
-                vals, zi, ri, neigh = pull_host(*runner(
-                    sl, tuple(tfs), tuple(idxs), top_lo, top_hi,
-                    jnp.float32(thresh_val), ids_dev))
-            yield c0, nb, vals, zi, ri, neigh
+            nc = min(chunk, B - c0)
+
+            def dispatch(lo, hi, c0=c0):
+                faultinject.trip("accel.stage_dispatch")
+                sl = spec_pad2[c0 + lo:c0 + hi]
+                telemetry.counter("accel.stage_dispatches")
+                with telemetry.span("accel_stage_batch", H=int(H),
+                                    batch=int(hi - lo),
+                                    n_seg=int(len(seg_ids))):
+                    # [len(seg_ids), nb, Wn, k] each; one batched pull
+                    return pull_host(*runner(
+                        sl, tuple(tfs), tuple(idxs), top_lo, top_hi,
+                        jnp.float32(thresh_val), ids_dev))
+
+            # the HBM budget is an estimate: a chunk it admitted that
+            # still RESOURCE_EXHAUSTs auto-halves with bounded backoff
+            # (per-spectrum results are independent — the halves are the
+            # chunk, bit-identically). Sharded chunks stay divisible by
+            # the mesh via min_size
+            for lo, hi, outs in halving_dispatch(
+                    dispatch, nc, min_size=max(1, mesh_devices),
+                    what="accel.stage"):
+                vals, zi, ri, neigh = outs
+                yield c0 + lo, hi - lo, vals, zi, ri, neigh
 
     def coarse_hits(H, banks_c, Zc, thresh_val, seg_ids):
         hit = np.zeros(len(seg_ids), bool)

@@ -118,8 +118,15 @@ def write_candlist(candlist: Sequence[Candidate],
     if fn is None:
         fn = sys.stdout
     if isinstance(fn, str):
-        with open(fn, "w") as f:
+        # atomic (tmp + os.replace): the .accelcands is the chain's final
+        # published artifact — downstream readers must never see a
+        # truncation from a killed writer
+        import os
+
+        tmp = fn + ".tmp"
+        with open(tmp, "w") as f:
             _write(candlist, f)
+        os.replace(tmp, fn)
     else:
         _write(candlist, fn)
 

@@ -184,9 +184,12 @@ class Datfile:
 
 def write_dat(basefn: str, data: np.ndarray, inf: InfoData):
     """Write a .dat/.inf pair (the artifact boundary the pipeline checkpoints
-    at; SURVEY.md §5 'Checkpoint / resume')."""
+    at; SURVEY.md §5 'Checkpoint / resume'). Both writes are atomic
+    (tmp + os.replace): a .dat on its published name is always complete."""
     data = np.asarray(data, dtype=np.float32)
-    data.tofile(basefn + ".dat")
+    tmp = basefn + ".dat.tmp"
+    data.tofile(tmp)
+    os.replace(tmp, basefn + ".dat")
     inf.basenm = os.path.basename(basefn)
     inf.N = len(data)
     inf.to_file(basefn + ".inf")

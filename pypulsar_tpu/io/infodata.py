@@ -132,8 +132,12 @@ class InfoData:
         out.append(" Any additional notes:\n")
         for note in self.notes:
             out.append(note if note.endswith("\n") else note + "\n")
-        with open(inffn, "w") as f:
-            f.writelines(out)
+        # atomic (tmp + os.replace): sift and the plotting tools trust
+        # .inf sidecars blindly — a killed run must never leave a
+        # truncated one on the published name
+        from pypulsar_tpu.resilience.journal import atomic_write_text
+
+        atomic_write_text(inffn, "".join(out))
 
 
 def infodata(inffn: str) -> InfoData:

@@ -104,7 +104,16 @@ class Telemetry:
         self.event_counts: Dict[str, int] = {}
         self.path = path
         self._last_counter_flush = 0.0
-        self._fh = open(path, "w") if path else None
+        self._sink_warned = False
+        self._fh = None
+        if path:
+            # an unwritable trace path must degrade the run to memory-only
+            # telemetry, never abort it: observability is a passenger, the
+            # survey is the payload
+            try:
+                self._fh = open(path, "w")
+            except OSError as e:
+                self._warn_sink(e)
         if self._fh is not None:
             rec = {"type": "meta", "version": SCHEMA_VERSION,
                    "t_unix": time.time(), "argv": list(sys.argv)}
@@ -117,15 +126,36 @@ class Telemetry:
     def _now(self) -> float:
         return time.perf_counter() - self._t0
 
+    def _warn_sink(self, e: OSError) -> None:
+        """Warn ONCE that the JSONL sink is gone (unwritable path, disk
+        full, fd yanked); subsequent records drop silently. In-memory
+        counters/stages keep collecting either way."""
+        if not self._sink_warned:
+            self._sink_warned = True
+            print(f"# telemetry: sink {self.path!r} unwritable "
+                  f"({type(e).__name__}: {e}); dropping further trace "
+                  f"records (run continues)", file=sys.stderr)
+
     def _write(self, rec: Dict[str, Any]) -> None:
         if self._fh is None:
             return
         line = json.dumps(rec, default=str) + "\n"
         with self._lock:
-            self._fh.write(line)
-            # flush per record: a killed/OOM'd run keeps its trace —
-            # records are span/chunk granularity, never per-sample
-            self._fh.flush()
+            if self._fh is None:  # sink died under another thread
+                return
+            try:
+                self._fh.write(line)
+                # flush per record: a killed/OOM'd run keeps its trace —
+                # records are span/chunk granularity, never per-sample
+                self._fh.flush()
+            except OSError as e:
+                # disk-full / EBADF mid-run: drop the sink, keep the run
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+                self._warn_sink(e)
 
     def _stack(self) -> list:
         st = getattr(self._tls, "stack", None)
@@ -215,8 +245,9 @@ class Telemetry:
         self._write({"type": "stages", "stages": stages})
         self._write({"type": "end", "wall": round(self._now(), 6)})
         with self._lock:
-            self._fh.close()
-            self._fh = None
+            if self._fh is not None:  # sink may have died mid-run
+                self._fh.close()
+                self._fh = None
 
 
 @contextlib.contextmanager

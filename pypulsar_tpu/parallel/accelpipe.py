@@ -38,12 +38,16 @@ searches instead of failing its whole batch.
 
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import Optional, Tuple
 
 import numpy as np
 
 from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.resilience.journal import RunJournal, candfile_complete
+from pypulsar_tpu.resilience.retry import halving_dispatch
 
 __all__ = [
     "accel_out_names",
@@ -67,21 +71,25 @@ def accel_out_names(outbase: str, zmax: float, wmax: float = 0.0
 def write_candfiles(candfn: str, txtfn: str, cands, T: float,
                     max_cands: int = 200) -> str:
     """Write one spectrum's .txtcand + .cand pair (shared by the .dat CLI
-    and the streamed handoff). .txtcand first, .cand last: the .cand's
-    existence is the restart completeness marker."""
+    and the streamed handoff). Both writes are atomic (tmp + os.replace)
+    and ordered .txtcand first, .cand last: the .cand's existence is the
+    restart completeness marker, and resilience.candfile_complete uses
+    the pair's header/row-count agreement to tell a legitimately empty
+    result from a killed run's debris."""
     from pypulsar_tpu.io.prestocand import write_rzwcands
+    from pypulsar_tpu.resilience.journal import atomic_write_text
 
     cands = cands[:max_cands]
-    with open(txtfn, "w") as f:
-        f.write("# cand   sigma    power  numharm          r          z"
-                "        freq(Hz)       fdot(Hz/s)      period(s)\n")
-        for i, c in enumerate(cands):
-            freq = c.freq(T)
-            f.write(
-                f"{i + 1:6d} {c.sigma:7.2f} {c.power:8.2f} {c.numharm:8d} "
-                f"{c.r:10.2f} {c.z:10.2f} {freq:15.8f} "
-                f"{c.fdot(T):16.6e} {1.0 / freq:14.10f}\n"
-            )
+    lines = ["# cand   sigma    power  numharm          r          z"
+             "        freq(Hz)       fdot(Hz/s)      period(s)\n"]
+    for i, c in enumerate(cands):
+        freq = c.freq(T)
+        lines.append(
+            f"{i + 1:6d} {c.sigma:7.2f} {c.power:8.2f} {c.numharm:8d} "
+            f"{c.r:10.2f} {c.z:10.2f} {freq:15.8f} "
+            f"{c.fdot(T):16.6e} {1.0 / freq:14.10f}\n"
+        )
+    atomic_write_text(txtfn, "".join(lines))
     write_rzwcands(candfn, [c.as_fourierprops() for c in cands])
     return candfn
 
@@ -105,6 +113,7 @@ def stream_series(
     from pypulsar_tpu.parallel.staged import (
         _ReaderSource,
         dat_append_rows,
+        dat_finalize_paths,
         dat_truncate_paths,
         dats_geometry,
         iter_dedispersed_chunks,
@@ -133,6 +142,7 @@ def stream_series(
             if paths is not None:
                 dat_append_rows(paths, rows)
     if dat_outbase is not None:
+        dat_finalize_paths(paths)
         write_dat_infs(dat_outbase, reader, dms, T, dt_eff)
     return buf, dt_eff
 
@@ -147,6 +157,29 @@ def _host_prep_rows(rows: np.ndarray, schedule) -> np.ndarray:
         np.asarray(deredden(np.fft.rfft(r).astype(np.complex64),
                             schedule=schedule))
         for r in rows])
+
+
+def _run_fingerprint(dms, config, outbase: str, downsamp: int, nsub: int,
+                     group_size: int, max_cands: int, device_prep: bool,
+                     rfimask) -> str:
+    """Journal fingerprint of everything that determines this handoff's
+    artifacts — ``max_cands`` (caps the .cand contents), ``device_prep``
+    (host/device candidates match only within tolerance, never
+    bit-identically) and the applied rfimask (a different zap table is a
+    different series). Resuming under different parameters must start
+    over, exactly the SweepCheckpoint contract."""
+    from pypulsar_tpu.parallel.staged import _mask_tag
+
+    h = hashlib.sha256()
+    h.update(np.asarray(dms, dtype=np.float64).tobytes())
+    h.update(np.float64([config.zmax, config.dz, config.sigma_min,
+                         config.wmax, config.dw]).tobytes())
+    h.update(np.int64([config.numharm, downsamp, nsub,
+                       group_size, max_cands,
+                       int(bool(device_prep))]).tobytes())
+    h.update(outbase.encode())
+    h.update(_mask_tag(rfimask).encode())
+    return h.hexdigest()
 
 
 def sweep_accel_stream(
@@ -166,13 +199,25 @@ def sweep_accel_stream(
     device_prep: bool = True,
     skip_existing: bool = False,
     prefetch_depth: int = 1,
+    journal_path: Optional[str] = None,
+    journal: Optional[RunJournal] = None,
     verbose: bool = False,
 ) -> dict:
     """Dedisperse ``dms`` over ``reader`` and accel-search every trial,
     writing ``{outbase}_DM{dm:.2f}_ACCEL_{zmax}.cand/.txtcand`` exactly
     as ``cli accelsearch`` would for the corresponding .dat files — but
     with the series handed over in RAM (see module docstring). Returns a
-    summary dict (searched/skipped counts, serial fallbacks, paths)."""
+    summary dict (searched/skipped counts, serial fallbacks, paths).
+
+    Resume: ``skip_existing`` skips trials whose .cand/.txtcand pair
+    VALIDATES (resilience.candfile_complete — a zero-byte .cand from a
+    killed run is redone, not trusted); ``journal_path`` additionally
+    keeps a fingerprinted work-unit journal (resilience.RunJournal) whose
+    entries are size/sha256-checked on load, so a truncated or swapped
+    artifact is also redone. A batched search that hits device
+    RESOURCE_EXHAUSTED auto-halves with bounded backoff
+    (resilience.retry.halving_dispatch) before the serial fallback is
+    even considered."""
     from pypulsar_tpu.fourier.accelsearch import (
         accel_search,
         accel_search_batch,
@@ -186,12 +231,29 @@ def sweep_accel_stream(
     D = len(dms)
     bases = [f"{outbase}_DM{dm:.2f}" for dm in dms]
     names = [accel_out_names(b, config.zmax, config.wmax) for b in bases]
-    todo = [i for i in range(D)
-            if not (skip_existing and os.path.exists(names[i][0]))]
+    units = [f"cand:DM{dm:.2f}" for dm in dms]
+    own_journal = journal is None and bool(journal_path)
+    if own_journal:
+        journal = RunJournal(journal_path, _run_fingerprint(
+            dms, config, outbase, downsamp, nsub, group_size, max_cands,
+            device_prep, rfimask), tool="sweep-accel")
+    journal_done: set = (journal.completed() if journal is not None
+                         else set())
+
+    def trial_done(i: int) -> bool:
+        if journal is not None and units[i] in journal_done:
+            return True  # journal entries are already disk-validated
+        return skip_existing and candfile_complete(names[i][0],
+                                                   names[i][1])
+
+    todo = [i for i in range(D) if not trial_done(i)]
     n_skipped = D - len(todo)
     if n_skipped and verbose:
-        print(f"# {n_skipped}/{D} trials already have .cands, skipping")
+        print(f"# {n_skipped}/{D} trials already have validated .cands, "
+              f"skipping")
     if not todo and not write_dats:
+        if own_journal:
+            journal.close()
         return {"n_searched": 0, "n_skipped": n_skipped, "n_failed": 0,
                 "serial_fallbacks": 0,
                 "cand_paths": [n[0] for n in names]}
@@ -265,6 +327,7 @@ def sweep_accel_stream(
             chunk_payload=chunk_payload,
             dat_outbase=outbase if write_dats else None,
             verbose=verbose)
+        faultinject.trip("accel.after_stream")  # kill-point (journal test)
         T_sec = T * dt_eff
 
         def groups():
@@ -295,16 +358,32 @@ def sweep_accel_stream(
             from pypulsar_tpu.parallel.prefetch import prefetch
 
             source = prefetch(groups(), depth=prefetch_depth,
-                              name="accel.pipe", transform=prep)
+                              name="accel.pipe", transform=prep,
+                              retries=2)
         else:  # --accel-prefetch 0: inline, single-threaded debugging
             source = (prep(g) for g in groups())
+        def search_halved(payload, n):
+            """The batched dispatch under the OOM-adaptive policy: a
+            RESOURCE_EXHAUSTED halves the batch (per-spectrum results
+            are independent, so the halves concatenate bit-identically);
+            any other failure — or an OOM that persists at batch 1 —
+            propagates to the serial-fallback handler below."""
+            def run(lo, hi):
+                faultinject.trip("accel.batch_dispatch")
+                part = (tuple(p[lo:hi] for p in payload)
+                        if isinstance(payload, tuple) else payload[lo:hi])
+                return accel_search_batch(part, T_sec, config)
+
+            parts = halving_dispatch(run, n, what="accel.batch")
+            return [c for _, _, cands in parts for c in cands]
+
         for idxs, payload, prep_err in source:
             try:
                 if prep_err is not None:
                     raise prep_err
                 with telemetry.span("accel_search", aggregate=False,
                                     batch=len(idxs)):
-                    all_cands = accel_search_batch(payload, T_sec, config)
+                    all_cands = search_halved(payload, len(idxs))
             except Exception as e:  # noqa: BLE001 - poison-spectrum
                 # contract of the batched CLI: degrade to per-spectrum
                 # serial host-prep searches, never fail the whole batch
@@ -341,9 +420,14 @@ def sweep_accel_stream(
             for i, cands in zip(idxs, all_cands):
                 if cands is None:
                     continue
+                faultinject.trip("accel.before_cand_write")  # kill-point
                 with telemetry.span("accel_write"):
                     write_candfiles(names[i][0], names[i][1], cands,
                                     T_sec, max_cands)
+                faultinject.trip("accel.after_cand_write")  # kill-point
+                if journal is not None:
+                    journal.done(units[i], [names[i][0], names[i][1]])
+                    faultinject.trip("accel.after_journal")  # kill-point
                 n_searched += 1
             telemetry.counter("accel.stream_batches")
             if verbose:
@@ -351,6 +435,11 @@ def sweep_accel_stream(
                       f"({n_searched}/{len(todo)})")
         del series  # free the slice buffer before the next pass
 
+    if journal is not None:
+        journal.note(event="accel_stream_done", n_searched=n_searched,
+                     n_skipped=n_skipped, n_failed=n_failed)
+        if own_journal:
+            journal.close()
     return {"n_searched": n_searched, "n_skipped": n_skipped,
             "n_failed": n_failed, "serial_fallbacks": fallbacks,
             "cand_paths": [n[0] for n in names]}

@@ -25,6 +25,16 @@ it lives here once:
   too: max == depth+1 means the producer kept fully ahead; max 0-1
   means the consumer starved.
 
+Resilience (round 7): the transform retries transient IO errors with
+exponential backoff (``retries``/``retry_on`` — a survey pass must not
+abort over one NFS hiccup; each retry emits a ``resilience.worker_retry``
+telemetry event), and the consumer enforces a per-item deadline
+(``timeout``, default ``PYPULSAR_TPU_PREFETCH_TIMEOUT`` or 900 s; 0
+disables) so a wedged producer fails LOUDLY with a TimeoutError naming
+the pipeline instead of parking the whole run on ``q.get()`` forever.
+The worker-side fault point ``{name}.produce`` sits inside the retry
+loop, so ``tests/test_resilience.py`` can prove both policies.
+
 ``PYPULSAR_TPU_SHIP_AHEAD=0`` disables the thread globally (inline
 transform, e.g. for single-threaded debugging); ordering and values are
 identical either way — threading only moves WHEN work happens.
@@ -35,26 +45,68 @@ from __future__ import annotations
 import os
 import queue
 import threading
-from typing import Callable, Iterable, Optional
+import time
+from typing import Callable, Iterable, Optional, Tuple
 
 from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.resilience.retry import RETRY_BACKOFF_MAX_S  # noqa: F401
 
 __all__ = ["prefetch"]
+
+ENV_TIMEOUT = "PYPULSAR_TPU_PREFETCH_TIMEOUT"
+DEFAULT_TIMEOUT_S = 900.0
+# how long the consumer's cleanup path waits for a (possibly wedged)
+# worker before abandoning it: the thread is a daemon, so leaking it is
+# safe — spinning on join() forever is the wedge we exist to prevent
+CLEANUP_DEADLINE_S = 5.0
+
+
+def _resolve_timeout(timeout: Optional[float]) -> Optional[float]:
+    if timeout is None:
+        timeout = float(os.environ.get(ENV_TIMEOUT, DEFAULT_TIMEOUT_S))
+    return None if timeout <= 0 else timeout
+
+
+def _produce(xf: Callable, item, name: str, retries: int,
+             retry_backoff: float, retry_on: Tuple[type, ...]):
+    """One item through the (fault-instrumented) transform with the
+    shared transient-error retry policy (resilience.retry_transient) —
+    used by the worker thread and the inline (SHIP_AHEAD=0) path alike
+    so retry semantics cannot diverge."""
+    from pypulsar_tpu.resilience import faultinject
+    from pypulsar_tpu.resilience.retry import retry_transient
+
+    def attempt():
+        faultinject.trip(f"{name}.produce")
+        return xf(item)
+
+    return retry_transient(attempt, retries=retries, backoff=retry_backoff,
+                           retry_on=retry_on, what=name)
 
 
 def prefetch(items: Iterable, depth: int = 2, name: str = "prefetch",
              transform: Optional[Callable] = None,
-             thread_name: Optional[str] = None):
+             thread_name: Optional[str] = None,
+             retries: int = 0, retry_backoff: float = 0.1,
+             retry_on: Tuple[type, ...] = (OSError,),
+             timeout: Optional[float] = None):
     """Yield ``transform(item)`` for each item, produced ``depth`` ahead
-    on a background thread (see module docstring for the contract)."""
+    on a background thread (see module docstring for the contract).
+
+    ``retries``: transform attempts re-run up to this many times on
+    ``retry_on`` exceptions (exponential backoff from ``retry_backoff``
+    seconds). ``timeout``: per-item consumer deadline in seconds (None =
+    the ``PYPULSAR_TPU_PREFETCH_TIMEOUT`` env default; <= 0 disables)."""
     xf = transform if transform is not None else (lambda it: it)
     gauge_name = f"{name}.pending_depth"
 
     if os.environ.get("PYPULSAR_TPU_SHIP_AHEAD", "1") == "0":
         for item in items:
-            yield xf(item)
+            yield _produce(xf, item, name, retries, retry_backoff,
+                           retry_on)
         return
 
+    deadline = _resolve_timeout(timeout)
     q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
     _done = object()
     stop = threading.Event()
@@ -64,7 +116,8 @@ def prefetch(items: Iterable, depth: int = 2, name: str = "prefetch",
             for item in items:
                 if stop.is_set():  # consumer gone: don't produce the rest
                     return
-                out = xf(item)
+                out = _produce(xf, item, name, retries, retry_backoff,
+                               retry_on)
                 if telemetry.is_active():  # gauges are thread-safe
                     telemetry.gauge(gauge_name, q.qsize() + 1)
                 q.put(out)
@@ -79,7 +132,18 @@ def prefetch(items: Iterable, depth: int = 2, name: str = "prefetch",
     t.start()
     try:
         while True:
-            item = q.get()
+            try:
+                item = q.get(timeout=deadline)
+            except queue.Empty:
+                telemetry.event("resilience.prefetch_timeout",
+                                pipeline=name, timeout_s=deadline)
+                raise TimeoutError(
+                    f"prefetch {name!r}: producer delivered nothing for "
+                    f"{deadline:.0f}s (worker "
+                    f"{'alive' if t.is_alive() else 'dead'}); the "
+                    f"pipeline would otherwise wedge silently — raise "
+                    f"{ENV_TIMEOUT} if items legitimately take longer"
+                ) from None
             if item is _done:
                 break
             if isinstance(item, BaseException):
@@ -90,9 +154,13 @@ def prefetch(items: Iterable, depth: int = 2, name: str = "prefetch",
     finally:
         # consumer abandoned mid-stream (error or early exit): signal the
         # worker, then drain queue slots so a put-parked worker can see
-        # the signal and exit instead of producing the rest of the stream
+        # the signal and exit instead of producing the rest of the
+        # stream. Deadline-bounded: a worker wedged INSIDE its transform
+        # never exits, and the cleanup must not inherit its wedge (the
+        # thread is a daemon — abandoning it is safe)
         stop.set()
-        while t.is_alive():
+        give_up = time.monotonic() + CLEANUP_DEADLINE_S
+        while t.is_alive() and time.monotonic() < give_up:
             try:
                 q.get_nowait()
             except queue.Empty:

@@ -291,8 +291,11 @@ def _ship_ahead(raw_blocks, depth: int = 2):
                               int(getattr(block, "nbytes", 0) or 0))
         return pos, jnp.asarray(block)
 
+    # retries: a transient wire failure re-ships the (still in hand)
+    # host block instead of aborting the whole streamed sweep
     return prefetch(raw_blocks, depth=depth, name="sweep.ship",
-                    transform=ship, thread_name="pypulsar-ship-ahead")
+                    transform=ship, thread_name="pypulsar-ship-ahead",
+                    retries=2)
 
 
 class _MaskedSource:
@@ -879,6 +882,7 @@ def write_dats_streamed(
             rfimask=rfimask, engine=engine, chunk_payload=chunk_payload,
             window=window, verbose=verbose):
         dat_append_rows(paths, rows)
+    dat_finalize_paths(paths)
     if write_inf:
         write_dat_infs(outbase, reader, dms, s1 - s0, dt_eff)
     return paths
@@ -887,22 +891,38 @@ def write_dats_streamed(
 def dat_truncate_paths(outbase: str, dms, suffix: str = "") -> List[str]:
     """Create (truncated) the per-DM .dat paths — the ONE definition of
     the .dat byte-emitting side, shared with the accel handoff's
-    --write-dats tee so the tee-identical contract has a single writer."""
+    --write-dats tee so the tee-identical contract has a single writer.
+
+    The byte stream accumulates in ``{path}.tmp`` and lands on the final
+    name only at :func:`dat_finalize_paths` (tmp + os.replace, the sweep
+    checkpoints' discipline): a killed run leaves tmp debris, never a
+    truncated ``.dat`` that a later stage would trust as complete."""
     paths = [f"{outbase}_DM{dm:.2f}{suffix}.dat" for dm in dms]
     # truncate once, then reopen per chunk in append mode: holding one
     # descriptor per DM trial would hit the fd limit at prepsubband-
     # scale grids (review r5: --numdms 2000 vs the common 1024 ulimit)
     for p in paths:
-        open(p, "wb").close()
+        open(p + ".tmp", "wb").close()
     return paths
 
 
 def dat_append_rows(paths: List[str], rows) -> None:
     """Append one chunk's [D, valid] float32 rows to the per-DM .dat
-    byte streams (other half of :func:`dat_truncate_paths`)."""
+    byte streams (other half of :func:`dat_truncate_paths`; bytes go to
+    the ``.tmp`` staging name until :func:`dat_finalize_paths`)."""
+    from pypulsar_tpu.resilience import faultinject
+
+    faultinject.trip("dats.append")  # kill-point: mid-stream .dat write
     for p, row in zip(paths, rows):
-        with open(p, "ab") as f:
+        with open(p + ".tmp", "ab") as f:
             row.tofile(f)
+
+
+def dat_finalize_paths(paths: List[str]) -> None:
+    """Atomically publish completed .dat streams (``.tmp`` ->
+    final, os.replace): readers only ever see whole files."""
+    for p in paths:
+        os.replace(p + ".tmp", p)
 
 
 def iter_dedispersed_chunks(

@@ -56,6 +56,22 @@ DEFAULT_WIDTHS = (1, 2, 4, 8, 16, 32)
 ENGINES = ("gather", "scan", "fourier")
 
 
+def shard_map_compat(fn, mesh, in_specs, out_specs,
+                     check_vma: Optional[bool] = None):
+    """``jax.shard_map`` across jax versions: the top-level API (with its
+    ``check_vma`` knob) where it exists, else the older
+    ``jax.experimental.shard_map.shard_map`` whose ``check_rep`` is the
+    same replication check under its pre-stabilization name."""
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
 def resolve_engine(engine: str = "auto") -> str:
     """Pick the chunk-kernel formulation.
 
@@ -435,7 +451,7 @@ def make_sharded_sweep_chunk(mesh: Mesh, nsub, out_len, slack2, widths,
         stat_len=stat_len,
         engine=engine,
     )
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         impl,
         mesh=mesh,
         in_specs=(P(), P("dm"), P("dm")),
@@ -487,7 +503,7 @@ def make_sharded_sweep_chunk_2d(
         ab = jnp.take_along_axis(ab_all, k[None], axis=0)[0]
         return s, ss, mb, ab
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         local_fn,
         mesh=mesh,
         in_specs=(P(None, "time"), P("dm"), P("dm")),
@@ -853,17 +869,42 @@ def sweep_stream(
     sharded_fns = {}  # stat_len -> compiled sharded chunk fn
 
     def run_chunk(data, stat_len):
-        if mesh is None:
-            return sweep_chunk(
-                data, s1, s2, plan.nsub, out_len, slack2, plan.widths,
-                stat_len, engine=engine
-            )
-        if stat_len not in sharded_fns:
-            sharded_fns[stat_len] = make_sharded_sweep_chunk(
-                mesh, plan.nsub, out_len, slack2, plan.widths, stat_len,
-                engine=engine
-            )
-        return sharded_fns[stat_len](data, s1, s2)
+        """Dispatch one chunk over the trial groups; returns a LIST of
+        output 4-tuples in group order (normally one entry covering every
+        group). A device RESOURCE_EXHAUSTED halves the group axis with
+        bounded backoff and re-dispatches the halves
+        (resilience.retry.halving_dispatch) — per-group scans share no
+        state, so host-side concatenation of the halves is bit-identical
+        to the whole dispatch. OOM only surfaces here at dispatch time;
+        an async-surfaced OOM at the drain pull stays fatal."""
+        from pypulsar_tpu.resilience import faultinject
+        from pypulsar_tpu.resilience.retry import halving_dispatch
+
+        ndm = 1 if mesh is None else mesh.shape["dm"]
+        n_groups = plan.n_groups
+
+        def dispatch(lo, hi):
+            faultinject.trip("sweep.chunk_dispatch")
+            whole = (lo, hi) == (0, n_groups)
+            s1_sl, s2_sl = (s1, s2) if whole else (s1[lo:hi], s2[lo:hi])
+            if mesh is None:
+                return sweep_chunk(
+                    data, s1_sl, s2_sl, plan.nsub, out_len, slack2,
+                    plan.widths, stat_len, engine=engine
+                )
+            if not whole:  # re-lay the sliced tables on the mesh
+                spec_sl = NamedSharding(mesh, P("dm"))
+                s1_sl = jax.device_put(s1_sl, spec_sl)
+                s2_sl = jax.device_put(s2_sl, spec_sl)
+            if stat_len not in sharded_fns:
+                sharded_fns[stat_len] = make_sharded_sweep_chunk(
+                    mesh, plan.nsub, out_len, slack2, plan.widths,
+                    stat_len, engine=engine
+                )
+            return sharded_fns[stat_len](data, s1_sl, s2_sl)
+
+        return [outs for _, _, outs in halving_dispatch(
+            dispatch, n_groups, min_size=ndm, what="sweep.chunk")]
 
     # Dispatch a few chunks ahead of the host-side accumulate so transfers
     # overlap compute, but bound the depth so queued input buffers (one chunk
@@ -871,7 +912,7 @@ def sweep_stream(
     # ``max_pending`` explicitly; each pending chunk holds one input buffer.
     MAX_PENDING = 4 if max_pending is None else max(1, int(max_pending))
     DRAIN_BATCH = min(4, MAX_PENDING)
-    pending = []  # (start, stat_len, device outputs)
+    pending = []  # (start, stat_len, [device output 4-tuples, group order])
 
     def drain(limit):
         nonlocal cursor
@@ -890,9 +931,20 @@ def sweep_stream(
             due.append(pending.pop(0))
         with profiling.stage("device_wait+accumulate"):
             flat = transfer.pull_host(
-                *(arr for _, _, outs in due for arr in outs))
-            for i, (start, stat_len, _) in enumerate(due):
-                s, ss, mb, ab = flat[4 * i: 4 * i + 4]
+                *(arr for _, _, parts in due for outs in parts
+                  for arr in outs))
+            k = 0
+            for start, stat_len, parts in due:
+                got = flat[k:k + 4 * len(parts)]
+                k += 4 * len(parts)
+                if len(parts) == 1:
+                    s, ss, mb, ab = got
+                else:
+                    # OOM-halved chunk: concatenate the group-axis
+                    # slices back to the full trial axis (group order
+                    # was preserved, so this is the whole dispatch)
+                    s, ss, mb, ab = (
+                        np.concatenate(got[j::4]) for j in range(4))
                 acc.update(start, stat_len, s, ss, mb, ab)
                 cursor = start + stat_len
         # outside the stage: checkpoint_save has its own profiling stage
@@ -1180,9 +1232,9 @@ def _make_resident_runner(nsub, out_len, slack2, widths, payload, need,
                    slack2=slack2, widths=widths, stat_len=payload,
                    engine=engine)
     if mesh is not None:
-        impl = jax.shard_map(impl, mesh=mesh,
-                             in_specs=(P(), P("dm"), P("dm")),
-                             out_specs=P("dm"))
+        impl = shard_map_compat(impl, mesh=mesh,
+                                in_specs=(P(), P("dm"), P("dm")),
+                                out_specs=P("dm"))
 
     # NOT donated: a full-size slice of the caller's Spectra shares its
     # buffer (verified), so donation would invalidate the caller's data on

@@ -1,0 +1,39 @@
+"""Resilience layer: fault injection, OOM-adaptive dispatch, journaled
+resume, artifact integrity.
+
+One transient failure must never abort or silently corrupt a multi-hour
+survey pass. The submodules divide the problem:
+
+- :mod:`~pypulsar_tpu.resilience.retry` — OOM-adaptive halving of the
+  independent dispatch axes (sweep trial groups, accel batches, stage
+  chunks), bit-identical recovery by construction;
+- :mod:`~pypulsar_tpu.resilience.journal` — the per-run JSONL work-unit
+  manifest with size/sha256 validation, plus the atomic-write and
+  ``.cand``-completeness helpers every output path shares;
+- :mod:`~pypulsar_tpu.resilience.faultinject` — deterministic, named
+  fault points (env/CLI-armed) that make every recovery path above
+  testable down to byte-identical candidate tables
+  (``tests/test_resilience.py``, ``make test-faults``).
+
+The failure model itself (what is retried, what is journaled, what is
+fatal) is documented in docs/ARCHITECTURE.md "Failure model & recovery".
+"""
+
+from pypulsar_tpu.resilience.faultinject import (  # noqa: F401
+    InjectedFault,
+    InjectedIOError,
+    InjectedKill,
+    InjectedOOM,
+    trip,
+)
+from pypulsar_tpu.resilience.journal import (  # noqa: F401
+    RunJournal,
+    atomic_write_bytes,
+    atomic_write_text,
+    candfile_complete,
+    file_digest,
+)
+from pypulsar_tpu.resilience.retry import (  # noqa: F401
+    halving_dispatch,
+    is_oom_error,
+)

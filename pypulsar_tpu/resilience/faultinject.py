@@ -1,0 +1,192 @@
+"""Deterministic fault injection: the testability half of the resilience
+layer.
+
+Every recovery path in this package (OOM-adaptive halving, worker IO
+retries, journaled resume) exists because a specific failure was observed
+or anticipated in production — and a recovery path that is never executed
+is a recovery path that is broken. This module lets a test (or an
+operator, via env/CLI) arm a *deterministic* failure at a *named point*
+in the pipeline:
+
+- ``oom`` — raise :class:`InjectedOOM` (recognized by
+  ``resilience.retry.is_oom_error`` exactly like a device
+  ``RESOURCE_EXHAUSTED``) at the Nth hit of a dispatch point;
+- ``io`` — raise :class:`InjectedIOError` (an ``OSError``) at the Nth hit
+  of a read/produce point;
+- ``kill`` — raise :class:`InjectedKill` (a ``BaseException``: ordinary
+  ``except Exception`` recovery code cannot swallow it, so it unwinds the
+  run like a SIGINT) at the Nth hit of a kill point;
+- ``exit`` — ``os._exit(137)``: the true SIGKILL-equivalent (no finally
+  blocks, no atexit, no flushing) for subprocess-based tests.
+
+Spec grammar (``PYPULSAR_TPU_FAULTS`` env var or the CLIs'
+``--fault-inject``)::
+
+    kind:point[:N][,kind:point[:N]...]
+
+e.g. ``oom:accel.batch_dispatch:2`` injects one OOM on the second batched
+accel dispatch. N defaults to 1 and counts 1-based hits of that point;
+each armed fault fires exactly once. Instrumented points call
+:func:`trip` — a no-op single dict check when nothing is armed, so the
+hooks are free in production.
+
+Every firing emits a ``resilience.fault_injected`` telemetry event, so a
+fault-injection run's trace shows both the failure and the recovery it
+provoked.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+from pypulsar_tpu.obs import telemetry
+
+__all__ = [
+    "InjectedFault",
+    "InjectedIOError",
+    "InjectedKill",
+    "InjectedOOM",
+    "add_fault_flag",
+    "configure",
+    "configure_from_env",
+    "hits",
+    "is_armed",
+    "reset",
+    "trip",
+]
+
+ENV_FAULTS = "PYPULSAR_TPU_FAULTS"
+
+KINDS = ("oom", "io", "kill", "exit")
+
+
+class InjectedFault:
+    """Mixin marking an exception as injected (not a real failure)."""
+
+
+class InjectedOOM(InjectedFault, RuntimeError):
+    """Stands in for the device allocator's failure: the message carries
+    RESOURCE_EXHAUSTED so any string-matching classifier (including
+    ``resilience.retry.is_oom_error``) treats it like the real thing."""
+
+    def __init__(self, point: str):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected device OOM at {point!r}")
+
+
+class InjectedIOError(InjectedFault, OSError):
+    """A transient read error, as an OSError so the worker retry policy
+    (``retry_on=(OSError,)``) catches it like a real EIO/ENETRESET."""
+
+    def __init__(self, point: str):
+        super().__init__(f"injected transient IO error at {point!r}")
+
+
+class InjectedKill(InjectedFault, BaseException):
+    """Unwinds the run past every ``except Exception`` recovery handler —
+    the in-process stand-in for a kill signal (for the no-cleanup-at-all
+    SIGKILL semantics use kind ``exit`` in a subprocess)."""
+
+    def __init__(self, point: str):
+        super().__init__(f"injected kill at {point!r}")
+
+
+# (kind, point) -> 1-based hit index at which to fire (popped once fired)
+_armed: Dict[Tuple[str, str], int] = {}
+_hits: Dict[str, int] = {}
+
+
+def parse_spec(spec: str) -> Dict[Tuple[str, str], int]:
+    """Parse the fault spec grammar; raises ValueError on malformed
+    entries (a typo'd fault spec silently injecting nothing would make a
+    green fault test meaningless)."""
+    out: Dict[Tuple[str, str], int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) == 2:
+            kind, point, n = fields[0], fields[1], 1
+        elif len(fields) == 3:
+            kind, point = fields[0], fields[1]
+            n = int(fields[2])
+        else:
+            raise ValueError(f"bad fault spec entry {part!r}; expected "
+                             f"kind:point[:N]")
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; expected one "
+                             f"of {KINDS}")
+        if n < 1:
+            raise ValueError(f"fault hit index must be >= 1; got {n}")
+        out[(kind, point)] = n
+    return out
+
+
+def configure(spec: Optional[str]) -> None:
+    """Arm the faults in ``spec`` (replacing any armed set); None or an
+    empty string clears everything."""
+    reset()
+    if spec:
+        _armed.update(parse_spec(spec))
+
+
+def configure_from_env() -> None:
+    """Arm faults from ``PYPULSAR_TPU_FAULTS`` (the subprocess-test
+    channel; unset leaves the armed set alone so a CLI flag survives)."""
+    spec = os.environ.get(ENV_FAULTS)
+    if spec:
+        _armed.update(parse_spec(spec))
+
+
+def reset() -> None:
+    """Clear armed faults and hit counters (test isolation)."""
+    _armed.clear()
+    _hits.clear()
+
+
+def is_armed() -> bool:
+    return bool(_armed)
+
+
+def hits(point: str) -> int:
+    """How many times ``point`` has tripped (diagnostics/tests)."""
+    return _hits.get(point, 0)
+
+
+def add_fault_flag(parser):
+    """Install the shared ``--fault-inject`` CLI option (one definition of
+    the flag for every CLI, like telemetry.add_telemetry_flag)."""
+    parser.add_argument(
+        "--fault-inject", default=None, metavar="SPEC",
+        help="arm deterministic faults for resilience testing: "
+             "kind:point[:N],... with kinds oom|io|kill|exit (e.g. "
+             "oom:accel.batch_dispatch:2 injects a device OOM on the "
+             "2nd batched accel dispatch); also via the "
+             f"{ENV_FAULTS} env var")
+    return parser
+
+
+def trip(point: str) -> None:
+    """Hook call at an instrumented point: fire the armed fault for this
+    point when its 1-based hit index is reached, else no-op. The
+    nothing-armed fast path is one dict truthiness check."""
+    if not _armed:
+        return
+    n = _hits.get(point, 0) + 1
+    _hits[point] = n
+    for kind in KINDS:
+        key = (kind, point)
+        if _armed.get(key) == n:
+            del _armed[key]
+            telemetry.counter("resilience.faults_injected")
+            telemetry.event("resilience.fault_injected", kind=kind,
+                            point=point, hit=n)
+            if kind == "oom":
+                raise InjectedOOM(point)
+            if kind == "io":
+                raise InjectedIOError(point)
+            if kind == "kill":
+                raise InjectedKill(point)
+            os._exit(137)  # "exit": SIGKILL-equivalent, no cleanup at all

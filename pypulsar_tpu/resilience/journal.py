@@ -1,0 +1,322 @@
+"""Journaled, integrity-checked resume: one manifest for the whole chain.
+
+Restartability in this package grew ad hoc — the sweep checkpoints its
+accumulator to ``.npz``, the accel stage keys resume on ``.cand``
+existence (``--skip-existing``), sift has nothing — and the weakest link
+defined the whole chain's behavior: a zero-byte ``.cand`` from a killed
+run was "done", a truncated ``.dat`` tee was trusted forever. This module
+generalizes all of it into one per-run JSONL **work-unit journal**:
+
+- every completed unit appends one ``done`` record naming its output
+  artifacts with their **size and sha256** (atomic append: single
+  ``write`` + ``flush`` + ``fsync``, so a kill leaves at most one
+  truncated trailing line, which the loader tolerates);
+- a header record fingerprints the run configuration — resuming under
+  different parameters starts from scratch instead of trusting stale
+  artifacts (the same contract SweepCheckpoint enforces for the sweep);
+- on resume, :meth:`RunJournal.completed` re-validates every recorded
+  artifact on disk (exists, size matches, checksum matches) — a
+  journal entry whose artifact was truncated, deleted or overwritten is
+  *redone*, not trusted, and emits a ``resilience.journal_invalid``
+  telemetry event saying why.
+
+The module also holds the artifact-integrity helpers the satellite fixes
+use standalone: :func:`candfile_complete` (the validated form of
+``--skip-existing``) and :func:`atomic_write_text`/``bytes`` (tmp +
+``os.replace``, the sweep checkpoints' discipline applied to every
+pipeline output).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from pypulsar_tpu.obs import telemetry
+
+__all__ = [
+    "RunJournal",
+    "atomic_write_bytes",
+    "atomic_write_text",
+    "candfile_complete",
+    "file_digest",
+]
+
+TMP_SUFFIX = ".tmp"
+JOURNAL_VERSION = 1
+
+
+def atomic_write_bytes(path: str, data: bytes) -> str:
+    """Write ``path`` atomically (tmp + os.replace): readers see either
+    the old complete file or the new complete file, never a truncation.
+    The tmp lives next to the target so the replace stays one-filesystem."""
+    tmp = path + TMP_SUFFIX
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+    return path
+
+
+def atomic_write_text(path: str, text: str) -> str:
+    return atomic_write_bytes(path, text.encode())
+
+
+def file_digest(path: str) -> Tuple[int, str]:
+    """(size_bytes, sha256 hex) of a file's current content."""
+    h = hashlib.sha256()
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(1 << 20)
+            if not block:
+                break
+            size += len(block)
+            h.update(block)
+    return size, h.hexdigest()
+
+
+def _fourierprops_bytes() -> int:
+    """The on-disk fourierprops record size, from the ONE definition
+    (io.prestocand.FOURIERPROPS_DTYPE) — a hardcoded 88 here would
+    silently diverge if the dtype ever changed, classifying every valid
+    .cand as truncation debris. Imported lazily: the journal itself has
+    no numpy dependency."""
+    from pypulsar_tpu.io.prestocand import FOURIERPROPS_DTYPE
+
+    return FOURIERPROPS_DTYPE.itemsize
+
+
+def candfile_complete(candfn: str, txtfn: Optional[str] = None) -> bool:
+    """True when a ``.cand`` file is a COMPLETE artifact, not debris from
+    a killed run: it exists, its size is a whole number of fourierprops
+    records, and (when the sibling ``.txtcand`` path is given) the
+    human-readable twin exists with a parseable header and a row count
+    equal to the binary record count.
+
+    The pair check is what disambiguates the zero-byte case: a
+    legitimately empty result is a 0-record ``.cand`` PLUS a
+    header-only ``.txtcand`` (the txt is written first, the cand last —
+    the completion marker order), while a killed run leaves the
+    zero-byte ``.cand`` alone."""
+    try:
+        size = os.path.getsize(candfn)
+    except OSError:
+        return False
+    rec = _fourierprops_bytes()
+    if size % rec:
+        return False
+    n_cands = size // rec
+    if txtfn is None:
+        return size > 0
+    try:
+        with open(txtfn) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return False
+    if not lines or not lines[0].startswith("#"):
+        return False
+    n_rows = sum(1 for ln in lines[1:] if ln.strip())
+    return n_rows == n_cands
+
+
+class RunJournal:
+    """Append-only JSONL manifest of completed work units (see module
+    docstring). ``fingerprint`` identifies the run configuration: opening
+    an existing journal whose header fingerprint differs archives nothing
+    — the file is restarted (the old journal described a different run,
+    the same contract as a SweepCheckpoint mismatch). ``tool`` guards the
+    restart: a journal whose header was written by a DIFFERENT tool is
+    never restarted — the first write raises instead, so pointing one
+    stage's CLI at another stage's manifest cannot silently erase it.
+    """
+
+    def __init__(self, path: str, fingerprint: str = "",
+                 tool: str = "run"):
+        self.path = path
+        self.fingerprint = fingerprint
+        self.tool = tool
+        self._fh = None
+        self._records: List[dict] = []
+        self._keep_bytes = 0  # byte offset after the last VALID line
+        self._foreign = False  # header written by a different tool
+        self._completed_cache: Optional[Set[str]] = None
+        self._load()
+        if self._foreign:
+            # fail FAST, before any work is done against the wrong
+            # manifest — proceeding would end in a refused write anyway
+            raise ValueError(
+                f"journal {path!r} belongs to a different tool; refusing "
+                f"to overwrite it — give {tool!r} its own journal file")
+
+    # -- read side -----------------------------------------------------------
+
+    def _load(self) -> None:
+        """Parse existing records, tolerating a truncated trailing line
+        (the one artifact a kill mid-append can leave; ``_keep_bytes``
+        marks where valid content ends so appends truncate the torn tail
+        instead of gluing the next record onto it)."""
+        self._records = []
+        self._keep_bytes = 0
+        self._foreign = False
+        if not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return
+        header_ok = False
+        offset = 0
+        lines = raw.decode(errors="replace").splitlines(keepends=True)
+        for i, line in enumerate(lines):
+            nbytes = len(line.encode())
+            stripped = line.strip()
+            if not stripped:
+                offset += nbytes
+                continue
+            try:
+                rec = json.loads(stripped)
+            except ValueError:
+                # only the LAST line may legitimately be torn; malformed
+                # interior lines mean the file is not ours — start over
+                if i == len(lines) - 1:
+                    break
+                self._records = []
+                self._keep_bytes = 0
+                return
+            if not self._records:
+                if rec.get("type") != "journal":
+                    self._keep_bytes = 0
+                    return  # not a journal: nothing usable
+                if rec.get("tool", "run") != self.tool:
+                    # another tool's manifest: refuse to ever restart it
+                    self._foreign = True
+                    self._keep_bytes = 0
+                    return
+                if rec.get("fingerprint") != self.fingerprint:
+                    self._keep_bytes = 0
+                    return  # same tool, different run: restartable
+                header_ok = True
+            offset += nbytes
+            self._records.append(rec)
+            self._keep_bytes = offset
+        if not header_ok:
+            self._records = []
+            self._keep_bytes = 0
+
+    def completed(self, validate: bool = True) -> Set[str]:
+        """Unit ids recorded done whose artifacts (still) validate:
+        every output exists with the recorded size and sha256. A unit
+        whose artifacts fail validation is excluded — the caller redoes
+        it — and the reason is surfaced as telemetry. The validated set
+        is cached per instance (several pipeline stages consult the one
+        shared journal; re-hashing every artifact per stage would
+        duplicate both the IO and the journal_invalid events)."""
+        if validate and self._completed_cache is not None:
+            return set(self._completed_cache)
+        done: Set[str] = set()
+        for rec in self._records:
+            if rec.get("type") != "done" or "unit" not in rec:
+                continue
+            unit = rec["unit"]
+            if not validate:
+                done.add(unit)
+                continue
+            ok = True
+            for out in rec.get("outputs", []):
+                reason = self._validate_output(out)
+                if reason is not None:
+                    ok = False
+                    telemetry.counter("resilience.journal_invalid")
+                    telemetry.event("resilience.journal_invalid",
+                                    unit=unit, path=out.get("path", "?"),
+                                    reason=reason)
+                    break
+            if ok:
+                done.add(unit)
+            else:
+                done.discard(unit)  # a later invalid entry wins
+        if validate:
+            self._completed_cache = set(done)
+        return done
+
+    @staticmethod
+    def _validate_output(out: dict) -> Optional[str]:
+        """None when the artifact matches its journal record, else a
+        short reason string."""
+        path = out.get("path")
+        if not path or not os.path.exists(path):
+            return "missing"
+        try:
+            size, digest = file_digest(path)
+        except OSError:
+            return "unreadable"
+        if size != out.get("bytes"):
+            return "size_mismatch"
+        if out.get("sha256") and digest != out["sha256"]:
+            return "checksum_mismatch"
+        return None
+
+    # -- write side ----------------------------------------------------------
+
+    def _open(self):
+        if self._fh is not None:
+            return self._fh
+        if self._foreign:
+            raise ValueError(
+                f"journal {self.path!r} belongs to a different tool; "
+                f"refusing to overwrite it — give {self.tool!r} its own "
+                f"journal file")
+        fresh = not self._records
+        if fresh:
+            # a journal from a different run (or corrupt) restarts the file
+            self._fh = open(self.path, "w")
+            self._append({"type": "journal", "version": JOURNAL_VERSION,
+                          "tool": self.tool,
+                          "fingerprint": self.fingerprint})
+        else:
+            # matching run: append — after truncating any torn trailing
+            # line so the next record starts on its own line
+            self._fh = open(self.path, "r+")
+            self._fh.seek(self._keep_bytes)
+            self._fh.truncate()
+        return self._fh
+
+    def _append(self, rec: dict) -> None:
+        fh = self._open()
+        fh.write(json.dumps(rec) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())  # a recorded unit must survive the next kill
+        self._records.append(rec)
+
+    def done(self, unit: str, outputs: Iterable[str]) -> None:
+        """Record ``unit`` complete with the current size + sha256 of each
+        of its output artifacts (digested NOW, after the atomic writes —
+        the journal describes what is actually on disk)."""
+        outs: List[Dict] = []
+        for path in outputs:
+            size, digest = file_digest(path)
+            outs.append({"path": path, "bytes": size, "sha256": digest})
+        self._append({"type": "done", "unit": unit, "outputs": outs})
+        if self._completed_cache is not None:
+            self._completed_cache.add(unit)
+        telemetry.counter("resilience.journal_units")
+
+    def note(self, **attrs) -> None:
+        """Free-form journal record (run milestones; ignored by
+        :meth:`completed`)."""
+        self._append({"type": "note", **attrs})
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,710 @@
+"""Resilience-layer tests (round 7): every recovery path proven under
+DETERMINISTIC injected failure, with the acceptance bar that candidate
+tables stay BIT-IDENTICAL to an unfaulted run — under injected device
+OOM (the dispatch auto-halves and completes), injected transient read
+errors (the worker retries), and kill+resume at every journal
+kill-point of the streamed ``sweep --accel-search`` chain — and each
+recovery emits a telemetry event visible in tlmsum."""
+
+import glob
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.resilience.journal import (
+    RunJournal,
+    atomic_write_text,
+    candfile_complete,
+    file_digest,
+)
+from pypulsar_tpu.resilience.retry import halving_dispatch, is_oom_error
+
+from tests.test_accel_pipeline import (
+    ACCEL_ARGS,
+    HANDOFF_ARGS,
+    SWEEP_ARGS,
+    _pulsar_fil,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Armed faults and hit counters never leak between tests."""
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+# ---------------------------------------------------------------------------
+# fault injection core
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_parsing():
+    spec = faultinject.parse_spec("oom:sweep.chunk_dispatch:2, io:x.produce")
+    assert spec == {("oom", "sweep.chunk_dispatch"): 2, ("io", "x.produce"): 1}
+    for bad in ("boom:x:1", "oom:x:0", "oom:x:1:2"):
+        with pytest.raises(ValueError):
+            faultinject.parse_spec(bad)
+
+
+def test_fault_trip_fires_on_nth_hit_once():
+    faultinject.configure("oom:p:3")
+    faultinject.trip("p")
+    faultinject.trip("p")
+    with pytest.raises(faultinject.InjectedOOM) as ei:
+        faultinject.trip("p")
+    assert "RESOURCE_EXHAUSTED" in str(ei.value)
+    # fired once: further hits pass (and with nothing left armed the
+    # no-op fast path stops even counting)
+    faultinject.trip("p")
+    assert faultinject.hits("p") == 3
+
+    faultinject.configure("io:q")
+    with pytest.raises(OSError):
+        faultinject.trip("q")
+    faultinject.configure("kill:r")
+    with pytest.raises(BaseException) as ei:
+        faultinject.trip("r")
+    assert isinstance(ei.value, faultinject.InjectedKill)
+    assert not isinstance(ei.value, Exception)  # unswallowable by handlers
+
+
+def test_fault_injection_emits_telemetry_event():
+    faultinject.configure("io:t")
+    with telemetry.session() as tlm:
+        with pytest.raises(OSError):
+            faultinject.trip("t")
+        assert tlm.event_counts.get("resilience.fault_injected") == 1
+
+
+def test_is_oom_error_classifier():
+    assert is_oom_error(faultinject.InjectedOOM("x"))
+    assert is_oom_error(RuntimeError("RESOURCE_EXHAUSTED: alloc failed"))
+    assert is_oom_error(RuntimeError("Out of memory allocating 5GB"))
+    assert not is_oom_error(RuntimeError("INVALID_ARGUMENT"))
+    assert not is_oom_error(KeyboardInterrupt())  # BaseException stays fatal
+
+
+# ---------------------------------------------------------------------------
+# halving dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_halving_dispatch_splits_only_oom_slices(monkeypatch):
+    monkeypatch.setattr("pypulsar_tpu.resilience.retry.BACKOFF_BASE_S", 0.0)
+    calls = []
+
+    def run(lo, hi):
+        calls.append((lo, hi))
+        if hi - lo > 2:
+            raise faultinject.InjectedOOM("big")
+        return list(range(lo, hi))
+
+    out = halving_dispatch(run, 8, what="t")
+    # results cover [0, 8) in order with no overlap
+    assert [x for _, _, r in out for x in r] == list(range(8))
+    assert all(hi - lo <= 2 for lo, hi, _ in out)
+    assert (0, 8) in calls  # the whole dispatch was attempted first
+
+
+def test_halving_dispatch_min_size_and_reraise(monkeypatch):
+    monkeypatch.setattr("pypulsar_tpu.resilience.retry.BACKOFF_BASE_S", 0.0)
+
+    def always_oom(lo, hi):
+        raise faultinject.InjectedOOM("p")
+
+    with pytest.raises(faultinject.InjectedOOM):
+        halving_dispatch(always_oom, 8, min_size=4, what="t")
+
+    def not_oom(lo, hi):
+        raise ValueError("unrelated")
+
+    with pytest.raises(ValueError):
+        halving_dispatch(not_oom, 8, what="t")
+
+    # min_size multiples: a mesh-constrained axis never splits off-grid
+    sizes = []
+
+    def run(lo, hi):
+        sizes.append(hi - lo)
+        if hi - lo > 4:
+            raise faultinject.InjectedOOM("p")
+        return hi - lo
+
+    out = halving_dispatch(run, 12, min_size=4, what="t")
+    assert all((hi - lo) % 4 == 0 for lo, hi, _ in out)
+    assert sum(r for _, _, r in out) == 12
+
+
+def test_halving_dispatch_emits_backoff_event(monkeypatch):
+    monkeypatch.setattr("pypulsar_tpu.resilience.retry.BACKOFF_BASE_S", 0.0)
+    state = {"failed": False}
+
+    def run(lo, hi):
+        if not state["failed"]:
+            state["failed"] = True
+            raise faultinject.InjectedOOM("p")
+        return hi - lo
+
+    with telemetry.session() as tlm:
+        halving_dispatch(run, 4, what="t")
+        assert tlm.event_counts.get("resilience.oom_backoff") == 1
+        assert tlm.counter_totals().get("resilience.oom_backoffs") == 1
+
+
+# ---------------------------------------------------------------------------
+# journal + artifact integrity
+# ---------------------------------------------------------------------------
+
+
+def test_journal_roundtrip_and_validation(tmp_path):
+    art = str(tmp_path / "a.bin")
+    with open(art, "wb") as f:
+        f.write(b"payload")
+    jp = str(tmp_path / "run.jsonl")
+    with RunJournal(jp, "fp1") as j:
+        j.done("unit:a", [art])
+        j.note(event="milestone")
+    j2 = RunJournal(jp, "fp1")
+    assert j2.completed() == {"unit:a"}
+
+    # truncated artifact -> unit is redone, not trusted
+    with open(art, "wb") as f:
+        f.write(b"pay")
+    with telemetry.session() as tlm:
+        assert RunJournal(jp, "fp1").completed() == set()
+        assert tlm.event_counts.get("resilience.journal_invalid") == 1
+    # same size, different bytes -> checksum catches it
+    with open(art, "wb") as f:
+        f.write(b"paYload")
+    assert RunJournal(jp, "fp1").completed() == set()
+    # restored content revalidates
+    with open(art, "wb") as f:
+        f.write(b"payload")
+    assert RunJournal(jp, "fp1").completed() == {"unit:a"}
+    # deleted artifact -> redone
+    os.remove(art)
+    assert RunJournal(jp, "fp1").completed() == set()
+
+
+def test_journal_torn_trailing_line_and_fingerprint(tmp_path):
+    art = str(tmp_path / "a.bin")
+    with open(art, "wb") as f:
+        f.write(b"x" * 64)
+    jp = str(tmp_path / "run.jsonl")
+    j = RunJournal(jp, "fp1")
+    j.done("u1", [art])
+    j.close()
+    # a kill mid-append leaves a torn trailing line: tolerated
+    with open(jp, "a") as f:
+        f.write('{"type": "done", "unit": "u2", "outp')
+    assert RunJournal(jp, "fp1").completed() == {"u1"}
+    # appending to the recovered journal keeps it parseable
+    j3 = RunJournal(jp, "fp1")
+    j3.done("u3", [art])
+    j3.close()
+    # NOTE: the torn line is superseded, u1/u3 survive
+    assert RunJournal(jp, "fp1").completed() == {"u1", "u3"}
+    # a different run fingerprint ignores everything
+    assert RunJournal(jp, "OTHER").completed() == set()
+
+
+def test_candfile_complete(tmp_path):
+    cand = str(tmp_path / "x_ACCEL_20.cand")
+    txt = str(tmp_path / "x_ACCEL_20.txtcand")
+    # missing -> incomplete
+    assert not candfile_complete(cand, txt)
+    # zero-byte .cand WITHOUT its txt twin: killed-run debris
+    open(cand, "wb").close()
+    assert not candfile_complete(cand, txt)
+    # legitimately empty result: 0 records + header-only txt
+    atomic_write_text(txt, "# cand   sigma\n")
+    assert candfile_complete(cand, txt)
+    # row-count mismatch -> incomplete
+    atomic_write_text(txt, "# cand   sigma\n1  5.0\n")
+    assert not candfile_complete(cand, txt)
+    # whole records + matching rows -> complete
+    with open(cand, "wb") as f:
+        f.write(b"\0" * 88)
+    assert candfile_complete(cand, txt)
+    # torn record -> incomplete regardless of the txt
+    with open(cand, "wb") as f:
+        f.write(b"\0" * 87)
+    assert not candfile_complete(cand, txt)
+
+
+def test_atomic_write_leaves_no_partial(tmp_path):
+    p = str(tmp_path / "out.txt")
+    atomic_write_text(p, "hello")
+    assert open(p).read() == "hello"
+    assert not os.path.exists(p + ".tmp")
+    size, digest = file_digest(p)
+    assert size == 5
+
+
+# ---------------------------------------------------------------------------
+# prefetch worker retry + consumer deadline
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_retries_transient_io_error():
+    from pypulsar_tpu.parallel.prefetch import prefetch
+
+    faultinject.configure("io:rt.produce:2")
+    with telemetry.session() as tlm:
+        out = list(prefetch(iter(range(6)), depth=2, name="rt",
+                            transform=lambda x: x * 10, retries=2,
+                            retry_backoff=0.01))
+        assert out == [x * 10 for x in range(6)]  # value + order unchanged
+        assert tlm.event_counts.get("resilience.worker_retry") == 1
+        assert tlm.counter_totals().get("resilience.worker_retries") == 1
+
+
+def test_prefetch_retry_exhaustion_reraises():
+    from pypulsar_tpu.parallel import prefetch as prefetch_mod
+    from pypulsar_tpu.parallel.prefetch import prefetch
+
+    def flaky(x):
+        raise OSError("persistent disk failure")
+
+    it = prefetch(iter(range(3)), depth=2, name="rx", transform=flaky,
+                  retries=1, retry_backoff=0.01)
+    with pytest.raises(OSError, match="persistent"):
+        list(it)
+
+    # retries=0 (the default) keeps the old fail-fast contract
+    it = prefetch(iter(range(3)), depth=2, name="rx0", transform=flaky)
+    with pytest.raises(OSError):
+        list(it)
+    assert prefetch_mod.RETRY_BACKOFF_MAX_S >= 1.0  # backoff is bounded
+
+
+def test_retry_transient_never_retries_permanent_errors():
+    """A typo'd path (FileNotFoundError) or bad permission fails on the
+    FIRST attempt — retrying a configuration error only delays it and
+    mislabels it as IO weather."""
+    from pypulsar_tpu.resilience.retry import retry_transient
+
+    calls = []
+
+    def missing():
+        calls.append(1)
+        raise FileNotFoundError("no such file: typo.dat")
+
+    with pytest.raises(FileNotFoundError):
+        retry_transient(missing, retries=3, backoff=0.01, what="t")
+    assert len(calls) == 1
+
+
+def test_prefetch_consumer_deadline_fails_loudly():
+    """A wedged producer must raise a TimeoutError naming the pipeline,
+    promptly, and the generator cleanup must not inherit the wedge."""
+    from pypulsar_tpu.parallel.prefetch import prefetch
+
+    release = threading.Event()
+
+    def wedge(x):
+        release.wait(30.0)  # simulates a hung read/ship
+        return x
+
+    it = prefetch(iter(range(3)), depth=1, name="wedged",
+                  transform=wedge, timeout=0.3)
+    with pytest.raises(TimeoutError, match="wedged"):
+        list(it)
+    release.set()  # let the daemon worker exit
+
+
+def test_prefetch_inline_mode_applies_same_retry(monkeypatch):
+    monkeypatch.setenv("PYPULSAR_TPU_SHIP_AHEAD", "0")
+    from pypulsar_tpu.parallel.prefetch import prefetch
+
+    faultinject.configure("io:inl.produce:1")
+    out = list(prefetch(iter(range(4)), name="inl", retries=1,
+                        retry_backoff=0.01))
+    assert out == list(range(4))
+
+
+# ---------------------------------------------------------------------------
+# telemetry sink hardening
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_sink_unwritable_path_never_crashes(tmp_path, capsys):
+    bad = str(tmp_path / "no" / "such" / "dir" / "t.jsonl")
+    with telemetry.session(bad) as tlm:
+        telemetry.counter("c", 2)
+        telemetry.event("e", k=1)
+        with telemetry.span("s"):
+            pass
+        assert tlm.counter_totals()["c"] == 2  # memory side still works
+    err = capsys.readouterr().err
+    assert err.count("telemetry: sink") == 1  # warned exactly once
+
+
+def test_telemetry_sink_dies_midrun_drops_quietly(tmp_path, capsys):
+    path = str(tmp_path / "t.jsonl")
+
+    class _Dying:
+        def __init__(self, fh):
+            self._fh = fh
+            self.writes = 0
+
+        def write(self, s):
+            self.writes += 1
+            if self.writes > 1:
+                raise OSError(28, "No space left on device")
+            return self._fh.write(s)
+
+        def flush(self):
+            pass
+
+        def close(self):
+            self._fh.close()
+
+        def fileno(self):
+            return self._fh.fileno()
+
+    with telemetry.session(path) as tlm:
+        tlm._fh = _Dying(tlm._fh)
+        telemetry.event("first")   # hits the dying write
+        telemetry.event("second")  # sink is gone: must not raise
+        telemetry.counter("c")
+        assert tlm.counter_totals()["c"] == 1
+    err = capsys.readouterr().err
+    assert err.count("telemetry: sink") == 1
+
+
+# ---------------------------------------------------------------------------
+# OOM-adaptive pipelines: bit-identical recovery
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_oom_backoff_bit_identical(tmp_path):
+    """Injected device OOM on a sweep chunk dispatch: the trial-group
+    axis halves, the run completes, and the result is BIT-identical."""
+    from pypulsar_tpu.io import filterbank
+    from pypulsar_tpu.parallel.staged import sweep_flat
+
+    fil = _pulsar_fil(tmp_path, T=8192)
+    dms = np.arange(12) * 10.0
+    clean = sweep_flat(filterbank.FilterbankFile(fil), dms, nsub=8,
+                       group_size=4, chunk_payload=2048).steps[0].result
+
+    faultinject.configure("oom:sweep.chunk_dispatch:2")
+    with telemetry.session() as tlm:
+        faulted = sweep_flat(filterbank.FilterbankFile(fil), dms, nsub=8,
+                             group_size=4,
+                             chunk_payload=2048).steps[0].result
+        assert tlm.event_counts.get("resilience.oom_backoff") == 1
+        assert tlm.event_counts.get("resilience.fault_injected") == 1
+    np.testing.assert_array_equal(faulted.snr, clean.snr)
+    np.testing.assert_array_equal(faulted.peak_sample, clean.peak_sample)
+    np.testing.assert_array_equal(faulted.mean, clean.mean)
+
+
+def test_accel_stage_oom_bit_identical(tmp_path):
+    """Injected OOM inside the batched stage runner: the HBM chunk
+    halves and the per-spectrum candidates are unchanged."""
+    from pypulsar_tpu.fourier.accelsearch import (
+        AccelSearchConfig,
+        accel_search_batch,
+    )
+
+    rng = np.random.RandomState(11)
+    N, T = 1 << 12, 8.0
+    ffts = (rng.standard_normal((4, N)) + 1j * rng.standard_normal((4, N))
+            ).astype(np.complex64)
+    ffts /= np.sqrt(2.0)
+    cfg = AccelSearchConfig(zmax=10.0, numharm=2, sigma_min=2.5,
+                            seg_width=1 << 10)
+    clean = accel_search_batch(ffts, T, cfg)
+    faultinject.configure("oom:accel.stage_dispatch:1")
+    with telemetry.session() as tlm:
+        faulted = accel_search_batch(ffts, T, cfg)
+        assert tlm.event_counts.get("resilience.oom_backoff", 0) >= 1
+    assert len(clean) == len(faulted)
+    for a, b in zip(clean, faulted):
+        assert [(c.r, c.z, c.power, c.sigma) for c in a] \
+            == [(c.r, c.z, c.power, c.sigma) for c in b]
+
+
+def test_accel_batch_oom_bit_identical_no_fallback(tmp_path, monkeypatch):
+    """Injected OOM on a streamed-handoff batch dispatch: the batch
+    halves (NOT the serial fallback — candidates must come from the
+    batched path) and every table is byte-identical."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    assert cli_sweep.main([fil, "-o", "c", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--accel-only"]) == 0
+    ref = {os.path.basename(f)[1:]: open(f, "rb").read()
+           for f in sorted(glob.glob("c_DM*_ACCEL_20.cand"))}
+    assert len(ref) == 8
+
+    tlm_path = str(tmp_path / "oom.jsonl")
+    assert cli_sweep.main([fil, "-o", "o", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--accel-only", "--telemetry", tlm_path,
+                           "--fault-inject",
+                           "oom:accel.batch_dispatch:1"]) == 0
+    got = {os.path.basename(f)[1:]: open(f, "rb").read()
+           for f in sorted(glob.glob("o_DM*_ACCEL_20.cand"))}
+    assert got == ref
+
+    # the recovery is visible in the tlmsum view of the trace, and the
+    # serial fallback never engaged
+    from pypulsar_tpu.obs.summarize import load_records, summarize
+
+    s = summarize(load_records(tlm_path))
+    assert s.events.get("resilience.oom_backoff", 0) >= 1
+    assert s.events.get("resilience.fault_injected") == 1
+    assert "accel.batch_serial_fallback" not in s.events
+
+
+# ---------------------------------------------------------------------------
+# kill + resume at every journal kill-point
+# ---------------------------------------------------------------------------
+
+KILL_POINTS = [
+    ("dats.append:2", True),          # mid-stream .dat tee write
+    ("accel.after_stream:1", False),  # series buffered, nothing searched
+    ("accel.before_cand_write:3", False),
+    ("accel.after_cand_write:2", False),  # written but not journaled
+    ("accel.after_journal:2", False),     # journaled, next trial pending
+]
+
+
+def test_kill_resume_every_kill_point_bit_identical(tmp_path, monkeypatch):
+    """Kill the streamed sweep->accel chain at EVERY journal kill-point;
+    a --journal resume redoes exactly the unfinished units and every
+    final artifact is byte-identical to an uninterrupted run."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    # --chunk 4096: the 16384-sample file streams as FOUR chunks, so the
+    # mid-stream kill-points actually sit mid-stream
+    assert cli_sweep.main([fil, "-o", "r", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--chunk", "4096", "--write-dats",
+                           "--journal", "r.jsonl"]) == 0
+    ref_cands = {os.path.basename(f)[1:]: open(f, "rb").read()
+                 for f in sorted(glob.glob("r_DM*_ACCEL_20.cand"))}
+    ref_dats = {os.path.basename(f)[1:]: open(f, "rb").read()
+                for f in sorted(glob.glob("r_DM*.dat"))}
+    ref_sp = open("r.cands", "rb").read()
+    assert len(ref_cands) == 8 and len(ref_dats) == 8
+
+    for ki, (spec, _tee_kill) in enumerate(KILL_POINTS):
+        tag = f"k{ki}"
+        argv = [fil, "-o", tag, *SWEEP_ARGS, *HANDOFF_ARGS,
+                "--chunk", "4096", "--write-dats",
+                "--journal", f"{tag}.jsonl"]
+        with pytest.raises(faultinject.InjectedKill):
+            cli_sweep.main(argv + ["--fault-inject", "kill:" + spec])
+        faultinject.reset()
+        # no published artifact may be a truncation: every .dat that made
+        # it to its final name is byte-complete (atomic tmp + replace)
+        for f in glob.glob(f"{tag}_DM*.dat"):
+            name = os.path.basename(f)[len(tag):]
+            assert open(f, "rb").read() == ref_dats[name], (spec, f)
+        # resume: same command, no fault
+        assert cli_sweep.main(argv) == 0, spec
+        got = {os.path.basename(f)[len(tag):]: open(f, "rb").read()
+               for f in sorted(glob.glob(f"{tag}_DM*_ACCEL_20.cand"))}
+        assert got == ref_cands, spec
+        dats = {os.path.basename(f)[len(tag):]: open(f, "rb").read()
+                for f in sorted(glob.glob(f"{tag}_DM*.dat"))}
+        assert dats == ref_dats, spec
+        assert open(f"{tag}.cands", "rb").read() == ref_sp, spec
+
+
+def test_kill_mid_tee_leaves_no_truncated_dat(tmp_path, monkeypatch):
+    """A kill between .dat chunk appends leaves only .tmp staging files —
+    a published .dat name is never a truncation."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    with pytest.raises(faultinject.InjectedKill):
+        cli_sweep.main([fil, "-o", "t", *SWEEP_ARGS, *HANDOFF_ARGS,
+                        "--chunk", "4096", "--write-dats",
+                        "--fault-inject", "kill:dats.append:2"])
+    faultinject.reset()
+    assert glob.glob("t_DM*.dat") == []  # nothing published
+    assert glob.glob("t_DM*.dat.tmp")   # staging debris only
+
+
+def test_journal_resume_skips_completed_sweep_pass(tmp_path, monkeypatch):
+    """A resumed --journal run whose sweep:cands unit validates skips the
+    single-pulse sweep pass entirely (and redoes it if the artifact was
+    corrupted)."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+    from pypulsar_tpu.parallel import staged as staged_mod
+
+    calls = []
+    real = staged_mod.sweep_flat
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(staged_mod, "sweep_flat", spy)
+    argv = [fil, "-o", "j", *SWEEP_ARGS, "--journal", "j.jsonl"]
+    assert cli_sweep.main(argv) == 0
+    assert len(calls) == 1
+    ref = open("j.cands", "rb").read()
+    assert cli_sweep.main(argv) == 0
+    assert len(calls) == 1  # second run resumed from the manifest
+    assert open("j.cands", "rb").read() == ref
+    # corrupt the artifact: the checksum catches it and the pass reruns
+    with open("j.cands", "ab") as f:
+        f.write(b"garbage\n")
+    assert cli_sweep.main(argv) == 0
+    assert len(calls) == 2
+    assert open("j.cands", "rb").read() == ref
+
+
+def test_journal_different_outbase_does_not_skip(tmp_path, monkeypatch):
+    """The journal fingerprint includes the outbase: rerunning with a
+    different -o against the same journal file must produce the new
+    artifacts, not skip against the old ones."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    assert cli_sweep.main([fil, "-o", "a", *SWEEP_ARGS,
+                           "--journal", "j.jsonl"]) == 0
+    assert cli_sweep.main([fil, "-o", "b", *SWEEP_ARGS,
+                           "--journal", "j.jsonl"]) == 0
+    assert os.path.exists("b.cands")
+    assert open("b.cands", "rb").read() == open("a.cands", "rb").read()
+
+
+def test_journal_refuses_foreign_tool_manifest(tmp_path, monkeypatch):
+    """Pointing one stage's --journal at another stage's manifest raises
+    instead of silently truncating it (the chain journal survives)."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sift as cli_sift
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    assert cli_sweep.main([fil, "-o", "f", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--accel-only", "--journal",
+                           "chain.jsonl"]) == 0
+    chain = open("chain.jsonl").read()
+    cands = sorted(glob.glob("f_DM*_ACCEL_20.cand"))
+    with pytest.raises(ValueError, match="different tool"):
+        cli_sift.main(cands + ["-s", "3", "--min-hits", "1",
+                               "-o", "f.accelcands",
+                               "--journal", "chain.jsonl"])
+    assert open("chain.jsonl").read() == chain  # manifest untouched
+
+
+def test_journal_detects_truncated_cand_on_resume(tmp_path, monkeypatch):
+    """A journaled trial whose .cand was truncated after the fact is
+    re-searched on resume (size/sha256 validation), restoring the exact
+    bytes."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    argv = [fil, "-o", "v", *SWEEP_ARGS, *HANDOFF_ARGS, "--accel-only",
+            "--journal", "v.jsonl"]
+    assert cli_sweep.main(argv) == 0
+    victim = sorted(glob.glob("v_DM*_ACCEL_20.cand"))[3]
+    ref = open(victim, "rb").read()
+    with open(victim, "wb") as f:
+        f.write(ref[:44])  # torn mid-record
+    assert cli_sweep.main(argv) == 0
+    assert open(victim, "rb").read() == ref
+
+
+def test_skip_existing_revalidates_zero_byte_cand(tmp_path, monkeypatch):
+    """--accel-skip-existing re-searches a zero-byte .cand (killed-run
+    debris) instead of treating it as done — the pre-round-7 behavior
+    permanently wedged such trials."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    argv = [fil, "-o", "z", *SWEEP_ARGS, *HANDOFF_ARGS, "--accel-only"]
+    assert cli_sweep.main(argv) == 0
+    fulls = sorted(glob.glob("z_DM*_ACCEL_20.cand"))
+    assert len(fulls) == 8
+    victim = fulls[2]
+    ref = open(victim, "rb").read()
+    open(victim, "wb").close()            # zero-byte debris
+    os.remove(victim[:-5] + ".txtcand")   # and no txt twin
+    assert cli_sweep.main(argv + ["--accel-skip-existing"]) == 0
+    assert open(victim, "rb").read() == ref
+
+
+def test_cli_accelsearch_skip_existing_revalidates(tmp_path, monkeypatch):
+    """The .dat-file CLI's --skip-existing applies the same validation."""
+    monkeypatch.chdir(tmp_path)
+    from pypulsar_tpu.cli import accelsearch as cli_accel
+    from tests.test_accelsearch import _write_fake_dat
+
+    rng = np.random.RandomState(31)
+    N, dt = 1 << 13, 5e-4
+    bases = []
+    for ii in range(3):
+        ts = rng.standard_normal(N).astype(np.float32)
+        ts += 0.3 * np.cos(2 * np.pi * (40.0 + 5 * ii)
+                           * np.arange(N) * dt).astype(np.float32)
+        bases.append(_write_fake_dat(str(tmp_path / f"sk{ii}"), ts, dt))
+    dats = [b + ".dat" for b in bases]
+    argv = dats + ["-z", "10", "-n", "2", "-s", "3"]
+    assert cli_accel.main(argv) == 0
+    ref = {b: open(b + "_ACCEL_10.cand", "rb").read() for b in bases}
+    # one zero-byte debris + one valid file left alone
+    open(bases[1] + "_ACCEL_10.cand", "wb").close()
+    os.remove(bases[1] + "_ACCEL_10.txtcand")
+    before = os.path.getmtime(bases[0] + "_ACCEL_10.cand")
+    assert cli_accel.main(argv + ["--skip-existing"]) == 0
+    for b in bases:
+        assert open(b + "_ACCEL_10.cand", "rb").read() == ref[b]
+    assert os.path.getmtime(bases[0] + "_ACCEL_10.cand") == before
+
+
+def test_sift_journal_and_truncated_input(tmp_path, monkeypatch):
+    """cli/sift skips truncated .cand inputs with a warning and its
+    --journal unit makes a rerun a validated no-op."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sift as cli_sift
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    assert cli_sweep.main([fil, "-o", "s", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--accel-only"]) == 0
+    cands = sorted(glob.glob("s_DM*_ACCEL_20.cand"))
+    argv = cands + ["-s", "3", "--min-hits", "1", "-o", "s.accelcands",
+                    "--journal", "sift.jsonl"]
+    assert cli_sift.main(argv) == 0
+    ref = open("s.accelcands").read()
+    mtime = os.path.getmtime("s.accelcands")
+    assert cli_sift.main(argv) == 0  # journaled no-op
+    assert os.path.getmtime("s.accelcands") == mtime
+    rec = json.loads(open("sift.jsonl").readline())
+    assert rec["type"] == "journal" and rec["tool"] == "sift"
+    # truncated input .cand: skipped with a warning, not read short
+    data = open(cands[0], "rb").read()
+    assert len(data) >= 88
+    with open(cands[0], "wb") as f:
+        f.write(data[:-40])
+    assert cli_sift.collect([cands[0]]) == []
+    # AND the content-hashed fingerprint makes the journaled rerun
+    # re-sift (a changed input is a different run, not a no-op): the
+    # journal restarts under a new fingerprint
+    assert cli_sift.main(argv) == 0
+    rec2 = json.loads(open("sift.jsonl").readline())
+    assert rec2["fingerprint"] != rec["fingerprint"]
+    assert ref  # sanity: the sift produced output
