@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier test-faults dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-telemetry native clean
+.PHONY: test test-fourier test-faults test-fold dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-telemetry native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -55,6 +55,14 @@ bench-accel-pipeline:
 	$(PY) tools/run_configs4.py --stream --ab-stream --keep
 	$(PY) tools/accel_roofline.py
 
+# the fold pipeline suite: batched-vs-serial archive parity (byte
+# identical), refinement vs a refold grid, kill/resume, OOM halving,
+# DM-group slicing (docs/ARCHITECTURE.md "Fold pipeline")
+test-fold:
+	$(CPU_ENV) $(PY) -m pytest tests/test_fold_pipeline.py -q
+
+# engine throughput + the batched candidate-fold pipeline A/B
+# (foldbatch vs the serial per-candidate prepfold loop)
 bench-fold:
 	$(PY) bench.py --fold
 

@@ -1137,6 +1137,12 @@ def run_fold(args):
                                atol=0.5)  # fused part_profs[0] twin-checked
     bl_samples_per_sec = C * T / bl_time
     speedup = fused_samples_per_sec / bl_samples_per_sec
+    try:
+        pipe_extras = _fold_pipeline_ab(args)
+    except Exception as e:  # noqa: BLE001 - the headline must still land
+        print(f"# fold pipeline A/B failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        pipe_extras = {"fold_pipe_error": f"{type(e).__name__}: {e}"}
     print(f"# fold: fused stats {fused_time:.3f}s = "
           f"{fused_samples_per_sec/1e9:.2f} Gsamp/s end-to-end "
           f"(kernel {kernel_time:.3f}s = "
@@ -1163,7 +1169,190 @@ def run_fold(args):
         "kernel_seconds": round(kernel_time, 3),
         "kernel_samples_per_sec": round(kernel_samples_per_sec, 1),
         "numpy_seconds_scaled": round(bl_time, 3),
+        **pipe_extras,
     }
+
+
+def _fold_pipeline_ab(args):
+    """Batched candidate-fold PIPELINE A/B (the round-8 tentpole's
+    acceptance measurement), two legs:
+
+    PARITY (per-DM .dat series): ``foldbatch --datbase`` vs one
+    in-process ``prepfold`` call per candidate on the same series — the
+    archives must be BYTE-identical (profs + stats arrays; the batched
+    one-hot fold runs the identical per-candidate contraction, so the
+    f32 accumulation matches bitwise) and the derived SNRs equal.
+
+    SPEEDUP (raw .fil): ``foldbatch <fil> --cands`` streams the
+    observation ONCE (dedisperse via the sweep chunk kernel, one batched
+    fold per DM group, on-device (p, pdot) refinement) vs the serial
+    workflow it replaces — one ``prepfold`` INVOCATION per candidate,
+    each a fresh process re-reading the raw file (exactly how the
+    per-candidate tool is used; measured on a subset and scaled
+    linearly, the bench's standing baseline pattern). The in-process
+    serial loop is also recorded (``*_inproc``) so the process-overhead
+    share is visible."""
+    import subprocess
+    import tempfile
+
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+    from pypulsar_tpu.cli import prepfold as cli_prepfold
+    from pypulsar_tpu.fold import profile_snr
+    from pypulsar_tpu.io import filterbank
+    from pypulsar_tpu.io.datfile import write_dat
+    from pypulsar_tpu.io.infodata import InfoData
+    from pypulsar_tpu.io.prestopfd import PfdFile
+
+    ndm, per_dm = 4, 8  # 32 candidates, the acceptance floor
+    Np = 1 << 15 if (args.quick or args.cpu_fallback) else 1 << 16
+    C, dtp = 32, 5e-4
+    nbins, npart = 64, 16
+    rng = np.random.default_rng(7)
+    dms = [10.0 * (d + 1) for d in range(ndm)]
+    cand_rows = []
+    t = np.arange(Np) * dtp
+    olddir = os.getcwd()
+    with tempfile.TemporaryDirectory() as td:
+        os.chdir(td)
+        try:
+            # toy observation: C-channel .fil with one dispersed pulse
+            # train, plus the per-DM dedispersed .dat series the parity
+            # leg folds (same noise seed per DM so the series are stable)
+            for d, dm in enumerate(dms):
+                base_p = 0.0517 * (1.0 + 0.13 * d)
+                ts = rng.standard_normal(Np).astype(np.float32)
+                ts += 3.0 * np.exp(
+                    -0.5 * (((t / base_p) % 1.0 - 0.4) / 0.03) ** 2
+                ).astype(np.float32)
+                inf = InfoData()
+                inf.epoch, inf.dt, inf.N = 55000.0, dtp, Np
+                inf.telescope, inf.object = "Fake", "BENCH"
+                inf.lofreq, inf.BW = 1400.0, 100.0
+                inf.numchan, inf.chan_width = 1, 100.0
+                inf.DM = dm
+                write_dat(f"toy_DM{dm:.2f}", ts, inf)
+                for j in range(per_dm):
+                    cand_rows.append((base_p * (1.0 + 0.021 * j), dm))
+            fildata = rng.standard_normal((Np, C)).astype(np.float32) * 2.0
+            phase = (t / 0.0731) % 1.0
+            fildata += 8.0 * np.exp(
+                -0.5 * ((phase - 0.5) / 0.03) ** 2
+            ).astype(np.float32)[:, None]
+            filterbank.write_filterbank(
+                "toy.fil", dict(nchans=C, tsamp=dtp, fch1=1500.0,
+                                foff=-4.0, tstart=55000.0, nbits=32,
+                                nifs=1, source_name="BENCH"), fildata)
+            with open("cands.txt", "w") as f:
+                f.writelines(f"{p!r} {dm}\n" for p, dm in cand_rows)
+            n = len(cand_rows)
+
+            # -- parity leg (.dat series, in-process both sides) --------
+            t0 = time.perf_counter()
+            for i, (p, dm) in enumerate(cand_rows):
+                rc = cli_prepfold.main(
+                    [f"toy_DM{dm:.2f}.dat", "-p", repr(p), "--dm",
+                     str(dm), "-n", str(nbins), "--npart", str(npart),
+                     "-o", f"serial_{i:04d}.pfd"])
+                assert rc == 0
+            dat_serial_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            rc = cli_foldbatch.main(
+                ["--cands", "cands.txt", "--datbase", "toy", "-o", "bb",
+                 "-n", str(nbins), "--npart", str(npart)])
+            assert rc == 0
+            dat_pipe_s = time.perf_counter() - t0
+
+            import json as _json
+
+            summary = _json.load(open("bb_foldbatch.json"))
+            results = [r for r in summary["results"]
+                       if not r.get("skipped")]
+            # join on the candNNNN index encoded in the name, and fail
+            # LOUDLY if any candidate is missing — a positional zip
+            # would silently misalign every comparison after one
+            # failed fold
+            assert len(results) == len(cand_rows), (
+                f"foldbatch folded {len(results)}/{len(cand_rows)}")
+            identical = 0
+            snr_diff = 0.0
+            for res in results:
+                i = int(res["name"][4:8])
+                a = PfdFile(f"serial_{i:04d}.pfd")
+                b = PfdFile(res["pfd"])
+                if (np.array_equal(a.profs, b.profs)
+                        and np.array_equal(a.stats, b.stats)):
+                    identical += 1
+                try:
+                    sa = profile_snr.pfd_snr(a)["snr"]
+                    sb = profile_snr.pfd_snr(b)["snr"]
+                    snr_diff = max(snr_diff, abs(sa - sb))
+                except profile_snr.OnPulseError:
+                    pass  # a noise fold with no on-pulse: nothing to score
+
+            # -- speedup leg (raw .fil) ---------------------------------
+            t0 = time.perf_counter()
+            rc = cli_foldbatch.main(
+                ["toy.fil", "--cands", "cands.txt", "-o", "ff",
+                 "-n", str(nbins), "--npart", str(npart), "-s", "8",
+                 "--group-size", "4"])
+            assert rc == 0
+            pipe_s = time.perf_counter() - t0
+            n_serial = min(6, n)  # subset, scaled linearly (cost is
+            # per-invocation constant + per-sample linear, both measured)
+            repo_root = os.path.dirname(os.path.abspath(__file__))
+            env = dict(os.environ)
+            env["PYTHONPATH"] = (repo_root + os.pathsep +
+                                 env.get("PYTHONPATH", "")).rstrip(
+                                     os.pathsep)
+            t0 = time.perf_counter()
+            for i, (p, dm) in enumerate(cand_rows[:n_serial]):
+                subprocess.run(
+                    [sys.executable, "-m", "pypulsar_tpu.cli.prepfold",
+                     "toy.fil", "-p", repr(p), "--dm", str(dm),
+                     "-n", str(nbins), "--npart", str(npart),
+                     "-o", f"raw_{i:04d}.pfd"],
+                    check=True, capture_output=True, env=env)
+            serial_s = (time.perf_counter() - t0) * (n / n_serial)
+            t0 = time.perf_counter()
+            for i, (p, dm) in enumerate(cand_rows[:n_serial]):
+                rc = cli_prepfold.main(
+                    ["toy.fil", "-p", repr(p), "--dm", str(dm),
+                     "-n", str(nbins), "--npart", str(npart),
+                     "-o", f"rawi_{i:04d}.pfd"])
+                assert rc == 0
+            serial_inproc_s = (time.perf_counter() - t0) * (n / n_serial)
+
+            print(f"# fold pipe A/B: raw-file serial loop "
+                  f"{serial_s:.1f}s est ({n / serial_s:.2f} cand/s, "
+                  f"{n_serial} invocations measured; in-process "
+                  f"{serial_inproc_s:.1f}s) vs streamed batched "
+                  f"{pipe_s:.2f}s ({n / pipe_s:.2f} cand/s) = "
+                  f"{serial_s / pipe_s:.1f}x; .dat parity leg "
+                  f"{dat_serial_s / dat_pipe_s:.1f}x with {identical}/"
+                  f"{n} archives byte-identical, max |dSNR| "
+                  f"{snr_diff:.2e}", file=sys.stderr)
+            return {
+                "fold_pipe_n_cands": n,
+                "fold_pipe_n_dms": ndm,
+                "fold_pipe_nsamp": Np,
+                "fold_pipe_nchan": C,
+                "fold_pipe_cands_per_sec": round(n / pipe_s, 2),
+                "fold_pipe_serial_cands_per_sec": round(n / serial_s, 3),
+                "fold_pipe_speedup": round(serial_s / pipe_s, 2),
+                "fold_pipe_seconds": round(pipe_s, 3),
+                "fold_pipe_serial_seconds_est": round(serial_s, 2),
+                "fold_pipe_serial_invocations_measured": n_serial,
+                "fold_pipe_serial_inproc_seconds_est":
+                    round(serial_inproc_s, 2),
+                "fold_pipe_speedup_inproc":
+                    round(serial_inproc_s / pipe_s, 2),
+                "fold_pipe_dat_speedup":
+                    round(dat_serial_s / dat_pipe_s, 2),
+                "fold_pipe_archives_identical": f"{identical}/{n}",
+                "fold_pipe_max_snr_diff": float(snr_diff),
+            }
+        finally:
+            os.chdir(olddir)
 
 
 def run_waterfall(args):

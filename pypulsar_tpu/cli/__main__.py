@@ -6,7 +6,7 @@ import importlib
 import sys
 
 TOOLS = [
-    "sweep", "accelsearch", "sift", "prepfold", "rfifind",
+    "sweep", "accelsearch", "sift", "prepfold", "foldbatch", "rfifind",
     "waterfaller", "zero_dm_filter", "freq_time", "spectrogram",
     "dissect", "pulses_to_toa", "sum_profs", "pulse_energy_distribution",
     "autozap", "plot_accelcands", "combinefil", "stitchdat",

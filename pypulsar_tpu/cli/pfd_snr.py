@@ -15,6 +15,8 @@ goes through ``pypulsar_tpu.fold.profile_snr``.
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
 from typing import List, Optional, Tuple
 
@@ -107,6 +109,12 @@ def build_parser():
     parser.add_argument("-g", "--gaussian-file", dest="gauss_file",
                         default=None,
                         help="pygaussfit-created Gaussians file")
+    parser.add_argument("--json", default=None, metavar="PATH",
+                        help="batch mode: write one machine-readable "
+                             "JSON summary (name, best DM, SNR, mean "
+                             "flux per archive) covering every input — "
+                             "file args may be globs (quoted), so a "
+                             "folded survey summarizes in one call")
     return parser
 
 
@@ -153,6 +161,30 @@ def interactive_snr(pfd, sefd=None, show=True):
     return picker.result
 
 
+def expand_pfd_args(files: List[str]) -> List[str]:
+    """Glob-expand file arguments that the shell did not (quoted
+    patterns, or callers passing literal globs): each arg that names no
+    existing file but contains glob magic expands sorted, so a folded
+    survey's archives enumerate deterministically."""
+    import glob as _glob
+
+    out: List[str] = []
+    for fn in files:
+        if not os.path.exists(fn) and _glob.has_magic(fn):
+            matches = sorted(_glob.glob(fn))
+            if not matches:
+                # keep the dead pattern: it fails LOUDLY downstream (a
+                # missing-file error, or an error row in --json batch
+                # mode) instead of a survey summary silently missing a
+                # whole archive set behind a typo'd glob
+                out.append(fn)
+            else:
+                out.extend(matches)
+        else:
+            out.append(fn)
+    return out
+
+
 def main(argv=None):
     args = build_parser().parse_args(argv)
     if args.sefd is not None and (args.tsys is not None or
@@ -164,40 +196,122 @@ def main(argv=None):
         print("Both gain and system temperature must be provided "
               "together.", file=sys.stderr)
         return 1
+    if args.json and args.interactive:
+        print("--json is batch mode; it does not compose with "
+              "--interactive.", file=sys.stderr)
+        return 1
+    args.files = expand_pfd_args(args.files)
+    rows = []
 
     for pfdfn in args.files:
         print(pfdfn)
-        pfd = PfdFile(pfdfn)
-        sefd = effective_sefd(args, pfd)
-
-        if args.interactive:
-            result = interactive_snr(pfd, sefd)
-            if result is not None:
-                print("SNR: %.3f" % result["snr"])
-                if result["smean"] is not None:
-                    print("Mean flux density (mJy): %.4f" % result["smean"])
-            else:
-                print("no valid on-pulse selection")
+        try:
+            pfd = PfdFile(pfdfn)
+        except Exception as e:  # noqa: BLE001 - any parse failure
+            # batch mode: one corrupt archive (truncation debris, a
+            # foreign file caught by the glob) must not lose the whole
+            # survey summary
+            if not args.json:
+                raise
+            print("unreadable archive (%s: %s); recording error row"
+                  % (type(e).__name__, e))
+            rows.append({"pfd": pfdfn, "name": None, "best_dm": None,
+                         "period": None, "snr": None, "weq_bins": None,
+                         "smean_mjy": None,
+                         "error": f"unreadable: {type(e).__name__}"})
             continue
+        try:
+            _append_archive_row(args, pfd, pfdfn, rows)
+        except profile_snr.OnPulseError:
+            raise  # handled (and rowed) inside; cannot reach here
+        except Exception as e:  # noqa: BLE001 - batch mode survives
+            # ANY per-archive failure (bad metadata through the SEFD sky
+            # lookup, a pathological stats block, ...) must not lose the
+            # rest of the survey summary
+            if not args.json:
+                raise
+            print("archive analysis failed (%s: %s); recording error row"
+                  % (type(e).__name__, e))
+            rows.append({"pfd": pfdfn, "name": pfd.candnm,
+                         "best_dm": float(pfd.bestdm),
+                         "period": float(pfd.curr_p1), "snr": None,
+                         "weq_bins": None, "smean_mjy": None,
+                         "error": f"failed: {type(e).__name__}"})
+    if args.json:
+        from pypulsar_tpu.resilience.journal import atomic_write_text
 
-        regions = None
-        model = None
-        if args.on_pulse is not None:
-            lo, hi = args.on_pulse
-            regions = [(int(lo * pfd.proflen), int(hi * pfd.proflen))]
-        elif args.model_file is not None:
-            model = model_from_components(
-                parse_model_file(args.model_file), pfd.proflen)
-        elif args.gauss_file is not None:
-            model = profile_snr.read_gaussfitfile(args.gauss_file,
-                                                  pfd.proflen)
+        atomic_write_text(args.json, json.dumps(rows, indent=1))
+        print("Wrote %s (%d archives)" % (args.json, len(rows)),
+              file=sys.stderr)
+        # exit-code contract: an UNREADABLE/FAILED input is an error in
+        # batch mode too (the non-JSON path raises on it) — the summary
+        # is still written, but a pipeline gating on the exit code sees
+        # the failure. A no-on-pulse non-detection stays rc 0: that is
+        # a measurement, not an error.
+        if any(str(r.get("error", "")).startswith(("unreadable",
+                                                   "failed"))
+               for r in rows):
+            return 1
+    return 0
 
+
+def _append_archive_row(args, pfd, pfdfn: str, rows: list) -> None:
+    """Analyse ONE archive into its summary row (the per-file body of
+    :func:`main`'s batch loop, isolated so batch mode can contain any
+    per-archive failure)."""
+    sefd = effective_sefd(args, pfd)
+
+    if args.interactive:
+        result = interactive_snr(pfd, sefd)
+        if result is not None:
+            print("SNR: %.3f" % result["snr"])
+            if result["smean"] is not None:
+                print("Mean flux density (mJy): %.4f" % result["smean"])
+        else:
+            print("no valid on-pulse selection")
+        return
+
+    regions = None
+    model = None
+    if args.on_pulse is not None:
+        lo, hi = args.on_pulse
+        regions = [(int(lo * pfd.proflen), int(hi * pfd.proflen))]
+    elif args.model_file is not None:
+        model = model_from_components(
+            parse_model_file(args.model_file), pfd.proflen)
+    elif args.gauss_file is not None:
+        model = profile_snr.read_gaussfitfile(args.gauss_file,
+                                              pfd.proflen)
+
+    try:
         result = profile_snr.pfd_snr(pfd, regions=regions, model=model,
                                      sefd=sefd, verbose=True)
-        print("SNR: %.3f" % result["snr"])
-        if result["smean"] is not None:
-            print("Mean flux density (mJy): %.4f" % result["smean"])
-    return 0
+    except profile_snr.OnPulseError as e:
+        # a survey fold of a noise candidate legitimately has no
+        # on-pulse region; batch mode records the non-detection
+        # instead of aborting the whole summary
+        if not args.json:
+            raise
+        print("no on-pulse region (%s); recording SNR null" % e)
+        rows.append({"pfd": pfdfn, "name": pfd.candnm,
+                     "best_dm": float(pfd.bestdm),
+                     "period": float(pfd.curr_p1), "snr": None,
+                     "weq_bins": None, "smean_mjy": None,
+                     "error": "no on-pulse region"})
+        return
+    print("SNR: %.3f" % result["snr"])
+    if result["smean"] is not None:
+        print("Mean flux density (mJy): %.4f" % result["smean"])
+    rows.append({
+        "pfd": pfdfn,
+        "name": pfd.candnm,
+        "best_dm": float(pfd.bestdm),
+        "period": float(pfd.curr_p1),
+        "snr": float(result["snr"]),
+        "weq_bins": float(result["weq"]),
+        "smean_mjy": (None if result["smean"] is None
+                      else float(result["smean"])),
+    })
 
 
 if __name__ == "__main__":

@@ -105,10 +105,18 @@ def build_parser():
                    help="phase bins per profile (default 64)")
     p.add_argument("--npart", type=int, default=32,
                    help="time partitions (default 32)")
-    p.add_argument("--nsub", type=int, default=32,
-                   help="frequency subbands (default 32; 1 for .dat)")
+    p.add_argument("--nsub", type=int, default=None,
+                   help="frequency subbands (default 32; 1 for .dat). "
+                        "None-default so --cands batch mode can detect "
+                        "and reject an explicit value")
     p.add_argument("-o", "--outfile", default=None,
                    help="output .pfd path (default <base>_<P-ms>ms.pfd)")
+    p.add_argument("--cands", default=None, metavar="FILE",
+                   help="BATCH mode: fold every candidate in FILE (a "
+                        "sifted .accelcands list or a 'period_s dm "
+                        "[pdot]' table) in one streamed pass via the "
+                        "batched fold pipeline (cli/foldbatch) instead "
+                        "of one (P, Pdot, DM) fold")
     from pypulsar_tpu.obs import telemetry
 
     telemetry.add_telemetry_flag(
@@ -119,6 +127,34 @@ def build_parser():
 def main(argv=None):
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.cands is not None:
+        # batch mode delegates to the shared fold pipeline: same fold
+        # geometry flags, one streamed pass for the whole list
+        if args.period is not None or args.par is not None:
+            parser.error("--cands is batch mode; -p/--par fold one "
+                         "candidate")
+        if args.pd or args.pdd or args.dm is not None:
+            parser.error("--pd/--pdd/--dm come from the candidate list "
+                         "in --cands batch mode")
+        if args.nsub is not None:
+            parser.error("--nsub is the ARCHIVE subband count and does "
+                         "not apply in --cands batch mode (the batch "
+                         "pipeline folds dedispersed 1-D series; its "
+                         "stream dedispersion subbands are foldbatch's "
+                         "-s flag)")
+        from pypulsar_tpu.cli import foldbatch
+
+        # NOTE: prepfold's --nsub (archive frequency subbands) is NOT
+        # forwarded — foldbatch's -s is the STREAM dedispersion subband
+        # count, a different knob with its own default; forwarding would
+        # silently change dedispersion quality vs a direct foldbatch run
+        fargv = [args.infile, "--cands", args.cands,
+                 "-n", str(args.proflen), "--npart", str(args.npart)]
+        if args.outfile:
+            fargv += ["-o", os.path.splitext(args.outfile)[0]]
+        if args.telemetry:
+            fargv += ["--telemetry", args.telemetry]
+        return foldbatch.main(fargv)
     if (args.period is None) == (args.par is None):
         parser.error("give exactly one of -p/--period or --par")
     if args.par is not None and (args.pd or args.pdd):
@@ -158,7 +194,7 @@ def _run(args):
         dt = float(fb.tsamp)
         total = fb.number_of_samples
         numchan = fb.nchans
-        nsub = args.nsub
+        nsub = 32 if args.nsub is None else args.nsub
         if numchan % nsub:
             raise SystemExit(f"nsub={nsub} must divide nchans={numchan}")
         freqs = np.asarray(fb.frequencies)

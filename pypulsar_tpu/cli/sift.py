@@ -170,11 +170,39 @@ def build_parser():
                         "-o only): a rerun whose output unit validates "
                         "(size+sha256) is a no-op — the sift end of the "
                         "sweep->accel->sift chain manifest")
+    p.add_argument("--fold", action="store_true",
+                   help="fold the sifted list into .pfd archives in one "
+                        "batched pass (parallel/foldpipe) off the per-DM "
+                        ".dat files sitting next to the input .cands — "
+                        "closes raw -> candidates -> .pfd in one command")
+    p.add_argument("--fold-nbins", type=int, default=64,
+                   help="with --fold: phase bins per profile (default 64)")
+    p.add_argument("--fold-npart", type=int, default=32,
+                   help="with --fold: time partitions (default 32)")
+    p.add_argument("--fold-outbase", default=None,
+                   help="with --fold: archive basename (default: the "
+                        "-o outfile sans extension, else 'sifted')")
+    from pypulsar_tpu.obs import telemetry
+
+    telemetry.add_telemetry_flag(
+        p, what="sift + (with --fold) foldpipe spans and counters")
     return p
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    from pypulsar_tpu.obs import telemetry
+
+    with telemetry.session_from_flag(args.telemetry, tool="sift"):
+        return _run(args)
+
+
+def _run(args):
+    if args.fold and not args.outfile:
+        build_parser().error("--fold requires -o/--outfile: the fold "
+                             "reads the WRITTEN .accelcands (the "
+                             "canonical handoff), so reruns fold "
+                             "identical candidates")
     journal = None
     unit = None
     if args.journal:
@@ -210,6 +238,10 @@ def main(argv=None):
             print(f"# journal: {args.outfile} validated complete, "
                   f"skipping", file=sys.stderr)
             journal.close()
+            if args.fold:
+                # the journal unit covers the SIFT artifact only: a run
+                # killed during --fold must still fold on resume
+                return _fold_sifted(args, collect(args.candfiles))
             return 0
     files = collect(args.candfiles)
     cands = sift(files, min_sigma=args.min_sigma, min_hits=args.min_hits)
@@ -222,7 +254,58 @@ def main(argv=None):
     if journal is not None:
         journal.done(unit, [args.outfile])
         journal.close()
+    if args.fold and cands:
+        return _fold_sifted(args, files)
     return 0
+
+
+def _fold_sifted(args, files) -> int:
+    """--fold: batch-fold the sifted list off the per-DM .dat series
+    sitting next to the input .cand files (the sweep's --write-dats
+    artifacts) — the sifted survey output goes straight to archives in
+    ONE pass per DM group, no per-candidate prepfold loop.
+
+    Candidates come from the WRITTEN ``.accelcands`` (not the in-memory
+    sift result): the text artifact is the canonical handoff, so a rerun
+    — including the journal-validated resume path — folds IDENTICAL
+    candidates and ``skip_existing`` keeps complete archives untouched
+    instead of rewriting them with perturbed values."""
+    from pypulsar_tpu.io.accelcands import parse_candlist
+    from pypulsar_tpu.parallel.foldpipe import (
+        cands_from_accelcands,
+        fold_pipeline,
+        print_fold_results,
+    )
+
+    cands = parse_candlist(args.outfile)
+    if not cands:
+        return 0
+
+    # key by the DM{:.2f} STRING, not the float: the candidate DM is
+    # parsed back from the written .accelcands (%.2f text) and ~1 in 5
+    # grid DMs do not round-trip through 2-decimal text to the exact
+    # .inf float — the filename convention is the stable join key
+    dat_by_dm = {f"{dm:.2f}": fn.split("_ACCEL_")[0] + ".dat"
+                 for fn, dm, _T, _c in files}
+    missing = sorted({f"DM{c.dm:.2f}" for c in cands
+                      if not os.path.exists(
+                          dat_by_dm.get(f"{c.dm:.2f}", ""))})
+    if missing:
+        print(f"# --fold: no .dat series for {', '.join(missing)} next "
+              f"to the .cand inputs; re-run the sweep with --write-dats, "
+              f"or use 'foldbatch <raw.fil> --cands' to stream from the "
+              f"raw file", file=sys.stderr)
+        return 1
+    outbase = args.fold_outbase or os.path.splitext(args.outfile)[0]
+    summary = fold_pipeline(
+        cands_from_accelcands(cands), outbase, source="dats",
+        dat_for_dm=lambda dm: dat_by_dm[f"{dm:.2f}"],
+        nbins=args.fold_nbins, npart=args.fold_npart,
+        skip_existing=True, verbose=True)
+    print_fold_results(summary)
+    print(f"# folded {summary['n_folded']} sifted candidates "
+          f"({summary['n_failed']} failed)", file=sys.stderr)
+    return 0 if summary["n_failed"] == 0 else 1
 
 
 if __name__ == "__main__":

@@ -315,6 +315,206 @@ def fold_snr_stats(data, bin_idx, nbins: int, npart: int, dt: float,
                 counts=counts)
 
 
+# ---------------------------------------------------------------------------
+# batched candidate folding (the fold-pipeline kernels)
+# ---------------------------------------------------------------------------
+
+def _onehot_fold_1d_batch(data, bin_idx, nbins: int):
+    """``[K]``-candidate fold of ONE shared 1-D block: each candidate k
+    scatters the same ``data[T]`` into its own bins via
+    ``einsum('t,ktb->kb', data, one_hot(bin_idx[k]))`` — the per-candidate
+    contraction is the identical length-T f32 gemv the serial 2-D path
+    (:func:`_onehot_fold_2d` at C=1) performs, batched on the candidate
+    axis. Time blocking at the same ``_FOLD_BLOCK`` seams as the serial
+    path, so the f32 accumulation splits match it; the LIVE one-hot is K
+    times the serial path's (the candidate axis is the halving_dispatch
+    axis on OOM — parallel/foldpipe). Byte-identity with the serial path
+    is PINNED on the CPU backend (tests + BENCH_r07_fold.json); on other
+    backends XLA may tile the batched contraction differently, where the
+    guaranteed contract is the f32/SNR tolerance of the golden twins.
+    Returns (prof[K, nbins] f32, counts[K, nbins] f32 — exact while
+    block counts < 2^24, the _onehot_fold_2d argument)."""
+    K, T = bin_idx.shape
+    if T <= _FOLD_BLOCK:
+        onehot = jax.nn.one_hot(bin_idx, nbins, dtype=data.dtype)
+        prof = jnp.einsum("t,ktb->kb", data, onehot,
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
+        return prof, onehot.sum(axis=1)
+    nblk = -(-T // _FOLD_BLOCK)
+    pad = nblk * _FOLD_BLOCK - T
+    d = jnp.pad(data, (0, pad)).reshape(nblk, _FOLD_BLOCK)
+    b = jnp.pad(bin_idx, ((0, 0), (0, pad)), constant_values=nbins)
+    b = b.reshape(K, nblk, _FOLD_BLOCK).transpose(1, 0, 2)
+
+    def body(acc, xs):
+        dblk, bblk = xs
+        acc_p, acc_c = acc
+        onehot = jax.nn.one_hot(bblk, nbins, dtype=dblk.dtype)
+        prof = jnp.einsum("t,ktb->kb", dblk, onehot,
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
+        return (acc_p + prof, acc_c + onehot.sum(axis=1)), None
+
+    (prof, cnt), _ = jax.lax.scan(
+        body, (jnp.zeros((K, nbins), jnp.float32),
+               jnp.zeros((K, nbins), jnp.float32)), (d, b))
+    return prof, cnt
+
+
+def _fold_parts_batch_impl(series, bin_idx, nbins: int, npart: int):
+    series = jnp.asarray(series)
+    bin_idx = jnp.asarray(bin_idx, jnp.int32)
+    K, T = bin_idx.shape
+    part_len = T // npart
+    if part_len >= 1 << 24:
+        raise ValueError(
+            f"part_len={part_len} >= 2^24: f32 one-hot counts would lose "
+            f"exactness; use more partitions")
+    d = series[: npart * part_len].reshape(npart, part_len)
+    b = bin_idx[:, : npart * part_len].reshape(
+        K, npart, part_len).transpose(1, 0, 2)
+
+    def body(carry, xs):
+        dpart, bpart = xs
+        prof, cnt = _onehot_fold_1d_batch(dpart, bpart, nbins)
+        return carry, (prof, cnt.astype(jnp.int32))
+
+    _, (profs, counts) = jax.lax.scan(body, 0, (d, b))
+    return profs.transpose(1, 0, 2), counts.transpose(1, 0, 2)
+
+
+_fold_parts_batch_jit = partial(jax.jit, static_argnames=("nbins", "npart"))(
+    _fold_parts_batch_impl)
+
+
+def fold_parts_batch(series, bin_idx, nbins: int, npart: int):
+    """Fold ONE shared dedispersed series at ``K`` candidates' phase
+    models in one compiled program: ``series[T]`` float32 is cut into
+    ``npart`` partitions (trailing remainder dropped, as
+    :func:`fold_parts`) and each partition is folded per candidate via
+    the batched one-hot contraction — the fold-pipeline core (candidates
+    sharing a DM share the data pass; only the per-candidate bin indices
+    differ). Returns (profiles[K, npart, nbins] f32,
+    counts[K, npart, nbins] int32)."""
+    if telemetry.is_active():
+        telemetry.counter("fold.samples",
+                          int(np.shape(bin_idx)[0]) * int(np.size(series)))
+    with telemetry.span("fold_parts_batch", nbins=nbins, npart=npart,
+                        n_cands=int(np.shape(bin_idx)[0])):
+        return _fold_parts_batch_jit(series, bin_idx, nbins, npart)
+
+
+def fold_parts_batch_numpy(series, bin_idx, nbins: int, npart: int):
+    """Golden float64 twin of :func:`fold_parts_batch`: per candidate,
+    per partition, the EXACT per-candidate :func:`fold_numpy` bincount —
+    bit-identical to folding each candidate alone (the parity contract
+    of the batched pipeline)."""
+    series = np.asarray(series, np.float64)
+    bin_idx = np.asarray(bin_idx)
+    K, T = bin_idx.shape
+    part_len = T // npart
+    profs = np.empty((K, npart, nbins), np.float64)
+    counts = np.empty((K, npart, nbins), np.int64)
+    for k in range(K):
+        for i in range(npart):
+            sl = slice(i * part_len, (i + 1) * part_len)
+            p, c = fold_numpy(series[sl], bin_idx[k, sl], nbins)
+            profs[k, i] = p
+            counts[k, i] = c.astype(np.int64)
+    return profs, counts
+
+
+@jax.jit
+def _refine_chi2_jit(part_profs, offsets):
+    """chi2[K, J] of every candidate x drift-trial combination: trial j
+    rotates candidate k's partition i by ``offsets[j, i]`` cycles
+    (Fourier phase ramp — exact for band-limited profiles, the
+    fold_stats dp machinery generalized to a shared 2-D (p, pdot) drift
+    grid), sums the re-aligned partitions and scores the summed profile
+    by its variance about the mean (the chi2-max trial is the
+    best-aligned one). ZERO refolds: the data never re-enters — only the
+    [npart, nbins] sub-profiles rotate."""
+    nbins = part_profs.shape[-1]
+    pf = jnp.fft.rfft(part_profs, axis=-1)  # [K, npart, F]
+    k = jnp.arange(pf.shape[-1], dtype=jnp.float32)
+    ang = -2.0 * jnp.pi * offsets[:, :, None] * k[None, None, :]
+    rot = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))  # [J, npart, F]
+    # HIGHEST: same bf16-rounding trap _fold_stats_jit documents
+    dp_f = jnp.einsum("inf,jnf->ijf", pf, rot,
+                      precision=jax.lax.Precision.HIGHEST)  # [K, J, F]
+    profs = jnp.fft.irfft(dp_f, n=nbins, axis=-1)  # [K, J, nbins]
+    return ((profs - profs.mean(axis=-1, keepdims=True)) ** 2).sum(axis=-1)
+
+
+def refine_chi2(part_profs, offsets):
+    """See :func:`_refine_chi2_jit`; this wrapper adds the dispatch span."""
+    with telemetry.span("fold_refine", n_cands=int(np.shape(part_profs)[0]),
+                        n_trials=int(np.shape(offsets)[0])):
+        return _refine_chi2_jit(jnp.asarray(part_profs),
+                                jnp.asarray(offsets, jnp.float32))
+
+
+def refine_chi2_numpy(part_profs, offsets):
+    """Golden float64 twin of :func:`refine_chi2`."""
+    part_profs = np.asarray(part_profs, np.float64)
+    off = np.asarray(offsets, np.float64)
+    pf = np.fft.rfft(part_profs, axis=-1)
+    k = np.arange(pf.shape[-1])
+    rot = np.exp(-2j * np.pi * off[:, :, None] * k[None, None, :])
+    profs = np.fft.irfft(np.einsum("inf,jnf->ijf", pf, rot),
+                         n=part_profs.shape[-1], axis=-1)
+    return ((profs - profs.mean(axis=-1, keepdims=True)) ** 2).sum(axis=-1)
+
+
+def refine_drift_grid(ntrial_p: int = 33, ntrial_pd: int = 17,
+                      max_drift_cycles: float = 2.0):
+    """The candidate-INDEPENDENT (p, pdot) refinement trial grid,
+    parametrized in whole-observation drift cycles so one grid (and one
+    device rotation tensor) serves every candidate in a batch regardless
+    of its period:
+
+    - ``dl``: linear drift over the observation, cycles. A fold at P of
+      a signal at P + dp is re-aligned by the trial with
+      ``dl = dp * T / P**2`` (the bestprof_offsets relation,
+      ``off = -t * dp / P**2`` with u = t/T normalized);
+    - ``dq``: quadratic drift, cycles. A pdot error dpd is re-aligned by
+      ``dq = dpd * T**2 / (2 P**2)``.
+
+    Returns (dl[J], dq[J]) flattened over the ``ntrial_p x ntrial_pd``
+    grid (``ntrial_pd=1`` collapses to the pure-period bestprof grid);
+    :func:`drift_offsets` turns them into per-partition rotation offsets
+    and :func:`drift_to_p_pd` maps a winning trial back to a candidate's
+    (p, pdot)."""
+    # a single-trial axis collapses to ZERO drift (np.linspace(-m, m, 1)
+    # would return [-m], biasing every refined value by a full -m drift)
+    dls = (np.linspace(-max_drift_cycles, max_drift_cycles, ntrial_p)
+           if ntrial_p > 1 else np.array([0.0]))
+    dqs = (np.linspace(-max_drift_cycles, max_drift_cycles, ntrial_pd)
+           if ntrial_pd > 1 else np.array([0.0]))
+    DL, DQ = np.meshgrid(dls, dqs, indexing="ij")
+    return DL.ravel(), DQ.ravel()
+
+
+def drift_offsets(dl: np.ndarray, dq: np.ndarray, npart: int) -> np.ndarray:
+    """offsets[J, npart] float32 rotation cycles for the drift grid:
+    partition i (normalized mid-time u_i) of trial j re-aligns by the
+    drift the trial hypothesizes at u_i (the bestprof_offsets sign
+    convention, which the fold_stats chi2-argmax machinery pins down)."""
+    u = (np.arange(npart) + 0.5) / npart
+    off = -(dl[:, None] * u[None, :] + dq[:, None] * u[None, :] ** 2)
+    return off.astype(np.float32)
+
+
+def drift_to_p_pd(dl: float, dq: float, period: float, pdot: float,
+                  T_sec: float):
+    """Map a winning drift trial back to this candidate's refined
+    (p, pdot): inverse of the :func:`refine_drift_grid` relations."""
+    dp = dl * period * period / max(T_sec, 1e-12)
+    dpd = 2.0 * dq * period * period / max(T_sec * T_sec, 1e-24)
+    return period + dp, pdot + dpd
+
+
 def phase_to_bins(phases: np.ndarray, nbins: int) -> np.ndarray:
     """Fractional rotation counts -> phase bin indices (host, float64)."""
     return (np.floor(np.asarray(phases, np.float64) * nbins).astype(np.int64)

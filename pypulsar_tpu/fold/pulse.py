@@ -175,8 +175,15 @@ class Pulse:
         self.N = numsamples
 
     def interp_and_downsamp(self, numsamples: int):
-        """Interpolate then downsample to ``numsamples`` bins (:263-279)."""
-        downsamp = int(self.N / numsamples) + 1
+        """Interpolate then downsample to ``numsamples`` bins (:263-279).
+
+        The reference's ``int(N / numsamples) + 1`` is a py2-heritage
+        ceil-div that over-downsamples when ``N % numsamples == 0``: at
+        an exact multiple it interpolated to a LARGER grid than the
+        profile has (resampling distortion for no reason) where the true
+        ceiling is the exact factor and the interpolation is the
+        identity."""
+        downsamp = -(-self.N // numsamples)
         warnings.warn("interp_and_downsamp() may be unreliable")
         self.interpolate(downsamp * numsamples)
         self.downsample(downsamp)

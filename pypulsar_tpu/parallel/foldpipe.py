@@ -1,0 +1,671 @@
+"""Batched candidate folding: one streamed pass folds the whole sifted
+list, with on-device (p, pdot) refinement.
+
+``cli/prepfold`` folds ONE candidate per invocation, re-reading and
+re-dedispersing the observation each time — folding a sifted list of
+hundreds of candidates is O(ncand) full passes over the raw file, and
+BASELINE config[3] (fold + sum_profs -> pfd_snr) was the only pipeline
+stage with no batched device path. The DM-trial-reuse insight that made
+the sweep fast (amortize one pass over the data across many trials,
+arXiv:1201.5380) applies verbatim to folding:
+
+- candidates sharing a DM share a dedispersed series: the list is
+  grouped by DM and each group folds its whole candidate batch off ONE
+  series with :func:`fold.engine.fold_parts_batch` (shared data block,
+  per-candidate phase polynomials -> per-candidate bin indices);
+- (p, pdot) refinement never needs a refold: the on-device
+  :func:`fold.engine.refine_chi2` kernel rotates each candidate's
+  ``[npart, nbins]`` sub-profiles by per-partition trial phase offsets
+  (Fourier phase ramp, the arXiv:2110.03482 shift trick the sweep
+  already uses) and reduces chi2 over a shared whole-observation drift
+  grid — PRESTO-prepfold-style optimization with zero extra data
+  passes, reported per candidate as a refined (p, pdot).
+
+Series come from existing ``.dat`` files (:func:`iter_groups_dats`,
+whose reads retry transient IO via ``resilience.retry_transient``) or
+from the streamed sweep handoff (:func:`iter_groups_stream`, built on
+``accelpipe.stream_series`` / ``staged.iter_dedispersed_chunks`` — raw
+file to folded archives with no ``.dat`` round trip). Host block prep
+(phase polynomial evaluation -> bin indices, per-partition data
+moments) runs one group AHEAD of the device folds on the shared
+prefetch core (``parallel/prefetch.py``; queue fill on the
+``fold.pending_depth`` gauge), a device OOM halves the CANDIDATE axis
+(``resilience.retry.halving_dispatch`` — per-candidate folds are
+independent, so the halves concatenate bit-identically), and outputs
+are journaled + atomic: every ``.pfd`` lands via tmp + ``os.replace``
+and a ``--journal`` manifest (``resilience.RunJournal``) lets a killed
+run resume past validated archives.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pypulsar_tpu.core import psrmath
+from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.resilience.journal import RunJournal
+from pypulsar_tpu.resilience.retry import halving_dispatch
+
+__all__ = [
+    "FoldCandidate",
+    "cands_from_accelcands",
+    "fold_pipeline",
+    "iter_groups_dats",
+    "iter_groups_stream",
+    "load_candidates",
+    "pfd_complete",
+    "pfd_out_name",
+    "print_fold_results",
+]
+
+ENV_STREAM_RAM = "PYPULSAR_TPU_FOLD_STREAM_RAM"
+ENV_BINIDX_RAM = "PYPULSAR_TPU_FOLD_BINIDX_RAM"
+
+
+@dataclass
+class FoldCandidate:
+    """One fold request: topocentric (period, pdot) at a trial DM.
+    ``name`` tags the output archive (assigned from the list position
+    when empty, so resume naming is deterministic)."""
+
+    period: float
+    dm: float
+    pdot: float = 0.0
+    name: str = ""
+
+
+def cands_from_accelcands(cands) -> List[FoldCandidate]:
+    """Sifted ``io.accelcands.Candidate`` objects -> fold requests.
+    pdot starts at 0 (the .accelcands grammar stores z in bins but not
+    the trial length needed to convert it); the on-device refinement
+    recovers the drift without a refold."""
+    return [FoldCandidate(period=float(c.period), dm=float(c.dm))
+            for c in cands]
+
+
+def load_candidates(path: str) -> List[FoldCandidate]:
+    """Parse a candidate list file: the sifted ``.accelcands`` grammar
+    (sniffed by its ``#`` header + ``file:candnum`` rows), or a plain
+    whitespace table ``period_s  dm  [pdot]`` (comments with '#') for
+    ad-hoc lists."""
+    with open(path) as f:
+        lines = f.read().splitlines()
+    body = [ln for ln in lines if ln.strip() and not ln.lstrip().startswith("#")]
+    if any(":" in ln.split()[0] for ln in body if ln.split()):
+        from pypulsar_tpu.io.accelcands import parse_candlist
+
+        return cands_from_accelcands(parse_candlist(path))
+    out = []
+    for ln in body:
+        fields = ln.split()
+        if len(fields) < 2:
+            raise ValueError(f"bad candidate line {ln!r}; expected "
+                             f"'period_s dm [pdot]'")
+        out.append(FoldCandidate(period=float(fields[0]),
+                                 dm=float(fields[1]),
+                                 pdot=float(fields[2]) if len(fields) > 2
+                                 else 0.0))
+    return out
+
+
+def _named(cands: Sequence[FoldCandidate]) -> List[FoldCandidate]:
+    """Assign deterministic names from list position (resume keys)."""
+    out = []
+    for gi, c in enumerate(cands):
+        name = c.name or (f"cand{gi:04d}_DM{c.dm:.2f}_"
+                          f"{c.period * 1e3:.4f}ms")
+        out.append(FoldCandidate(c.period, c.dm, c.pdot, name))
+    return out
+
+
+def pfd_out_name(outbase: str, cand: FoldCandidate) -> str:
+    """The ONE definition of a batched fold's archive path."""
+    return f"{outbase}_{cand.name}.pfd"
+
+
+def print_fold_results(summary: dict, stream=None) -> None:
+    """Per-candidate report of a :func:`fold_pipeline` summary (archive
+    path + refined p/pdot) — the ONE formatter both CLI surfaces
+    (``foldbatch`` and ``sift --fold``) print, so the schema and the
+    report cannot drift apart."""
+    import sys
+
+    stream = stream if stream is not None else sys.stderr
+    for res in summary["results"]:
+        if res.get("skipped"):
+            continue  # resume rows: already reported by the run that
+            # folded them; the summary JSON still carries them
+        if res.get("failed"):
+            print(f"# {res['name']}: FAILED ({res.get('error', '?')})",
+                  file=stream)
+            continue
+        line = f"# {res['name']}: {res['pfd']}"
+        if "best_period" in res:
+            line += (f"  refined P {res['best_period']:.9f} s, "
+                     f"Pdot {res['best_pdot']:.3e}")
+        print(line, file=stream)
+
+
+def pfd_complete(path: str, npart: int, nbins: int) -> bool:
+    """True when ``path`` parses as a complete ``[npart, 1, nbins]``
+    archive — the validated form of skip-existing (a truncated .pfd from
+    a killed writer fails the parse or the shape check, so it is redone,
+    never trusted)."""
+    from pypulsar_tpu.io.prestopfd import PfdFile
+
+    try:
+        p = PfdFile(path)
+    except Exception:  # noqa: BLE001 - any parse failure means incomplete
+        return False
+    return p.profs.shape == (npart, 1, nbins)
+
+
+# ---------------------------------------------------------------------------
+# series providers: DM group -> (series, dt, metadata)
+# ---------------------------------------------------------------------------
+
+def _group_by_dm(cands: Sequence[Tuple[int, FoldCandidate]],
+                 batch: int) -> List[Tuple[float, list]]:
+    """[(dm, [(gi, cand), ...]), ...] sorted by DM, each group's member
+    list split at ``batch`` candidates (the bin-index buffer and the live
+    one-hot scale with the candidate axis)."""
+    by_dm: Dict[float, list] = {}
+    for gi, c in cands:
+        by_dm.setdefault(float(c.dm), []).append((gi, c))
+    groups = []
+    for dm in sorted(by_dm):
+        members = by_dm[dm]
+        for g0 in range(0, len(members), max(1, batch)):
+            groups.append((dm, members[g0:g0 + max(1, batch)]))
+    return groups
+
+
+def iter_groups_dats(groups, dat_for_dm):
+    """Yield ``(dm, series, dt, meta, members)`` from per-DM ``.dat``
+    files (``dat_for_dm(dm) -> path``; ``{path[:-4]}.inf`` sidecars give
+    dt and the archive metadata). Groups sharing a DM re-read the .dat —
+    sub-batches of one DM only happen past the candidate batch cap,
+    where the bin-index buffer dwarfs the read."""
+    from pypulsar_tpu.io.datfile import Datfile
+    from pypulsar_tpu.resilience.retry import retry_transient
+
+    for dm, members in groups:
+        datfn = dat_for_dm(dm)
+
+        def read():
+            dat = Datfile(datfn)
+            return dat, dat.read_all()
+
+        try:
+            # the retry lives AT the read (a survey fold must not abort
+            # over one NFS hiccup); the prefetch transform cannot retry
+            # for us — it ships exceptions as values by design
+            dat, series = retry_transient(read, retries=2,
+                                          what="fold.dats")
+            inf = dat.infdata
+            meta = dict(
+                lofreq=float(getattr(inf, "lofreq", 1400.0) or 1400.0),
+                chan_wid=float(getattr(inf, "chan_width", 1.0) or 1.0),
+                numchan=1,
+                tepoch=float(getattr(inf, "epoch", 56000.0) or 56000.0),
+                telescope=str(getattr(inf, "telescope", "unknown")),
+                filenm=os.path.basename(datfn),
+            )
+        except Exception as e:  # noqa: BLE001 - fail the GROUP, not the run
+            # a missing/corrupt .dat travels as a value: raised here it
+            # would unwind through the prefetch worker and abort every
+            # remaining DM group (and lose the summary); as a value the
+            # pipeline records these candidates failed and keeps folding
+            yield dm, e, 0.0, {}, members
+            continue
+        yield dm, series, float(inf.dt), meta, members
+
+
+def iter_groups_stream(groups, reader, downsamp: int = 1, nsub: int = 64,
+                       group_size: int = 32, rfimask=None,
+                       engine: str = "auto",
+                       chunk_payload: Optional[int] = None,
+                       all_dms=None,
+                       verbose: bool = False):
+    """Yield fold groups from ONE streamed pass over the raw
+    observation: the unique DMs dedisperse through the sweep's own chunk
+    kernel (``accelpipe.stream_series`` / ``staged.iter_dedispersed_chunks``)
+    into a host buffer, and each DM's row serves every candidate at that
+    DM. Past the ``PYPULSAR_TPU_FOLD_STREAM_RAM`` budget (default 12 GB)
+    the DM list streams in slices of one extra raw-file pass each,
+    aligned to stage-1 group boundaries (the accelpipe slicing contract:
+    a misaligned slice regroups trials at different group-mean DMs).
+
+    ``all_dms`` (default: the groups' own DMs) is the FULL run's DM
+    grid: a resumed run whose remaining groups cover fewer DMs must
+    still plan — group sizing, stage-1 grouping, slice boundaries — over
+    the whole grid, or the surviving trials regroup at different
+    group-mean DMs and fold from slightly different series than the
+    uninterrupted run (the accelpipe slice-alignment lesson). Slices
+    containing no wanted DM are skipped whole; a partially wanted slice
+    streams whole (unused rows cost compute, never correctness)."""
+    from pypulsar_tpu.parallel.accelpipe import stream_series
+    from pypulsar_tpu.parallel.staged import _ReaderSource, dats_geometry
+
+    needed = {dm for dm, _ in groups}
+    dms = sorted(set(all_dms) if all_dms is not None else needed)
+    src = _ReaderSource(reader)
+    if group_size <= 0:
+        from pypulsar_tpu.parallel.sweep import choose_group_size
+
+        group_size = choose_group_size(
+            np.asarray(dms, np.float64), src.frequencies,
+            src.tsamp * max(1, downsamp), nsub)
+    _plan, _payload, T = dats_geometry(reader, np.asarray(dms, np.float64),
+                                       downsamp=downsamp, nsub=nsub,
+                                       group_size=group_size,
+                                       chunk_payload=chunk_payload)
+    freqs = np.asarray(src.frequencies)
+    # the dedispersed series integrates the FULL band, and pfd_snr's
+    # radiometer math reads bw = chan_wid * numchan from the archive —
+    # recording one raw channel's width would deflate it ~nchan-fold and
+    # inflate mean flux ~sqrt(nchan). (The .dat provider keeps the
+    # serial prepfold .dat convention of numchan=1 for byte parity.)
+    bw = float(abs(freqs.max() - freqs.min()))
+    meta = dict(
+        lofreq=float(freqs.min()),
+        chan_wid=float(bw / max(len(freqs) - 1, 1)) or 1.0,
+        numchan=len(freqs),
+        tepoch=float(getattr(reader, "tstart", 56000.0) or 56000.0),
+        telescope=str(getattr(reader, "telescope", "unknown") or "unknown"),
+        filenm=os.path.basename(str(getattr(reader, "filename", "stream"))),
+    )
+    budget = int(float(os.environ.get(ENV_STREAM_RAM, 12e9)))
+    slice_dms = max(1, int(budget // (4 * max(T, 1))))
+    slice_dms = max(group_size, (slice_dms // group_size) * group_size)
+    if slice_dms < len(dms) and verbose:
+        print(f"# fold series buffer {4 * len(dms) * T / 1e9:.1f} GB over "
+              f"the {budget / 1e9:.1f} GB budget; streaming in "
+              f"{-(-len(dms) // slice_dms)} DM slices")
+    for d0 in range(0, len(dms), slice_dms):
+        dm_slice = dms[d0:d0 + slice_dms]
+        if not any(dm in needed for dm in dm_slice):
+            continue  # whole slice already folded (resume)
+        series_buf, dt_eff = stream_series(
+            reader, np.asarray(dm_slice, np.float64), downsamp=downsamp,
+            nsub=nsub, group_size=group_size, rfimask=rfimask,
+            engine=engine, chunk_payload=chunk_payload, verbose=verbose)
+        row = {dm: i for i, dm in enumerate(dm_slice)}
+        for dm, members in groups:
+            if dm in row:
+                # a per-row COPY, not a view: queued groups must not pin
+                # the whole slice buffer while the next slice allocates
+                # (a view would transiently double the RAM budget)
+                yield (dm, np.array(series_buf[row[dm]]), dt_eff, meta,
+                       members)
+        del series_buf
+
+
+# ---------------------------------------------------------------------------
+# the pipeline
+# ---------------------------------------------------------------------------
+
+def _run_fingerprint(cands: Sequence[FoldCandidate], nbins: int, npart: int,
+                     refine: bool, ntrial_p: int, ntrial_pd: int,
+                     max_drift: float, outbase: str, source_tag: str) -> str:
+    """Journal fingerprint of everything that determines the archives:
+    resuming under different fold geometry, refinement grid, candidate
+    list or series source starts over (the SweepCheckpoint contract)."""
+    h = hashlib.sha256()
+    for c in cands:
+        h.update(np.float64([c.period, c.pdot, c.dm]).tobytes())
+        h.update(c.name.encode() + b"\0")
+    h.update(np.int64([nbins, npart, int(refine), ntrial_p,
+                       ntrial_pd]).tobytes())
+    h.update(np.float64([max_drift]).tobytes())
+    h.update(outbase.encode() + b"\0" + source_tag.encode())
+    return h.hexdigest()
+
+
+def _prep_group(group, nbins: int, npart: int):
+    """Worker-side half of the pipeline: per-partition data moments of
+    the shared series plus every member's phase-polynomial bin indices —
+    the serial host time the prefetch core hides behind the previous
+    group's device fold. Exceptions travel as values (accelpipe
+    contract: raised on the worker they would abort the run instead of
+    failing one group)."""
+    from pypulsar_tpu.fold.engine import phase_to_bins
+
+    dm, series, dt, meta, members = group
+    if isinstance(series, Exception):
+        return group, None, None, None, series  # provider-side failure
+    try:
+        with telemetry.span("fold_prep", n_cands=len(members)):
+            T = len(series)
+            part_len = T // npart
+            if part_len < 1:
+                raise ValueError(f"npart={npart} exceeds the {T}-sample "
+                                 f"series at DM {dm:g}")
+            used = np.asarray(series[: npart * part_len], np.float64)
+            parts = used.reshape(npart, part_len)
+            pmean = parts.mean(axis=1)
+            pvar = parts.var(axis=1)
+            t = np.arange(T, dtype=np.float64) * dt
+            bin_idx = np.empty((len(members), T), np.int32)
+            for j, (_, c) in enumerate(members):
+                f0, f1, f2 = psrmath.p_to_f(c.period, c.pdot, 0.0)
+                phase = t * (f0 + t * (f1 / 2.0 + t * f2 / 6.0))
+                bin_idx[j] = phase_to_bins(phase, nbins)
+    except Exception as e:  # noqa: BLE001 - consumer decides
+        return group, None, None, None, e
+    return group, pmean, pvar, bin_idx, None
+
+
+def fold_pipeline(
+    cands: Sequence[FoldCandidate],
+    outbase: str,
+    *,
+    source: str = "dats",
+    dat_for_dm=None,
+    source_id: str = "",
+    reader=None,
+    nbins: int = 64,
+    npart: int = 32,
+    batch: int = 32,
+    refine: bool = True,
+    ntrial_p: int = 33,
+    ntrial_pd: int = 17,
+    max_drift: float = 2.0,
+    prefetch_depth: int = 1,
+    skip_existing: bool = False,
+    journal_path: Optional[str] = None,
+    downsamp: int = 1,
+    nsub: int = 64,
+    group_size: int = 0,
+    rfimask=None,
+    engine: str = "auto",
+    chunk_payload: Optional[int] = None,
+    verbose: bool = False,
+) -> dict:
+    """Fold every candidate into ``{outbase}_{name}.pfd`` in one batched
+    pass per DM group (module docstring). ``source`` picks the series
+    provider: ``"dats"`` (``dat_for_dm(dm) -> path``) or ``"stream"``
+    (one pass over ``reader`` via the sweep chunk kernel). Returns a
+    summary dict with per-candidate results (path, refined p/pdot,
+    chi2) and counts.
+
+    Resume: ``skip_existing`` skips candidates whose archive VALIDATES
+    (:func:`pfd_complete`); ``journal_path`` keeps a fingerprinted
+    work-unit manifest whose artifacts are size/sha256-checked on load.
+    A batched fold that hits device RESOURCE_EXHAUSTED halves its
+    candidate axis (bit-identical recovery); any other device failure
+    degrades the group to the NumPy golden-twin fold instead of failing
+    the run."""
+    from pypulsar_tpu.fold.engine import (
+        drift_offsets,
+        drift_to_p_pd,
+        fold_parts_batch,
+        fold_parts_batch_numpy,
+        refine_chi2,
+        refine_chi2_numpy,
+        refine_drift_grid,
+    )
+    from pypulsar_tpu.io.prestopfd import make_pfd
+
+    cands = _named(cands)
+    names = [pfd_out_name(outbase, c) for c in cands]
+    units = [f"fold:{c.name}" for c in cands]
+    if source == "stream":
+        # rfimask is part of the series definition (a different zap
+        # table is a different dedispersed stream) — a resume under a
+        # different mask must start over, not trust mixed-mask archives
+        from pypulsar_tpu.parallel.staged import _mask_tag
+
+        source_tag = (f"stream:{getattr(reader, 'filename', '?')}"
+                      f":ds{downsamp}:ns{nsub}:gs{group_size}"
+                      f":mask{_mask_tag(rfimask)}")
+    else:
+        # source_id names WHICH .dat set feeds the fold (the caller's
+        # datbase / file path): a resume pointed at a different dataset
+        # must start over, exactly like the stream tag above
+        source_tag = f"dats:{source_id}"
+    journal = None
+    if journal_path:
+        journal = RunJournal(journal_path, _run_fingerprint(
+            cands, nbins, npart, refine, ntrial_p, ntrial_pd, max_drift,
+            outbase, source_tag), tool="foldbatch")
+    journal_done = journal.completed() if journal is not None else set()
+
+    def cand_done(i: int) -> bool:
+        if units[i] in journal_done:
+            return True
+        return skip_existing and pfd_complete(names[i], npart, nbins)
+
+    todo = [i for i in range(len(cands)) if not cand_done(i)]
+    todo_set = set(todo)
+    n_skipped = len(cands) - len(todo)
+    for i in todo:
+        # stale tmp debris from a killed writer: remove the exact
+        # derived names up front (the cli/sweep restart discipline —
+        # atomic outputs must not accumulate orphaned .tmp files)
+        try:
+            os.remove(names[i] + ".tmp")
+        except OSError:
+            pass
+    if n_skipped and verbose:
+        print(f"# {n_skipped}/{len(cands)} candidates already have "
+              f"validated archives, skipping")
+    # skipped candidates still get a summary row (archive path + fold
+    # parameters, flagged "skipped"): a RESUMED run's summary JSON must
+    # enumerate the whole candidate list, not just the tail it refolded
+    # — it overwrites the first run's file. Refined (p, pdot) of
+    # already-folded candidates are BACKFILLED from the journal's
+    # fold_result notes: they live nowhere else (the archive stores the
+    # fold period, not the refined one), and a kill must not lose them
+    prior = {}
+    if journal is not None:
+        prior = {n.get("name"): {k: v for k, v in n.items()
+                                 if k not in ("type", "event")}
+                 for n in journal.notes("fold_result")}
+
+    def skipped_row(i: int) -> dict:
+        base = {"name": cands[i].name, "pfd": names[i],
+                "dm": cands[i].dm, "period": cands[i].period,
+                "pdot": cands[i].pdot}
+        return {**base, **prior.get(cands[i].name, {}), "skipped": True}
+
+    summary = {"n_folded": 0, "n_skipped": n_skipped, "n_failed": 0,
+               "numpy_fallbacks": 0,
+               "results": [skipped_row(i) for i in range(len(cands))
+                           if i not in todo_set],
+               "pfd_paths": list(names)}
+    if not todo:
+        if journal is not None:
+            journal.close()
+        return summary
+
+    # bound the per-group bin-index buffer (K x T int32 — the dominant
+    # host allocation AND the dominant H2D payload; the series itself is
+    # T floats shared by the whole group): clamp the candidate batch to
+    # the PYPULSAR_TPU_FOLD_BINIDX_RAM budget (default 4 GB) once the
+    # series length is known. halving_dispatch shrinks only the DEVICE
+    # axis — the host buffer must be bounded before prep ever allocates.
+    binidx_budget = int(float(os.environ.get(ENV_BINIDX_RAM, 4e9)))
+    T_est = None
+    if source == "stream" and reader is not None:
+        from pypulsar_tpu.parallel.staged import _ReaderSource
+
+        T_est = _ReaderSource(reader).nsamples // max(1, downsamp)
+    elif dat_for_dm is not None:
+        try:
+            T_est = os.path.getsize(dat_for_dm(cands[todo[0]].dm)) // 4
+        except OSError:
+            T_est = None  # provider will surface the real read error
+    if T_est:
+        cap = max(1, binidx_budget // (4 * T_est))
+        if cap < batch:
+            if verbose:
+                print(f"# candidate batch {batch} -> {cap}: bin-index "
+                      f"buffers capped at {binidx_budget / 1e9:.1f} GB "
+                      f"for the {T_est}-sample series ({ENV_BINIDX_RAM} "
+                      f"to raise)")
+            batch = cap
+    groups = _group_by_dm([(i, cands[i]) for i in todo], batch)
+    if source == "stream":
+        if reader is None:
+            raise ValueError("source='stream' needs a reader")
+        group_iter = iter_groups_stream(
+            groups, reader, downsamp=downsamp, nsub=nsub,
+            group_size=group_size, rfimask=rfimask, engine=engine,
+            chunk_payload=chunk_payload,
+            all_dms={c.dm for c in cands},  # FULL grid: resume must not
+            verbose=verbose)               # re-plan over fewer DMs
+    else:
+        if dat_for_dm is None:
+            raise ValueError("source='dats' needs dat_for_dm")
+        group_iter = iter_groups_dats(groups, dat_for_dm)
+
+    dl, dq = refine_drift_grid(ntrial_p, ntrial_pd, max_drift)
+    offsets = drift_offsets(dl, dq, npart)
+
+    if prefetch_depth > 0:
+        from pypulsar_tpu.parallel.prefetch import prefetch
+
+        # stream source: the FIRST item arrives only after stream_series
+        # finishes a whole raw-file pass over a DM slice — minutes to
+        # hours at survey scale — so the per-item consumer deadline
+        # (default 900 s, built for per-chunk producers) would kill a
+        # healthy run; the chunk stream underneath has its own telemetry
+        # heartbeat, so the deadline is disabled rather than guessed
+        prepped = prefetch(group_iter, depth=prefetch_depth, name="fold",
+                           transform=lambda g: _prep_group(g, nbins, npart),
+                           timeout=(0 if source == "stream" else None))
+    else:  # inline, single-threaded debugging (values identical)
+        prepped = (_prep_group(g, nbins, npart) for g in group_iter)
+
+    # the journal closes however the loop exits: appends are
+    # fsync'd per record, so close is hygiene, but an abort must
+    # not leak the handle of a long-lived caller
+    try:
+        for group, pmean, pvar, bin_idx, prep_err in prepped:
+            dm, series, dt, meta, members = group
+            K = len(members)
+            if prep_err is not None:
+                summary["n_failed"] += K
+                telemetry.event("fold.group_prep_failed", dm=dm, n=K,
+                                error=type(prep_err).__name__)
+                print(f"# fold group DM{dm:.2f} prep FAILED "
+                      f"({type(prep_err).__name__}: {prep_err}); "
+                      f"{K} candidates not folded")
+                # failed candidates are still ENUMERATED in the summary
+                # (the JSON is the machine-readable record of which
+                # archives exist and why the others do not)
+                summary["results"].extend(
+                    {"name": c.name, "pfd": names[gi], "dm": c.dm,
+                     "period": c.period, "pdot": c.pdot, "failed": True,
+                     "error": f"{type(prep_err).__name__}: {prep_err}"}
+                    for gi, c in members)
+                continue
+            T = len(series)
+            part_len = T // npart
+            T_sec = npart * part_len * dt
+
+            with telemetry.span("foldpipe_group", aggregate=False, dm=dm,
+                                n_cands=K):
+                try:
+                    def run(lo, hi):
+                        faultinject.trip("fold.batch_dispatch")
+                        # counts stay on device: stats[...,0] is part_len by
+                        # construction (the serial fold_partitions contract),
+                        # so pulling the [K, npart, nbins] int cube would be
+                        # pure transfer waste
+                        profs_dev, _ = fold_parts_batch(
+                            series, bin_idx[lo:hi], nbins, npart)
+                        outs = ((profs_dev, refine_chi2(profs_dev, offsets))
+                                if refine else (profs_dev,))
+                        from pypulsar_tpu.ops.transfer import pull_host
+
+                        return tuple(np.asarray(x) for x in pull_host(*outs))
+
+                    parts = halving_dispatch(run, K, what="fold.batch")
+                    profs = np.concatenate([p[2][0] for p in parts])
+                    chi2 = (np.concatenate([p[2][1] for p in parts])
+                            if refine else None)
+                except Exception as e:  # noqa: BLE001 - degrade, don't die
+                    summary["numpy_fallbacks"] += 1
+                    telemetry.counter("fold.numpy_fallbacks")
+                    telemetry.event("fold.numpy_fallback", dm=dm, n=K,
+                                    error=type(e).__name__)
+                    print(f"# batched device fold of {K} candidates failed "
+                          f"({type(e).__name__}: {e}); folding this group "
+                          f"with the NumPy twin")
+                    profs, _counts = fold_parts_batch_numpy(
+                        series, bin_idx, nbins, npart)
+                    chi2 = (refine_chi2_numpy(profs, offsets) if refine
+                            else None)
+
+            for j, (gi, c) in enumerate(members):
+                res = {"name": c.name, "pfd": names[gi], "dm": c.dm,
+                       "period": c.period, "pdot": c.pdot}
+                if refine:
+                    jbest = int(np.argmax(chi2[j]))
+                    bp, bpd = drift_to_p_pd(dl[jbest], dq[jbest], c.period,
+                                            c.pdot, T_sec)
+                    j0 = int(np.argmin(np.abs(dl) + np.abs(dq)))
+                    res.update(best_period=float(bp), best_pdot=float(bpd),
+                               chi2_best=float(chi2[j, jbest]),
+                               chi2_nominal=float(chi2[j, j0]))
+                # f64 FIRST, then the moments: the serial fold_partitions
+                # path computes prof.mean()/var() on the f64-cast profiles,
+                # and an f32-accumulated mean would differ in the low bits
+                # (breaking the bit-identical-archive contract)
+                pj64 = np.asarray(profs[j], np.float64)
+                stats = np.zeros((npart, 1, 7))
+                stats[:, 0, 0] = part_len
+                stats[:, 0, 1] = pmean
+                stats[:, 0, 2] = pvar
+                stats[:, 0, 3] = nbins
+                stats[:, 0, 4] = pj64.mean(axis=1)
+                stats[:, 0, 5] = pj64.var(axis=1)
+                stats[:, 0, 6] = 1.0
+                pfd = make_pfd(
+                    pj64[:, None, :], dt=dt,
+                    lofreq=meta["lofreq"], chan_wid=meta["chan_wid"],
+                    numchan=meta["numchan"], fold_p1=c.period, bestdm=c.dm,
+                    stats=stats, tepoch=meta["tepoch"], candnm=c.name,
+                    telescope=meta["telescope"], filenm=meta["filenm"])
+                pfd.topo_p1, pfd.topo_p2, pfd.topo_p3 = c.period, c.pdot, 0.0
+                pfd.curr_p1, pfd.curr_p2, pfd.curr_p3 = c.period, c.pdot, 0.0
+                faultinject.trip("fold.before_pfd_write")  # kill-point
+                with telemetry.span("fold_write"):
+                    pfd.write(names[gi] + ".tmp")
+                    os.replace(names[gi] + ".tmp", names[gi])
+                faultinject.trip("fold.after_pfd_write")  # kill-point
+                if journal is not None:
+                    # refined (p, pdot) ride the journal too: a resumed
+                    # run's summary backfills them for skipped
+                    # candidates. The note lands BEFORE the done record:
+                    # a kill between the two then redoes the candidate
+                    # (done missing) instead of skipping it with its
+                    # refined values lost; the duplicate note a redo
+                    # writes is harmless (the backfill dict is last-wins)
+                    journal.note(event="fold_result", **res)
+                    journal.done(units[gi], [names[gi]])
+                    faultinject.trip("fold.after_journal")  # kill-point
+                telemetry.counter("fold.cands_folded")
+                summary["n_folded"] += 1
+                summary["results"].append(res)
+            if verbose:
+                print(f"# folded {K} candidates at DM{dm:.2f} "
+                      f"({summary['n_folded']}/{len(todo)})")
+
+        if journal is not None:
+            journal.note(event="foldbatch_done",
+                         n_folded=summary["n_folded"],
+                         n_skipped=n_skipped,
+                         n_failed=summary["n_failed"])
+    finally:
+        if journal is not None:
+            journal.close()
+    return summary

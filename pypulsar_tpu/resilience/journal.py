@@ -309,6 +309,18 @@ class RunJournal:
         :meth:`completed`)."""
         self._append({"type": "note", **attrs})
 
+    def notes(self, event: Optional[str] = None) -> List[dict]:
+        """Note records loaded from this journal (optionally filtered by
+        their ``event`` attr) — the channel pipelines use to persist
+        small per-unit RESULTS (e.g. refined fold parameters) across
+        kills: the artifacts themselves validate via :meth:`completed`,
+        but derived numbers that live only in a summary file would
+        otherwise be lost with it."""
+        out = [r for r in self._records if r.get("type") == "note"]
+        if event is not None:
+            out = [r for r in out if r.get("event") == event]
+        return out
+
     def close(self) -> None:
         if self._fh is not None:
             self._fh.close()

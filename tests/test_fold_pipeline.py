@@ -1,0 +1,812 @@
+"""Batched fold pipeline tests (round 8): the batched kernels against
+their per-candidate golden twins (bit-identical f64 accumulation; device
+within the SNR contract), device (p, pdot) refinement against a NumPy
+refold-based grid on a toy pulsar, `foldbatch` archives byte-identical to
+the serial per-candidate `prepfold` loop, kill/resume through the
+journal, OOM halving on the candidate axis, DM-group slicing, and the
+telemetry counters visible in tlmsum — mirroring test_accel_pipeline
+structure for the fold stage."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pypulsar_tpu.core import psrmath
+from pypulsar_tpu.resilience import faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+def _toy_dat(tmp_path, dm, N=1 << 14, dt=1e-3, period=0.0517, pdot=0.0,
+             amp=3.0, seed=None):
+    """A .dat/.inf pair with an injected pulse train at (period, pdot)."""
+    from pypulsar_tpu.io.datfile import write_dat
+    from pypulsar_tpu.io.infodata import InfoData
+
+    rng = np.random.RandomState(int(dm) if seed is None else seed)
+    t = np.arange(N) * dt
+    f0, f1, _ = psrmath.p_to_f(period, pdot, 0.0)
+    phase = t * (f0 + t * (f1 / 2.0))
+    ts = rng.standard_normal(N).astype(np.float32)
+    ts += amp * np.exp(-0.5 * ((phase % 1.0 - 0.4) / 0.03) ** 2
+                       ).astype(np.float32)
+    inf = InfoData()
+    inf.epoch, inf.dt, inf.N = 55000.0, dt, N
+    inf.telescope, inf.object = "Fake", "FOLDPIPE"
+    inf.lofreq, inf.BW, inf.numchan, inf.chan_width = 1400.0, 100.0, 1, 100.0
+    inf.DM = dm
+    base = str(tmp_path / f"toy_DM{dm:.2f}")
+    write_dat(base, ts, inf)
+    return base + ".dat", ts
+
+
+def _cands_file(tmp_path, rows, name="cands.txt"):
+    fn = str(tmp_path / name)
+    with open(fn, "w") as f:
+        f.write("# period_s dm [pdot]\n")
+        for row in rows:
+            f.write(" ".join(repr(x) for x in row) + "\n")
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# kernel parity
+# ---------------------------------------------------------------------------
+
+def test_fold_parts_batch_golden_twins():
+    """Batched fold vs per-candidate twins: the f64 NumPy batch twin is
+    BIT-identical to folding each candidate alone with fold_numpy, and
+    the device batch matches it within f32 accumulation (counts exact);
+    the device batch is also bitwise equal to the serial per-candidate
+    device path (fold_parts at C=1) — the archive-parity foundation."""
+    from pypulsar_tpu.fold.engine import (
+        fold_numpy,
+        fold_parts,
+        fold_parts_batch,
+        fold_parts_batch_numpy,
+        phase_to_bins,
+    )
+
+    rng = np.random.RandomState(0)
+    T, nbins, npart, dt = 1 << 13, 32, 8, 1e-3
+    series = rng.standard_normal(T).astype(np.float32)
+    periods = [0.0517, 0.0731, 0.0213, 0.1024, 0.0099]
+    bin_idx = np.stack([phase_to_bins(np.arange(T) * dt / p, nbins)
+                        for p in periods])
+    profs, counts = fold_parts_batch(series, bin_idx, nbins, npart)
+    profs, counts = np.asarray(profs), np.asarray(counts)
+    pN, cN = fold_parts_batch_numpy(series, bin_idx, nbins, npart)
+
+    part_len = T // npart
+    for k in range(len(periods)):
+        for i in range(npart):
+            sl = slice(i * part_len, (i + 1) * part_len)
+            p1, c1 = fold_numpy(series[sl].astype(np.float64),
+                                bin_idx[k, sl], nbins)
+            np.testing.assert_array_equal(pN[k, i], p1)  # bit-identical
+            np.testing.assert_array_equal(cN[k, i], c1.astype(np.int64))
+    np.testing.assert_array_equal(counts, cN)
+    np.testing.assert_allclose(profs, pN, rtol=1e-5, atol=1e-3)
+
+    for k in range(len(periods)):
+        pk, _ = fold_parts(series[None, :], bin_idx[k], nbins, npart)
+        np.testing.assert_array_equal(np.asarray(pk)[:, 0, :], profs[k])
+
+
+def test_refine_device_vs_numpy_refold_grid():
+    """Device (p, pdot) refinement vs a NumPy REFOLD-based grid on a toy
+    pulsar: folding the data at every trial (p, pd) and scoring chi2
+    must crown the same grid winner the rotation kernel finds without a
+    single refold, and the winner must sit within one grid step of the
+    injected truth."""
+    from pypulsar_tpu.fold.engine import (
+        drift_offsets,
+        drift_to_p_pd,
+        fold_numpy,
+        fold_parts_batch,
+        phase_to_bins,
+        refine_chi2,
+        refine_drift_grid,
+    )
+
+    rng = np.random.RandomState(3)
+    T, nbins, npart, dt = 1 << 15, 64, 16, 1e-3
+    P = 0.0517
+    T_sec = T * dt
+    dp_true, pd_true = 4e-5, 0.0
+    t = np.arange(T) * dt
+    f0, f1, _ = psrmath.p_to_f(P + dp_true, pd_true, 0.0)
+    phase_true = t * (f0 + t * (f1 / 2.0))
+    sig = 5.0 * np.exp(-0.5 * ((phase_true % 1.0 - 0.5) / 0.04) ** 2
+                       ).astype(np.float32)
+    sig += 0.1 * rng.standard_normal(T).astype(np.float32)
+
+    bin_idx = phase_to_bins(t / P, nbins)[None, :]
+    part_profs, _ = fold_parts_batch(sig, bin_idx, nbins, npart)
+    dl, dq = refine_drift_grid(21, 5, 2.0)
+    chi2 = np.asarray(refine_chi2(part_profs, drift_offsets(dl, dq, npart)))
+
+    # refold-based reference: fold the DATA at each trial's (p, pd)
+    chi2_refold = np.empty(len(dl))
+    for j in range(len(dl)):
+        pj, pdj = drift_to_p_pd(dl[j], dq[j], P, 0.0, T_sec)
+        fj0, fj1, _ = psrmath.p_to_f(pj, pdj, 0.0)
+        bi = phase_to_bins(t * (fj0 + t * (fj1 / 2.0)), nbins)
+        prof, _ = fold_numpy(sig.astype(np.float64), bi, nbins)
+        chi2_refold[j] = ((prof - prof.mean()) ** 2).sum()
+
+    jdev, jref = int(chi2[0].argmax()), int(chi2_refold.argmax())
+    assert jdev == jref, (jdev, jref)
+    best_p, best_pd = drift_to_p_pd(dl[jdev], dq[jdev], P, 0.0, T_sec)
+    dp_spacing = (4.0 / 20) * P * P / T_sec
+    pd_spacing = (4.0 / 4) * 2 * P * P / (T_sec * T_sec)
+    assert abs(best_p - (P + dp_true)) <= dp_spacing
+    assert abs(best_pd - pd_true) <= pd_spacing
+
+
+# ---------------------------------------------------------------------------
+# foldbatch vs serial prepfold (the acceptance contract)
+# ---------------------------------------------------------------------------
+
+def test_foldbatch_archives_match_serial_prepfold(tmp_path, monkeypatch):
+    """N=32 toy candidates through `foldbatch` produce archives whose
+    profile and stats arrays are BYTE-identical to per-candidate serial
+    `prepfold` runs on the same series, and whose derived SNRs agree
+    within the <=2e-6 contract (they are equal: same bytes in, same
+    float pipeline)."""
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+    from pypulsar_tpu.cli import prepfold as cli_prepfold
+    from pypulsar_tpu.fold import profile_snr
+    from pypulsar_tpu.io.prestopfd import PfdFile
+
+    monkeypatch.chdir(tmp_path)
+    rows = []
+    for d, dm in enumerate((10.0, 20.0, 30.0, 40.0)):
+        _toy_dat(tmp_path, dm, period=0.0517 * (1 + 0.13 * d))
+        rows += [(0.0517 * (1 + 0.13 * d) * (1 + 0.021 * j), dm)
+                 for j in range(8)]
+    cands = _cands_file(tmp_path, rows)
+    assert cli_foldbatch.main(["--cands", cands, "--datbase", "toy",
+                               "-o", "bb", "-n", "32", "--npart", "8"]) == 0
+    summary = json.load(open("bb_foldbatch.json"))
+    assert summary["n_folded"] == 32
+
+    snr_max_diff = 0.0
+    n_scored = 0
+    for i, ((p, dm), res) in enumerate(zip(rows, summary["results"])):
+        out = f"serial_{i:04d}.pfd"
+        assert cli_prepfold.main([f"toy_DM{dm:.2f}.dat", "-p", repr(p),
+                                  "--dm", str(dm), "-n", "32",
+                                  "--npart", "8", "-o", out]) == 0
+        a, b = PfdFile(out), PfdFile(res["pfd"])
+        np.testing.assert_array_equal(a.profs, b.profs)
+        np.testing.assert_array_equal(a.stats, b.stats)
+        try:
+            sa = profile_snr.pfd_snr(a)["snr"]
+            sb = profile_snr.pfd_snr(b)["snr"]
+            snr_max_diff = max(snr_max_diff, abs(sa - sb))
+            n_scored += 1
+        except profile_snr.OnPulseError:
+            pass
+    assert n_scored > 0
+    assert snr_max_diff <= 2e-6
+
+
+def test_foldbatch_refinement_recovers_injected_drift(tmp_path,
+                                                      monkeypatch):
+    """A candidate folded slightly off the injected period gets its
+    refined (p, pdot) pulled toward the truth in the foldbatch summary."""
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+
+    monkeypatch.chdir(tmp_path)
+    P, dp = 0.0517, 5e-5
+    _toy_dat(tmp_path, 15.0, N=1 << 15, period=P + dp, amp=6.0)
+    cands = _cands_file(tmp_path, [(P, 15.0)])
+    assert cli_foldbatch.main(["--cands", cands, "--datbase", "toy",
+                               "-o", "rf", "-n", "64", "--npart", "16",
+                               "--ntrial-p", "33", "--ntrial-pd", "5"]) == 0
+    res = json.load(open("rf_foldbatch.json"))["results"][0]
+    # refined period is closer to the truth than the fold period was
+    assert abs(res["best_period"] - (P + dp)) < abs(P - (P + dp))
+    assert res["chi2_best"] >= res["chi2_nominal"]
+
+
+# ---------------------------------------------------------------------------
+# resilience: kill/resume, OOM halving, prep failure
+# ---------------------------------------------------------------------------
+
+def _fold_args(cands, out, journal=None):
+    argv = ["--cands", cands, "--datbase", "toy", "-o", out, "-n", "32",
+            "--npart", "8", "--ntrial-p", "9", "--ntrial-pd", "3"]
+    if journal:
+        argv += ["--journal", journal]
+    return argv
+
+
+def test_foldbatch_kill_resume_journal_identical(tmp_path, monkeypatch):
+    """A run killed mid-batch (after some archives + journal records)
+    resumes from the journal: finished candidates are skipped, the rest
+    fold, and every final archive is byte-identical to an uninterrupted
+    run's — the journal-identical acceptance proof."""
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+
+    monkeypatch.chdir(tmp_path)
+    rows = []
+    for d, dm in enumerate((10.0, 20.0)):
+        _toy_dat(tmp_path, dm)
+        rows += [(0.0517 * (1 + 0.021 * j), dm) for j in range(4)]
+    cands = _cands_file(tmp_path, rows)
+
+    assert cli_foldbatch.main(_fold_args(cands, "ref")) == 0
+    ref = {os.path.basename(f)[len("ref_"):]: open(f, "rb").read()
+           for f in sorted(glob.glob("ref_cand*.pfd"))}
+    assert len(ref) == 8
+
+    # kill after the 3rd journal record: mid-run, past whole+partial work
+    with pytest.raises(faultinject.InjectedKill):
+        cli_foldbatch.main(_fold_args(cands, "kk", journal="kk.jsonl")
+                           + ["--fault-inject", "kill:fold.after_journal:3"])
+    done = sorted(glob.glob("kk_cand*.pfd"))
+    assert 0 < len(done) < 8
+
+    # stale tmp debris on a candidate the kill left UNfolded (a kill mid
+    # pfd.write leaves exactly this): the resume must clean it up
+    unfolded = sorted(set(ref) - {os.path.basename(f)[len("kk_"):]
+                                  for f in glob.glob("kk_cand*.pfd")})[0]
+    with open("kk_" + unfolded + ".tmp", "wb") as f:
+        f.write(b"stale writer debris")
+    assert cli_foldbatch.main(_fold_args(cands, "kk",
+                                         journal="kk.jsonl")) == 0
+    got = {os.path.basename(f)[len("kk_"):]: open(f, "rb").read()
+           for f in sorted(glob.glob("kk_cand*.pfd"))}
+    assert got == ref
+    assert not glob.glob("kk_cand*.pfd.tmp")
+    # the journal recorded every unit exactly once across both runs
+    units = [json.loads(ln)["unit"] for ln in open("kk.jsonl")
+             if json.loads(ln).get("type") == "done"]
+    assert len(units) == len(set(units)) == 8
+
+    # the resumed summary backfills refined (p, pdot) for candidates the
+    # FIRST (killed) run folded: they ride the journal's fold_result
+    # notes, so the overwritten summary JSON still carries them all
+    summary = json.load(open("kk_foldbatch.json"))
+    assert len(summary["results"]) == 8
+    assert all("best_period" in r for r in summary["results"])
+    ref_summary = {r["name"]: r for r in
+                   json.load(open("ref_foldbatch.json"))["results"]}
+    for r in summary["results"]:
+        assert r["best_period"] == ref_summary[r["name"]]["best_period"]
+
+
+def test_missing_dat_fails_group_not_run(tmp_path, monkeypatch):
+    """A missing/unreadable per-DM .dat fails only ITS candidates: the
+    remaining groups still fold, the summary is written, and the CLI
+    exits 1 to flag the partial failure."""
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+
+    monkeypatch.chdir(tmp_path)
+    _toy_dat(tmp_path, 10.0)  # DM 20 .dat deliberately absent
+    rows = [(0.0517, 10.0), (0.0731, 10.0), (0.0517, 20.0)]
+    cands = _cands_file(tmp_path, rows)
+    assert cli_foldbatch.main(_fold_args(cands, "md")) == 1
+    assert len(glob.glob("md_cand*_DM10.00_*.pfd")) == 2
+    assert not glob.glob("md_cand*_DM20.00_*.pfd")
+    summary = json.load(open("md_foldbatch.json"))
+    assert summary["n_folded"] == 2 and summary["n_failed"] == 1
+    # the summary enumerates the failure, not just counts it
+    failed = [r for r in summary["results"] if r.get("failed")]
+    assert len(failed) == 1 and "DM20.00" in failed[0]["name"]
+    assert len(summary["results"]) == 3
+
+
+def test_journal_fingerprint_covers_dat_source(tmp_path, monkeypatch):
+    """A journaled run re-pointed at a DIFFERENT .dat set must restart,
+    not skip units folded from the other data (the dats source identity
+    is part of the run fingerprint, like the stream tag)."""
+    import shutil
+
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+
+    monkeypatch.chdir(tmp_path)
+    _toy_dat(tmp_path, 10.0)
+    shutil.copy("toy_DM10.00.dat", "other_DM10.00.dat")
+    shutil.copy("toy_DM10.00.inf", "other_DM10.00.inf")
+    cands = _cands_file(tmp_path, [(0.0517, 10.0)])
+    assert cli_foldbatch.main(_fold_args(cands, "fp",
+                                         journal="fp.jsonl")) == 0
+    argv = ["--cands", cands, "--datbase", "other", "-o", "fp", "-n",
+            "32", "--npart", "8", "--ntrial-p", "9", "--ntrial-pd", "3",
+            "--journal", "fp.jsonl"]
+    assert cli_foldbatch.main(argv) == 0
+    # the other-base run REFOLDED (fingerprint mismatch restarts the
+    # journal) instead of trusting the toy-base archive
+    s = json.load(open("fp_foldbatch.json"))
+    assert s["n_folded"] == 1 and s["n_skipped"] == 0
+
+
+def test_foldbatch_skip_existing_validates(tmp_path, monkeypatch):
+    """--skip-existing trusts only archives that PARSE complete: debris
+    (a truncated .pfd from a kill) is refolded, finished ones skip."""
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+
+    monkeypatch.chdir(tmp_path)
+    _toy_dat(tmp_path, 10.0)
+    rows = [(0.0517 * (1 + 0.021 * j), 10.0) for j in range(3)]
+    cands = _cands_file(tmp_path, rows)
+    assert cli_foldbatch.main(_fold_args(cands, "sk")) == 0
+    pfds = sorted(glob.glob("sk_cand*.pfd"))
+    assert len(pfds) == 3
+    blob = open(pfds[0], "rb").read()
+    with open(pfds[0], "wb") as f:
+        f.write(blob[: len(blob) // 2])  # truncation debris
+    assert cli_foldbatch.main(_fold_args(cands, "sk")
+                              + ["--skip-existing"]) == 0
+    assert open(pfds[0], "rb").read() == blob  # refolded, bit-identical
+
+
+def test_foldbatch_oom_halves_candidate_axis(tmp_path, monkeypatch):
+    """An injected device OOM on the batched fold dispatch halves the
+    CANDIDATE axis and recovers bit-identically (per-candidate folds are
+    independent), with the backoff visible on the telemetry counters."""
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+    from pypulsar_tpu.obs import telemetry
+
+    monkeypatch.chdir(tmp_path)
+    _toy_dat(tmp_path, 10.0)
+    rows = [(0.0517 * (1 + 0.013 * j), 10.0) for j in range(6)]
+    cands = _cands_file(tmp_path, rows)
+    assert cli_foldbatch.main(_fold_args(cands, "aa")) == 0
+    ref = {os.path.basename(f)[3:]: open(f, "rb").read()
+           for f in sorted(glob.glob("aa_cand*.pfd"))}
+
+    with telemetry.session() as tlm:
+        assert cli_foldbatch.main(
+            _fold_args(cands, "bb")
+            + ["--fault-inject", "oom:fold.batch_dispatch"]) == 0
+        totals = tlm.counter_totals()
+    assert totals.get("resilience.oom_backoffs", 0) >= 1
+    got = {os.path.basename(f)[3:]: open(f, "rb").read()
+           for f in sorted(glob.glob("bb_cand*.pfd"))}
+    assert got == ref
+
+
+def test_foldbatch_device_failure_falls_back_numpy(tmp_path, monkeypatch):
+    """A non-OOM device failure degrades the group to the NumPy twin
+    fold (profiles within f32 tolerance of the device result) instead of
+    failing the run."""
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+    from pypulsar_tpu.fold import engine as fold_engine
+    from pypulsar_tpu.io.prestopfd import PfdFile
+
+    monkeypatch.chdir(tmp_path)
+    _toy_dat(tmp_path, 10.0)
+    rows = [(0.0517, 10.0), (0.0731, 10.0)]
+    cands = _cands_file(tmp_path, rows)
+    assert cli_foldbatch.main(_fold_args(cands, "dd")) == 0
+
+    def boom(*a, **kw):
+        raise RuntimeError("synthetic device fold failure")
+
+    monkeypatch.setattr(fold_engine, "_fold_parts_batch_jit", boom)
+    assert cli_foldbatch.main(_fold_args(cands, "nn")) == 0
+    for fd in sorted(glob.glob("dd_cand*.pfd")):
+        fn = "nn" + os.path.basename(fd)[2:]
+        a, b = PfdFile(fd), PfdFile(fn)
+        np.testing.assert_allclose(a.profs, b.profs, rtol=1e-5, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# DM-group slicing + sources
+# ---------------------------------------------------------------------------
+
+def test_dm_group_slicing_and_batch_cap(tmp_path, monkeypatch):
+    """Candidates across DMs group by DM; a batch cap splits one DM's
+    list into sub-batches sharing the series; every archive lands with
+    its own (dm, period) regardless of the slicing."""
+    from pypulsar_tpu.io.prestopfd import PfdFile
+    from pypulsar_tpu.parallel.foldpipe import FoldCandidate, fold_pipeline
+
+    monkeypatch.chdir(tmp_path)
+    for dm in (10.0, 20.0, 30.0):
+        _toy_dat(tmp_path, dm)
+    cands = [FoldCandidate(0.0517 * (1 + 0.017 * j), dm)
+             for dm in (10.0, 30.0, 20.0) for j in range(5)]
+    s = fold_pipeline(cands, "gg", source="dats",
+                      dat_for_dm=lambda dm: f"toy_DM{dm:.2f}.dat",
+                      nbins=32, npart=8, batch=2, ntrial_p=5, ntrial_pd=1)
+    assert s["n_folded"] == 15
+    by_name = {r["name"]: r for r in s["results"]}
+    assert len(by_name) == 15
+    for i, c in enumerate(cands):
+        name = f"cand{i:04d}_DM{c.dm:.2f}_{c.period * 1e3:.4f}ms"
+        p = PfdFile(f"gg_{name}.pfd")
+        assert p.bestdm == c.dm
+        assert abs(p.curr_p1 - c.period) < 1e-12
+        assert p.profs.shape == (8, 1, 32)
+
+    # second run with skip_existing: everything validated, nothing redone
+    s2 = fold_pipeline(cands, "gg", source="dats",
+                       dat_for_dm=lambda dm: f"toy_DM{dm:.2f}.dat",
+                       nbins=32, npart=8, batch=2, ntrial_p=5,
+                       ntrial_pd=1, skip_existing=True)
+    assert s2["n_skipped"] == 15 and s2["n_folded"] == 0
+
+
+def test_foldbatch_stream_source_recovers_pulsar(tmp_path, monkeypatch):
+    """The streamed source (raw .fil, no .dat round trip) folds the
+    sifted DM's candidates off the sweep chunk kernel's series and
+    recovers the injected pulsar's phase-coherent profile."""
+    from tests.test_accel_pipeline import _pulsar_fil
+
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+    from pypulsar_tpu.io.prestopfd import PfdFile
+
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)  # P=102.4 ms at DM 40
+    cands = _cands_file(tmp_path, [(0.1024, 40.0), (0.1024, 20.0)])
+    assert cli_foldbatch.main([fil, "--cands", cands, "-o", "st",
+                               "-n", "64", "--npart", "8", "-s", "8",
+                               "--group-size", "4"]) == 0
+    pfds = sorted(glob.glob("st_cand*.pfd"))
+    assert len(pfds) == 2
+
+    def contrast(fn):
+        prof = PfdFile(fn).sumprof
+        return (prof.max() - np.median(prof)) / max(prof.std(), 1e-9)
+
+    # at the true DM the fold is sharp; 20 DM units off, smeared
+    c40 = contrast([f for f in pfds if "_DM40.00_" in f][0])
+    c20 = contrast([f for f in pfds if "_DM20.00_" in f][0])
+    assert c40 > c20
+    # the archive records the FULL integrated band (pfd_snr's radiometer
+    # bw = chan_wid * numchan), not one raw channel's width
+    p = PfdFile(pfds[0])
+    assert p.numchan == 32
+    assert p.chan_wid * p.numchan == pytest.approx(4.0 * 32, rel=0.05)
+
+
+def test_stream_ram_budget_slices_identical(tmp_path, monkeypatch):
+    """A fold series buffer over PYPULSAR_TPU_FOLD_STREAM_RAM streams in
+    group-aligned DM slices with byte-identical archives."""
+    from tests.test_accel_pipeline import _pulsar_fil
+
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    rows = [(0.1024, dm) for dm in (0.0, 10.0, 20.0, 30.0, 40.0, 50.0)]
+    cands = _cands_file(tmp_path, rows)
+    argv = [fil, "--cands", cands, "-n", "32", "--npart", "8", "-s", "8",
+            "--group-size", "2"]
+    assert cli_foldbatch.main(argv + ["-o", "full"]) == 0
+    fulls = sorted(glob.glob("full_cand*.pfd"))
+    assert len(fulls) == 6
+    # budget for ~3 trials, NOT a multiple of --group-size 2 after the
+    # floor divide: must round to group boundaries
+    monkeypatch.setenv("PYPULSAR_TPU_FOLD_STREAM_RAM",
+                       str(4 * 16384 * 3))
+    assert cli_foldbatch.main(argv + ["-o", "sl"]) == 0
+    for ff in fulls:
+        fs = "sl" + os.path.basename(ff)[4:]
+        assert open(ff, "rb").read() == open(fs, "rb").read(), ff
+
+
+def test_stream_kill_resume_byte_identical(tmp_path, monkeypatch):
+    """A STREAMED run killed mid-fold resumes from the journal with
+    byte-identical archives: the resumed pass re-plans grouping and
+    slice boundaries over the FULL candidate DM grid (not just the
+    remaining DMs), so the surviving trials dedisperse from the same
+    group-mean series as the uninterrupted run."""
+    from tests.test_accel_pipeline import _pulsar_fil
+
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    rows = [(0.1024 * (1 + 0.1 * j), dm)
+            for dm in (0.0, 10.0, 20.0, 30.0, 40.0, 50.0)
+            for j in range(2)]
+    cands = _cands_file(tmp_path, rows)
+    argv = [fil, "--cands", cands, "-n", "32", "--npart", "8", "-s", "8",
+            "--group-size", "2"]
+    assert cli_foldbatch.main(argv + ["-o", "un"]) == 0
+    ref = {os.path.basename(f)[len("un_"):]: open(f, "rb").read()
+           for f in sorted(glob.glob("un_cand*.pfd"))}
+    assert len(ref) == 12
+
+    with pytest.raises(faultinject.InjectedKill):
+        cli_foldbatch.main(argv + ["-o", "ks", "--journal", "ks.jsonl",
+                                   "--fault-inject",
+                                   "kill:fold.after_journal:5"])
+    assert 0 < len(glob.glob("ks_cand*.pfd")) < 12
+    assert cli_foldbatch.main(argv + ["-o", "ks", "--journal",
+                                      "ks.jsonl"]) == 0
+    got = {os.path.basename(f)[len("ks_"):]: open(f, "rb").read()
+           for f in sorted(glob.glob("ks_cand*.pfd"))}
+    assert got == ref
+
+
+def test_prefetch_zero_inline_identical(tmp_path, monkeypatch):
+    """--prefetch 0 (inline prep, no worker thread) produces identical
+    archives — the pipeline moves WHEN prep happens, never the values."""
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+
+    monkeypatch.chdir(tmp_path)
+    for dm in (10.0, 20.0):
+        _toy_dat(tmp_path, dm)
+    rows = [(0.0517 * (1 + 0.021 * j), dm) for dm in (10.0, 20.0)
+            for j in range(3)]
+    cands = _cands_file(tmp_path, rows)
+    assert cli_foldbatch.main(_fold_args(cands, "pf")) == 0
+    assert cli_foldbatch.main(_fold_args(cands, "pz")
+                              + ["--prefetch", "0"]) == 0
+    fulls = sorted(glob.glob("pf_cand*.pfd"))
+    assert len(fulls) == 6
+    for fp in fulls:
+        fz = "pz" + os.path.basename(fp)[2:]
+        assert open(fp, "rb").read() == open(fz, "rb").read(), fp
+
+
+# ---------------------------------------------------------------------------
+# CLI surface: sift --fold, prepfold --cands, pfd_snr batch
+# ---------------------------------------------------------------------------
+
+def test_sift_fold_closes_chain(tmp_path, monkeypatch):
+    """raw -> sweep --write-dats -> accelsearch -> sift --fold -> .pfd:
+    the whole chain in-tree, ending in archives for every sifted
+    candidate."""
+    from tests.test_accel_pipeline import (
+        ACCEL_ARGS,
+        SWEEP_ARGS,
+        _pulsar_fil,
+    )
+
+    from pypulsar_tpu.cli import accelsearch as cli_accel
+    from pypulsar_tpu.cli import sift as cli_sift
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("PYPULSAR_TPU_DATS_RESIDENT_LIMIT", "0")
+    fil = _pulsar_fil(tmp_path)
+    assert cli_sweep.main([fil, "-o", "ch", *SWEEP_ARGS,
+                           "--write-dats"]) == 0
+    dats = sorted(glob.glob("ch_DM*.dat"))
+    assert cli_accel.main([*dats, "--batch", "4", *ACCEL_ARGS]) == 0
+    cands = sorted(glob.glob("ch_DM*_ACCEL_20.cand"))
+    sift_argv = [*cands, "-o", "ch.accelcands", "--fold",
+                 "--fold-nbins", "32", "--fold-npart", "8",
+                 "--min-sigma", "8", "--journal", "ch.jsonl"]
+    assert cli_sift.main(sift_argv) == 0
+    from pypulsar_tpu.io.accelcands import parse_candlist
+
+    sifted = parse_candlist("ch.accelcands")
+    pfds = sorted(glob.glob("ch_cand*.pfd"))
+    assert len(pfds) == len(sifted) > 0
+    blobs = {p: open(p, "rb").read() for p in pfds}
+
+    # a rerun whose sift unit validates in the journal must STILL fold:
+    # archives lost after the sift completed (e.g. a kill during --fold)
+    # reappear BYTE-identical (both passes fold the written .accelcands)
+    # while surviving complete archives are skipped, not rewritten
+    for p in pfds[: len(pfds) // 2 + 1]:
+        os.remove(p)
+    assert cli_sift.main(sift_argv) == 0
+    assert sorted(glob.glob("ch_cand*.pfd")) == pfds
+    for p in pfds:
+        assert open(p, "rb").read() == blobs[p], p
+
+    # --fold without -o is an error, not a silently unnamed fold
+    with pytest.raises(SystemExit):
+        cli_sift.main([*cands, "--fold"])
+
+
+def test_sift_fold_missing_dats_errors(tmp_path, monkeypatch):
+    """sift --fold without the .dat series fails loudly with guidance,
+    not silently or with a traceback."""
+    from tests.test_accel_pipeline import (
+        ACCEL_ARGS,
+        SWEEP_ARGS,
+        _pulsar_fil,
+    )
+
+    from pypulsar_tpu.cli import accelsearch as cli_accel
+    from pypulsar_tpu.cli import sift as cli_sift
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("PYPULSAR_TPU_DATS_RESIDENT_LIMIT", "0")
+    fil = _pulsar_fil(tmp_path)
+    assert cli_sweep.main([fil, "-o", "md", *SWEEP_ARGS,
+                           "--write-dats"]) == 0
+    dats = sorted(glob.glob("md_DM*.dat"))
+    assert cli_accel.main([*dats, "--batch", "4", *ACCEL_ARGS]) == 0
+    for d in dats:
+        os.remove(d)
+    cands = sorted(glob.glob("md_DM*_ACCEL_20.cand"))
+    rc = cli_sift.main([*cands, "-o", "md.accelcands", "--fold",
+                        "--min-sigma", "8"])
+    assert rc == 1
+    assert not glob.glob("md_cand*.pfd")
+
+
+def test_sift_fold_dm_text_roundtrip(tmp_path, monkeypatch):
+    """The .dat join key survives DMs that do not round-trip through the
+    .accelcands %.2f text (~1 in 5 grid DMs): a candidate parsed back as
+    147.33 must still find toy_DM147.33.dat whose .inf stores
+    147.32999999999998."""
+    import argparse
+
+    from pypulsar_tpu.cli.sift import _fold_sifted
+    from pypulsar_tpu.io.accelcands import Candidate, write_candlist
+
+    monkeypatch.chdir(tmp_path)
+    dm_exact = 0.03 * 4911  # 147.32999999999998 != float("147.33")
+    assert float(f"{dm_exact:.2f}") != dm_exact
+    _toy_dat(tmp_path, dm_exact)  # writes toy_DM147.33.dat
+    cand = Candidate(accelfile="toy_DM147.33_ACCEL_20.cand", candnum=1,
+                     dm=f"{dm_exact:.2f}", snr=10.0, sigma=8.0,
+                     numharm=1, ipow=50.0, cpow=50.0, period=0.0517,
+                     r=100.0, z=0.0)
+    write_candlist([cand], "rt.accelcands")
+    files = [("toy_DM147.33_ACCEL_20.cand", dm_exact, 16.384, [])]
+    args = argparse.Namespace(outfile="rt.accelcands", fold_nbins=32,
+                              fold_npart=8, fold_outbase=None)
+    assert _fold_sifted(args, files) == 0
+    assert glob.glob("rt_cand*.pfd")
+
+
+def test_prepfold_cands_delegates_to_foldbatch(tmp_path, monkeypatch):
+    """prepfold --cands FILE folds the whole list through the shared
+    pipeline, rejecting the single-candidate flags."""
+    from pypulsar_tpu.cli import prepfold as cli_prepfold
+
+    monkeypatch.chdir(tmp_path)
+    datfn, _ = _toy_dat(tmp_path, 10.0)
+    cands = _cands_file(tmp_path, [(0.0517, 10.0), (0.0731, 10.0)])
+    assert cli_prepfold.main([datfn, "--cands", cands, "-n", "32",
+                              "--npart", "8", "-o", "pc"]) == 0
+    assert len(glob.glob("pc_cand*.pfd")) == 2
+    with pytest.raises(SystemExit):
+        cli_prepfold.main([datfn, "--cands", cands, "-p", "0.05"])
+    # single-candidate overrides are rejected, not silently dropped
+    with pytest.raises(SystemExit):
+        cli_prepfold.main([datfn, "--cands", cands, "--dm", "80"])
+    with pytest.raises(SystemExit):
+        cli_prepfold.main([datfn, "--cands", cands, "--pd", "1e-12"])
+    with pytest.raises(SystemExit):
+        cli_prepfold.main([datfn, "--cands", cands, "--nsub", "128"])
+
+
+def test_pfd_snr_batch_glob_json(tmp_path, monkeypatch):
+    """pfd_snr takes a glob + --json and emits one machine-readable
+    summary row per archive (name, best DM, SNR, mean flux)."""
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+    from pypulsar_tpu.cli import pfd_snr as cli_snr
+
+    monkeypatch.chdir(tmp_path)
+    _toy_dat(tmp_path, 10.0, amp=6.0)
+    cands = _cands_file(tmp_path, [(0.0517, 10.0), (0.0731, 10.0)])
+    assert cli_foldbatch.main(_fold_args(cands, "sj")) == 0
+    # clean batch: rc 0
+    assert cli_snr.main(["sj_cand*.pfd", "--sefd", "10.0",
+                         "--json", "clean.json"]) == 0
+    assert len(json.load(open("clean.json"))) == 2
+    with open("sj_cand9999_corrupt.pfd", "wb") as f:
+        f.write(b"\x01\x02debris")  # truncation debris caught by the glob
+    # unreadable inputs: summary still written, but rc 1 for pipelines
+    # gating on the exit code
+    assert cli_snr.main(["sj_cand*.pfd", "typo_*.pfd", "--sefd", "10.0",
+                         "--json", "snr.json"]) == 1
+    rows = json.load(open("snr.json"))
+    # corrupt archive AND the zero-match glob each get an error row —
+    # neither silently vanishes from the survey summary
+    assert len(rows) == 4
+    assert any(r["pfd"] == "typo_*.pfd" and r.get("error")
+               for r in rows)
+    for row in rows:
+        assert {"pfd", "name", "best_dm", "period", "snr"} <= set(row)
+    assert sum(1 for r in rows if r.get("error", "").startswith(
+        "unreadable")) == 2
+    scored = [r for r in rows if r["snr"] is not None]
+    assert scored and scored[0]["snr"] > 5.0
+    assert scored[0]["smean_mjy"] is not None
+
+    # a mid-analysis failure on ONE archive (not just a parse failure)
+    # is contained to an error row too
+    from pypulsar_tpu.fold import profile_snr as _ps
+
+    real = _ps.pfd_snr
+    hits = {"n": 0}
+
+    def flaky(pfd, **kw):
+        hits["n"] += 1
+        if hits["n"] == 2:
+            raise RuntimeError("synthetic analysis failure")
+        return real(pfd, **kw)
+
+    monkeypatch.setattr(_ps, "pfd_snr", flaky)
+    os.remove("sj_cand9999_corrupt.pfd")
+    assert cli_snr.main(["sj_cand*.pfd", "--sefd", "10.0",
+                         "--json", "fl.json"]) == 1
+    fl = json.load(open("fl.json"))
+    assert len(fl) == 2
+    assert sum(1 for r in fl if str(r.get("error", "")).startswith(
+        "failed")) == 1
+    assert sum(1 for r in fl if r["snr"] is not None) == 1
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+def test_foldbatch_telemetry_counters_in_tlmsum(tmp_path, monkeypatch,
+                                                capsys):
+    """--telemetry records fold.cands_folded and the fold.pending_depth
+    prefetch gauge, and tlmsum renders them."""
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+    from pypulsar_tpu.cli.tlmsum import main as tlmsum_main
+
+    monkeypatch.chdir(tmp_path)
+    for dm in (10.0, 20.0):
+        _toy_dat(tmp_path, dm)
+    rows = [(0.0517 * (1 + 0.021 * j), dm) for dm in (10.0, 20.0)
+            for j in range(3)]
+    cands = _cands_file(tmp_path, rows)
+    assert cli_foldbatch.main(_fold_args(cands, "tl")
+                              + ["--telemetry", "tl.jsonl"]) == 0
+    recs = [json.loads(ln) for ln in open("tl.jsonl")]
+    counters = {}
+    gauges = set()
+    for r in recs:
+        if r.get("type") == "counters":
+            counters.update(r.get("counters", {}))
+            gauges.update(r.get("gauges", {}))
+    assert counters.get("fold.cands_folded") == 6
+    assert "fold.pending_depth" in gauges
+    capsys.readouterr()
+    assert tlmsum_main(["tl.jsonl"]) == 0
+    out = capsys.readouterr().out
+    assert "fold.cands_folded" in out
+    assert "fold_parts_batch" in out
+    assert "fold.pending_depth" in out
+
+
+# ---------------------------------------------------------------------------
+# satellite: pulse ceil-div fix
+# ---------------------------------------------------------------------------
+
+def test_pulse_interp_and_downsamp_exact_multiple():
+    """fold/pulse.py:179 regression: at an exact multiple the ceil-div
+    is the exact factor — the interpolation is the identity and the
+    result is the pure block-mean of the original profile (the py2
+    ``int(N/num)+1`` resampled through a 25%-larger grid instead)."""
+    import warnings
+
+    from pypulsar_tpu.fold.pulse import Pulse
+
+    prof = np.arange(8, dtype=float)
+    p = Pulse(1, 55000.0, 0.0, 8e-3, prof, "x.dat", 1e-3, 10.0, "Fake",
+              1400.0, 1.0, 100.0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        p.interp_and_downsamp(4)
+    assert p.N == 4
+    # interpolate(8) is the identity; downsample(2) sums adjacent bins
+    np.testing.assert_allclose(p.profile, [1.0, 5.0, 9.0, 13.0])
+    assert p.dt == pytest.approx(2e-3)
+
+    # non-multiple case unchanged: ceil(10/4) == int(10/4)+1 == 3
+    p2 = Pulse(2, 55000.0, 0.0, 1e-2, np.arange(10, dtype=float), "x.dat",
+               1e-3, 10.0, "Fake", 1400.0, 1.0, 100.0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        p2.interp_and_downsamp(4)
+    assert p2.N == 4
