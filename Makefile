@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier test-faults test-fold dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-telemetry native clean
+.PHONY: test test-fourier test-faults test-fold test-survey dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-telemetry native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -23,9 +23,17 @@ test-fourier:
 
 # the resilience suite: injected OOM / IO errors / kill+resume at every
 # journal kill-point, candidate tables proven bit-identical to unfaulted
-# runs (docs/ARCHITECTURE.md "Failure model & recovery")
+# runs (docs/ARCHITECTURE.md "Failure model & recovery") — plus the
+# survey orchestrator's kill/resume + quarantine cases
 test-faults:
 	$(CPU_ENV) $(PY) -m pytest tests/test_resilience.py -q
+	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -k "kill or resume or quarantine or retry"
+
+# the survey orchestrator suite: fleet-vs-serial byte parity, device
+# lease exclusivity / host overlap, kill+resume at every stage
+# boundary, quarantine (docs/ARCHITECTURE.md "Survey orchestrator")
+test-survey:
+	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q
 
 dryrun:
 	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -65,6 +73,11 @@ test-fold:
 # (foldbatch vs the serial per-candidate prepfold loop)
 bench-fold:
 	$(PY) bench.py --fold
+
+# the survey orchestrator A/B: serial per-observation chain vs the
+# fleet scheduler (host/device overlap) on 4 toy observations
+bench-survey:
+	$(PY) bench.py --survey --out BENCH_r08_survey.json
 
 native:
 	$(PY) -c "from pypulsar_tpu import native; assert native.available(); print('native codec OK')"

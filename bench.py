@@ -73,6 +73,11 @@ def parse_args(argv=None):
     ap.add_argument("--waterfall", action="store_true",
                     help="benchmark the single-DM waterfall path "
                          "(configs[0]) instead of the DM sweep")
+    ap.add_argument("--survey", action="store_true",
+                    help="A/B the survey orchestrator (pypulsar_tpu."
+                         "survey) against the serial per-observation "
+                         "chain on a 4-observation toy fleet — the "
+                         "round-9 host/device-overlap measurement")
     ap.add_argument("--prepass", action="store_true",
                     help="benchmark the zero-DM + spectrogram + detrend "
                          "prepass (configs[1]) instead of the DM sweep")
@@ -1355,6 +1360,137 @@ def _fold_pipeline_ab(args):
             os.chdir(olddir)
 
 
+def run_survey(args):
+    """Survey-orchestrator A/B (the round-9 tentpole's acceptance
+    measurement): the SAME per-observation stage chain (rfifind-mask ->
+    sweep --accel-search --write-dats -> sift -> foldbatch -> pfd_snr,
+    identical in-process CLI argvs) over a 4-observation toy fleet, run
+    two ways —
+
+    - **serial**: one observation at a time, one stage at a time (the
+      shell-loop workflow the orchestrator replaces);
+    - **orchestrated**: the fleet scheduler, one device lease + a
+      2-worker host pool, so observation B's sift/SNR summaries overlap
+      observation A's device stages.
+
+    Both legs run after a full warmup chain (jit caches hot — the A/B
+    measures orchestration, not compilation). Artifacts are checked
+    byte-identical across legs (.txtcand candidate tables and .pfd
+    archives), so the speedup is overlap, not skipped work."""
+    acquire_backend()
+    import glob as _glob
+    import tempfile
+
+    from pypulsar_tpu.io import filterbank
+    from pypulsar_tpu.ops import numpy_ref
+    from pypulsar_tpu.survey.dag import SurveyConfig, build_dag
+    from pypulsar_tpu.survey.scheduler import FleetScheduler
+    from pypulsar_tpu.survey.state import Observation
+
+    n_obs = 4
+    C, T, dtp = 32, (1 << 14 if (args.quick or args.cpu_fallback)
+                     else 1 << 15), 5e-4
+    rng_freqs = 1500.0 - 4.0 * np.arange(C)
+    cfg = SurveyConfig(
+        mask=True, mask_time=2.0, lodm=0.0, dmstep=10.0, numdms=8,
+        nsub=8, group_size=4, threshold=8.0,
+        accel_zmax=20.0, accel_numharm=2, accel_sigma=3.0, accel_batch=4,
+        sift_sigma=3.0, sift_min_hits=1, fold_nbins=32, fold_npart=8)
+    stages = build_dag(cfg)
+
+    def make_obs_fil(fn, seed, dm=40.0, period=0.1024, amp=10.0):
+        rng = np.random.RandomState(seed)
+        data = rng.randn(T, C).astype(np.float32) * 2.0 + 30.0
+        bins = numpy_ref.bin_delays(dm, rng_freqs, dtp)
+        for t0 in np.arange(0.01, T * dtp, period):
+            s0 = int(t0 / dtp)
+            for c in range(C):
+                idx = s0 + bins[c]
+                if idx < T:
+                    data[idx, c] += amp
+        filterbank.write_filterbank(
+            fn, dict(nchans=C, tsamp=dtp, fch1=float(rng_freqs[0]),
+                     foff=-4.0, tstart=55000.0, nbits=32, nifs=1,
+                     source_name=f"BENCH{seed}"), data)
+        return fn
+
+    def run_serial(obs_list):
+        for obs in obs_list:
+            for stage in stages:
+                stage.execute(obs, cfg)
+
+    with tempfile.TemporaryDirectory() as td:
+        fils = [make_obs_fil(os.path.join(td, f"obs{i}.fil"), seed=11 + i,
+                             period=0.1024 * (1.0 + 0.07 * i))
+                for i in range(n_obs)]
+
+        def fleet(dirname):
+            out = os.path.join(td, dirname)
+            os.makedirs(out, exist_ok=True)
+            return [Observation(f"obs{i}", fils[i],
+                                os.path.join(out, f"obs{i}"))
+                    for i in range(n_obs)]
+
+        # warmup: one full chain compiles every stage's jit programs
+        run_serial(fleet("warm")[:1])
+
+        t0 = time.perf_counter()
+        run_serial(fleet("serial"))
+        serial_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        result = FleetScheduler(fleet("orch"), cfg, max_host_workers=2,
+                                devices=1).run()
+        orch_s = time.perf_counter() - t0
+        assert result.ok and len(result.ran) == n_obs * len(stages)
+
+        # parity: the orchestrated fleet's candidate tables and archives
+        # are byte-identical to the serial chain's — enforced, not just
+        # reported: a speedup over divergent/missing work is not a win
+        identical = total = 0
+        for pattern in ("*_ACCEL_*.txtcand", "*_cand*.pfd"):
+            for fa in sorted(_glob.glob(os.path.join(td, "serial",
+                                                     pattern))):
+                fb = os.path.join(td, "orch", os.path.basename(fa))
+                total += 1
+                if (os.path.exists(fb) and open(fa, "rb").read()
+                        == open(fb, "rb").read()):
+                    identical += 1
+        assert identical == total and total > 0, \
+            f"orchestrated artifacts diverged: {identical}/{total}"
+
+    speedup = serial_s / orch_s
+    print(f"# survey A/B: serial chain {serial_s:.2f}s vs orchestrated "
+          f"{orch_s:.2f}s = {speedup:.2f}x ({n_obs} obs x "
+          f"{len(stages)} stages, 1 device lease + 2 host workers; "
+          f"{identical}/{total} artifacts byte-identical)",
+          file=sys.stderr)
+    unit = (f"orchestrated-fleet speedup over the serial per-observation "
+            f"chain ({n_obs} toy obs x {len(stages)} stages "
+            f"[mask/sweep+accel/sift/fold/snr], {C}-chan x {T}-sample "
+            f"each, warm jit caches, 1 device lease + 2 host workers — "
+            f"host-stage/device-stage overlap only, artifacts "
+            f"byte-checked against the serial legs)")
+    if args.cpu_fallback:
+        unit += " [CPU FALLBACK: accelerator backend unavailable]"
+    return {
+        "metric": "survey_fleet_speedup",
+        "value": round(speedup, 3),
+        "unit": unit,
+        "vs_baseline": round(speedup, 3),
+        "survey_n_obs": n_obs,
+        "survey_n_stages": len(stages),
+        "survey_serial_seconds": round(serial_s, 3),
+        "survey_orchestrated_seconds": round(orch_s, 3),
+        "survey_stages_run": len(result.ran),
+        "survey_max_host_workers": 2,
+        "survey_devices": 1,
+        "survey_artifacts_identical": f"{identical}/{total}",
+        "survey_nsamp": T,
+        "survey_nchan": C,
+    }
+
+
 def run_waterfall(args):
     """Single-DM waterfall path (BASELINE configs[0]: waterfaller.py
     dedisperse + downsample + scale on a 10 s, 256-chan filterbank —
@@ -1635,7 +1771,7 @@ def run_child(args, cpu: bool, timeout: float):
         if args.stream_window is not None:
             argv += ["--stream-window", str(args.stream_window)]
     for flag in ("quick", "profile", "ab", "accel", "fold", "waterfall",
-                 "prepass"):
+                 "prepass", "survey"):
         if getattr(args, flag):
             argv.append("--" + flag)
     proc = subprocess.run(argv, env=env, capture_output=True, text=True,
@@ -1668,7 +1804,7 @@ def main():
     args = parse_args()
     if (args.stream is None and not args.child
             and not (args.quick or args.ab or args.accel or args.fold
-                     or args.waterfall or args.prepass
+                     or args.waterfall or args.prepass or args.survey
                      or args.cpu_fallback or args.nsamp or args.nchan)
             and os.path.exists(DEFAULT_STREAM_FIL)):
         # the north-star workload exists on disk: measure THAT (streamed,
@@ -1697,6 +1833,8 @@ def main():
                 record = run_fold(args)
             elif args.waterfall:
                 record = run_waterfall(args)
+            elif args.survey:
+                record = run_survey(args)
             elif args.prepass:
                 record = run_prepass(args)
             elif args.stream:

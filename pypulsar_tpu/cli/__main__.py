@@ -6,7 +6,8 @@ import importlib
 import sys
 
 TOOLS = [
-    "sweep", "accelsearch", "sift", "prepfold", "foldbatch", "rfifind",
+    "survey", "sweep", "accelsearch", "sift", "prepfold", "foldbatch",
+    "rfifind",
     "waterfaller", "zero_dm_filter", "freq_time", "spectrogram",
     "dissect", "pulses_to_toa", "sum_profs", "pulse_energy_distribution",
     "autozap", "plot_accelcands", "combinefil", "stitchdat",
@@ -26,9 +27,16 @@ def main(argv=None):
         return 0 if argv else 1
     tool = argv[0]
     if tool not in TOOLS:
-        print("unknown tool %r; run with --help for the list" % tool,
-              file=sys.stderr)
-        return 1
+        # exit 2 (usage error, the argparse convention) with a
+        # closest-match hint — a survey driver's typo'd tool name must
+        # be distinguishable from a tool that ran and failed (rc 1)
+        import difflib
+
+        close = difflib.get_close_matches(tool, TOOLS, n=1)
+        hint = "; did you mean %r?" % close[0] if close else ""
+        print("unknown tool %r%s (run with --help for the list)"
+              % (tool, hint), file=sys.stderr)
+        return 2
     mod = importlib.import_module("pypulsar_tpu.cli.%s" % tool)
     return mod.main(argv[1:])
 

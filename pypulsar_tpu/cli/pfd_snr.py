@@ -165,24 +165,12 @@ def expand_pfd_args(files: List[str]) -> List[str]:
     """Glob-expand file arguments that the shell did not (quoted
     patterns, or callers passing literal globs): each arg that names no
     existing file but contains glob magic expands sorted, so a folded
-    survey's archives enumerate deterministically."""
-    import glob as _glob
+    survey's archives enumerate deterministically. ONE implementation of
+    the contract, shared with tlmsum (dead patterns are kept so they
+    fail loudly downstream)."""
+    from pypulsar_tpu.obs.summarize import expand_trace_args
 
-    out: List[str] = []
-    for fn in files:
-        if not os.path.exists(fn) and _glob.has_magic(fn):
-            matches = sorted(_glob.glob(fn))
-            if not matches:
-                # keep the dead pattern: it fails LOUDLY downstream (a
-                # missing-file error, or an error row in --json batch
-                # mode) instead of a survey summary silently missing a
-                # whole archive set behind a typo'd glob
-                out.append(fn)
-            else:
-                out.extend(matches)
-        else:
-            out.append(fn)
-    return out
+    return expand_trace_args(files)
 
 
 def main(argv=None):

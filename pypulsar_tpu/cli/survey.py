@@ -1,0 +1,202 @@
+"""Run the full search chain over a fleet of observations (``survey``).
+
+The one-command form of the per-tool chain (rfifind -> sweep
+--accel-search -> sift -> foldbatch -> pfd_snr), orchestrated per
+observation by the survey scheduler (``pypulsar_tpu.survey``):
+device-bound stages take an exclusive device lease while host-bound
+stages (sift, SNR summaries) overlap on a bounded worker pool; every
+completed stage lands in a fingerprinted per-observation manifest, so a
+killed fleet resumes with ``--resume`` (validated stages skipped, torn
+ones redone) and a persistently failing observation is quarantined while
+the rest of the fleet completes.
+
+Usage::
+
+    python -m pypulsar_tpu.cli survey beam*.fil -o out/ --numdms 256 \
+        --accel-zmax 200 --max-host-workers 4 --telemetry-dir out/tlm
+    python -m pypulsar_tpu.cli survey --status -o out/     # progress table
+    python -m pypulsar_tpu.cli survey beam*.fil -o out/ --resume
+
+Artifacts land at ``out/<stem>.*`` with exactly the bytes the serial
+per-tool chain would write (the stages ARE the serial tools, invoked
+in-process); the manifest is ``out/<stem>.survey.jsonl``. With
+``--telemetry-dir`` each observation writes one trace plus one fleet
+trace, all summarizable together via
+``tlmsum 'out/tlm/*.jsonl'`` (fleet roll-up mode).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+
+def build_parser():
+    from pypulsar_tpu.obs import telemetry
+    from pypulsar_tpu.resilience import faultinject
+
+    p = argparse.ArgumentParser(
+        prog="survey",
+        description="Orchestrate the rfifind -> sweep --accel-search -> "
+                    "sift -> foldbatch -> pfd_snr chain over a fleet of "
+                    "observations (TPU backend).")
+    p.add_argument("infile", nargs="*",
+                   help=".fil/.fits observations (omit with --status)")
+    p.add_argument("-o", "--outdir", required=True,
+                   help="directory for all artifacts + manifests; each "
+                        "observation's chain is rooted at "
+                        "<outdir>/<input stem>")
+    p.add_argument("--status", action="store_true",
+                   help="print the fleet progress table read from the "
+                        "manifests in --outdir and exit")
+    p.add_argument("--resume", action="store_true",
+                   help="replan from the per-observation manifests: "
+                        "stages whose recorded artifacts validate "
+                        "(size+sha256) are skipped, torn ones redone")
+    p.add_argument("--max-host-workers", type=int, default=2,
+                   help="bounded pool for host-bound stages (sift, SNR "
+                        "summaries) overlapping device time (default 2)")
+    p.add_argument("--devices", type=int, default=1,
+                   help="exclusive device leases for device-bound "
+                        "stages (default 1: one device-bound stage at a "
+                        "time)")
+    p.add_argument("--retries", type=int, default=1,
+                   help="bounded per-stage retries (exponential backoff) "
+                        "before the observation is quarantined "
+                        "(default 1)")
+    p.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                   help="write one JSONL trace per observation plus one "
+                        "fleet trace (fleet.jsonl) here; summarize "
+                        "together with `tlmsum 'DIR/*.jsonl'`")
+    # stage knobs (grouped; names mirror the per-tool flags)
+    g = p.add_argument_group("mask stage (rfifind)")
+    g.add_argument("--no-mask", dest="mask", action="store_false",
+                   help="skip the RFI-mask stage (sweep runs unmasked)")
+    g.add_argument("--mask-time", type=float, default=1.0,
+                   help="rfifind seconds per statistics interval "
+                        "(default 1.0)")
+    g = p.add_argument_group("sweep stage (flat DM grid + accel handoff)")
+    g.add_argument("--lodm", type=float, default=0.0)
+    g.add_argument("--dmstep", type=float, default=1.0)
+    g.add_argument("--numdms", type=int, default=32)
+    g.add_argument("-s", "--nsub", type=int, default=64)
+    g.add_argument("--group-size", type=int, default=0)
+    g.add_argument("--downsamp", type=int, default=1)
+    g.add_argument("--chunk", type=int, default=None)
+    g.add_argument("--threshold", type=float, default=6.0)
+    g.add_argument("--accel-zmax", type=float, default=200.0)
+    g.add_argument("--accel-dz", type=float, default=2.0)
+    g.add_argument("--accel-numharm", type=int, default=8,
+                   choices=(1, 2, 4, 8))
+    g.add_argument("--accel-sigma", type=float, default=2.0)
+    g.add_argument("--accel-batch", type=int, default=32)
+    g = p.add_argument_group("sift stage")
+    g.add_argument("--sift-sigma", type=float, default=4.0)
+    g.add_argument("--sift-min-hits", type=int, default=2)
+    g.add_argument("--sift-min-dm", type=float, default=None)
+    g = p.add_argument_group("fold stage")
+    g.add_argument("--fold-nbins", type=int, default=64)
+    g.add_argument("--fold-npart", type=int, default=32)
+    g.add_argument("--fold-batch", type=int, default=32)
+    telemetry.add_telemetry_flag(
+        p, what="fleet trace: per-stage spans + scheduler counters; "
+                "--telemetry-dir is the multi-trace form")
+    faultinject.add_fault_flag(p)
+    return p
+
+
+def _status(outdir: str) -> int:
+    from pypulsar_tpu.survey.state import MANIFEST_SUFFIX, format_status, status_rows
+
+    paths = sorted(glob.glob(os.path.join(outdir, "*" + MANIFEST_SUFFIX)))
+    if not paths:
+        print(f"# no survey manifests under {outdir!r}", file=sys.stderr)
+        return 1
+    print(format_status(status_rows(paths)))
+    return 0
+
+
+def _observations(infiles, outdir):
+    from pypulsar_tpu.survey.state import Observation
+
+    obs = []
+    seen = set()
+    for fn in infiles:
+        stem = os.path.splitext(os.path.basename(fn))[0]
+        if stem in seen:
+            raise ValueError(
+                f"duplicate observation stem {stem!r}: fleet inputs must "
+                f"have distinct basenames (their artifact chains share "
+                f"{outdir!r})")
+        seen.add(stem)
+        obs.append(Observation(stem, fn, os.path.join(outdir, stem)))
+    return obs
+
+
+def main(argv=None):
+    p = build_parser()
+    args = p.parse_args(argv)
+    if args.status:
+        return _status(args.outdir)
+    if not args.infile:
+        p.error("give at least one observation (or --status)")
+    from pypulsar_tpu.obs import telemetry
+    from pypulsar_tpu.resilience import faultinject
+
+    faultinject.configure_from_env()
+    if args.fault_inject:
+        faultinject.configure(args.fault_inject)
+    os.makedirs(args.outdir, exist_ok=True)
+    fleet_trace = args.telemetry
+    if args.telemetry_dir:
+        os.makedirs(args.telemetry_dir, exist_ok=True)
+        if fleet_trace is None:
+            fleet_trace = os.path.join(args.telemetry_dir, "fleet.jsonl")
+    with telemetry.session_from_flag(fleet_trace, tool="survey"):
+        return _run(args)
+
+
+def _run(args) -> int:
+    from pypulsar_tpu.survey.dag import SurveyConfig
+    from pypulsar_tpu.survey.scheduler import FleetScheduler
+
+    try:
+        obs = _observations(args.infile, args.outdir)
+    except ValueError as e:
+        print(f"survey: {e}", file=sys.stderr)
+        return 2
+    cfg = SurveyConfig(
+        mask=args.mask, mask_time=args.mask_time,
+        lodm=args.lodm, dmstep=args.dmstep, numdms=args.numdms,
+        nsub=args.nsub, group_size=args.group_size,
+        downsamp=args.downsamp, chunk=args.chunk,
+        threshold=args.threshold,
+        accel_zmax=args.accel_zmax, accel_dz=args.accel_dz,
+        accel_numharm=args.accel_numharm, accel_sigma=args.accel_sigma,
+        accel_batch=args.accel_batch,
+        sift_sigma=args.sift_sigma, sift_min_hits=args.sift_min_hits,
+        sift_min_dm=args.sift_min_dm,
+        fold_nbins=args.fold_nbins, fold_npart=args.fold_npart,
+        fold_batch=args.fold_batch)
+    sched = FleetScheduler(
+        obs, cfg, max_host_workers=args.max_host_workers,
+        devices=args.devices, retries=args.retries, resume=args.resume,
+        telemetry_dir=args.telemetry_dir, verbose=True)
+    result = sched.run()
+    n_stages = len(sched.stages)
+    print(f"# survey: {len(obs)} observations x {n_stages} stages in "
+          f"{result.wall:.2f}s — {len(result.ran)} stages run, "
+          f"{len(result.skipped)} skipped (validated), "
+          f"{result.retried} retried, "
+          f"{len(result.quarantined)} observations quarantined")
+    for name, q in sorted(result.quarantined.items()):
+        print(f"#   QUARANTINED {name} at {q['stage']}: {q['error']}")
+    if not result.ok:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -11,10 +11,16 @@ Usage::
 
     python -m pypulsar_tpu.cli tlmsum run.jsonl
     python -m pypulsar_tpu.cli tlmsum run.jsonl --top 30
+    python -m pypulsar_tpu.cli tlmsum 'out/tlm/*.jsonl'   # fleet roll-up
 
 Robust to truncated traces (a killed run stops mid-file): span records are
 aggregated line by line, and the final ``counters``/``stages`` flush is
 used only when present.
+
+Multiple paths (or quoted globs) render one section per trace followed by
+a combined fleet roll-up — stage seconds/calls, counters and events
+summed, walls summed (total compute, not elapsed: traces may have run
+concurrently under the survey orchestrator), gauge maxima kept.
 """
 
 from __future__ import annotations
@@ -118,6 +124,58 @@ def summarize(records: Iterable[dict]) -> TraceSummary:
     return s
 
 
+def combine_summaries(summaries: List[TraceSummary]) -> TraceSummary:
+    """Fleet roll-up of several finished summaries: stage seconds/calls,
+    counters and event counts sum; walls sum (total compute across the
+    fleet — the traces may have overlapped in real time); gauges keep
+    the max-of-max watermark and the last trace's last value; the device
+    snapshot is the last one seen."""
+    out = TraceSummary()
+    out.meta = {"tool": f"fleet roll-up ({len(summaries)} traces)"}
+    wall = 0.0
+    for s in summaries:
+        wall += s.wall or 0.0
+        out.n_spans += s.n_spans
+        out.n_events += s.n_events
+        for name, (secs, count) in s.stages.items():
+            ent = out.stages.setdefault(name, [0.0, 0])
+            ent[0] += secs
+            ent[1] += count
+        for k, v in s.counters.items():
+            out.counters[k] = out.counters.get(k, 0) + v
+        for k, n in s.events.items():
+            out.events[k] = out.events.get(k, 0) + n
+        for k, g in s.gauges.items():
+            ent = out.gauges.setdefault(k, dict(g))
+            ent["last"] = g.get("last", 0)
+            ent["max"] = max(ent.get("max", 0), g.get("max", 0))
+        if s.last_device is not None:
+            out.last_device = s.last_device
+    out.wall = wall
+    return out
+
+
+def expand_trace_args(paths: List[str]) -> List[str]:
+    """Glob-expand file arguments the shell did not (quoted patterns):
+    an arg naming no existing file but containing glob magic expands
+    sorted; a dead pattern is kept so it fails loudly downstream (a
+    missing-file error, or an error row in batch mode) instead of a
+    summary silently missing a whole file set behind a typo. The ONE
+    definition of the contract — pfd_snr's batch inputs delegate
+    here."""
+    import glob as _glob
+    import os
+
+    out: List[str] = []
+    for fn in paths:
+        if not os.path.exists(fn) and _glob.has_magic(fn):
+            matches = sorted(_glob.glob(fn))
+            out.extend(matches if matches else [fn])
+        else:
+            out.append(fn)
+    return out
+
+
 def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
     p = lambda *a: print(*a, file=file)  # noqa: E731
     if s.meta is not None:
@@ -172,19 +230,34 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tlmsum",
-        description="Summarize a pypulsar_tpu telemetry JSONL trace "
-                    "(recorded with --telemetry PATH.jsonl).")
-    ap.add_argument("jsonl", help="telemetry trace file")
+        description="Summarize pypulsar_tpu telemetry JSONL traces "
+                    "(recorded with --telemetry PATH.jsonl). Several "
+                    "paths (or quoted globs) add per-trace sections and "
+                    "a combined fleet roll-up.")
+    ap.add_argument("jsonl", nargs="+",
+                    help="telemetry trace file(s); quoted glob patterns "
+                         "expand sorted")
     ap.add_argument("--top", type=int, default=20,
                     help="stages to show (default 20)")
     args = ap.parse_args(argv)
-    try:
-        s = summarize(load_records(args.jsonl))
-    except OSError as e:
-        print(f"tlmsum: cannot read {args.jsonl}: {e}", file=sys.stderr)
-        return 1
-    render(s, sys.stdout, top=args.top)
-    return 0
+    paths = expand_trace_args(args.jsonl)
+    summaries = []
+    rc = 0
+    for path in paths:
+        try:
+            s = summarize(load_records(path))
+        except OSError as e:
+            print(f"tlmsum: cannot read {path}: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        if len(paths) > 1:
+            print(f"# ===== trace: {path} =====")
+        render(s, sys.stdout, top=args.top)
+        summaries.append(s)
+    if len(paths) > 1 and len(summaries) > 1:
+        print(f"# ===== fleet roll-up: {len(summaries)} traces =====")
+        render(combine_summaries(summaries), sys.stdout, top=args.top)
+    return rc
 
 
 if __name__ == "__main__":

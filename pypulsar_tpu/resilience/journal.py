@@ -206,6 +206,14 @@ class RunJournal:
             self._records = []
             self._keep_bytes = 0
 
+    def is_fresh(self) -> bool:
+        """True when no prior usable records were loaded — a new journal
+        file, or a restart after a fingerprint mismatch/corruption. What
+        callers key start-over side effects on (e.g. the survey
+        scheduler scrubbing stale artifacts a reconfigured rerun must
+        not glob up)."""
+        return not self._records
+
     def completed(self, validate: bool = True) -> Set[str]:
         """Unit ids recorded done whose artifacts (still) validate:
         every output exists with the recorded size and sha256. A unit

@@ -1,0 +1,37 @@
+"""Survey orchestrator: run the full search chain over fleets of
+observations.
+
+PRs 1-4 built the per-observation pieces (telemetry, the streamed
+sweep->accel handoff, journaled resume + fault injection, batched
+folding); this package composes them into one fleet-level runtime:
+
+- :mod:`.dag` — the per-observation stage DAG (rfifind-mask ->
+  ``sweep --accel-search`` -> sift -> foldbatch -> pfd_snr), each stage
+  declaring its inputs/outputs and running the SAME in-process CLI entry
+  point the serial chain uses (artifacts stay byte-identical);
+- :mod:`.scheduler` — the fleet scheduler: device-bound stages take an
+  exclusive device lease (priority + FIFO), host-bound stages run on a
+  bounded worker pool so observation B's prep/post overlaps observation
+  A's device time;
+- :mod:`.state` — fingerprinted per-observation manifests
+  (``resilience.journal`` underneath): kill -9 mid-fleet and
+  ``survey --resume`` replans, skips validated stages, and re-runs only
+  torn ones; persistent per-stage failure quarantines the observation
+  instead of aborting the fleet.
+
+Surfaced as ``python -m pypulsar_tpu.cli survey`` (cli/survey.py).
+"""
+
+from pypulsar_tpu.survey.dag import StageExit, SurveyConfig, build_dag
+from pypulsar_tpu.survey.scheduler import FleetResult, FleetScheduler
+from pypulsar_tpu.survey.state import Observation, ObsManifest
+
+__all__ = [
+    "FleetResult",
+    "FleetScheduler",
+    "Observation",
+    "ObsManifest",
+    "StageExit",
+    "SurveyConfig",
+    "build_dag",
+]

@@ -1,0 +1,259 @@
+"""The per-observation stage DAG: what one fleet member runs, declared.
+
+Five stages close the raw -> science chain in-tree::
+
+    mask (device)  rfifind-compatible RFI mask from the data
+      └─ sweep (device)  DM sweep + streamed accel handoff
+           (``sweep --accel-search --write-dats --journal``: single-pulse
+           .cands, per-DM .dat/.inf tee, per-trial .cand/.txtcand)
+           └─ sift (host)  cluster per-DM candidates -> .accelcands
+                └─ fold (device)  batched candidate folding -> .pfd
+                     └─ snr (host)  pfd_snr --json fleet summary
+
+Each :class:`StageSpec` declares whether it needs the device (the
+scheduler's lease axis), which stages it depends on, the argv of the
+EXACT in-process CLI entry point the serial per-tool chain would run
+(artifact bytes therefore cannot diverge from the serial chain — the
+orchestrator adds concurrency, not a second implementation), and an
+output enumerator resolved AFTER the run (fold archives are named by the
+sifted candidates, so the set is dynamic). Outputs feed the manifest's
+validate-or-redo hook: ``resilience.journal`` records size + sha256 per
+artifact and a resumed fleet re-runs any stage whose outputs no longer
+validate.
+
+Stage failure granularity: a stage that exits nonzero raises
+:class:`StageExit` — an ordinary Exception, so the scheduler's bounded
+retry/quarantine policy owns it; injected kills (BaseException) unwind
+the fleet like a signal.
+"""
+
+from __future__ import annotations
+
+import glob
+import importlib
+import os
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from pypulsar_tpu.survey.state import Observation
+
+__all__ = [
+    "StageExit",
+    "StageSpec",
+    "SurveyConfig",
+    "build_dag",
+    "stage_names",
+]
+
+
+class StageExit(RuntimeError):
+    """A stage's CLI entry point returned a nonzero exit code."""
+
+
+@dataclass
+class SurveyConfig:
+    """Every knob the five stages take, with the individual tools'
+    defaults. One config per fleet: the manifest fingerprint hashes all
+    of it, so changing any knob restarts (never resumes) the affected
+    manifests."""
+
+    # mask (rfifind)
+    mask: bool = True
+    mask_time: float = 1.0
+    # sweep (flat grid; the DDplan path stays a per-tool workflow)
+    lodm: float = 0.0
+    dmstep: float = 1.0
+    numdms: int = 32
+    nsub: int = 64
+    group_size: int = 0
+    downsamp: int = 1
+    chunk: Optional[int] = None
+    threshold: float = 6.0
+    # accel handoff
+    accel_zmax: float = 200.0
+    accel_dz: float = 2.0
+    accel_numharm: int = 8
+    accel_sigma: float = 2.0
+    accel_batch: int = 32
+    # sift
+    sift_sigma: float = 4.0
+    sift_min_hits: int = 2
+    sift_min_dm: Optional[float] = None
+    # fold
+    fold_nbins: int = 64
+    fold_npart: int = 32
+    fold_batch: int = 32
+
+
+@dataclass(frozen=True)
+class StageSpec:
+    """One DAG node. ``run`` defaults to dispatching ``argv`` to the
+    ``tool`` CLI's in-process ``main``; stages with pre/post logic that
+    is not a plain CLI call (snr's empty-fleet guard) override it."""
+
+    name: str
+    tool: str
+    device_bound: bool
+    deps: Tuple[str, ...]
+    argv: Callable[[Observation, SurveyConfig], List[str]]
+    outputs: Callable[[Observation, SurveyConfig], List[str]]
+    run: Optional[Callable[[Observation, SurveyConfig], int]] = field(
+        default=None)
+
+    def execute(self, obs: Observation, cfg: SurveyConfig) -> None:
+        if self.run is not None:
+            rc = self.run(obs, cfg)
+        else:
+            rc = run_cli_tool(self.tool, self.argv(obs, cfg))
+        if rc:
+            raise StageExit(f"stage {self.name!r} ({self.tool}) exited "
+                            f"{rc} for observation {obs.name!r}")
+
+
+def run_cli_tool(tool: str, argv: List[str]) -> int:
+    """Invoke a CLI tool's ``main`` in-process (a library call, not a
+    subprocess — the readers, jit caches and telemetry session are
+    shared with the fleet). argparse errors (SystemExit) become exit
+    codes so the scheduler's retry/quarantine policy sees them instead
+    of a fleet-fatal BaseException."""
+    mod = importlib.import_module(f"pypulsar_tpu.cli.{tool}")
+    try:
+        return int(mod.main(argv) or 0)
+    except SystemExit as e:  # argparse .error() inside a worker thread
+        code = e.code
+        return code if isinstance(code, int) else 1
+
+
+def _sorted_glob(pattern: str) -> List[str]:
+    return sorted(glob.glob(pattern))
+
+
+def _mask_file(obs: Observation) -> str:
+    return f"{obs.outbase}_rfifind.mask"
+
+
+def _mask_argv(obs: Observation, cfg: SurveyConfig) -> List[str]:
+    return [obs.infile, "-o", obs.outbase, "-t", str(cfg.mask_time)]
+
+
+def _mask_outputs(obs: Observation, cfg: SurveyConfig) -> List[str]:
+    outs = [_mask_file(obs)]
+    stats = f"{obs.outbase}_rfifind.stats.npz"
+    if os.path.exists(stats):
+        outs.append(stats)
+    return outs
+
+
+def _sweep_argv(obs: Observation, cfg: SurveyConfig) -> List[str]:
+    argv = [obs.infile, "-o", obs.outbase,
+            "--lodm", str(cfg.lodm), "--dmstep", str(cfg.dmstep),
+            "--numdms", str(cfg.numdms), "-s", str(cfg.nsub),
+            "--group-size", str(cfg.group_size),
+            "--threshold", str(cfg.threshold),
+            "--write-dats", "--accel-search",
+            "--accel-zmax", str(cfg.accel_zmax),
+            "--accel-dz", str(cfg.accel_dz),
+            "--accel-numharm", str(cfg.accel_numharm),
+            "--accel-sigma", str(cfg.accel_sigma),
+            "--accel-batch", str(cfg.accel_batch),
+            # the chain journal gives the (long) sweep stage its own
+            # intra-stage resume: a redone stage skips validated units
+            "--journal", f"{obs.outbase}.chain.jsonl"]
+    if cfg.downsamp != 1:
+        argv += ["--downsamp", str(cfg.downsamp)]
+    if cfg.chunk is not None:
+        argv += ["--chunk", str(cfg.chunk)]
+    if cfg.mask:
+        argv += ["--mask", _mask_file(obs)]
+    return argv
+
+
+def _sweep_outputs(obs: Observation, cfg: SurveyConfig) -> List[str]:
+    return ([f"{obs.outbase}.cands"]
+            + _sorted_glob(f"{obs.outbase}_DM*.dat")
+            + _sorted_glob(f"{obs.outbase}_DM*.inf")
+            + _sorted_glob(f"{obs.outbase}_DM*_ACCEL_*.cand")
+            + _sorted_glob(f"{obs.outbase}_DM*_ACCEL_*.txtcand"))
+
+
+def _sift_argv(obs: Observation, cfg: SurveyConfig) -> List[str]:
+    argv = (_sorted_glob(f"{obs.outbase}_DM*_ACCEL_*.cand")
+            + ["-s", str(cfg.sift_sigma),
+               "--min-hits", str(cfg.sift_min_hits),
+               "-o", f"{obs.outbase}.accelcands"])
+    if cfg.sift_min_dm is not None:
+        argv += ["--min-dm", str(cfg.sift_min_dm)]
+    return argv
+
+
+def _sift_outputs(obs: Observation, cfg: SurveyConfig) -> List[str]:
+    return [f"{obs.outbase}.accelcands"]
+
+
+def _fold_argv(obs: Observation, cfg: SurveyConfig) -> List[str]:
+    return ["--cands", f"{obs.outbase}.accelcands",
+            "--datbase", obs.outbase, "-o", obs.outbase,
+            "-n", str(cfg.fold_nbins), "--npart", str(cfg.fold_npart),
+            "--batch", str(cfg.fold_batch)]
+
+
+def _fold_outputs(obs: Observation, cfg: SurveyConfig) -> List[str]:
+    outs = _sorted_glob(f"{obs.outbase}_cand*.pfd")
+    summary = f"{obs.outbase}_foldbatch.json"
+    if os.path.exists(summary):
+        outs.append(summary)
+    return outs
+
+
+def _snr_json(obs: Observation) -> str:
+    return f"{obs.outbase}_snr.json"
+
+
+def _snr_argv(obs: Observation, cfg: SurveyConfig) -> List[str]:
+    return (_sorted_glob(f"{obs.outbase}_cand*.pfd")
+            + ["--json", _snr_json(obs)])
+
+
+def _snr_run(obs: Observation, cfg: SurveyConfig) -> int:
+    """pfd_snr over the folded archives; an observation whose sift kept
+    nothing (no archives) is a legitimate empty survey row, not an
+    error — pfd_snr requires at least one input, so write the empty
+    summary directly."""
+    argv = _snr_argv(obs, cfg)
+    if not _sorted_glob(f"{obs.outbase}_cand*.pfd"):
+        from pypulsar_tpu.resilience.journal import atomic_write_text
+
+        atomic_write_text(_snr_json(obs), "[]")
+        return 0
+    return run_cli_tool("pfd_snr", argv)
+
+
+def _snr_outputs(obs: Observation, cfg: SurveyConfig) -> List[str]:
+    return [_snr_json(obs)]
+
+
+def build_dag(cfg: SurveyConfig) -> List[StageSpec]:
+    """The stage list in topological order (the chain above; ``mask``
+    drops out — and the sweep drops ``--mask`` — under
+    ``cfg.mask=False``)."""
+    stages: List[StageSpec] = []
+    sweep_deps: Tuple[str, ...] = ()
+    if cfg.mask:
+        stages.append(StageSpec("mask", "rfifind", True, (),
+                                _mask_argv, _mask_outputs))
+        sweep_deps = ("mask",)
+    stages += [
+        StageSpec("sweep", "sweep", True, sweep_deps,
+                  _sweep_argv, _sweep_outputs),
+        StageSpec("sift", "sift", False, ("sweep",),
+                  _sift_argv, _sift_outputs),
+        StageSpec("fold", "foldbatch", True, ("sift",),
+                  _fold_argv, _fold_outputs),
+        StageSpec("snr", "pfd_snr", False, ("fold",),
+                  _snr_argv, _snr_outputs, run=_snr_run),
+    ]
+    return stages
+
+
+def stage_names(stages: Sequence[StageSpec]) -> List[str]:
+    return [s.name for s in stages]

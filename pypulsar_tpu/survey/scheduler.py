@@ -1,0 +1,401 @@
+"""Fleet scheduler: device leases + a bounded host pool over the obs DAG.
+
+Survey-scale pipelines are throughput systems (arXiv:1601.01165 frames
+dedispersion surveys exactly this way): the accelerator must stay
+saturated while host-side IO, prep and post-processing for OTHER beams
+proceed concurrently. The serial per-tool chain leaves the device idle
+during every sift and pfd_snr; this scheduler runs the per-observation
+stage DAG (:mod:`.dag`) over the whole fleet with two execution lanes:
+
+- **device lane** — ``device_bound`` stages queue for one of N exclusive
+  device leases (default 1: one device-bound stage at a time per
+  device). The queue is priority + FIFO: deeper stages first (drain
+  observations toward completion, bounding in-flight intermediate
+  artifacts), submission order breaking ties.
+- **host lane** — host-bound stages (sift, pfd_snr summaries) run on a
+  bounded worker pool (``max_host_workers``), overlapping the device
+  lane.
+
+Failure policy: a stage that raises an ordinary Exception (including a
+nonzero CLI exit, an injected IO fault, an OOM that escaped the in-stage
+halving) retries up to ``retries`` times with bounded exponential
+backoff; past that the OBSERVATION is quarantined — recorded in its
+manifest, its remaining stages cancelled, the fleet continues — instead
+of aborting the run. A BaseException (``faultinject.InjectedKill``,
+KeyboardInterrupt) unwinds the whole fleet like a signal: nothing is
+marked done that did not finish, and a ``--resume`` replans from the
+manifests.
+
+Fault points (``--fault-inject`` / PYPULSAR_TPU_FAULTS), armed at stage
+boundaries: ``survey.stage_start`` / ``survey.stage_done`` (any stage,
+Nth hit) and the per-stage ``survey.stage_start.<name>`` /
+``survey.stage_done.<name>``. ``stage_done`` trips AFTER the artifacts
+are written but BEFORE the manifest records them — the torn-stage window
+a resume must redo.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.survey.dag import StageSpec, SurveyConfig, build_dag, stage_names
+from pypulsar_tpu.survey.state import (
+    Observation,
+    ObsManifest,
+    ObsTrace,
+    fleet_fingerprint,
+)
+
+__all__ = ["FleetResult", "FleetScheduler"]
+
+# bounded backoff between retries of a failed stage (base * 2^attempt,
+# capped): the delay runs on a timer thread, NOT the lane worker, so a
+# backing-off observation never stalls the device lease or a host slot
+RETRY_BACKOFF_BASE_S = 0.25
+RETRY_BACKOFF_MAX_S = 5.0
+
+_PENDING, _QUEUED, _RUNNING, _DONE, _QUARANTINED = range(5)
+
+
+@dataclass
+class FleetResult:
+    """What one scheduler run did: ``ran`` (executed this run, in
+    completion order), ``skipped`` (validated complete from the
+    manifests — the resume contract's receipt), ``quarantined``
+    (obs -> failing stage + error), ``retried`` stage-retry count."""
+
+    ran: List[Tuple[str, str]] = field(default_factory=list)
+    skipped: List[Tuple[str, str]] = field(default_factory=list)
+    quarantined: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    retried: int = 0
+    wall: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.quarantined
+
+
+class _Task:
+    __slots__ = ("obs_i", "stage", "state", "attempts", "seq")
+
+    def __init__(self, obs_i: int, stage: StageSpec):
+        self.obs_i = obs_i
+        self.stage = stage
+        self.state = _PENDING
+        self.attempts = 0
+        self.seq = -1
+
+
+class FleetScheduler:
+    """See module docstring. ``stages`` defaults to the standard five-
+    stage DAG (:func:`build_dag`); tests inject synthetic DAGs."""
+
+    def __init__(self, observations: Sequence[Observation],
+                 cfg: Optional[SurveyConfig] = None, *,
+                 stages: Optional[Sequence[StageSpec]] = None,
+                 max_host_workers: int = 2, devices: int = 1,
+                 retries: int = 1, resume: bool = False,
+                 telemetry_dir: Optional[str] = None,
+                 verbose: bool = False):
+        self.cfg = cfg if cfg is not None else SurveyConfig()
+        self.stages = list(stages) if stages is not None \
+            else build_dag(self.cfg)
+        self._by_name = {s.name: s for s in self.stages}
+        self._depth = {s.name: i for i, s in enumerate(self.stages)}
+        for s in self.stages:
+            for d in s.deps:
+                if d not in self._by_name:
+                    raise ValueError(f"stage {s.name!r} depends on "
+                                     f"unknown stage {d!r}")
+        self.obs = list(observations)
+        names = [o.name for o in self.obs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate observation names: {names}")
+        self.max_host_workers = max(1, int(max_host_workers))
+        self.devices = max(1, int(devices))
+        self.retries = max(0, int(retries))
+        self.resume = resume
+        self.telemetry_dir = telemetry_dir
+        self.verbose = verbose
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._device_q: "queue.PriorityQueue" = queue.PriorityQueue()
+        self._host_q: "queue.PriorityQueue" = queue.PriorityQueue()
+        self._seq = 0
+        self._stop = False
+        self._fatal: Optional[BaseException] = None
+        self._tasks: Dict[Tuple[int, str], _Task] = {
+            (i, s.name): _Task(i, s)
+            for i in range(len(self.obs)) for s in self.stages}
+        self.result = FleetResult()
+        self._manifests: List[ObsManifest] = []
+        self._traces: List[Optional[ObsTrace]] = []
+        self._t0 = 0.0
+
+    # -- manifests ----------------------------------------------------------
+
+    def _clean_stale_outputs(self, obs: Observation) -> None:
+        """Scrub every artifact the stages would enumerate for this
+        observation (plus the sweep's chain journal). Runs only when the
+        manifest is FRESH — a reconfigured rerun into the same outdir
+        must not let the previous grid's files leak into the glob-driven
+        stage inputs/outputs (sift would cluster old-grid .cand trails,
+        snr would summarize orphaned archives), which would diverge from
+        a clean-dir serial chain."""
+        stale = [f"{obs.outbase}.chain.jsonl"]
+        for s in self.stages:
+            stale += s.outputs(obs, self.cfg)
+        for path in stale:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def _open_manifests(self) -> None:
+        snames = stage_names(self.stages)
+        for obs in self.obs:
+            if not self.resume and os.path.exists(obs.manifest):
+                # a fresh (non-resume) fleet starts from scratch — the
+                # same contract as `sweep --checkpoint` without --resume
+                os.remove(obs.manifest)
+            m = ObsManifest(obs.manifest,
+                            fleet_fingerprint(obs, self.cfg, snames))
+            if m.fresh:
+                # new manifest OR a restart after changed params/input:
+                # nothing will be skipped, so nothing stale may linger
+                self._clean_stale_outputs(obs)
+            m.plan(obs, snames)
+            self._manifests.append(m)
+            trace = None
+            if self.telemetry_dir:
+                trace = ObsTrace(
+                    os.path.join(self.telemetry_dir, f"{obs.name}.jsonl"),
+                    obs.name, append=self.resume)
+            self._traces.append(trace)
+
+    # -- scheduling core ----------------------------------------------------
+
+    def _enqueue_locked(self, task: _Task) -> None:
+        task.state = _QUEUED
+        self._seq += 1
+        task.seq = self._seq
+        # deeper stages first (finish observations, free their
+        # intermediates), FIFO within a depth
+        entry = (-self._depth[task.stage.name], task.seq, task)
+        (self._device_q if task.stage.device_bound
+         else self._host_q).put(entry)
+
+    def _promote_locked(self, obs_i: int) -> None:
+        for s in self.stages:
+            task = self._tasks[(obs_i, s.name)]
+            if task.state != _PENDING:
+                continue
+            if all(self._tasks[(obs_i, d)].state == _DONE for d in s.deps):
+                self._enqueue_locked(task)
+
+    def _finished_locked(self) -> bool:
+        return all(t.state in (_DONE, _QUARANTINED)
+                   for t in self._tasks.values())
+
+    # -- execution ----------------------------------------------------------
+
+    def _execute(self, task: _Task) -> None:
+        obs = self.obs[task.obs_i]
+        stage = task.stage
+        faultinject.trip("survey.stage_start")
+        faultinject.trip(f"survey.stage_start.{stage.name}")
+        telemetry.counter("survey.stages_run")
+        t_rel = time.perf_counter() - self._t0
+        t0 = time.perf_counter()
+        with telemetry.span(f"survey.stage.{stage.name}", obs=obs.name):
+            stage.execute(obs, self.cfg)
+        dur = time.perf_counter() - t0
+        faultinject.trip("survey.stage_done")
+        faultinject.trip(f"survey.stage_done.{stage.name}")
+        outputs = stage.outputs(obs, self.cfg)
+        self._manifests[task.obs_i].mark_done(stage.name, outputs)
+        trace = self._traces[task.obs_i]
+        if trace is not None:
+            trace.span(f"survey.stage.{stage.name}", t_rel, dur,
+                       outputs=len(outputs))
+        if self.verbose:
+            print(f"# survey: {obs.name}: {stage.name} done "
+                  f"({dur:.2f}s, {len(outputs)} artifacts)")
+        with self._cv:
+            task.state = _DONE
+            self.result.ran.append((obs.name, stage.name))
+            self._promote_locked(task.obs_i)
+            if self._finished_locked():
+                self._stop = True
+            self._cv.notify_all()
+
+    def _requeue_retry(self, task: _Task) -> None:
+        """Timer callback re-enqueuing a backing-off task — unless its
+        observation was quarantined (or the fleet stopped) while it
+        waited: a retry must not resurrect a cancelled stage."""
+        with self._cv:
+            if not self._stop and task.state != _QUARANTINED:
+                self._enqueue_locked(task)
+                self._cv.notify_all()
+
+    def _handle_failure(self, task: _Task, err: Exception) -> None:
+        obs = self.obs[task.obs_i]
+        stage = task.stage
+        with self._lock:
+            if task.state == _QUARANTINED:
+                # another stage of this observation quarantined it while
+                # this one was running: its failure is already verdict
+                return
+        telemetry.counter("survey.stage_failures")
+        telemetry.event("survey.stage_failed", obs=obs.name,
+                        stage=stage.name, error=type(err).__name__)
+        if task.attempts < self.retries:
+            task.attempts += 1
+            self.result.retried += 1
+            delay = min(RETRY_BACKOFF_BASE_S * (2 ** (task.attempts - 1)),
+                        RETRY_BACKOFF_MAX_S)
+            telemetry.event("survey.stage_retry", obs=obs.name,
+                            stage=stage.name, attempt=task.attempts)
+            if self.verbose:
+                print(f"# survey: {obs.name}: {stage.name} failed "
+                      f"({type(err).__name__}: {err}); retry "
+                      f"{task.attempts}/{self.retries} in {delay:.2f}s")
+            # re-enqueue from a timer, not this worker: the backoff must
+            # not hold the device lease / host slot idle. The fleet
+            # cannot finish early — the task stays non-terminal until
+            # the timer fires and the retry settles.
+            timer = threading.Timer(delay, self._requeue_retry, (task,))
+            timer.daemon = True
+            timer.start()
+            return
+        # bounded retries exhausted: quarantine the OBSERVATION — the
+        # fleet continues, the verdict is recorded, and a later resume
+        # may try again (the operator explicitly asked)
+        error = f"{type(err).__name__}: {err}"
+        self._manifests[task.obs_i].quarantine(stage.name, error)
+        telemetry.event("survey.quarantine", obs=obs.name,
+                        stage=stage.name, error=type(err).__name__)
+        trace = self._traces[task.obs_i]
+        if trace is not None:
+            trace.event("survey.quarantine", stage=stage.name)
+        print(f"# survey: QUARANTINED {obs.name} at {stage.name}: {error} "
+              f"(fleet continues)")
+        with self._cv:
+            for s in self.stages:
+                t = self._tasks[(task.obs_i, s.name)]
+                if t.state != _DONE:
+                    t.state = _QUARANTINED
+            self.result.quarantined[obs.name] = {"stage": stage.name,
+                                                 "error": error}
+            if self._finished_locked():
+                self._stop = True
+            self._cv.notify_all()
+
+    def _lease_device(self, lease: Optional[int]):
+        """The JAX device backing lease ``lease``, or None when no
+        binding is needed. With one lease (the default) the process
+        default device already IS the lease; with several, each device
+        worker pins its stages via ``jax.default_device`` (thread-local)
+        so N leases really are N chips, not N-fold oversubscription of
+        device 0. Guarded: a jax-less run (stub DAGs) just skips the
+        binding."""
+        if lease is None or self.devices <= 1:
+            return None
+        try:
+            import jax
+
+            devs = jax.local_devices()
+        except Exception:  # noqa: BLE001 - no backend: nothing to pin
+            return None
+        return devs[lease % len(devs)]
+
+    def _worker(self, q: "queue.PriorityQueue",
+                lease: Optional[int] = None) -> None:
+        device = self._lease_device(lease)
+        while True:
+            try:
+                _, _, task = q.get(timeout=0.05)
+            except queue.Empty:
+                if self._stop:
+                    return
+                continue
+            with self._lock:
+                if self._stop and self._fatal is not None:
+                    continue  # fleet is unwinding: drop queued work
+                if task.state == _QUARANTINED:
+                    continue  # cancelled while queued
+                task.state = _RUNNING
+            try:
+                if device is not None:
+                    import jax
+
+                    with jax.default_device(device):
+                        self._execute(task)
+                else:
+                    self._execute(task)
+            except Exception as e:  # noqa: BLE001 - retry/quarantine policy
+                self._handle_failure(task, e)
+            except BaseException as e:  # injected kill / interrupt
+                with self._cv:
+                    if self._fatal is None:
+                        self._fatal = e
+                    self._stop = True
+                    self._cv.notify_all()
+                return
+
+    # -- entry point --------------------------------------------------------
+
+    def run(self) -> FleetResult:
+        """Run the fleet to completion (or first fatal error). Returns
+        the :class:`FleetResult`; re-raises a BaseException (injected
+        kill, KeyboardInterrupt) after the in-flight stages settle."""
+        self._t0 = time.perf_counter()
+        self._open_manifests()
+        try:
+            with self._cv:
+                for i in range(len(self.obs)):
+                    done = (self._manifests[i].done_stages()
+                            if self.resume else set())
+                    for s in self.stages:
+                        if s.name in done:
+                            self._tasks[(i, s.name)].state = _DONE
+                            self.result.skipped.append(
+                                (self.obs[i].name, s.name))
+                            telemetry.counter("survey.stages_skipped")
+                    self._promote_locked(i)
+                if self._finished_locked():
+                    self._stop = True
+            workers = (
+                [threading.Thread(target=self._worker,
+                                  args=(self._device_q, d),
+                                  name=f"survey-device{d}")
+                 for d in range(self.devices)]
+                + [threading.Thread(target=self._worker,
+                                    args=(self._host_q,),
+                                    name=f"survey-host{h}")
+                   for h in range(self.max_host_workers)])
+            for w in workers:
+                w.start()
+            with self._cv:
+                while not self._stop:
+                    self._cv.wait(0.1)
+            for w in workers:
+                w.join()
+        finally:
+            self.result.wall = time.perf_counter() - self._t0
+            for m in self._manifests:
+                m.close()
+            for t in self._traces:
+                if t is not None:
+                    t.close()
+        if self._fatal is not None:
+            raise self._fatal
+        return self.result

@@ -1,0 +1,268 @@
+"""Fleet state: per-observation manifests, status views, obs traces.
+
+One observation's progress through the stage DAG is a fingerprinted
+``resilience.journal.RunJournal`` (tool ``"survey"``) living next to its
+artifacts: every completed stage appends one ``done`` record naming its
+output artifacts with size + sha256 (fsync'd, torn-tail tolerant), so a
+``kill -9`` mid-fleet followed by ``survey --resume`` replans from what
+actually validates on disk — a stage whose artifacts were truncated,
+deleted or half-written is redone, never trusted. Rerunning under
+different stage parameters changes the fingerprint and restarts the
+manifest instead of skipping against stale artifacts (the same contract
+the sweep chain journal enforces).
+
+The module also holds the read-only views the ``survey --status`` table
+renders (raw, fingerprint-agnostic manifest parsing: status must work on
+a manifest written by a run with parameters this process does not know)
+and :class:`ObsTrace`, the per-observation JSONL trace writer whose
+records use the telemetry schema so ``tlmsum`` — including its fleet
+roll-up mode — summarizes obs traces and the fleet trace alike.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from pypulsar_tpu.resilience.journal import RunJournal
+
+__all__ = [
+    "ObsManifest",
+    "ObsTrace",
+    "Observation",
+    "fleet_fingerprint",
+    "format_status",
+    "load_manifest_records",
+    "manifest_path",
+    "status_rows",
+]
+
+MANIFEST_SUFFIX = ".survey.jsonl"
+
+
+@dataclass(frozen=True)
+class Observation:
+    """One fleet member: a raw file plus the basename its whole artifact
+    chain (mask, .cands, .dat/.cand trails, .accelcands, .pfd, SNR
+    summary, manifest) is rooted at."""
+
+    name: str
+    infile: str
+    outbase: str
+
+    @property
+    def manifest(self) -> str:
+        return manifest_path(self.outbase)
+
+
+def manifest_path(outbase: str) -> str:
+    return outbase + MANIFEST_SUFFIX
+
+
+def fleet_fingerprint(obs: Observation, cfg, stage_names: Sequence[str]) -> str:
+    """Hash of everything that determines one observation's artifacts:
+    the input file (path + size + mtime — a replaced raw file, even a
+    same-size regeneration, must redo, not skip), the stage list, and
+    the full stage configuration. Matches the sweep-journal contract: a
+    manifest written under other parameters is restarted, never
+    resumed."""
+    h = hashlib.sha256()
+    h.update(obs.infile.encode() + b"\0" + obs.outbase.encode() + b"\0")
+    try:
+        st = os.stat(obs.infile)
+        h.update(f"{st.st_size}:{st.st_mtime_ns}".encode())
+    except OSError:
+        h.update(b"missing")
+    h.update(("|".join(stage_names)).encode())
+    if cfg is not None:
+        for key in sorted(vars(cfg)):
+            h.update(f"{key}={vars(cfg)[key]!r};".encode())
+    return h.hexdigest()
+
+
+class ObsManifest:
+    """One observation's stage journal (see module docstring). Unit ids
+    are ``stage:<name>``; free-form notes record the plan (for --status)
+    and quarantine verdicts."""
+
+    def __init__(self, path: str, fingerprint: str):
+        self._journal = RunJournal(path, fingerprint, tool="survey")
+        self._lock = threading.Lock()
+        self.path = path
+        # captured BEFORE any write: a fresh manifest (new file, or a
+        # restart after a parameter/input change) means the chain starts
+        # over and stale artifacts must be scrubbed, not globbed up
+        self.fresh = self._journal.is_fresh()
+
+    def plan(self, obs: Observation, stage_names: Sequence[str]) -> None:
+        """Record the planned stage list once per fresh manifest — the
+        denominator the --status table renders without re-deriving the
+        DAG (a resumed manifest already carries it)."""
+        with self._lock:
+            if not self._journal.notes(event="plan"):
+                self._journal.note(event="plan", obs=obs.name,
+                                   infile=obs.infile,
+                                   stages=list(stage_names))
+
+    def done_stages(self, validate: bool = True) -> set:
+        """Stage names recorded done whose artifacts (still) validate."""
+        with self._lock:
+            units = self._journal.completed(validate=validate)
+        return {u.split(":", 1)[1] for u in units if u.startswith("stage:")}
+
+    def mark_done(self, stage: str, outputs: Iterable[str]) -> None:
+        with self._lock:
+            self._journal.done(f"stage:{stage}", outputs)
+
+    def quarantine(self, stage: str, error: str) -> None:
+        with self._lock:
+            self._journal.note(event="quarantine", stage=stage, error=error)
+
+    def close(self) -> None:
+        self._journal.close()
+
+
+def load_manifest_records(path: str) -> List[dict]:
+    """Raw manifest records, fingerprint-agnostic and torn-tail tolerant
+    — the --status reader (RunJournal itself discards records whose
+    fingerprint it cannot re-derive, which status cannot)."""
+    out: List[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn trailing line from a kill
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def status_rows(manifest_paths: Sequence[str]) -> List[Dict]:
+    """One status dict per manifest: observation, planned stages, stages
+    recorded done, and any quarantine verdict. Artifact validation is
+    NOT re-run here (status is a cheap read-only view; ``--resume`` does
+    the hashing)."""
+    rows: List[Dict] = []
+    for path in sorted(manifest_paths):
+        recs = load_manifest_records(path)
+        obs = os.path.basename(path)
+        if obs.endswith(MANIFEST_SUFFIX):
+            obs = obs[: -len(MANIFEST_SUFFIX)]
+        stages: List[str] = []
+        done: List[str] = []
+        quarantine = None
+        for rec in recs:
+            if rec.get("type") == "note" and rec.get("event") == "plan":
+                stages = list(rec.get("stages", []))
+                obs = rec.get("obs", obs)
+            elif rec.get("type") == "done":
+                unit = rec.get("unit", "")
+                if unit.startswith("stage:"):
+                    name = unit.split(":", 1)[1]
+                    if name not in done:
+                        done.append(name)
+                    if quarantine is not None \
+                            and quarantine["stage"] == name:
+                        # a LATER done record for the quarantined stage
+                        # means a resume got past it — the verdict is
+                        # superseded, not the observation's fate
+                        quarantine = None
+            elif rec.get("type") == "note" and rec.get("event") == "quarantine":
+                quarantine = {"stage": rec.get("stage", "?"),
+                              "error": rec.get("error", "?")}
+        rows.append({"obs": obs, "manifest": path, "stages": stages,
+                     "done": done, "quarantine": quarantine})
+    return rows
+
+
+def format_status(rows: Sequence[Dict]) -> str:
+    """Render the --status progress table."""
+    lines = [f"# {'observation':<20s} {'progress':<10s} state"]
+    for r in rows:
+        total = len(r["stages"]) or "?"
+        done = r["done"]
+        prog = f"{len(done)}/{total}"
+        if r["quarantine"] is not None:
+            q = r["quarantine"]
+            state = f"QUARANTINED at {q['stage']} ({q['error']})"
+        elif r["stages"] and len(done) == len(r["stages"]):
+            state = "complete"
+        else:
+            pend = [s for s in r["stages"] if s not in done]
+            state = ("next: " + pend[0]) if pend else \
+                ("done: " + ",".join(done) if done else "pending")
+        lines.append(f"# {r['obs']:<20s} {prog:<10s} {state}")
+    return "\n".join(lines)
+
+
+class ObsTrace:
+    """Per-observation JSONL trace in the telemetry schema (``meta`` /
+    ``span`` / ``event`` / ``end`` records), append-per-record flushed so
+    a killed fleet keeps every finished stage's timing. Thread-safe: the
+    scheduler records a stage span from whichever worker ran it. Written
+    directly (not via obs.telemetry) because that module is one
+    process-global session — which the fleet trace owns."""
+
+    def __init__(self, path: str, obs: str, append: bool = False):
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._fh: Optional[object] = None
+        # a resumed fleet APPENDS: the killed run's recorded stage spans
+        # are exactly the forensics worth keeping (tlmsum aggregates
+        # spans across the whole file; later end/meta records win)
+        fresh = not (append and os.path.exists(path)
+                     and os.path.getsize(path) > 0)
+        try:
+            self._fh = open(path, "w" if fresh else "a")
+        except OSError:
+            return  # observability is a passenger, never the payload
+        if fresh:
+            self._write({"type": "meta", "tool": "survey-obs", "obs": obs})
+
+    def _write(self, rec: dict) -> None:
+        with self._lock:
+            if self._fh is None:
+                return
+            try:
+                self._fh.write(json.dumps(rec) + "\n")
+                self._fh.flush()
+            except OSError:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+    def span(self, name: str, t_start: float, dur: float, **attrs) -> None:
+        rec = {"type": "span", "name": name, "t": round(t_start, 6),
+               "dur": round(dur, 6)}
+        if attrs:
+            rec["attrs"] = attrs
+        self._write(rec)
+
+    def event(self, name: str, **attrs) -> None:
+        rec = {"type": "event", "name": name,
+               "t": round(time.perf_counter() - self._t0, 6)}
+        if attrs:
+            rec["attrs"] = attrs
+        self._write(rec)
+
+    def close(self) -> None:
+        self._write({"type": "end",
+                     "wall": round(time.perf_counter() - self._t0, 6)})
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

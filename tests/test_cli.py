@@ -182,3 +182,24 @@ def test_mockspecfil2subbands(tmp_path):
     inf = InfoData(out + ".sub.inf")
     assert inf.numchan == 4
     assert inf.lofreq == pytest.approx(1500.0 - 8.0)
+
+
+def test_cli_unknown_tool_exits_2_with_suggestion(capsys):
+    """A typo'd tool name is a usage error (exit 2, distinguishable from
+    a tool that ran and failed) with a closest-match hint."""
+    from pypulsar_tpu.cli.__main__ import main as cli_main
+
+    assert cli_main(["swep"]) == 2
+    err = capsys.readouterr().err
+    assert "unknown tool 'swep'" in err
+    assert "did you mean 'sweep'?" in err
+    # gibberish with no close match: still exit 2, no bogus hint
+    assert cli_main(["zzqqxx"]) == 2
+    err = capsys.readouterr().err
+    assert "unknown tool" in err and "did you mean" not in err
+
+
+def test_cli_survey_tool_registered():
+    from pypulsar_tpu.cli.__main__ import TOOLS
+
+    assert "survey" in TOOLS and "tlmsum" in TOOLS

@@ -300,3 +300,54 @@ def test_tlmsum_truncated_trace(small_sweep_trace, capsys):
 
     assert tlmsum_main([trunc]) == 0
     assert "dispatch_sweep_chunk" in capsys.readouterr().out
+
+
+def test_tlmsum_multi_trace_fleet_rollup(tmp_path, capsys):
+    """tlmsum over several traces (paths or a quoted glob) renders one
+    section per trace plus a combined fleet roll-up with summed stage
+    seconds/calls, counters and events — the survey orchestrator's
+    --telemetry-dir consumer. The single-file contract is unchanged (no
+    section headers)."""
+    import glob as _glob
+
+    for i in range(2):
+        path = str(tmp_path / f"obs{i}.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps({"type": "meta", "tool": "survey-obs",
+                                "obs": f"obs{i}"}) + "\n")
+            f.write(json.dumps({"type": "span", "name": "survey.stage.x",
+                                "t": 0.0, "dur": 1.0 + i}) + "\n")
+            f.write(json.dumps({"type": "counters",
+                                "counters": {"h2d.bytes": 100.0 * (i + 1),
+                                             "sweep.chunks": 3.0},
+                                "gauges": {"g": {"last": i, "max": i + 1}},
+                                "events": {"e": 2}}) + "\n")
+            f.write(json.dumps({"type": "end", "wall": 2.0}) + "\n")
+    from pypulsar_tpu.obs.summarize import (
+        combine_summaries,
+        load_records,
+        main as tlmsum_main,
+    )
+
+    paths = sorted(str(p) for p in _glob.glob(str(tmp_path / "obs*.jsonl")))
+    assert tlmsum_main(paths) == 0
+    out = capsys.readouterr().out
+    assert out.count("# ===== trace:") == 2
+    assert "# ===== fleet roll-up: 2 traces =====" in out
+    # combined totals: counters summed, walls summed, stage calls summed
+    combined = combine_summaries(
+        [summarize.summarize(load_records(p)) for p in paths])
+    assert combined.counters["h2d.bytes"] == 300.0
+    assert combined.counters["sweep.chunks"] == 6.0
+    assert combined.events["e"] == 4
+    assert combined.wall == 4.0
+    assert combined.stages["survey.stage.x"] == [3.0, 2]
+    assert combined.gauges["g"]["max"] == 2
+    # quoted-glob form expands (the CLI surface the survey docs show)
+    assert tlmsum_main([str(tmp_path / "obs*.jsonl")]) == 0
+    assert "fleet roll-up" in capsys.readouterr().out
+    # single-file behavior unchanged: no section headers
+    assert tlmsum_main([paths[0]]) == 0
+    assert "=====" not in capsys.readouterr().out
+    # one unreadable path among several: others still render, rc 1
+    assert tlmsum_main([paths[0], str(tmp_path / "missing.jsonl")]) == 1

@@ -1,0 +1,639 @@
+"""Survey-orchestrator tests (round 9): the fleet scheduler must add
+CONCURRENCY, never a second implementation — a 2-observation toy fleet's
+artifacts are byte-identical to the serial per-tool chain; kill+resume
+at every stage boundary re-runs exactly the unjournaled stages; a
+persistently failing observation quarantines while the other completes;
+the device lease serializes device-bound stages while host stages
+overlap."""
+
+import glob
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.survey.dag import StageSpec, SurveyConfig, build_dag
+from pypulsar_tpu.survey.scheduler import FleetScheduler
+from pypulsar_tpu.survey.state import (
+    Observation,
+    format_status,
+    status_rows,
+)
+
+from tests.test_accel_pipeline import _pulsar_fil
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+# toy fleet geometry: small enough that a full 5-stage chain runs in a
+# few seconds warm, strong enough that the accel search recovers the
+# injected pulsar through sift into real .pfd archives
+OBS = dict(C=16, T=8192)
+CFG_KW = dict(mask=True, mask_time=1.0, lodm=0.0, dmstep=10.0, numdms=6,
+              nsub=8, group_size=2, threshold=8.0,
+              accel_zmax=20.0, accel_numharm=2, accel_sigma=3.0,
+              accel_batch=4, sift_sigma=5.0, sift_min_hits=2,
+              fold_nbins=32, fold_npart=8)
+SURVEY_FLAGS = ["--lodm", "0", "--dmstep", "10", "--numdms", "6",
+                "-s", "8", "--group-size", "2", "--threshold", "8",
+                "--mask-time", "1.0",
+                "--accel-zmax", "20", "--accel-numharm", "2",
+                "--accel-sigma", "3", "--accel-batch", "4",
+                "--sift-sigma", "5", "--sift-min-hits", "2",
+                "--fold-nbins", "32", "--fold-npart", "8"]
+ARTIFACT_PATTERNS = (".cands", "_DM*_ACCEL_*.cand", "_DM*_ACCEL_*.txtcand",
+                     ".accelcands", "_cand*.pfd")
+
+
+def _fleet_obs(fils, outdir):
+    os.makedirs(outdir, exist_ok=True)
+    return [Observation(os.path.splitext(os.path.basename(f))[0], f,
+                        os.path.join(outdir,
+                                     os.path.splitext(
+                                         os.path.basename(f))[0]))
+            for f in fils]
+
+
+def _serial_chain(fil, outbase):
+    """The exact per-tool chain the orchestrator composes, run serially
+    by hand — the parity reference. Note: NO --journal on the sweep (the
+    orchestrated stage passes one); artifact bytes must not depend on
+    it."""
+    from pypulsar_tpu.cli import foldbatch as cli_foldbatch
+    from pypulsar_tpu.cli import pfd_snr as cli_pfd_snr
+    from pypulsar_tpu.cli import rfifind as cli_rfifind
+    from pypulsar_tpu.cli import sift as cli_sift
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    assert cli_rfifind.main([fil, "-o", outbase, "-t", "1.0"]) == 0
+    assert cli_sweep.main(
+        [fil, "-o", outbase, "--lodm", "0", "--dmstep", "10",
+         "--numdms", "6", "-s", "8", "--group-size", "2",
+         "--threshold", "8", "--write-dats", "--accel-search",
+         "--accel-zmax", "20", "--accel-dz", "2.0",
+         "--accel-numharm", "2", "--accel-sigma", "3",
+         "--accel-batch", "4",
+         "--mask", outbase + "_rfifind.mask"]) == 0
+    cands = sorted(glob.glob(outbase + "_DM*_ACCEL_*.cand"))
+    assert cli_sift.main(cands + ["-s", "5", "--min-hits", "2",
+                                  "-o", outbase + ".accelcands"]) == 0
+    assert cli_foldbatch.main(
+        ["--cands", outbase + ".accelcands", "--datbase", outbase,
+         "-o", outbase, "-n", "32", "--npart", "8", "--batch", "32"]) == 0
+    pfds = sorted(glob.glob(outbase + "_cand*.pfd"))
+    assert pfds, "sift kept no candidates; the toy fleet is too weak"
+    assert cli_pfd_snr.main(pfds + ["--json", outbase + "_snr.json"]) == 0
+
+
+def _artifact_bytes(outdir, stem):
+    out = {}
+    for pat in ARTIFACT_PATTERNS:
+        for f in sorted(glob.glob(os.path.join(outdir, stem + pat))):
+            out[os.path.basename(f)] = open(f, "rb").read()
+    return out
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    """Two distinguishable toy observations + the serial-chain reference
+    artifacts, computed once per module (the parity target for the
+    orchestrated and kill/resume runs, and the jit warmup)."""
+    root = tmp_path_factory.mktemp("survey")
+    fils = [_pulsar_fil(root, name=f"psr{i}.fil", seed=5 + i, **OBS)
+            for i in range(2)]
+    refdir = str(root / "serial")
+    os.makedirs(refdir)
+    ref = {}
+    for i, fil in enumerate(fils):
+        stem = f"psr{i}"
+        _serial_chain(fil, os.path.join(refdir, stem))
+        ref[stem] = _artifact_bytes(refdir, stem)
+        assert ref[stem], stem
+    return {"root": root, "fils": fils, "refdir": refdir, "ref": ref}
+
+
+def _assert_matches_reference(fleet_dict, outdir, stems=("psr0", "psr1")):
+    for stem in stems:
+        got = _artifact_bytes(outdir, stem)
+        assert got.keys() == fleet_dict["ref"][stem].keys(), stem
+        for name, data in fleet_dict["ref"][stem].items():
+            assert got[name] == data, f"{stem}: {name} diverged"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end parity
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_end_to_end_byte_identical_to_serial_chain(fleet):
+    """The acceptance contract: the orchestrated fleet's candidate
+    tables and archives are byte-identical to running the serial chain
+    per observation, and the SNR summaries carry the same science."""
+    from pypulsar_tpu.cli import survey as cli_survey
+
+    outdir = str(fleet["root"] / "orch")
+    tlmdir = str(fleet["root"] / "tlm")
+    rc = cli_survey.main(fleet["fils"] + ["-o", outdir,
+                                          "--telemetry-dir", tlmdir,
+                                          *SURVEY_FLAGS])
+    assert rc == 0
+    _assert_matches_reference(fleet, outdir)
+    for stem in ("psr0", "psr1"):
+        a = json.load(open(os.path.join(fleet["refdir"],
+                                        stem + "_snr.json")))
+        b = json.load(open(os.path.join(outdir, stem + "_snr.json")))
+        assert [(r["name"], r["best_dm"], r["snr"]) for r in a] \
+            == [(r["name"], r["best_dm"], r["snr"]) for r in b]
+    # one trace per observation + one fleet trace, all tlmsum-readable
+    traces = sorted(os.path.basename(f)
+                    for f in glob.glob(os.path.join(tlmdir, "*.jsonl")))
+    assert traces == ["fleet.jsonl", "psr0.jsonl", "psr1.jsonl"]
+    from pypulsar_tpu.obs.summarize import load_records, summarize
+
+    obs_sum = summarize(load_records(os.path.join(tlmdir, "psr0.jsonl")))
+    assert "survey.stage.sweep" in obs_sum.stages
+    fleet_sum = summarize(load_records(os.path.join(tlmdir,
+                                                    "fleet.jsonl")))
+    assert fleet_sum.counters.get("survey.stages_run") == 10
+    # --status renders both observations complete
+    rc = cli_survey.main(["--status", "-o", outdir])
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# kill + resume at every stage boundary
+# ---------------------------------------------------------------------------
+
+
+def test_kill_resume_every_stage_boundary_bit_identical(fleet):
+    """Kill the fleet at EVERY stage's completion boundary (artifacts
+    written, manifest record pending — the torn window) plus one
+    start boundary; ``--resume`` must re-run exactly the stages the
+    manifests do not validate, and every final artifact is
+    byte-identical to the serial chain."""
+    cfg = SurveyConfig(**CFG_KW)
+    all_stages = {s.name for s in build_dag(cfg)}
+    points = [f"survey.stage_done.{s}"
+              for s in ("mask", "sweep", "sift", "fold", "snr")]
+    points.append("survey.stage_start.sweep")
+    for ki, point in enumerate(points):
+        outdir = str(fleet["root"] / f"kill{ki}")
+        obs = _fleet_obs(fleet["fils"], outdir)
+        faultinject.configure(f"kill:{point}:1")
+        with pytest.raises(faultinject.InjectedKill):
+            FleetScheduler(obs, cfg, max_host_workers=2).run()
+        faultinject.reset()
+        # what the manifests recorded done at the kill is what resume
+        # must skip; everything else must re-run
+        recorded = {(r["obs"], s)
+                    for r in status_rows([o.manifest for o in obs])
+                    for s in r["done"]}
+        result = FleetScheduler(obs, cfg, max_host_workers=2,
+                                resume=True).run()
+        assert result.ok, point
+        assert set(result.skipped) == recorded, point
+        assert set(result.ran) == (
+            {(o.name, s) for o in obs for s in all_stages} - recorded), \
+            point
+        _assert_matches_reference(fleet, outdir)
+
+
+def test_kill9_subprocess_exit_then_resume(fleet):
+    """The literal kill -9 semantics (os._exit(137): no finally blocks,
+    no flushing) in a real subprocess, mid-fleet; a --resume completes
+    the fleet without re-running validated stages."""
+    outdir = str(fleet["root"] / "kill9")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (repo_root + os.pathsep
+                         + env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pypulsar_tpu.cli", "survey",
+         *fleet["fils"], "-o", outdir, *SURVEY_FLAGS,
+         "--fault-inject", "exit:survey.stage_done.sweep:1"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 137, proc.stderr[-2000:]
+    obs = _fleet_obs(fleet["fils"], outdir)
+    recorded = {(r["obs"], s)
+                for r in status_rows([o.manifest for o in obs])
+                for s in r["done"]}
+    # the killed subprocess completed (and journaled) at least one stage
+    assert recorded, "kill fired before any stage completed"
+    from pypulsar_tpu.cli import survey as cli_survey
+
+    rc = cli_survey.main(fleet["fils"] + ["-o", outdir, "--resume",
+                                          *SURVEY_FLAGS])
+    assert rc == 0
+    _assert_matches_reference(fleet, outdir)
+
+
+def test_resume_skips_whole_validated_fleet_and_redoes_corruption(fleet):
+    """Resuming a COMPLETE fleet runs nothing; corrupting one artifact
+    re-runs exactly that stage chainward (size/sha256 validation)."""
+    cfg = SurveyConfig(**CFG_KW)
+    outdir = str(fleet["root"] / "revalidate")
+    obs = _fleet_obs(fleet["fils"], outdir)
+    assert FleetScheduler(obs, cfg).run().ok
+    result = FleetScheduler(obs, cfg, resume=True).run()
+    assert result.ran == [] and len(result.skipped) == 10
+    # truncate one observation's sifted list: its sift stage (only) is
+    # redone; the other observation still skips everything
+    victim = os.path.join(outdir, "psr0.accelcands")
+    ref = open(victim, "rb").read()
+    with open(victim, "wb") as f:
+        f.write(ref[: len(ref) // 2])
+    result = FleetScheduler(obs, cfg, resume=True).run()
+    assert result.ok
+    assert ("psr0", "sift") in result.ran
+    assert all(o == "psr0" for o, _ in result.ran)
+    assert open(victim, "rb").read() == ref
+    _assert_matches_reference(fleet, outdir)
+
+
+def test_changed_config_restarts_manifest(tmp_path):
+    """A resume under different stage parameters must restart the
+    manifest (fingerprint mismatch) instead of trusting stale
+    artifacts — the sweep-journal contract at fleet scope."""
+    stages = _stub_stages()
+    obs = [Observation("a", str(tmp_path / "a.raw"),
+                       str(tmp_path / "a"))]
+    cfg = SurveyConfig(numdms=8)
+    assert FleetScheduler(obs, cfg, stages=stages).run().ok
+    r = FleetScheduler(obs, cfg, stages=stages, resume=True).run()
+    assert r.ran == [] and len(r.skipped) == 2
+    r = FleetScheduler(obs, SurveyConfig(numdms=16), stages=stages,
+                       resume=True).run()
+    assert r.skipped == [] and len(r.ran) == 2
+
+
+def test_replaced_input_file_restarts_manifest(tmp_path):
+    """A regenerated raw file — even at the SAME size — restarts the
+    manifest (the fingerprint includes mtime): resuming against
+    artifacts derived from the old input would report stale science."""
+    stages = _stub_stages()
+    raw = str(tmp_path / "a.raw")
+    with open(raw, "wb") as f:
+        f.write(b"A" * 64)
+    obs = [Observation("a", raw, str(tmp_path / "a"))]
+    cfg = SurveyConfig()
+    assert FleetScheduler(obs, cfg, stages=stages).run().ok
+    assert FleetScheduler(obs, cfg, stages=stages,
+                          resume=True).run().ran == []
+    time.sleep(0.01)  # distinct mtime even on coarse filesystems
+    with open(raw, "wb") as f:
+        f.write(b"B" * 64)  # same size, new content
+    r = FleetScheduler(obs, cfg, stages=stages, resume=True).run()
+    assert r.skipped == [] and len(r.ran) == 2
+
+
+def test_multi_device_leases_bind_distinct_jax_devices(tmp_path):
+    """--devices N pins each device worker to its own JAX device
+    (thread-local default_device), so N leases are N chips — not N-fold
+    oversubscription of device 0. conftest forces an 8-device CPU mesh,
+    so the binding is observable."""
+    import jax
+    import jax.numpy as jnp
+
+    used = []
+
+    def dev_run(obs, cfg):
+        d, = jnp.ones(4).sum().devices()
+        with _conc_lock:
+            used.append(d.id)
+        with open(f"{obs.outbase}.dev1.out", "w") as f:
+            f.write("x")
+        return 0
+
+    stages = [StageSpec("dev1", "stub", True, (), lambda o, c: [],
+                        _stub_outputs("dev1"), run=dev_run)]
+    obs = [Observation(f"o{i}", str(tmp_path / f"o{i}.raw"),
+                       str(tmp_path / f"o{i}")) for i in range(6)]
+    assert FleetScheduler(obs, SurveyConfig(), stages=stages,
+                          devices=2).run().ok
+    assert len(used) == 6
+    assert set(used) <= {d.id for d in jax.local_devices()[:2]}
+    # with one lease (the default) nothing is pinned: process default
+    used.clear()
+    assert FleetScheduler(obs, SurveyConfig(), stages=stages,
+                          devices=1).run().ok
+    assert set(used) == {jax.local_devices()[0].id}
+
+
+def test_obs_trace_appends_on_resume(tmp_path):
+    """A resumed fleet appends to the per-observation trace instead of
+    truncating the killed run's recorded spans."""
+    from pypulsar_tpu.obs.summarize import load_records, summarize
+    from pypulsar_tpu.survey.state import ObsTrace
+
+    path = str(tmp_path / "o.jsonl")
+    t = ObsTrace(path, "o")
+    t.span("survey.stage.mask", 0.0, 1.0)
+    t.close()
+    t = ObsTrace(path, "o", append=True)  # the --resume run
+    t.span("survey.stage.sweep", 0.0, 2.0)
+    t.close()
+    s = summarize(load_records(path))
+    assert set(s.stages) == {"survey.stage.mask", "survey.stage.sweep"}
+    # a fresh (non-resume) run still truncates
+    t = ObsTrace(path, "o")
+    t.close()
+    assert summarize(load_records(path)).stages == {}
+
+
+# ---------------------------------------------------------------------------
+# quarantine + retry
+# ---------------------------------------------------------------------------
+
+
+def test_reconfigured_rerun_scrubs_stale_artifacts(fleet):
+    """Rerunning a SMALLER configuration into the same outdir must not
+    let the previous grid's files leak into the glob-driven stage
+    inputs (sift would cluster old-grid .cand trails): a fresh manifest
+    scrubs every stage's enumerable artifacts first, so the rerun
+    matches a clean-dir run byte for byte."""
+    cfg6 = SurveyConfig(**CFG_KW)
+    cfg4 = SurveyConfig(**{**CFG_KW, "numdms": 4})
+    fil = fleet["fils"][0]
+    shared = str(fleet["root"] / "reconf")
+    assert FleetScheduler(_fleet_obs([fil], shared), cfg6).run().ok
+    assert glob.glob(os.path.join(shared, "psr0_DM50.00_ACCEL_*.cand"))
+    assert FleetScheduler(_fleet_obs([fil], shared), cfg4).run().ok
+    # old-grid trails (DM 40/50) are gone, not globbed into the sift
+    assert not glob.glob(os.path.join(shared, "psr0_DM[45]0*"))
+    clean = str(fleet["root"] / "reconf_clean")
+    assert FleetScheduler(_fleet_obs([fil], clean), cfg4).run().ok
+    got = _artifact_bytes(shared, "psr0")
+    want = _artifact_bytes(clean, "psr0")
+    assert got.keys() == want.keys()
+    for name, data in want.items():
+        assert got[name] == data, name
+
+
+def test_retry_timer_does_not_resurrect_quarantined_stage(tmp_path):
+    """The backoff timer's requeue must drop a task whose observation
+    was quarantined (or whose fleet stopped) while it waited."""
+    sched = FleetScheduler(
+        [Observation("a", str(tmp_path / "a.raw"), str(tmp_path / "a"))],
+        SurveyConfig(), stages=_stub_stages())
+    task = sched._tasks[(0, "host1")]
+    task.state = 4  # _QUARANTINED
+    sched._requeue_retry(task)
+    assert sched._host_q.empty()
+    task.state = 2  # _RUNNING (normal backing-off state)
+    sched._requeue_retry(task)
+    assert not sched._host_q.empty()
+    # a stopped fleet also drops the requeue
+    task2 = sched._tasks[(0, "dev1")]
+    sched._stop = True
+    sched._requeue_retry(task2)
+    assert sched._device_q.empty()
+
+
+def test_quarantine_keeps_other_observation_complete(fleet):
+    """A persistently failing observation (unreadable input) is
+    quarantined after bounded retries; the OTHER observation's chain
+    completes with byte-identical artifacts and the verdict lands in
+    the manifest + --status."""
+    from pypulsar_tpu.cli import survey as cli_survey
+
+    bad = str(fleet["root"] / "bad.fil")
+    with open(bad, "wb") as f:
+        f.write(b"this is not a filterbank")
+    outdir = str(fleet["root"] / "quarantine")
+    rc = cli_survey.main([fleet["fils"][0], bad, "-o", outdir,
+                          "--retries", "1", *SURVEY_FLAGS])
+    assert rc == 1
+    _assert_matches_reference(fleet, outdir, stems=("psr0",))
+    assert os.path.exists(os.path.join(outdir, "psr0_snr.json"))
+    rows = {r["obs"]: r for r in status_rows(
+        sorted(glob.glob(os.path.join(outdir, "*.survey.jsonl"))))}
+    assert rows["bad"]["quarantine"] is not None
+    assert rows["bad"]["quarantine"]["stage"] == "mask"
+    assert rows["psr0"]["quarantine"] is None
+    assert len(rows["psr0"]["done"]) == 5
+    table = format_status(rows.values())
+    assert "QUARANTINED" in table and "complete" in table
+    # --status over the same manifests
+    assert cli_survey.main(["--status", "-o", outdir]) == 0
+
+
+def test_stage_retry_recovers_from_transient_fault(tmp_path):
+    """An injected transient IO fault at a stage boundary is retried
+    (bounded backoff) and the fleet completes — visible as a
+    survey.stage_retry telemetry event."""
+    stages = _stub_stages()
+    obs = [Observation("a", str(tmp_path / "a.raw"), str(tmp_path / "a"))]
+    faultinject.configure("io:survey.stage_start.host1:1")
+    with telemetry.session() as tlm:
+        result = FleetScheduler(obs, SurveyConfig(), stages=stages,
+                                retries=2).run()
+        assert tlm.event_counts.get("survey.stage_retry") == 1
+        assert tlm.event_counts.get("survey.stage_failed") == 1
+    assert result.ok and result.retried == 1
+    assert ("a", "host1") in result.ran
+
+
+def test_retries_exhausted_quarantines_not_aborts(tmp_path):
+    """A stage that fails every attempt quarantines its observation;
+    the scheduler returns (no exception) and the other observation
+    completes."""
+
+    # only observation 'a' fails; 'b' runs the normal stub body
+    def selective_fail(o, c):
+        if o.name == "a":
+            raise OSError("persistent read failure")
+        return _stub_body("host1")(o, c)
+
+    stages = [_stub("dev1", True, ()),
+              StageSpec("host1", "stub", False, ("dev1",),
+                        lambda o, c: [], _stub_outputs("host1"),
+                        run=selective_fail)]
+    obs = [Observation(n, str(tmp_path / f"{n}.raw"), str(tmp_path / n))
+           for n in ("a", "b")]
+    with telemetry.session() as tlm:
+        result = FleetScheduler(obs, SurveyConfig(), stages=stages,
+                                retries=1).run()
+        assert tlm.event_counts.get("survey.quarantine") == 1
+    assert not result.ok
+    assert set(result.quarantined) == {"a"}
+    assert result.quarantined["a"]["stage"] == "host1"
+    assert ("b", "host1") in result.ran
+    assert os.path.exists(str(tmp_path / "b") + ".host1.out")
+
+
+# ---------------------------------------------------------------------------
+# scheduler semantics (synthetic stages; no pipeline cost)
+# ---------------------------------------------------------------------------
+
+_conc_lock = threading.Lock()
+
+
+def _stub_body(name, sleep=0.0, conc=None, key=None, order=None):
+    def run(obs, cfg):
+        if conc is not None:
+            with _conc_lock:
+                conc[key] += 1
+                conc[key + "_max"] = max(conc[key + "_max"], conc[key])
+        if order is not None:
+            with _conc_lock:
+                order.append((obs.name, name))
+        if sleep:
+            time.sleep(sleep)
+        if conc is not None:
+            with _conc_lock:
+                conc[key] -= 1
+        with open(f"{obs.outbase}.{name}.out", "w") as f:
+            f.write(f"{name} {obs.name}\n")
+        return 0
+    return run
+
+
+def _stub_outputs(name):
+    def outputs(obs, cfg):
+        return [f"{obs.outbase}.{name}.out"]
+    return outputs
+
+
+def _stub(name, device, deps, **kw):
+    return StageSpec(name, "stub", device, deps, lambda o, c: [],
+                     _stub_outputs(name), run=_stub_body(name, **kw))
+
+
+def _stub_stages():
+    return [_stub("dev1", True, ()), _stub("host1", False, ("dev1",))]
+
+
+def test_device_lease_exclusive_host_pool_overlaps(tmp_path):
+    """Device-bound stages never overlap (one lease); host-bound stages
+    from different observations DO overlap on the worker pool — the
+    wall-clock mechanism the bench A/B measures."""
+    conc = {"dev": 0, "dev_max": 0, "host": 0, "host_max": 0}
+    stages = [
+        _stub("dev1", True, (), sleep=0.02, conc=conc, key="dev"),
+        _stub("host1", False, ("dev1",), sleep=0.15, conc=conc,
+              key="host"),
+    ]
+    obs = [Observation(f"o{i}", str(tmp_path / f"o{i}.raw"),
+                       str(tmp_path / f"o{i}")) for i in range(4)]
+    result = FleetScheduler(obs, SurveyConfig(), stages=stages,
+                            max_host_workers=2, devices=1).run()
+    assert result.ok and len(result.ran) == 8
+    assert conc["dev_max"] == 1          # exclusive lease
+    assert conc["host_max"] >= 2         # B's post overlaps A's device time
+
+
+def test_device_queue_prefers_deeper_stages(tmp_path):
+    """Priority + FIFO on the device lease: when a later-chain stage
+    becomes ready it runs before an earlier-chain stage of another
+    observation (drain observations toward completion)."""
+    order = []
+    stages = [
+        _stub("dev1", True, (), order=order),
+        _stub("dev2", True, ("dev1",), order=order),
+    ]
+    obs = [Observation(f"o{i}", str(tmp_path / f"o{i}.raw"),
+                       str(tmp_path / f"o{i}")) for i in range(2)]
+    result = FleetScheduler(obs, SurveyConfig(), stages=stages,
+                            devices=1).run()
+    assert result.ok
+    # o0.dev1 runs first; its dev2 (deeper) then outranks o1.dev1
+    assert order[0] == ("o0", "dev1")
+    assert order[1] == ("o0", "dev2")
+
+
+def test_scheduler_rejects_bad_dags_and_duplicate_names(tmp_path):
+    with pytest.raises(ValueError, match="unknown stage"):
+        FleetScheduler([], SurveyConfig(),
+                       stages=[_stub("a", True, ("missing",))])
+    obs = [Observation("x", "x.raw", str(tmp_path / "x")),
+           Observation("x", "y.raw", str(tmp_path / "y"))]
+    with pytest.raises(ValueError, match="duplicate"):
+        FleetScheduler(obs, SurveyConfig(), stages=_stub_stages())
+
+
+# ---------------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------------
+
+
+def _load_make_synthetic_fil():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "make_synthetic_fil.py")
+    spec = importlib.util.spec_from_file_location("make_synthetic_fil",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_make_synthetic_fil_src_name_and_start_mjd(tmp_path):
+    """--src-name/--start-mjd land in the header (round-trip through the
+    reader); defaults unchanged."""
+    from pypulsar_tpu.io.filterbank import FilterbankFile
+
+    mod = _load_make_synthetic_fil()
+    common = ["--nchan", "8", "--duration", "0.5", "--tsamp", "1e-3",
+              "--period-samples", "128", "--width", "2"]
+    fn = str(tmp_path / "beam7.fil")
+    mod.main(["--out", fn, *common,
+              "--src-name", "FLEET_BEAM7", "--start-mjd", "58765.5"])
+    with FilterbankFile(fn) as fb:
+        assert fb.header["source_name"] == "FLEET_BEAM7"
+        assert fb.header["tstart"] == 58765.5
+    fn2 = str(tmp_path / "default.fil")
+    mod.main(["--out", fn2, *common])
+    with FilterbankFile(fn2) as fb:
+        assert fb.header["source_name"].startswith("SYNTH_DM")
+        assert fb.header["tstart"] == 60000.0
+
+
+def test_status_rows_and_render_from_raw_manifests(tmp_path):
+    """--status reads manifests fingerprint-agnostically, tolerating a
+    torn trailing line, and renders progress/quarantine states."""
+    p1 = str(tmp_path / "a.survey.jsonl")
+    with open(p1, "w") as f:
+        f.write(json.dumps({"type": "journal", "tool": "survey",
+                            "fingerprint": "zzz"}) + "\n")
+        f.write(json.dumps({"type": "note", "event": "plan", "obs": "a",
+                            "stages": ["s1", "s2", "s3"]}) + "\n")
+        f.write(json.dumps({"type": "done", "unit": "stage:s1",
+                            "outputs": []}) + "\n")
+        f.write('{"type": "done", "unit": "stage:s2", "outp')  # torn
+    p2 = str(tmp_path / "b.survey.jsonl")
+    with open(p2, "w") as f:
+        f.write(json.dumps({"type": "journal", "tool": "survey",
+                            "fingerprint": "zzz"}) + "\n")
+        f.write(json.dumps({"type": "note", "event": "plan", "obs": "b",
+                            "stages": ["s1", "s2"]}) + "\n")
+        f.write(json.dumps({"type": "note", "event": "quarantine",
+                            "stage": "s1", "error": "boom"}) + "\n")
+    rows = status_rows([p1, p2])
+    assert rows[0]["done"] == ["s1"] and rows[0]["quarantine"] is None
+    assert rows[1]["quarantine"]["stage"] == "s1"
+    table = format_status(rows)
+    assert "1/3" in table and "next: s2" in table
+    assert "QUARANTINED at s1 (boom)" in table
+    # a LATER done record for the quarantined stage (a resume got past
+    # it) supersedes the verdict — --status must not say QUARANTINED
+    # about a completed observation
+    with open(p2, "a") as f:
+        f.write(json.dumps({"type": "done", "unit": "stage:s1",
+                            "outputs": []}) + "\n")
+        f.write(json.dumps({"type": "done", "unit": "stage:s2",
+                            "outputs": []}) + "\n")
+    rows = status_rows([p1, p2])
+    assert rows[1]["quarantine"] is None
+    assert "complete" in format_status([rows[1]])

@@ -60,6 +60,13 @@ def parse_args(argv=None):
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--blocks-per-write", type=int, default=32,
                     help="periods per written block")
+    ap.add_argument("--src-name", default=None,
+                    help="header source_name (default: the injected-"
+                         "signal tag SYNTH_DM{dm}_P{period}) — fleet "
+                         "tests use this to generate distinguishable "
+                         "observations")
+    ap.add_argument("--start-mjd", type=float, default=60000.0,
+                    help="header tstart MJD (default 60000.0)")
     return ap.parse_args(argv)
 
 
@@ -89,9 +96,9 @@ def main(argv=None):
     pattern[rows, np.arange(C)[None, :]] = a.amp
 
     hdr = {
-        "source_name": f"SYNTH_DM{a.dm:g}_P{P}",
+        "source_name": a.src_name or f"SYNTH_DM{a.dm:g}_P{P}",
         "fch1": a.fch1, "foff": foff, "nchans": C, "tsamp": a.tsamp,
-        "nbits": a.nbits, "nifs": 1, "tstart": 60000.0, "data_type": 1,
+        "nbits": a.nbits, "nifs": 1, "tstart": a.start_mjd, "data_type": 1,
         "telescope_id": 0, "machine_id": 0, "barycentric": 0,
         "src_raj": 0.0, "src_dej": 0.0, "az_start": 0.0, "za_start": 0.0,
     }
