@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier test-faults test-fold test-survey dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-telemetry native clean
+.PHONY: test test-fourier test-faults test-fold test-survey dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-multichip bench-telemetry native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -31,9 +31,12 @@ test-faults:
 
 # the survey orchestrator suite: fleet-vs-serial byte parity, device
 # lease exclusivity / host overlap, kill+resume at every stage
-# boundary, quarantine (docs/ARCHITECTURE.md "Survey orchestrator")
+# boundary, quarantine, gang-lease placement (docs/ARCHITECTURE.md
+# "Survey orchestrator" / "Scale-out") — plus the DM-sharded
+# sweep->accel handoff parity tests that gang-leases place
 test-survey:
 	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q
+	$(CPU_ENV) $(PY) -m pytest tests/test_accel_pipeline.py -q -k "sharded or lease"
 
 dryrun:
 	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -78,6 +81,15 @@ bench-fold:
 # fleet scheduler (host/device overlap) on 4 toy observations
 bench-survey:
 	$(PY) bench.py --survey --out BENCH_r08_survey.json
+
+# multi-chip (round 11): the sharded sweep->accel parity suite + the
+# k-device orchestrator A/B (gang-leases, fleet-parallel vs gang
+# placement, artifacts byte-checked against the serial AND 1-device
+# runs) on the 8-virtual-device CPU recipe -> BENCH_r09
+bench-multichip:
+	$(CPU_ENV) $(PY) -m pytest tests/test_accel_pipeline.py -q -k "sharded or lease"
+	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -k "gang"
+	$(CPU_ENV) $(PY) bench.py --survey --devices 4 --out BENCH_r09_multichip.json
 
 native:
 	$(PY) -c "from pypulsar_tpu import native; assert native.available(); print('native codec OK')"

@@ -78,6 +78,14 @@ def parse_args(argv=None):
                          "survey) against the serial per-observation "
                          "chain on a 4-observation toy fleet — the "
                          "round-9 host/device-overlap measurement")
+    ap.add_argument("--devices", type=int, default=1,
+                    help="with --survey: also run the orchestrator with "
+                         "this many device leases (gang auto), the "
+                         "round-11 multi-chip leg — artifacts byte-"
+                         "checked against BOTH the serial chain and the "
+                         "1-device orchestrated run. Needs that many "
+                         "JAX devices (CPU recipe: XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=8)")
     ap.add_argument("--prepass", action="store_true",
                     help="benchmark the zero-DM + spectrogram + detrend "
                          "prepass (configs[1]) instead of the DM sweep")
@@ -1447,17 +1455,108 @@ def run_survey(args):
         # parity: the orchestrated fleet's candidate tables and archives
         # are byte-identical to the serial chain's — enforced, not just
         # reported: a speedup over divergent/missing work is not a win
-        identical = total = 0
-        for pattern in ("*_ACCEL_*.txtcand", "*_cand*.pfd"):
-            for fa in sorted(_glob.glob(os.path.join(td, "serial",
-                                                     pattern))):
-                fb = os.path.join(td, "orch", os.path.basename(fa))
-                total += 1
-                if (os.path.exists(fb) and open(fa, "rb").read()
-                        == open(fb, "rb").read()):
-                    identical += 1
+        def _parity(dir_a, dir_b):
+            ident = tot = 0
+            for pattern in ("*_ACCEL_*.cand", "*_ACCEL_*.txtcand",
+                            "*_cand*.pfd"):
+                for fa in sorted(_glob.glob(os.path.join(td, dir_a,
+                                                         pattern))):
+                    fb = os.path.join(td, dir_b, os.path.basename(fa))
+                    tot += 1
+                    if (os.path.exists(fb) and open(fa, "rb").read()
+                            == open(fb, "rb").read()):
+                        ident += 1
+            return ident, tot
+
+        identical, total = _parity("serial", "orch")
         assert identical == total and total > 0, \
             f"orchestrated artifacts diverged: {identical}/{total}"
+
+        # multi-chip leg (round 11): the SAME fleet with k device
+        # leases + gang auto — fleet-parallel while ready device stages
+        # fill the chips, gang-widened (`sweep --mesh k` over the
+        # leased chips) when they would idle. Byte-parity is asserted
+        # against BOTH the serial chain and the 1-device orchestrated
+        # run: placement is not science
+        orchk_s = None
+        identical_k = total_k = None
+        gang_decisions = []
+        if args.devices > 1:
+            import jax
+
+            ndev = len(jax.devices())
+            assert ndev >= args.devices, (
+                f"--devices {args.devices} needs that many JAX devices, "
+                f"have {ndev} (CPU recipe: XLA_FLAGS="
+                f"--xla_force_host_platform_device_count=8)")
+            # warm EVERY chip's jit caches, not just device 0's: stages
+            # pin via jax.default_device and executables are
+            # per-device, so an unwarmed chip would recompile the whole
+            # chain inside the timed leg. One fleet-parallel pass warms
+            # the k per-device 1-chip programs, one gang pass warms the
+            # mesh-sharded (gang-width) programs
+            FleetScheduler(fleet("warmk"), cfg, max_host_workers=2,
+                           devices=args.devices, gang=1).run()
+            FleetScheduler(fleet("warmg")[:1], cfg, max_host_workers=2,
+                           devices=args.devices,
+                           gang=args.devices).run()
+            tlm_k = os.path.join(td, "tlm_k")
+            t0 = time.perf_counter()
+            result_k = FleetScheduler(
+                fleet("orchk"), cfg, max_host_workers=2,
+                devices=args.devices, gang="auto",
+                telemetry_dir=tlm_k).run()
+            orchk_s = time.perf_counter() - t0
+            assert result_k.ok \
+                and len(result_k.ran) == n_obs * len(stages)
+            identical_k, total_k = _parity("serial", "orchk")
+            assert identical_k == total_k and total_k > 0, (
+                f"multi-chip artifacts diverged from the serial chain: "
+                f"{identical_k}/{total_k}")
+            ik, tk = _parity("orch", "orchk")
+            assert ik == tk and tk > 0, (
+                f"multi-chip artifacts diverged from the 1-device "
+                f"orchestrated run: {ik}/{tk}")
+
+            # the single-observation shape (the tentpole itself): a LONE
+            # observation on k idle chips gang-widens (`sweep --mesh k`
+            # over the leased gang) — timed against the same observation
+            # through the serial 1-chip chain, artifacts byte-checked
+            t0 = time.perf_counter()
+            run_serial(fleet("serial1")[:1])
+            serial1_s = time.perf_counter() - t0
+            tlm_g = os.path.join(td, "tlm_g")
+            t0 = time.perf_counter()
+            result_g = FleetScheduler(
+                fleet("gangk")[:1], cfg, max_host_workers=2,
+                devices=args.devices, gang="auto",
+                telemetry_dir=tlm_g).run()
+            gang_s = time.perf_counter() - t0
+            assert result_g.ok and len(result_g.ran) == len(stages)
+            ig, tg = _parity("serial1", "gangk")
+            assert ig == tg and tg > 0, (
+                f"gang-leased artifacts diverged: {ig}/{tg}")
+
+            # the recorded placement decisions (the obs traces carry
+            # the same survey.gang_decision events the fleet trace does)
+            gang_decisions_g = []
+            for tdir, sink in ((tlm_k, gang_decisions),
+                               (tlm_g, gang_decisions_g)):
+                for p in sorted(_glob.glob(os.path.join(tdir, "*.jsonl"))):
+                    for line in open(p):
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        if (rec.get("type") == "event"
+                                and rec.get("name")
+                                == "survey.gang_decision"):
+                            sink.append(rec.get("attrs", {}))
+            # the widening claim is about the LONE-obs leg only; the
+            # fleet leg's decisions must not be able to satisfy it
+            assert any(d.get("k", 1) > 1 for d in gang_decisions_g), \
+                "the lone observation never gang-widened"
+            gang_decisions.extend(gang_decisions_g)
 
     speedup = serial_s / orch_s
     print(f"# survey A/B: serial chain {serial_s:.2f}s vs orchestrated "
@@ -1471,9 +1570,7 @@ def run_survey(args):
             f"each, warm jit caches, 1 device lease + 2 host workers — "
             f"host-stage/device-stage overlap only, artifacts "
             f"byte-checked against the serial legs)")
-    if args.cpu_fallback:
-        unit += " [CPU FALLBACK: accelerator backend unavailable]"
-    return {
+    record = {
         "metric": "survey_fleet_speedup",
         "value": round(speedup, 3),
         "unit": unit,
@@ -1489,6 +1586,63 @@ def run_survey(args):
         "survey_nsamp": T,
         "survey_nchan": C,
     }
+    if orchk_s is not None:
+        speedup_k = serial_s / orchk_s
+        n_gang = sum(1 for d in gang_decisions if d.get("k", 1) > 1)
+        print(f"# survey multi-chip: {args.devices} device leases + gang "
+              f"auto {orchk_s:.2f}s = {speedup_k:.2f}x vs serial "
+              f"({orch_s / orchk_s:.2f}x vs 1-device orchestrated; "
+              f"{len(gang_decisions)} placement decisions, {n_gang} "
+              f"gang-widened; {identical_k}/{total_k} artifacts "
+              f"byte-identical to the serial chain)", file=sys.stderr)
+        print(f"# survey 1-obs gang: serial chain {serial1_s:.2f}s vs "
+              f"gang x{args.devices} {gang_s:.2f}s = "
+              f"{serial1_s / gang_s:.2f}x (one observation spanning "
+              f"{args.devices} chips end to end, artifacts "
+              f"byte-identical)", file=sys.stderr)
+        record.update({
+            "metric": "survey_multichip_speedup",
+            "value": round(speedup_k, 3),
+            "vs_baseline": round(speedup_k, 3),
+            "unit": unit.replace(
+                "1 device lease + 2 host workers",
+                f"{args.devices} device leases (gang auto: fleet-"
+                f"parallel + gang-widening onto idle chips) + 2 host "
+                f"workers").replace(
+                "byte-checked against the serial legs",
+                "byte-checked against BOTH the serial chain and the "
+                "1-device orchestrated run"),
+            "survey_devices": args.devices,
+            "survey_multichip_seconds": round(orchk_s, 3),
+            "survey_orchestrated_1dev_speedup": round(speedup, 3),
+            "survey_multichip_vs_1dev": round(orch_s / orchk_s, 3),
+            "survey_multichip_artifacts_identical":
+                f"{identical_k}/{total_k}",
+            "survey_1obs_serial_seconds": round(serial1_s, 3),
+            "survey_1obs_gang_seconds": round(gang_s, 3),
+            "survey_1obs_gang_speedup": round(serial1_s / gang_s, 3),
+            "survey_gang_decisions": len(gang_decisions),
+            "survey_gang_widened": n_gang,
+            "survey_gang_reasons": sorted(
+                {d.get("reason", "?") for d in gang_decisions})[:6],
+        })
+        try:
+            import jax
+
+            platform = jax.devices()[0].platform
+        except Exception:  # noqa: BLE001 - note is best-effort
+            platform = "?"
+        if platform == "cpu":
+            record["survey_multichip_note"] = (
+                "k virtual CPU devices share ONE host's cores, so "
+                "multi-chip wall-clock is not expected to improve here "
+                "— the record's claims are the byte-parity of every "
+                "artifact at k chips and the recorded gang/fleet "
+                "placement decisions; wall-clock scaling needs real "
+                "chips")
+    if args.cpu_fallback:
+        record["unit"] += " [CPU FALLBACK: accelerator backend unavailable]"
+    return record
 
 
 def run_waterfall(args):
@@ -1766,6 +1920,8 @@ def run_child(args, cpu: bool, timeout: float):
         if val is not None:
             argv += [flag, str(val)]
     argv += ["--dm-max", str(args.dm_max), "--engine", args.engine]
+    if args.devices != 1:
+        argv += ["--devices", str(args.devices)]
     if args.stream and not cpu:  # a CPU 1-hr streamed sweep is infeasible
         argv += ["--stream", args.stream]
         if args.stream_window is not None:

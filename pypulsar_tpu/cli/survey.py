@@ -62,6 +62,17 @@ def build_parser():
                    help="exclusive device leases for device-bound "
                         "stages (default 1: one device-bound stage at a "
                         "time)")
+    p.add_argument("--gang", default="auto", metavar="K|auto",
+                   help="device-count per gang-able stage (the sweep "
+                        "stage runs `--mesh K` over K leased chips — "
+                        "ONE observation spanning K devices; artifacts "
+                        "byte-identical at any K). An integer pins the "
+                        "gang width; 'auto' (default) stays "
+                        "fleet-parallel while ready device stages fill "
+                        "the chips and widens gangs onto idle chips, "
+                        "weighted by the measured per-stage cost — "
+                        "each decision is recorded in the fleet trace "
+                        "(survey.gang_decision)")
     p.add_argument("--retries", type=int, default=1,
                    help="bounded per-stage retries (exponential backoff) "
                         "before the observation is quarantined "
@@ -180,10 +191,22 @@ def _run(args) -> int:
         sift_min_dm=args.sift_min_dm,
         fold_nbins=args.fold_nbins, fold_npart=args.fold_npart,
         fold_batch=args.fold_batch)
+    gang = args.gang
+    if gang != "auto":
+        try:
+            gang = int(gang)
+        except ValueError:
+            print(f"survey: --gang must be an integer or 'auto', got "
+                  f"{gang!r}", file=sys.stderr)
+            return 2
+        if gang > args.devices:
+            print(f"survey: --gang {gang} exceeds --devices "
+                  f"{args.devices}", file=sys.stderr)
+            return 2
     sched = FleetScheduler(
         obs, cfg, max_host_workers=args.max_host_workers,
         devices=args.devices, retries=args.retries, resume=args.resume,
-        telemetry_dir=args.telemetry_dir, verbose=True)
+        telemetry_dir=args.telemetry_dir, gang=gang, verbose=True)
     result = sched.run()
     n_stages = len(sched.stages)
     print(f"# survey: {len(obs)} observations x {n_stages} stages in "

@@ -195,13 +195,16 @@ def _main_multi(args, ap, widths):
     rfimask = _load_mask(args)
     mesh = None
     if args.mesh:
-        import jax
+        # lease_devices, NOT jax.local_devices()[:N]: under a scheduler
+        # gang lease the thread's leased chips come first (two leased
+        # runs must never both grab chips 0..N-1), and under
+        # jax.distributed it stays host-local (the global list includes
+        # other hosts' devices, which a host-local shard_map cannot
+        # address)
+        from pypulsar_tpu.parallel.mesh import lease_devices
 
-        # local_devices, NOT devices: under jax.distributed the global
-        # list includes other hosts' devices, which a host-local
-        # shard_map cannot address
         mesh = make_mesh([args.mesh], ("dm",),
-                         devices=jax.local_devices()[: args.mesh])
+                         devices=lease_devices(args.mesh))
     if args.all_events:
         ap.error("--all-events is a single-file option")
 
@@ -340,10 +343,11 @@ def _main_timeshard(args, ap, widths):
     rfimask = _load_mask(args)
     mesh = None
     if args.mesh:
-        import jax
+        # lease-aware device resolution (see _main_multi)
+        from pypulsar_tpu.parallel.mesh import lease_devices
 
         mesh = make_mesh([args.mesh], ("dm",),
-                         devices=jax.local_devices()[: args.mesh])
+                         devices=lease_devices(args.mesh))
     if args.checkpoint and not args.resume:
         rank = dist.process_index()
         _remove_stale_checkpoints(f"{args.checkpoint}.r{rank}")
@@ -451,7 +455,13 @@ def main(argv=None):
     ap.add_argument("-k", "--topk", type=int, default=10,
                     help="candidates to print")
     ap.add_argument("--mesh", type=int, default=0,
-                    help="shard DM trials over this many devices")
+                    help="shard DM trials over this many devices — the "
+                         "sweep pass AND the --accel-search handoff "
+                         "(DM-sharded dedispersion, batch-sharded "
+                         "prep+search; artifacts byte-identical at any "
+                         "device count). Devices come from the active "
+                         "gang lease when the survey scheduler placed "
+                         "this run, else the local device list")
     ap.add_argument("--engine", default="auto",
                     choices=("auto", "gather", "scan", "fourier"),
                     help="chunk-kernel formulation (auto: fourier on TPU, "
@@ -633,10 +643,14 @@ def _main_parsed(args, ap):
     rfimask = _load_mask(args)
     mesh = None
     if args.mesh:
-        import jax
+        # build the mesh from the LEASED device set, never bare
+        # jax.devices()[:N] — under the survey scheduler's gang leases
+        # two concurrent observations would otherwise silently share
+        # chips 0..N-1 (the mesh/lease collision)
+        from pypulsar_tpu.parallel.mesh import lease_devices
 
         mesh = make_mesh([args.mesh], ("dm",),
-                         devices=jax.devices()[: args.mesh])
+                         devices=lease_devices(args.mesh))
 
     rc = 0
     if args.ddplan:
@@ -717,7 +731,10 @@ def _main_parsed(args, ap):
                 device_prep=args.accel_device_prep,
                 skip_existing=args.accel_skip_existing,
                 prefetch_depth=args.accel_prefetch,
-                journal=journal, verbose=True)
+                # --mesh now spans the WHOLE chain: the handoff shards
+                # the (dm x spectrum) axes over the same devices the
+                # sweep pass used (artifacts byte-identical at any k)
+                journal=journal, mesh=mesh, verbose=True)
             print(f"# accel handoff: {summary['n_searched']} trials "
                   f"searched, {summary['n_skipped']} skipped"
                   + (f", {summary['serial_fallbacks']} serial fallbacks"

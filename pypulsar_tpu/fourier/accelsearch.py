@@ -342,7 +342,7 @@ def _make_stage_runner(segw: int, Z: int, Wn: int, topk: int,
 @functools.lru_cache(maxsize=64)
 def _make_stage_runner_batch(segw: int, Z: int, Wn: int, topk: int,
                              bank_meta: Tuple[Tuple[int, int, int, int], ...],
-                             mesh_batch: int = 0):
+                             mesh_devs: Tuple = ()):
     """Batched stage runner (VERDICT r3 item 2): B spectra correlate
     against the SHARED template bank in one dispatch.
 
@@ -354,10 +354,13 @@ def _make_stage_runner_batch(segw: int, Z: int, Wn: int, topk: int,
     TPU FFT lowering needs (the serial path measured 121 GFLOP/s at
     rows=2Z; the batch axis multiplies the batch size by B).
 
-    ``mesh_batch`` > 0 additionally shard_maps the batch axis over the
-    'dm' axis of a device mesh (each device holds B/mesh_batch spectra
-    and the full bank — zero cross-device communication; candidates
-    gather on host), the same layout the sweep uses.
+    A non-empty ``mesh_devs`` (a tuple of jax devices — resolved by the
+    caller through the gang lease, never ``jax.devices()[:k]``, so two
+    gang-leased observations cannot collide on chips 0..k-1)
+    additionally shard_maps the batch axis over the 'dm' axis of a mesh
+    built on exactly those devices (each device holds B/k spectra and
+    the full bank — zero cross-device communication; candidates gather
+    on host), the same layout the sweep uses.
     """
 
     def run(spec_pad2, tfs, idxs, top_lo, top_hi, thresh, seg_ids):
@@ -393,25 +396,22 @@ def _make_stage_runner_batch(segw: int, Z: int, Wn: int, topk: int,
         _, res = jax.lax.scan(body, 0, seg_ids)
         return res  # each [n_seg, B, Wn, ...]
 
-    if not mesh_batch:
+    if not mesh_devs:
         return jax.jit(run)
 
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import Mesh
     from jax.sharding import PartitionSpec as P
 
-    devs = jax.devices()
-    if len(devs) < mesh_batch:
-        raise ValueError(f"mesh_batch {mesh_batch} exceeds the "
-                         f"{len(devs)} available devices")
-    mesh = Mesh(np.array(devs[:mesh_batch]), ("dm",))
+    from pypulsar_tpu.parallel.sweep import shard_map_compat
+
+    mesh = Mesh(np.array(list(mesh_devs)), ("dm",))
 
     def run_sharded(spec_pad2, tfs, idxs, top_lo, top_hi, thresh, seg_ids):
-        shd = shard_map(
+        shd = shard_map_compat(
             run, mesh=mesh,
             in_specs=(P("dm"), P(), P(), P(), P(), P(), P()),
             out_specs=P(None, "dm"),
-            check_rep=False,
+            check_vma=False,
         )
         return shd(spec_pad2, tfs, idxs,
                    jnp.int32(top_lo), jnp.int32(top_hi), thresh, seg_ids)
@@ -802,6 +802,7 @@ def accel_search_batch(
     config: AccelSearchConfig = AccelSearchConfig(),
     mesh_devices: int = 0,
     hbm_budget_bytes: Optional[int] = None,
+    devices: Optional[Tuple] = None,
 ) -> List[List[AccelCandidate]]:
     """Search a BATCH of normalized FFTs sharing one configuration
     (VERDICT r3 item 2: the 4096-DM-trial workload searches thousands of
@@ -827,9 +828,21 @@ def accel_search_batch(
 
     ``mesh_devices`` > 0 shards the batch over that many devices
     (shard_map over a 'dm' mesh axis; B must be a multiple of it, and
-    chunks round down to a multiple of it).
+    chunks round down to a multiple of it). The device set comes from
+    ``devices`` when given, else from the gang-lease resolver
+    (parallel.mesh.lease_devices) — NEVER bare ``jax.devices()[:k]``,
+    so a gang-leased search addresses exactly its leased chips.
     """
     cfg = config
+    if devices is not None:
+        devices = tuple(devices)
+        mesh_devices = len(devices)
+    elif mesh_devices:
+        from pypulsar_tpu.parallel.mesh import lease_devices
+
+        devices = tuple(lease_devices(mesh_devices))
+    else:
+        devices = ()
     if isinstance(ffts, tuple):
         # (re, im) REAL-dtyped plane arrays — possibly already device-
         # resident (kernels.prep_spectra_batch): no host conversion, no
@@ -875,7 +888,8 @@ def accel_search_batch(
             out.extend(accel_search_batch(
                 (re_a[c0:c0 + max_resident], im_a[c0:c0 + max_resident]),
                 T, config, mesh_devices=mesh_devices,
-                hbm_budget_bytes=hbm_budget_bytes))
+                hbm_budget_bytes=hbm_budget_bytes,
+                devices=devices or None))
         return out
 
     spec_pad2 = _build_spec_pad_batch(jnp.asarray(re_a), jnp.asarray(im_a),
@@ -898,8 +912,12 @@ def accel_search_batch(
             chunk = max(mesh_devices, (chunk // mesh_devices) * mesh_devices)
         runner = _make_stage_runner_batch(segw, Zrows, Wn, cfg.topk,
                                           tuple(bank_meta),
-                                          mesh_batch=mesh_devices)
+                                          mesh_devs=devices)
         ids_dev = jnp.asarray(seg_ids, dtype=jnp.int32)
+        span_attrs = {}
+        if devices:
+            span_attrs["dev"] = [int(getattr(d, "id", -1))
+                                 for d in devices]
         from pypulsar_tpu.resilience import faultinject
         from pypulsar_tpu.resilience.retry import halving_dispatch
 
@@ -912,9 +930,12 @@ def accel_search_batch(
                 faultinject.trip("accel.stage_dispatch")
                 sl = spec_pad2[c0 + lo:c0 + hi]
                 telemetry.counter("accel.stage_dispatches")
+                for d in span_attrs.get("dev", ()):
+                    telemetry.counter(f"device{d}.accel.stage_dispatches")
                 with telemetry.span("accel_stage_batch", H=int(H),
                                     batch=int(hi - lo),
-                                    n_seg=int(len(seg_ids))):
+                                    n_seg=int(len(seg_ids)),
+                                    **span_attrs):
                     # [len(seg_ids), nb, Wn, k] each; one batched pull
                     return pull_host(*runner(
                         sl, tuple(tfs), tuple(idxs), top_lo, top_hi,

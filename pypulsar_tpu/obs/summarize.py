@@ -70,6 +70,10 @@ class TraceSummary:
         self.last_device: Optional[dict] = None
         self.n_events = 0
         self.n_spans = 0
+        # device id -> [busy seconds, span count] from spans stamped
+        # with a `dev` attribute (the gang-lease / mesh paths) — the
+        # per-chip utilization view scaling records need
+        self.device_busy: Dict[int, List] = {}
         self._span_stages: Dict[str, List] = {}
         self._t_max = 0.0
 
@@ -87,6 +91,21 @@ class TraceSummary:
                                                    [0.0, 0])
                 ent[0] += float(rec.get("dur", 0.0))
                 ent[1] += 1
+            dev = (rec.get("attrs") or {}).get("dev")
+            if dev is not None and not rec.get("noagg") \
+                    and not str(rec.get("name", "")).startswith(
+                        "survey.stage."):
+                # leaf device spans only: noagg wrappers (accel_search,
+                # accel_stream_sweep) and the scheduler's enclosing
+                # survey.stage.* spans carry the stamp for attribution
+                # in the raw trace, but counting them here would
+                # double-book the nested device seconds
+                if not isinstance(dev, (list, tuple)):
+                    dev = [dev]
+                for d in dev:
+                    ent = self.device_busy.setdefault(int(d), [0.0, 0])
+                    ent[0] += float(rec.get("dur", 0.0))
+                    ent[1] += 1
             self._t_max = max(self._t_max,
                               float(rec.get("t", 0.0))
                               + float(rec.get("dur", 0.0)))
@@ -139,6 +158,10 @@ def combine_summaries(summaries: List[TraceSummary]) -> TraceSummary:
         out.n_events += s.n_events
         for name, (secs, count) in s.stages.items():
             ent = out.stages.setdefault(name, [0.0, 0])
+            ent[0] += secs
+            ent[1] += count
+        for d, (secs, count) in s.device_busy.items():
+            ent = out.device_busy.setdefault(d, [0.0, 0])
             ent[0] += secs
             ent[1] += count
         for k, v in s.counters.items():
@@ -216,6 +239,30 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
         p("#\n# events:")
         for name, n in sorted(s.events.items()):
             p(f"#   {name:<28s} {n:>8d}")
+    # per-device roll-up: chips only appear once something stamped them
+    # (gang-leased stages, sharded sweep/accel spans, device{N}.*
+    # counters) — a 1-chip unstamped run keeps its old output exactly
+    dev_counter_ids = set()
+    for k in s.counters:
+        if k.startswith("device") and "." in k:
+            head = k.split(".", 1)[0][len("device"):]
+            if head.isdigit():
+                dev_counter_ids.add(int(head))
+    dev_ids = sorted(set(s.device_busy) | dev_counter_ids)
+    if dev_ids:
+        p("#\n# per-device:")
+        for d in dev_ids:
+            busy, nsp = s.device_busy.get(d, (0.0, 0))
+            pct = 100.0 * busy / max(wall, 1e-12)
+            line = (f"#   device {d:<3d} busy {busy:9.3f}s  {pct:5.1f}%"
+                    f"  ({nsp} spans)")
+            prefix = f"device{d}."
+            cs = {k[len(prefix):]: v for k, v in s.counters.items()
+                  if k.startswith(prefix)}
+            if cs:
+                line += "  " + "  ".join(
+                    f"{k}={_fmt_count(v)}" for k, v in sorted(cs.items()))
+            p(line)
     if s.last_device is not None:
         p(f"#\n# device snapshot ({s.last_device.get('tag', '?')}):")
         for d in s.last_device.get("devices", []):

@@ -104,12 +104,16 @@ def stream_series(
     engine: str = "auto",
     chunk_payload: Optional[int] = None,
     dat_outbase: Optional[str] = None,
+    mesh=None,
     verbose: bool = False,
 ) -> Tuple[np.ndarray, float]:
     """One pass over ``reader``: every DM trial's full dedispersed series
     as a host ``[D, T_ds]`` float32 buffer, plus the effective sampling
     time. ``dat_outbase`` tees the IDENTICAL bytes to ``.dat``/``.inf``
-    files as they stream (the optional --write-dats path)."""
+    files as they stream (the optional --write-dats path). ``mesh``
+    shards the trial groups of each chunk over its 'dm' devices
+    (staged.iter_dedispersed_chunks) — rows stay bit-identical, so the
+    tee and every downstream artifact are unchanged by the chip count."""
     from pypulsar_tpu.parallel.staged import (
         _ReaderSource,
         dat_append_rows,
@@ -132,12 +136,15 @@ def stream_series(
         # the tee shares write_dats_streamed's writer helpers, so the
         # two paths' .dat byte streams have ONE definition
         paths = dat_truncate_paths(dat_outbase, dms)
-    with telemetry.span("accel_stream_sweep", aggregate=False,
-                        n_trials=len(dms), n_samples=int(T)):
+    attrs = dict(n_trials=len(dms), n_samples=int(T))
+    if mesh is not None:
+        attrs["dev"] = [int(getattr(d, "id", -1))
+                        for d in mesh.devices.flat]
+    with telemetry.span("accel_stream_sweep", aggregate=False, **attrs):
         for pos, rows in iter_dedispersed_chunks(
                 reader, dms, downsamp=factor, nsub=nsub,
                 group_size=group_size, rfimask=rfimask, engine=engine,
-                chunk_payload=chunk_payload, verbose=verbose):
+                chunk_payload=chunk_payload, mesh=mesh, verbose=verbose):
             buf[:, pos:pos + rows.shape[1]] = rows
             if paths is not None:
                 dat_append_rows(paths, rows)
@@ -201,6 +208,7 @@ def sweep_accel_stream(
     prefetch_depth: int = 1,
     journal_path: Optional[str] = None,
     journal: Optional[RunJournal] = None,
+    mesh=None,
     verbose: bool = False,
 ) -> dict:
     """Dedisperse ``dms`` over ``reader`` and accel-search every trial,
@@ -217,7 +225,23 @@ def sweep_accel_stream(
     artifact is also redone. A batched search that hits device
     RESOURCE_EXHAUSTED auto-halves with bounded backoff
     (resilience.retry.halving_dispatch) before the serial fallback is
-    even considered."""
+    even considered.
+
+    Multi-chip: ``mesh`` (a 1-D 'dm' Mesh, e.g. parallel.mesh.gang_mesh)
+    makes ONE observation span every mesh device end to end — the sweep
+    side shards each chunk's trial groups (sharded
+    iter_dedispersed_chunks), the prep side shards the batch rows
+    (prep_spectra_batch(mesh=...)), and the search side shard_maps the
+    spectrum axis (accel_search_batch over the SAME devices). Batches
+    pad to a device multiple by replicating the last row (padding
+    results drop deterministically before the writers), the per-batch
+    HBM budget scales by the device count (each chip holds only its
+    shard), and the .cand/.txtcand writers consume per-device results
+    in trial order — so artifacts are byte-identical to the 1-device
+    run, which the multi-chip parity tests and the BENCH_r09 record
+    assert. NOTE: ``mesh`` is a placement choice, not science — it is
+    deliberately absent from the journal fingerprint, so a gang-leased
+    resume can pick up a 1-chip run's journal and vice versa."""
     from pypulsar_tpu.fourier.accelsearch import (
         accel_search,
         accel_search_batch,
@@ -228,6 +252,10 @@ def sweep_accel_stream(
     )
 
     dms = np.asarray(dms, dtype=np.float64)
+    ndm = 1 if mesh is None else int(mesh.shape["dm"])
+    mesh_devs = (tuple(mesh.devices.flat) if mesh is not None else None)
+    dev_ids = ([int(getattr(d, "id", -1)) for d in mesh_devs]
+               if mesh_devs else None)
     D = len(dms)
     bases = [f"{outbase}_DM{dm:.2f}" for dm in dms]
     names = [accel_out_names(b, config.zmax, config.wmax) for b in bases]
@@ -306,11 +334,18 @@ def sweep_accel_stream(
     # Unlike the sequential CLI, the pipeline holds several prepped
     # batches in HBM at once — the one searching, the queued ones, and
     # the one the parked worker holds (prefetch_depth + 2 in flight) —
-    # so each batch gets only its share of the budget
+    # so each batch gets only its share of the budget. The budget is PER
+    # DEVICE: a DM-sharded batch splits across the mesh, so k chips
+    # admit k x the spectra per dispatch (the per-shard slice of each
+    # chip stays inside its own HBM share)
     hbm = int(float(os.environ.get("PYPULSAR_TPU_ACCEL_HBM", 5e9)))
     inflight = prefetch_depth + 2 if prefetch_depth > 0 else 1
-    unit = (min(batch, max(1, (hbm // inflight) // (24 * T)))
+    unit = (min(batch, max(1, ndm * ((hbm // inflight) // (24 * T))))
             if device_prep else batch)
+    if ndm > 1:
+        # dispatch batches stay whole device multiples; short tails pad
+        # by replicating the last row (dropped after the search)
+        unit = max(ndm, (unit // ndm) * ndm)
     schedule = deredden_schedule(T // 2 + 1)
     n_searched = 0
     n_failed = 0
@@ -326,7 +361,7 @@ def sweep_accel_stream(
             group_size=group_size, rfimask=rfimask, engine=engine,
             chunk_payload=chunk_payload,
             dat_outbase=outbase if write_dats else None,
-            verbose=verbose)
+            mesh=mesh, verbose=verbose)
         faultinject.trip("accel.after_stream")  # kill-point (journal test)
         T_sec = T * dt_eff
 
@@ -341,13 +376,25 @@ def sweep_accel_stream(
             the search consumes without a host round trip). Exceptions
             (a failed device dispatch) travel as values — raised on the
             worker they would abort the whole run instead of degrading
-            this one batch to the serial fallback."""
+            this one batch to the serial fallback. Under a mesh the
+            rows pad to a whole device multiple by REPLICATING the last
+            row — replication (not zeros) keeps every shard's numerics
+            on real data shapes, and the padded results drop before the
+            writers, so padding cannot change any artifact byte."""
             try:
                 rows = np.ascontiguousarray(series[[i - d0 for i in idxs]])
+                if ndm > 1 and rows.shape[0] % ndm:
+                    pad = ndm - rows.shape[0] % ndm
+                    rows = np.concatenate(
+                        [rows, np.repeat(rows[-1:], pad, axis=0)])
+                prep_attrs = {"batch": len(idxs)}
+                if dev_ids is not None:
+                    prep_attrs["dev"] = dev_ids
                 with telemetry.span("accel_prep_device" if device_prep
                                     else "accel_prep_host",
-                                    batch=len(idxs)):
-                    payload = (prep_spectra_batch(rows, schedule)
+                                    **prep_attrs):
+                    payload = (prep_spectra_batch(rows, schedule,
+                                                  mesh=mesh)
                                if device_prep
                                else _host_prep_rows(rows, schedule))
             except Exception as e:  # noqa: BLE001 - consumer decides
@@ -367,23 +414,38 @@ def sweep_accel_stream(
             RESOURCE_EXHAUSTED halves the batch (per-spectrum results
             are independent, so the halves concatenate bit-identically);
             any other failure — or an OOM that persists at batch 1 —
-            propagates to the serial-fallback handler below."""
+            propagates to the serial-fallback handler below. ``n`` is
+            the PADDED batch under a mesh (a whole device multiple;
+            min_size keeps halves on it), and the caller slices the
+            result back to the real trials."""
             def run(lo, hi):
                 faultinject.trip("accel.batch_dispatch")
                 part = (tuple(p[lo:hi] for p in payload)
                         if isinstance(payload, tuple) else payload[lo:hi])
-                return accel_search_batch(part, T_sec, config)
+                return accel_search_batch(part, T_sec, config,
+                                          mesh_devices=ndm if ndm > 1
+                                          else 0, devices=mesh_devs)
 
-            parts = halving_dispatch(run, n, what="accel.batch")
+            parts = halving_dispatch(run, n, min_size=ndm,
+                                     what="accel.batch")
             return [c for _, _, cands in parts for c in cands]
 
         for idxs, payload, prep_err in source:
             try:
                 if prep_err is not None:
                     raise prep_err
+                n_padded = (len(payload[0])
+                            if isinstance(payload, tuple)
+                            else len(payload))
+                search_attrs = {"batch": len(idxs)}
+                if dev_ids is not None:
+                    search_attrs["dev"] = dev_ids
                 with telemetry.span("accel_search", aggregate=False,
-                                    batch=len(idxs)):
-                    all_cands = search_halved(payload, len(idxs))
+                                    **search_attrs):
+                    # padded replicas (mesh batches round up to a device
+                    # multiple) searched then DROPPED: zip(idxs, ...)
+                    # below stops at the real trials
+                    all_cands = search_halved(payload, n_padded)
             except Exception as e:  # noqa: BLE001 - poison-spectrum
                 # contract of the batched CLI: degrade to per-spectrum
                 # serial host-prep searches, never fail the whole batch
@@ -430,6 +492,9 @@ def sweep_accel_stream(
                     faultinject.trip("accel.after_journal")  # kill-point
                 n_searched += 1
             telemetry.counter("accel.stream_batches")
+            if dev_ids is not None:
+                for d in dev_ids:
+                    telemetry.counter(f"device{d}.accel.stream_batches")
             if verbose:
                 print(f"# searched trials {idxs[0]}..{idxs[-1]} "
                       f"({n_searched}/{len(todo)})")

@@ -1,19 +1,90 @@
-"""Device mesh construction for the sweep engine.
+"""Device mesh construction + the thread-local gang lease registry.
 
 The scale axes of this domain (SURVEY.md §2.4): DM trials (embarrassingly
 parallel — the data-parallel analogue), the time axis (long-context analogue,
 sharded with halo exchange since dedispersion is a pure per-channel shift),
 and multi-beam/multi-file batches across hosts over DCN.
+
+The **gang lease** half solves the mesh/lease collision: the survey
+scheduler hands a stage k exclusive chips, but every mesh-building call
+site used to root itself at ``jax.local_devices()[0]`` — two gang-leased
+observations would silently build meshes over the SAME chips 0..k-1.
+:func:`device_lease` publishes the leased device set thread-locally;
+:func:`lease_devices` is the ONE resolver every mesh builder goes
+through (the active lease first, then the thread's ``jax.default_device``
+as the root of the local-device ring, then plain ``jax.local_devices()``),
+so a mesh built inside a lease can only address the leased chips.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import contextlib
+import threading
+from typing import List, Optional, Sequence
 
 import jax
 import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
+
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def device_lease(devices):
+    """Publish ``devices`` as THIS thread's exclusive device gang for the
+    block (re-entrant: an inner lease shadows, then restores, the outer).
+    The survey scheduler wraps each device-bound stage in one; any mesh
+    built below it via :func:`lease_devices` sees only these chips."""
+    prev = getattr(_tls, "lease", None)
+    _tls.lease = tuple(devices)
+    try:
+        yield _tls.lease
+    finally:
+        _tls.lease = prev
+
+
+def current_lease() -> Optional[tuple]:
+    """The active thread's leased device tuple, or None outside a lease."""
+    return getattr(_tls, "lease", None)
+
+
+def lease_device_ids() -> Optional[List[int]]:
+    """Integer device ids of the active lease (telemetry attribution
+    stamps these on span/counter records), or None outside a lease."""
+    lease = current_lease()
+    if not lease:
+        return None
+    return [int(getattr(d, "id", -1)) for d in lease]
+
+
+def lease_devices(k: Optional[int] = None) -> list:
+    """The device set this thread's work may address, optionally cut to
+    ``k``. Resolution order: the active :func:`device_lease` (the gang);
+    else ``jax.local_devices()`` rotated so the thread's
+    ``jax.default_device`` (a single-chip lease) comes first; else plain
+    ``jax.local_devices()``. Raises when fewer than ``k`` are
+    addressable — a gang must never silently spill past its lease."""
+    lease = current_lease()
+    if lease:
+        devs = list(lease)
+    else:
+        devs = list(jax.local_devices())
+        default = None
+        try:
+            default = jax.config.jax_default_device
+        except Exception:  # noqa: BLE001 - config name moved: no rotation
+            default = None
+        if default is not None and default in devs:
+            i = devs.index(default)
+            devs = devs[i:] + devs[:i]
+    if k is not None:
+        if len(devs) < k:
+            raise ValueError(
+                f"need {k} devices but this thread's lease/host offers "
+                f"only {len(devs)} ({[str(d) for d in devs]})")
+        devs = devs[:k]
+    return devs
 
 
 def make_mesh(
@@ -35,3 +106,10 @@ def make_mesh(
         raise ValueError(f"axis sizes {axis_sizes} do not multiply to {n} devices")
     dev_array = mesh_utils.create_device_mesh(tuple(axis_sizes), devices=devices)
     return Mesh(dev_array, tuple(axis_names))
+
+
+def gang_mesh(k: int) -> Mesh:
+    """A 1-D 'dm' mesh over this thread's k leased/addressable devices —
+    the one-call form every DM-sharding CLI path uses (see module
+    docstring for the resolution order)."""
+    return make_mesh([k], ("dm",), devices=lease_devices(k))

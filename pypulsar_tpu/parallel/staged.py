@@ -935,6 +935,7 @@ def iter_dedispersed_chunks(
     engine: str = "auto",
     chunk_payload: Optional[int] = None,
     window: Optional[Tuple[int, int]] = None,
+    mesh=None,
     verbose: bool = False,
 ):
     """Stream the file ONCE and yield ``(pos, rows[D, valid] float32)``
@@ -948,7 +949,13 @@ def iter_dedispersed_chunks(
     :func:`dats_geometry`, so windows must be whole-payload multiples
     (the seam contract). Every value a consumer sees is the f32 the .dat
     byte stream would contain — the paths are bit-identical by
-    construction, which the candidate-table parity test pins down."""
+    construction, which the candidate-table parity test pins down.
+
+    ``mesh`` shards the trial groups over its 'dm' axis
+    (sweep.make_sharded_series_chunk): each device dedisperses its local
+    groups of the replicated chunk, and because per-group math is
+    device-count independent the yielded rows stay bit-identical to the
+    unsharded stream (the multi-chip byte-parity contract)."""
     from pypulsar_tpu.ops.transfer import pull_host
     from pypulsar_tpu.parallel.sweep import dedisperse_series_chunk
 
@@ -958,6 +965,23 @@ def iter_dedispersed_chunks(
     plan, payload, T = dats_geometry(reader, dms, downsamp=factor,
                                      nsub=nsub, group_size=group_size,
                                      chunk_payload=chunk_payload)
+    dev_ids = None
+    sharded_fn = None
+    if mesh is not None:
+        from pypulsar_tpu.parallel.sweep import make_sharded_series_chunk
+
+        ndm = int(mesh.shape["dm"])
+        padded_groups = -(-plan.n_groups // ndm) * ndm
+        if padded_groups != plan.n_groups:
+            # padded groups replicate the last real trial; group math is
+            # independent, so the real rows below are untouched
+            plan = make_sweep_plan(dms, probe.frequencies,
+                                   probe.tsamp * factor, nsub=nsub,
+                                   group_size=plan.group_size, widths=(1,),
+                                   pad_groups_to=padded_groups)
+        sharded_fn = make_sharded_series_chunk(
+            mesh, plan.nsub, payload, plan.max_shift2, engine)
+        dev_ids = [int(getattr(d, "id", -1)) for d in mesh.devices.flat]
     s0, s1 = window if window is not None else (0, T)
     if not 0 <= s0 <= s1 <= T:
         raise ValueError(f"bad window [{s0}, {s1}) of {T}")
@@ -975,16 +999,24 @@ def iter_dedispersed_chunks(
         if L < need:  # tail: zero-pad to the static chunk shape
             block = jnp.pad(block, ((0, 0), (0, need - L)))
         valid = min(payload, s1 - pos)
-        with telemetry.span("dedisperse_chunk", n_trials=len(dms),
-                            valid=int(valid)):
-            series = dedisperse_series_chunk(
-                block, s1b, s2b, plan.nsub, payload, plan.max_shift2,
-                engine)
+        attrs = dict(n_trials=len(dms), valid=int(valid))
+        if dev_ids is not None:
+            attrs["dev"] = dev_ids
+        with telemetry.span("dedisperse_chunk", **attrs):
+            if sharded_fn is not None:
+                series = sharded_fn(block, s1b, s2b)
+            else:
+                series = dedisperse_series_chunk(
+                    block, s1b, s2b, plan.nsub, payload, plan.max_shift2,
+                    engine)
             (host,) = pull_host(series[:, :valid].astype(jnp.float32))
         if verbose:
             print(f"# dats chunk at {pos}: {valid} samples "
                   f"x {len(dms)} DMs")
         telemetry.counter("dedisperse.chunks")
+        if dev_ids is not None:
+            for d in dev_ids:
+                telemetry.counter(f"device{d}.dedisperse.chunks")
         # the plan pads trial groups to the group size; only the real
         # trials leave this generator
         yield pos, np.asarray(host)[:len(dms)]

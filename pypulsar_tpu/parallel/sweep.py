@@ -460,6 +460,31 @@ def make_sharded_sweep_chunk(mesh: Mesh, nsub, out_len, slack2, widths,
     return jax.jit(fn)
 
 
+def make_sharded_series_chunk(mesh: Mesh, nsub, out_len, slack2,
+                              engine="gather"):
+    """:func:`dedisperse_series_chunk` with trial groups sharded over the
+    mesh 'dm' axis — the chunk engine of the DM-sharded sweep->accel
+    handoff (parallel.accelpipe). The chunk replicates to every device;
+    each device dedisperses only its local trial groups and the [D, out]
+    series concatenates in group order (out_specs P('dm')), so the rows a
+    consumer sees are BIT-identical to the unsharded kernel's — per-group
+    math is device-count independent. The group count must divide the
+    'dm' axis size (make_sweep_plan(pad_groups_to=...))."""
+    engine = resolve_engine(engine)
+
+    def impl(data, stage1_bins, stage2_bins):
+        return dedisperse_series_chunk(data, stage1_bins, stage2_bins,
+                                       nsub, out_len, slack2, engine)
+
+    fn = shard_map_compat(
+        impl,
+        mesh=mesh,
+        in_specs=(P(), P("dm"), P("dm")),
+        out_specs=P("dm"),
+    )
+    return jax.jit(fn)
+
+
 def make_sharded_sweep_chunk_2d(
     mesh: Mesh, nsub, local_payload, overlap, slack2, widths, engine="gather"
 ):

@@ -89,7 +89,16 @@ class SurveyConfig:
 class StageSpec:
     """One DAG node. ``run`` defaults to dispatching ``argv`` to the
     ``tool`` CLI's in-process ``main``; stages with pre/post logic that
-    is not a plain CLI call (snr's empty-fleet guard) override it."""
+    is not a plain CLI call (snr's empty-fleet guard) override it.
+
+    ``devices_max`` declares the stage's device-count range [1, max]:
+    the scheduler may gang-lease up to that many chips to ONE execution
+    of this stage (vs the default fleet-parallel 1-chip placement), and
+    ``gang_argv(obs, cfg, k)`` builds the argv that actually spans k
+    chips (the sweep stage adds ``--mesh k``). Gang size is a PLACEMENT
+    choice, never science: a gang-aware stage must produce byte-
+    identical artifacts at any k, so manifests resume across gang
+    changes (the fingerprint deliberately excludes placement)."""
 
     name: str
     tool: str
@@ -99,12 +108,19 @@ class StageSpec:
     outputs: Callable[[Observation, SurveyConfig], List[str]]
     run: Optional[Callable[[Observation, SurveyConfig], int]] = field(
         default=None)
+    devices_max: int = 1
+    gang_argv: Optional[Callable[[Observation, SurveyConfig, int],
+                                 List[str]]] = field(default=None)
 
-    def execute(self, obs: Observation, cfg: SurveyConfig) -> None:
+    def execute(self, obs: Observation, cfg: SurveyConfig,
+                gang: int = 1) -> None:
         if self.run is not None:
             rc = self.run(obs, cfg)
         else:
-            rc = run_cli_tool(self.tool, self.argv(obs, cfg))
+            argv = (self.gang_argv(obs, cfg, gang)
+                    if gang > 1 and self.gang_argv is not None
+                    else self.argv(obs, cfg))
+            rc = run_cli_tool(self.tool, argv)
         if rc:
             raise StageExit(f"stage {self.name!r} ({self.tool}) exited "
                             f"{rc} for observation {obs.name!r}")
@@ -144,6 +160,11 @@ def _mask_outputs(obs: Observation, cfg: SurveyConfig) -> List[str]:
     return outs
 
 
+# widest gang one sweep stage may hold (chips, not a science knob — NOT
+# in SurveyConfig, so changing it can never restart a manifest)
+SWEEP_GANG_MAX = 8
+
+
 def _sweep_argv(obs: Observation, cfg: SurveyConfig) -> List[str]:
     argv = [obs.infile, "-o", obs.outbase,
             "--lodm", str(cfg.lodm), "--dmstep", str(cfg.dmstep),
@@ -166,6 +187,16 @@ def _sweep_argv(obs: Observation, cfg: SurveyConfig) -> List[str]:
     if cfg.mask:
         argv += ["--mask", _mask_file(obs)]
     return argv
+
+
+def _sweep_gang_argv(obs: Observation, cfg: SurveyConfig,
+                     k: int) -> List[str]:
+    """The k-chip form of the sweep stage: the SAME argv plus ``--mesh
+    k`` — the sweep pass shards its trial groups and the accel handoff
+    shards (dm x spectrum) over the k leased chips (cli/sweep builds the
+    mesh from the thread's gang lease). Artifacts are byte-identical to
+    the 1-chip argv, the contract the multi-chip bench asserts."""
+    return _sweep_argv(obs, cfg) + ["--mesh", str(k)]
 
 
 def _sweep_outputs(obs: Observation, cfg: SurveyConfig) -> List[str]:
@@ -244,7 +275,9 @@ def build_dag(cfg: SurveyConfig) -> List[StageSpec]:
         sweep_deps = ("mask",)
     stages += [
         StageSpec("sweep", "sweep", True, sweep_deps,
-                  _sweep_argv, _sweep_outputs),
+                  _sweep_argv, _sweep_outputs,
+                  devices_max=SWEEP_GANG_MAX,
+                  gang_argv=_sweep_gang_argv),
         StageSpec("sift", "sift", False, ("sweep",),
                   _sift_argv, _sift_outputs),
         StageSpec("fold", "foldbatch", True, ("sift",),

@@ -7,11 +7,27 @@ proceed concurrently. The serial per-tool chain leaves the device idle
 during every sift and pfd_snr; this scheduler runs the per-observation
 stage DAG (:mod:`.dag`) over the whole fleet with two execution lanes:
 
-- **device lane** — ``device_bound`` stages queue for one of N exclusive
-  device leases (default 1: one device-bound stage at a time per
-  device). The queue is priority + FIFO: deeper stages first (drain
-  observations toward completion, bounding in-flight intermediate
-  artifacts), submission order breaking ties.
+- **device lane** — ``device_bound`` stages queue for exclusive device
+  leases drawn from a pool of N chips (default 1: one device-bound
+  stage at a time). The queue is priority + FIFO: deeper stages first
+  (drain observations toward completion, bounding in-flight
+  intermediate artifacts), submission order breaking ties. A stage
+  whose spec declares ``devices_max > 1`` may be **gang-leased**: one
+  execution holds k chips at once (the stage's ``gang_argv`` spans
+  them, e.g. ``sweep --mesh k``), the alternative placement to
+  fleet-parallel k-obs-x-1-chip. ``gang`` picks the shape — a fixed k,
+  or ``"auto"``: fleet-parallel while enough ready device stages exist
+  to fill the chips, widening gangs (scaled by the measured per-stage
+  cost share from this run's completed stages — the numbers the obs
+  traces record) when chips would otherwise idle. Every placement
+  decision lands in the fleet trace as a ``survey.gang_decision`` event
+  (k, chips, reason) and in the observation's trace. Gang acquisition
+  is FIFO with full reservation (an older waiting claim reserves freed
+  chips), so a wide gang can never starve behind a stream of 1-chip
+  stages. Leased chips publish thread-locally
+  (``parallel.mesh.device_lease``), which is where ``cli/sweep
+  --mesh`` resolves its mesh devices — two concurrent gangs can never
+  both address chips 0..k-1.
 - **host lane** — host-bound stages (sift, pfd_snr summaries) run on a
   bounded worker pool (``max_host_workers``), overlapping the device
   lane.
@@ -61,6 +77,14 @@ __all__ = ["FleetResult", "FleetScheduler"]
 RETRY_BACKOFF_BASE_S = 0.25
 RETRY_BACKOFF_MAX_S = 5.0
 
+# auto-gang cost gate: a gang-able stage whose measured mean cost is
+# under this share of the whole device chain runs 1-chip even when
+# chips idle — k chips on a minor stage buys k x the lease churn for a
+# sliver of wall time
+GANG_COST_MIN_FRAC = 0.25
+
+_UNSET = object()  # _n_jax_devices cache sentinel (None = no backend)
+
 _PENDING, _QUEUED, _RUNNING, _DONE, _QUARANTINED = range(5)
 
 
@@ -103,6 +127,7 @@ class FleetScheduler:
                  max_host_workers: int = 2, devices: int = 1,
                  retries: int = 1, resume: bool = False,
                  telemetry_dir: Optional[str] = None,
+                 gang="auto",
                  verbose: bool = False):
         self.cfg = cfg if cfg is not None else SurveyConfig()
         self.stages = list(stages) if stages is not None \
@@ -120,9 +145,25 @@ class FleetScheduler:
             raise ValueError(f"duplicate observation names: {names}")
         self.max_host_workers = max(1, int(max_host_workers))
         self.devices = max(1, int(devices))
+        self._njax: object = _UNSET
         self.retries = max(0, int(retries))
         self.resume = resume
         self.telemetry_dir = telemetry_dir
+        if telemetry_dir:
+            # ObsTrace silently disables itself on an unopenable path
+            # (observability is a passenger) — a missing directory would
+            # drop every trace, so create it here for library callers,
+            # not just the CLI
+            try:
+                os.makedirs(telemetry_dir, exist_ok=True)
+            except OSError:
+                pass
+        if gang != "auto":
+            gang = max(1, int(gang))
+            if gang > self.devices:
+                raise ValueError(f"--gang {gang} exceeds the "
+                                 f"{self.devices} device leases")
+        self.gang = gang
         self.verbose = verbose
 
         self._lock = threading.Lock()
@@ -135,6 +176,11 @@ class FleetScheduler:
         self._tasks: Dict[Tuple[int, str], _Task] = {
             (i, s.name): _Task(i, s)
             for i in range(len(self.obs)) for s in self.stages}
+        # the device POOL gangs draw from (lease ids 0..devices-1) and
+        # the FIFO claim line that keeps wide gangs starvation-free
+        self._free_ids = set(range(self.devices))
+        self._claims: List[Tuple[object, int]] = []
+        self._stage_cost: Dict[str, List[float]] = {}  # name -> [s, n]
         self.result = FleetResult()
         self._manifests: List[ObsManifest] = []
         self._traces: List[Optional[ObsTrace]] = []
@@ -207,16 +253,22 @@ class FleetScheduler:
 
     # -- execution ----------------------------------------------------------
 
-    def _execute(self, task: _Task) -> None:
+    def _execute(self, task: _Task, gang: int = 1,
+                 dev_ids: Optional[List[int]] = None) -> None:
         obs = self.obs[task.obs_i]
         stage = task.stage
         faultinject.trip("survey.stage_start")
         faultinject.trip(f"survey.stage_start.{stage.name}")
         telemetry.counter("survey.stages_run")
+        span_attrs = {"obs": obs.name}
+        if dev_ids is not None:
+            span_attrs["dev"] = dev_ids
+        if gang > 1:
+            span_attrs["gang"] = gang
         t_rel = time.perf_counter() - self._t0
         t0 = time.perf_counter()
-        with telemetry.span(f"survey.stage.{stage.name}", obs=obs.name):
-            stage.execute(obs, self.cfg)
+        with telemetry.span(f"survey.stage.{stage.name}", **span_attrs):
+            stage.execute(obs, self.cfg, gang=gang)
         dur = time.perf_counter() - t0
         faultinject.trip("survey.stage_done")
         faultinject.trip(f"survey.stage_done.{stage.name}")
@@ -224,13 +276,26 @@ class FleetScheduler:
         self._manifests[task.obs_i].mark_done(stage.name, outputs)
         trace = self._traces[task.obs_i]
         if trace is not None:
+            tr_attrs = {"outputs": len(outputs)}
+            if dev_ids is not None:
+                tr_attrs["dev"] = dev_ids
+            if gang > 1:
+                tr_attrs["gang"] = gang
             trace.span(f"survey.stage.{stage.name}", t_rel, dur,
-                       outputs=len(outputs))
+                       **tr_attrs)
         if self.verbose:
             print(f"# survey: {obs.name}: {stage.name} done "
-                  f"({dur:.2f}s, {len(outputs)} artifacts)")
+                  f"({dur:.2f}s, {len(outputs)} artifacts"
+                  + (f", gang x{gang} on chips {dev_ids}"
+                     if gang > 1 else "") + ")")
         with self._cv:
             task.state = _DONE
+            if stage.device_bound:
+                # the measured per-stage cost the auto-gang policy
+                # consults (same numbers the obs trace records)
+                ent = self._stage_cost.setdefault(stage.name, [0.0, 0])
+                ent[0] += dur
+                ent[1] += 1
             self.result.ran.append((obs.name, stage.name))
             self._promote_locked(task.obs_i)
             if self._finished_locked():
@@ -299,15 +364,110 @@ class FleetScheduler:
                 self._stop = True
             self._cv.notify_all()
 
-    def _lease_device(self, lease: Optional[int]):
-        """The JAX device backing lease ``lease``, or None when no
-        binding is needed. With one lease (the default) the process
-        default device already IS the lease; with several, each device
-        worker pins its stages via ``jax.default_device`` (thread-local)
-        so N leases really are N chips, not N-fold oversubscription of
-        device 0. Guarded: a jax-less run (stub DAGs) just skips the
-        binding."""
-        if lease is None or self.devices <= 1:
+    # -- gang leases --------------------------------------------------------
+
+    def _gang_size(self, task: _Task) -> Tuple[int, str]:
+        """(k, reason) — how many chips THIS execution gets. Fixed
+        ``gang`` pins k; ``"auto"`` picks fleet-parallel while enough
+        ready device-bound stages exist to fill the chips and widens a
+        gang-able stage onto idle chips otherwise, gated by the
+        measured per-stage cost share (see GANG_COST_MIN_FRAC)."""
+        stage = task.stage
+        gmax = min(int(getattr(stage, "devices_max", 1)), self.devices)
+        njax = self._n_jax_devices()
+        if njax is not None:
+            # a gang mesh needs k DISTINCT chips; an oversubscribed
+            # lease pool (--devices > real devices) may only widen up
+            # to the real count
+            gmax = min(gmax, njax)
+        if gmax <= 1:
+            return 1, "single-device stage"
+        if self.gang != "auto":
+            k = min(int(self.gang), gmax)
+            return k, f"fixed --gang {self.gang}"
+        with self._lock:
+            other_ready = sum(
+                1 for t in self._tasks.values()
+                if t is not task and t.stage.device_bound
+                and t.state in (_QUEUED, _RUNNING))
+            cost = {n: c[0] / max(c[1], 1)
+                    for n, c in self._stage_cost.items() if c[1]}
+        idle = self.devices - 1 - other_ready
+        if idle <= 0:
+            return 1, (f"fleet-parallel: {other_ready} other ready "
+                       f"device stages fill the {self.devices} chips")
+        k = min(gmax, 1 + idle)
+        total = sum(cost.values())
+        mine = cost.get(stage.name)
+        if mine is not None and total > 0:
+            frac = mine / total
+            if frac < GANG_COST_MIN_FRAC:
+                return 1, (f"measured {stage.name} cost share "
+                           f"{frac:.0%} < {GANG_COST_MIN_FRAC:.0%} of "
+                           f"the device chain: gang not worth it")
+            return k, (f"gang x{k}: {idle} idle chips and "
+                       f"{stage.name} owns {frac:.0%} of the measured "
+                       f"device chain")
+        return k, f"gang x{k}: {idle} idle chips, cost unmeasured yet"
+
+    def _acquire_devices(self, k: int) -> Optional[List[int]]:
+        """Block until k lease ids are free and claim them. FIFO with
+        full reservation: an older waiting claim reserves freed chips
+        (up to its need) before any younger claim may take them, so a
+        wide gang cannot starve behind 1-chip traffic. Returns None
+        when the fleet is unwinding (fatal)."""
+        ticket = object()
+        with self._cv:
+            self._claims.append((ticket, k))
+            try:
+                while True:
+                    if self._stop and self._fatal is not None:
+                        return None
+                    rem = len(self._free_ids)
+                    grant = False
+                    for t, need in self._claims:
+                        if t is ticket:
+                            grant = rem >= k
+                            break
+                        rem -= min(need, rem)  # older claims reserve
+                    if grant:
+                        ids = sorted(self._free_ids)[:k]
+                        self._free_ids.difference_update(ids)
+                        return ids
+                    self._cv.wait(0.1)
+            finally:
+                self._claims.remove((ticket, k))
+
+    def _release_devices(self, ids: List[int]) -> None:
+        with self._cv:
+            self._free_ids.update(ids)
+            self._cv.notify_all()
+
+    def _n_jax_devices(self) -> Optional[int]:
+        """Real JAX device count, cached; None without a backend."""
+        if self._njax is _UNSET:
+            try:
+                import jax
+
+                self._njax = len(jax.local_devices())
+            except Exception:  # noqa: BLE001 - no backend
+                self._njax = None
+        return self._njax
+
+    def _jax_gang(self, ids: List[int]) -> Optional[list]:
+        """The JAX devices backing lease ids, or None when no binding
+        is needed. With one lease (the default) the process default
+        device already IS the lease; with several, the stage pins via
+        ``jax.default_device`` + ``parallel.mesh.device_lease`` so k
+        leases really are k chips, not k-fold oversubscription of
+        device 0. Guarded: a jax-less run (stub DAGs) skips binding.
+
+        Lease ids wrap modulo the real device count (an oversubscribed
+        pool is legal for 1-chip fleet placement), but a GANG mesh must
+        hold distinct chips — colliding ids are bumped to the next free
+        device; ``_gang_size`` caps k at the real count so a solution
+        always exists."""
+        if self.devices <= 1:
             return None
         try:
             import jax
@@ -315,11 +475,55 @@ class FleetScheduler:
             devs = jax.local_devices()
         except Exception:  # noqa: BLE001 - no backend: nothing to pin
             return None
-        return devs[lease % len(devs)]
+        n = len(devs)
+        if len(ids) > 1:
+            if len(ids) > n:
+                raise ValueError(
+                    f"gang of {len(ids)} leases needs {len(ids)} distinct "
+                    f"devices but only {n} exist")
+            picked: List[int] = []
+            used: set = set()
+            for i in ids:
+                j = i % n
+                while j in used:
+                    j = (j + 1) % n
+                used.add(j)
+                picked.append(j)
+            return [devs[j] for j in picked]
+        return [devs[i % n] for i in ids]
+
+    def _run_device_task(self, task: _Task) -> None:
+        """One device-lane execution: decide the gang shape, take the
+        lease(s), record the placement decision, run pinned."""
+        obs = self.obs[task.obs_i]
+        k, reason = self._gang_size(task)
+        ids = self._acquire_devices(k)
+        if ids is None:  # fleet unwinding while we waited
+            return
+        try:
+            telemetry.event("survey.gang_decision", obs=obs.name,
+                            stage=task.stage.name, k=k, chips=ids,
+                            reason=reason)
+            trace = self._traces[task.obs_i]
+            if trace is not None:
+                trace.event("survey.gang_decision", stage=task.stage.name,
+                            k=k, chips=ids, reason=reason)
+            gang_devs = self._jax_gang(ids)
+            if gang_devs is not None:
+                import jax
+
+                from pypulsar_tpu.parallel.mesh import device_lease
+
+                with jax.default_device(gang_devs[0]), \
+                        device_lease(gang_devs):
+                    self._execute(task, gang=k, dev_ids=ids)
+            else:
+                self._execute(task, gang=k)
+        finally:
+            self._release_devices(ids)
 
     def _worker(self, q: "queue.PriorityQueue",
-                lease: Optional[int] = None) -> None:
-        device = self._lease_device(lease)
+                device_lane: bool = False) -> None:
         while True:
             try:
                 _, _, task = q.get(timeout=0.05)
@@ -334,11 +538,8 @@ class FleetScheduler:
                     continue  # cancelled while queued
                 task.state = _RUNNING
             try:
-                if device is not None:
-                    import jax
-
-                    with jax.default_device(device):
-                        self._execute(task)
+                if device_lane:
+                    self._run_device_task(task)
                 else:
                     self._execute(task)
             except Exception as e:  # noqa: BLE001 - retry/quarantine policy
@@ -375,7 +576,7 @@ class FleetScheduler:
                     self._stop = True
             workers = (
                 [threading.Thread(target=self._worker,
-                                  args=(self._device_q, d),
+                                  args=(self._device_q, True),
                                   name=f"survey-device{d}")
                  for d in range(self.devices)]
                 + [threading.Thread(target=self._worker,

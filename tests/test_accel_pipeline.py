@@ -420,3 +420,120 @@ def test_stream_handoff_auto_group_size_parity(tmp_path, monkeypatch):
     for fg in fulls:
         fn = "n" + os.path.basename(fg)[1:]
         assert open(fg, "rb").read() == open(fn, "rb").read(), fg
+
+
+# ---------------------------------------------------------------------------
+# multi-chip: DM-sharded sweep->accel handoff (round 11)
+# ---------------------------------------------------------------------------
+
+_MESH_PROBE: list = []  # cached (ok, detail) — the same capability-probe
+#                         pattern as test_distributed's CPU-collectives gate
+
+
+def require_virtual_mesh(k):
+    """Skip cleanly where fewer than k devices exist or the backend
+    cannot execute an in-process shard_map (environment capability, not
+    a code bug); cached per session. tests/conftest.py forces the
+    8-virtual-device CPU recipe, so these normally run."""
+    import jax
+
+    if len(jax.devices()) < k:
+        pytest.skip(f"environment capability: {len(jax.devices())} "
+                    f"devices < {k} (needs "
+                    f"--xla_force_host_platform_device_count)")
+    if not _MESH_PROBE:
+        try:
+            import jax.numpy as jnp
+            from jax.sharding import PartitionSpec as P
+
+            from pypulsar_tpu.parallel import make_mesh
+            from pypulsar_tpu.parallel.sweep import shard_map_compat
+
+            mesh = make_mesh([2], ("dm",), devices=jax.devices()[:2])
+            fn = shard_map_compat(lambda x: x * 2, mesh=mesh,
+                                  in_specs=(P("dm"),), out_specs=P("dm"))
+            np.testing.assert_array_equal(
+                np.asarray(fn(jnp.arange(4.0))), np.arange(4.0) * 2)
+            _MESH_PROBE.append((True, ""))
+        except Exception as e:  # noqa: BLE001 - capability, not a bug
+            _MESH_PROBE.append((False, f"{type(e).__name__}: {e}"))
+    ok, detail = _MESH_PROBE[0]
+    if not ok:
+        pytest.skip("environment capability: in-process shard_map "
+                    "collectives unavailable: " + detail)
+
+
+@pytest.mark.parametrize("numdms,mesh_k", [(8, 4), (6, 4)])
+def test_stream_handoff_sharded_byte_identical(tmp_path, monkeypatch,
+                                               numdms, mesh_k):
+    """The multi-chip acceptance contract: `sweep --mesh k
+    --accel-search` (DM-sharded dedispersion + batch-sharded prep +
+    shard_map'd search, all over the same k devices) writes
+    .cand/.txtcand/.dat artifacts BYTE-identical to the 1-device run —
+    including the 6-trials-on-4-chips case, where both the trial groups
+    and the dispatch batches pad to device multiples."""
+    require_virtual_mesh(mesh_k)
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    args = ["--lodm", "0", "--dmstep", "10", "--numdms", str(numdms),
+            "-s", "8", "--group-size", "4", "--threshold", "8",
+            *HANDOFF_ARGS, "--accel-only", "--write-dats"]
+    assert cli_sweep.main([fil, "-o", "s1", *args]) == 0
+    assert cli_sweep.main([fil, "-o", "sk", *args,
+                           "--mesh", str(mesh_k)]) == 0
+    compared = 0
+    for fa in sorted(glob.glob("s1_DM*")):
+        if fa.endswith(".inf"):
+            continue  # .inf embeds the basename; parity-checked elsewhere
+        fb = "sk" + os.path.basename(fa)[2:]
+        assert os.path.exists(fb), fb
+        assert open(fa, "rb").read() == open(fb, "rb").read(), fa
+        compared += 1
+    assert compared == 3 * numdms  # .dat + .cand + .txtcand per trial
+
+
+def test_sharded_handoff_stamps_device_telemetry(tmp_path, monkeypatch):
+    """The sharded pipeline stamps device ids on its spans/counters so
+    tlmsum's per-device section can show per-chip utilization."""
+    require_virtual_mesh(2)
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+    from pypulsar_tpu.obs.summarize import load_records, summarize
+
+    assert cli_sweep.main([fil, "-o", "t", "--lodm", "0", "--dmstep",
+                           "10", "--numdms", "8", "-s", "8",
+                           "--group-size", "4", "--threshold", "8",
+                           *HANDOFF_ARGS, "--accel-only", "--mesh", "2",
+                           "--telemetry", "t.jsonl"]) == 0
+    s = summarize(load_records("t.jsonl"))
+    assert sorted(s.device_busy) and len(s.device_busy) == 2
+    for _d, (busy, nsp) in s.device_busy.items():
+        assert busy > 0 and nsp > 0
+    assert s.counters.get("device0.dedisperse.chunks", 0) >= 1
+    assert s.counters.get("device1.accel.stream_batches", 0) >= 1
+
+
+def test_lease_devices_resolver_contract():
+    """parallel.mesh.lease_devices: inside a device_lease only the
+    leased chips are addressable (and over-asking raises); outside, the
+    local device list is the pool."""
+    require_virtual_mesh(3)
+    import jax
+
+    from pypulsar_tpu.parallel import mesh as mesh_mod
+
+    local = jax.local_devices()
+    assert mesh_mod.lease_devices(2) == local[:2]
+    with mesh_mod.device_lease(local[2:3]):
+        assert mesh_mod.lease_devices() == [local[2]]
+        assert mesh_mod.lease_devices(1) == [local[2]]
+        with pytest.raises(ValueError, match="lease"):
+            mesh_mod.lease_devices(2)
+        # nesting shadows then restores
+        with mesh_mod.device_lease(local[:2]):
+            assert mesh_mod.lease_devices(2) == local[:2]
+        assert mesh_mod.lease_devices() == [local[2]]
+    assert mesh_mod.lease_devices() == local

@@ -565,6 +565,215 @@ def test_scheduler_rejects_bad_dags_and_duplicate_names(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# gang leases (multi-chip single-observation scale-out)
+# ---------------------------------------------------------------------------
+
+# cached capability gate shared with the sharded-handoff tests (same
+# pattern as test_distributed's CPU-collectives probe)
+from tests.test_accel_pipeline import require_virtual_mesh as \
+    _require_virtual_mesh
+
+
+def _gang_stub(name, deps=(), devices_max=4, body=None):
+    def run(obs, cfg):
+        if body is not None:
+            body(obs, cfg)
+        with open(f"{obs.outbase}.{name}.out", "w") as f:
+            f.write(f"{name} {obs.name}\n")
+        return 0
+
+    return StageSpec(name, "stub", True, deps, lambda o, c: [],
+                     _stub_outputs(name), run=run,
+                     devices_max=devices_max)
+
+
+def test_gang_lease_pins_k_distinct_devices(tmp_path):
+    """A gang-leased stage sees its k chips through the thread-local
+    lease (parallel.mesh.device_lease / lease_devices) — the resolver
+    every mesh-building call site goes through, so `sweep --mesh k`
+    inside the stage can only address the leased chips."""
+    _require_virtual_mesh(2)
+    import jax
+
+    from pypulsar_tpu.parallel import mesh as mesh_mod
+
+    seen = []
+
+    def body(obs, cfg):
+        lease = mesh_mod.current_lease()
+        devs = mesh_mod.lease_devices(2)
+        with _conc_lock:
+            seen.append((tuple(d.id for d in lease),
+                         tuple(d.id for d in devs)))
+
+    stages = [_gang_stub("gangdev", devices_max=2, body=body)]
+    obs = [Observation(f"o{i}", str(tmp_path / f"o{i}.raw"),
+                       str(tmp_path / f"o{i}")) for i in range(3)]
+    assert FleetScheduler(obs, SurveyConfig(), stages=stages,
+                          devices=2, gang=2).run().ok
+    assert len(seen) == 3
+    local = [d.id for d in jax.local_devices()]
+    for lease_ids, resolved_ids in seen:
+        assert len(set(lease_ids)) == 2          # two DISTINCT chips
+        assert resolved_ids == lease_ids         # resolver == the lease
+        assert set(lease_ids) <= set(local)
+
+
+def test_gang_auto_places_both_shapes(tmp_path):
+    """The placement policy demonstrably picks BOTH shapes: a deep
+    fleet stays fleet-parallel (k obs x 1 chip), a lone observation
+    widens onto the idle chips (1 obs x k chips) — and each decision is
+    recorded with its reason (survey.gang_decision)."""
+    _require_virtual_mesh(2)
+
+    def decisions(n_obs, subdir):
+        path = str(tmp_path / f"{subdir}.jsonl")
+        stages = [_gang_stub("gangable", devices_max=2)]
+        obs = [Observation(f"o{i}", str(tmp_path / f"{subdir}{i}.raw"),
+                           str(tmp_path / f"{subdir}_o{i}"))
+               for i in range(n_obs)]
+        with telemetry.session(path):
+            assert FleetScheduler(obs, SurveyConfig(), stages=stages,
+                                  devices=2, gang="auto").run().ok
+        recs = [json.loads(l) for l in open(path)]
+        return [r["attrs"] for r in recs
+                if r.get("type") == "event"
+                and r.get("name") == "survey.gang_decision"]
+
+    deep = decisions(4, "deep")
+    assert len(deep) == 4
+    # with 4 ready observations on 2 chips at least the contended
+    # decisions stay fleet-parallel, with the reason recorded
+    assert any(d["k"] == 1 and "fleet-parallel" in d["reason"]
+               for d in deep)
+    lone = decisions(1, "lone")
+    assert len(lone) == 1
+    assert lone[0]["k"] == 2 and len(lone[0]["chips"]) == 2
+    assert "idle" in lone[0]["reason"]
+
+
+def test_gang_auto_cost_gate():
+    """The measured-cost gate: a gang-able stage that owns a sliver of
+    the measured device chain runs 1-chip even with idle chips; the
+    dominant stage gangs. (Unit-level: the policy reads the same
+    per-stage costs the obs traces record.)"""
+    stages = [_gang_stub("cheap", devices_max=4),
+              _gang_stub("dominant", devices_max=4)]
+    sched = FleetScheduler(
+        [Observation("a", "a.raw", "/tmp/unused_a")],
+        SurveyConfig(), stages=stages, devices=4, gang="auto")
+    sched._stage_cost = {"cheap": [0.1, 1], "dominant": [9.9, 1]}
+    k, reason = sched._gang_size(sched._tasks[(0, "cheap")])
+    assert k == 1 and "not worth" in reason
+    k, reason = sched._gang_size(sched._tasks[(0, "dominant")])
+    assert k == 4 and "99%" in reason
+
+
+def test_gang_oversubscribed_pool_distinct_devices(tmp_path):
+    """An oversubscribed lease pool (--devices > real chips) is legal
+    for 1-chip fleet placement, but a gang mesh must hold DISTINCT
+    chips: colliding lease ids (e.g. 0 and 0+n) are bumped to free
+    devices, and auto-gang width is capped at the real device count."""
+    _require_virtual_mesh(2)
+    import jax
+    n = len(jax.local_devices())
+    sched = FleetScheduler(
+        [Observation("a", "a.raw", str(tmp_path / "a"))],
+        SurveyConfig(), stages=[_gang_stub("s", devices_max=4 * n)],
+        devices=4 * n, gang="auto")
+    # lease ids that wrap modulo n and collide: [0, n] both map to dev 0
+    gang = sched._jax_gang([0, n])
+    assert len(set(gang)) == 2
+    # a full-width gang over the whole oversubscribed pool is impossible
+    with pytest.raises(ValueError, match="distinct devices"):
+        sched._jax_gang(list(range(n + 1)))
+    # ...and the placement policy never asks for one: k caps at n
+    k, _reason = sched._gang_size(sched._tasks[(0, "s")])
+    assert k <= n
+
+
+def test_gang_acquisition_fifo_no_starvation(tmp_path):
+    """Device-pool acquisition is FIFO with reservation: a waiting wide
+    gang reserves freed chips, so 1-chip traffic cannot starve it."""
+    sched = FleetScheduler(
+        [Observation("a", "a.raw", str(tmp_path / "a"))],
+        SurveyConfig(), stages=_stub_stages(), devices=2)
+    one = sched._acquire_devices(1)
+    assert one == [0]
+    got = []
+    t = threading.Thread(target=lambda: got.append(
+        sched._acquire_devices(2)))
+    t.start()
+    time.sleep(0.05)
+    assert not got                       # gang waits: only 1 chip free
+    # a younger 1-chip claim must NOT overtake the waiting gang's
+    # reservation once the first chip frees
+    sched._release_devices(one)
+    t.join(timeout=5.0)
+    assert got and sorted(got[0]) == [0, 1]
+    sched._release_devices(got[0])
+    assert sched._acquire_devices(1) is not None
+
+
+def test_gang_lease_kill_resume_byte_identical(fleet):
+    """Kill a gang-leased fleet at the sweep completion boundary; a
+    --resume under the same gang shape completes with artifacts
+    byte-identical to the serial 1-chip chain — placement is not
+    science, so the manifest resumes across ANY gang shape."""
+    _require_virtual_mesh(4)
+    cfg = SurveyConfig(**CFG_KW)
+    outdir = str(fleet["root"] / "gangkill")
+    obs = _fleet_obs(fleet["fils"][:1], outdir)
+    faultinject.configure("kill:survey.stage_done.sweep:1")
+    with pytest.raises(faultinject.InjectedKill):
+        FleetScheduler(obs, cfg, devices=4, gang="auto").run()
+    faultinject.reset()
+    result = FleetScheduler(obs, cfg, devices=4, gang="auto",
+                            resume=True).run()
+    assert result.ok
+    assert ("psr0", "sweep") in result.ran   # the torn stage redone
+    _assert_matches_reference(fleet, outdir, stems=("psr0",))
+
+
+def test_gang_fleet_byte_identical_and_per_device_rollup(fleet):
+    """One observation spanning 4 chips end to end produces artifacts
+    byte-identical to the serial chain, and the traces carry per-chip
+    attribution tlmsum's per-device roll-up renders."""
+    _require_virtual_mesh(4)
+    from pypulsar_tpu.cli import survey as cli_survey
+    from pypulsar_tpu.obs.summarize import load_records, summarize
+
+    outdir = str(fleet["root"] / "gangfleet")
+    tlmdir = str(fleet["root"] / "gangtlm")
+    rc = cli_survey.main([fleet["fils"][0], "-o", outdir,
+                          "--devices", "4", "--gang", "4",
+                          "--telemetry-dir", tlmdir, *SURVEY_FLAGS])
+    assert rc == 0
+    _assert_matches_reference(fleet, outdir, stems=("psr0",))
+    s = summarize(load_records(os.path.join(tlmdir, "fleet.jsonl")))
+    assert s.events.get("survey.gang_decision")
+    # the sharded sweep/accel spans stamped all 4 leased chips
+    assert len(s.device_busy) == 4
+    for _d, (busy, nsp) in sorted(s.device_busy.items()):
+        assert busy > 0 and nsp > 0
+    assert s.counters.get("device0.dedisperse.chunks", 0) >= 1
+    import io
+
+    from pypulsar_tpu.obs.summarize import render
+
+    buf = io.StringIO()
+    render(s, buf)
+    assert "# per-device:" in buf.getvalue()
+    assert "device 3" in buf.getvalue()
+
+
+def test_gang_rejects_more_than_devices():
+    with pytest.raises(ValueError, match="exceeds"):
+        FleetScheduler([], SurveyConfig(), stages=_stub_stages(),
+                       devices=2, gang=4)
+
+
+# ---------------------------------------------------------------------------
 # satellites
 # ---------------------------------------------------------------------------
 
