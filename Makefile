@@ -24,10 +24,21 @@ test-fourier:
 # the resilience suite: injected OOM / IO errors / kill+resume at every
 # journal kill-point, candidate tables proven bit-identical to unfaulted
 # runs (docs/ARCHITECTURE.md "Failure model & recovery") — plus the
-# survey orchestrator's kill/resume + quarantine cases
-test-faults:
+# survey orchestrator's kill/resume/quarantine and fleet-health
+# (watchdog, device-strike, admission) cases, and the seeded chaos
+# fleet
+test-faults: test-chaos
 	$(CPU_ENV) $(PY) -m pytest tests/test_resilience.py -q
-	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -k "kill or resume or quarantine or retry"
+	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -k "kill or resume or quarantine or retry or stall or deadline or evict or admission or chaos"
+
+# the seeded chaos harness (bounded time: --quick geometry, seeded
+# spray + one armed fault per family, resumed until complete, byte
+# parity vs a clean run asserted) — the committed record is
+# CHAOS_r01.json; the pytest-scale twin is marked `slow` so tier-1
+# (-m 'not slow') stays bounded
+test-chaos:
+	$(CPU_ENV) $(PY) bench.py --chaos --quick
+	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -m slow -k chaos
 
 # the survey orchestrator suite: fleet-vs-serial byte parity, device
 # lease exclusivity / host overlap, kill+resume at every stage

@@ -86,6 +86,19 @@ def parse_args(argv=None):
                          "1-device orchestrated run. Needs that many "
                          "JAX devices (CPU recipe: XLA_FLAGS="
                          "--xla_force_host_platform_device_count=8)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run a toy fleet under seeded probabilistic "
+                         "fault chaos (kills + OOMs + IO errors + hangs "
+                         "+ device faults sprayed across every "
+                         "registered fault point), resume until it "
+                         "completes, and ASSERT byte-parity against a "
+                         "clean run — the fleet-health acceptance "
+                         "measurement (CHAOS_rXX.json)")
+    ap.add_argument("--chaos-seed", type=int, default=1,
+                    help="with --chaos: the chaos seed (default 1)")
+    ap.add_argument("--chaos-rate", type=float, default=None,
+                    help="with --chaos: per-(point,hit) fault "
+                         "probability (default 0.015, --quick 0.01)")
     ap.add_argument("--prepass", action="store_true",
                     help="benchmark the zero-DM + spectrogram + detrend "
                          "prepass (configs[1]) instead of the DM sweep")
@@ -1368,6 +1381,31 @@ def _fold_pipeline_ab(args):
             os.chdir(olddir)
 
 
+def _synth_survey_fil(fn, seed, C, T, dtp, freqs, src_name,
+                      dm=40.0, period=0.1024, amp=10.0):
+    """One synthetic pulsar filterbank for the survey/chaos harnesses
+    (shared so the two A/Bs can never drift apart on the recipe)."""
+    import numpy as np
+
+    from pypulsar_tpu.io import filterbank
+    from pypulsar_tpu.ops import numpy_ref
+
+    rng = np.random.RandomState(seed)
+    data = rng.randn(T, C).astype(np.float32) * 2.0 + 30.0
+    bins = numpy_ref.bin_delays(dm, freqs, dtp)
+    for t0 in np.arange(0.01, T * dtp, period):
+        s0 = int(t0 / dtp)
+        for c in range(C):
+            idx = s0 + bins[c]
+            if idx < T:
+                data[idx, c] += amp
+    filterbank.write_filterbank(
+        fn, dict(nchans=C, tsamp=dtp, fch1=float(freqs[0]),
+                 foff=-4.0, tstart=55000.0, nbits=32, nifs=1,
+                 source_name=src_name), data)
+    return fn
+
+
 def run_survey(args):
     """Survey-orchestrator A/B (the round-9 tentpole's acceptance
     measurement): the SAME per-observation stage chain (rfifind-mask ->
@@ -1407,20 +1445,9 @@ def run_survey(args):
     stages = build_dag(cfg)
 
     def make_obs_fil(fn, seed, dm=40.0, period=0.1024, amp=10.0):
-        rng = np.random.RandomState(seed)
-        data = rng.randn(T, C).astype(np.float32) * 2.0 + 30.0
-        bins = numpy_ref.bin_delays(dm, rng_freqs, dtp)
-        for t0 in np.arange(0.01, T * dtp, period):
-            s0 = int(t0 / dtp)
-            for c in range(C):
-                idx = s0 + bins[c]
-                if idx < T:
-                    data[idx, c] += amp
-        filterbank.write_filterbank(
-            fn, dict(nchans=C, tsamp=dtp, fch1=float(rng_freqs[0]),
-                     foff=-4.0, tstart=55000.0, nbits=32, nifs=1,
-                     source_name=f"BENCH{seed}"), data)
-        return fn
+        return _synth_survey_fil(fn, seed, C, T, dtp, rng_freqs,
+                                 f"BENCH{seed}", dm=dm, period=period,
+                                 amp=amp)
 
     def run_serial(obs_list):
         for obs in obs_list:
@@ -1643,6 +1670,208 @@ def run_survey(args):
     if args.cpu_fallback:
         record["unit"] += " [CPU FALLBACK: accelerator backend unavailable]"
     return record
+
+
+def run_chaos(args):
+    """Seeded chaos harness (the fleet-health acceptance measurement):
+    run a toy fleet CLEAN, then run the SAME fleet with
+
+    - seeded probabilistic chaos (``--fault-chaos SEED:RATE``) spraying
+      kills / OOMs / IO errors / hangs / device faults across every
+      registered fault point, and
+    - one deterministic armed fault per family on top (so every family
+      provably fires regardless of what the seed happens to draw),
+
+    resuming after every kill until the fleet completes, with the
+    watchdog (heartbeat-stall detection) turning injected hangs into
+    ordinary retryable failures. Then assert:
+
+    - a final no-chaos ``--resume`` validates everything and runs ZERO
+      stages (the manifests survived every torn window), and
+    - every artifact is byte-identical to the clean run's — recovery
+      reconstructed the exact bytes, not approximately the science.
+    """
+    acquire_backend()
+    import glob as _glob
+    import random
+    import tempfile
+
+    from pypulsar_tpu.io import filterbank
+    from pypulsar_tpu.ops import numpy_ref
+    from pypulsar_tpu.resilience import faultinject
+    from pypulsar_tpu.survey.dag import SurveyConfig, build_dag
+    from pypulsar_tpu.survey.scheduler import FleetScheduler
+    from pypulsar_tpu.survey.state import Observation
+
+    seed = args.chaos_seed
+    rate = args.chaos_rate if args.chaos_rate is not None \
+        else (0.01 if (args.quick or args.cpu_fallback) else 0.015)
+    n_obs = 3
+    stall_s = 8.0
+    max_rounds = 40
+    C, T, dtp = 32, (1 << 13 if (args.quick or args.cpu_fallback)
+                     else 1 << 14), 5e-4
+    rng_freqs = 1500.0 - 4.0 * np.arange(C)
+    cfg = SurveyConfig(
+        mask=True, mask_time=2.0, lodm=0.0, dmstep=10.0, numdms=8,
+        nsub=8, group_size=4, threshold=8.0,
+        accel_zmax=20.0, accel_numharm=2, accel_sigma=3.0, accel_batch=4,
+        sift_sigma=3.0, sift_min_hits=1, fold_nbins=32, fold_npart=8)
+    stages = build_dag(cfg)
+
+    def make_obs_fil(fn, seed_i, dm=40.0, period=0.1024, amp=10.0):
+        return _synth_survey_fil(fn, seed_i, C, T, dtp, rng_freqs,
+                                 f"CHAOS{seed_i}", dm=dm, period=period,
+                                 amp=amp)
+
+    # bound the injected hangs and a chaos-wedged prefetch consumer so
+    # the harness's wall time stays bounded even when an interrupt
+    # cannot land (a hang must outlive stall_s for the watchdog path to
+    # be the one that ends it)
+    env_save = {k: os.environ.get(k) for k in
+                ("PYPULSAR_TPU_HANG_S", "PYPULSAR_TPU_PREFETCH_TIMEOUT")}
+    os.environ["PYPULSAR_TPU_HANG_S"] = str(stall_s + 4.0)
+    os.environ["PYPULSAR_TPU_PREFETCH_TIMEOUT"] = "15"
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            fils = [make_obs_fil(os.path.join(td, f"obs{i}.fil"),
+                                 seed_i=23 + i,
+                                 period=0.1024 * (1.0 + 0.07 * i))
+                    for i in range(n_obs)]
+
+            def fleet(dirname):
+                out = os.path.join(td, dirname)
+                os.makedirs(out, exist_ok=True)
+                return [Observation(f"obs{i}", fils[i],
+                                    os.path.join(out, f"obs{i}"))
+                        for i in range(n_obs)]
+
+            # clean leg (also warms every stage's jit programs, so the
+            # chaos leg's stall detector never sees a cold compile)
+            faultinject.reset()
+            t0 = time.perf_counter()
+            clean = FleetScheduler(fleet("clean"), cfg,
+                                   max_host_workers=2, devices=1).run()
+            clean_s = time.perf_counter() - t0
+            assert clean.ok and len(clean.ran) == n_obs * len(stages)
+
+            # chaos leg: seeded spray + one guaranteed fault per family
+            # (kill in the stage_done torn window, an escaped OOM, a
+            # mid-.dat-stream IO error, an in-stage hang for the
+            # watchdog, a chip-indicting device fault)
+            faultinject.reset()
+            faultinject.configure_chaos(f"{seed}:{rate}")
+            faultinject.configure(
+                "kill:survey.stage_done:1,"
+                "oom:accel.batch_dispatch:1,"
+                "io:dats.append:2,"
+                "hang:sweep.chunk_dispatch:3,"
+                "device:fold.batch_dispatch:1")
+            rounds = kills = timeouts = retried = quarantined = 0
+            t0 = time.perf_counter()
+            result = None
+            while rounds < max_rounds:
+                rounds += 1
+                sched = FleetScheduler(
+                    fleet("chaos"), cfg, max_host_workers=2, devices=1,
+                    retries=2, resume=(rounds > 1), stall_s=stall_s,
+                    jitter_rng=random.Random(seed + rounds))
+                try:
+                    result = sched.run()
+                except faultinject.InjectedKill:
+                    kills += 1
+                    timeouts += sched.result.timeouts
+                    retried += sched.result.retried
+                    continue  # "the process died": restart + --resume
+                timeouts += result.timeouts
+                retried += result.retried
+                quarantined += len(result.quarantined)
+                if result.ok:
+                    break
+                # quarantined observations: the operator resumes them
+            chaos_s = time.perf_counter() - t0
+            fired = faultinject.fired_counts()
+            assert result is not None and result.ok, (
+                f"chaos fleet did not complete in {max_rounds} rounds "
+                f"(fired: {fired})")
+            for kind in ("kill", "oom", "io", "hang", "device"):
+                assert fired.get(kind, 0) >= 1, (
+                    f"fault family {kind!r} never fired: {fired}")
+            assert timeouts >= 1, (
+                "no watchdog interrupt fired — the injected hang was "
+                "not recovered by the deadline/stall path")
+
+            # chaos off: a final validated resume must run NOTHING
+            faultinject.reset()
+            final = FleetScheduler(fleet("chaos"), cfg,
+                                   max_host_workers=2, devices=1,
+                                   resume=True).run()
+            assert final.ok and len(final.ran) == 0, (
+                f"post-chaos manifests did not validate clean: "
+                f"{len(final.ran)} stages re-ran")
+
+            # byte-parity: the chaos run's artifacts ARE the clean
+            # run's artifacts
+            ident = tot = 0
+            diverged = []
+            for pattern in ("*_ACCEL_*.cand", "*_ACCEL_*.txtcand",
+                            "*_cand*.pfd", "*.dat"):
+                for fa in sorted(_glob.glob(os.path.join(td, "clean",
+                                                         pattern))):
+                    fb = os.path.join(td, "chaos", os.path.basename(fa))
+                    tot += 1
+                    if (os.path.exists(fb) and open(fa, "rb").read()
+                            == open(fb, "rb").read()):
+                        ident += 1
+                    else:
+                        diverged.append(os.path.basename(fa))
+            assert ident == tot and tot > 0, (
+                f"chaos artifacts diverged from clean: {ident}/{tot} "
+                f"({diverged[:8]})")
+    finally:
+        faultinject.reset()
+        for k, v in env_save.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    n_faults = sum(fired.values())
+    print(f"# chaos: seed {seed} rate {rate}: {n_faults} faults "
+          f"({', '.join(f'{k}={v}' for k, v in sorted(fired.items()))}) "
+          f"over {rounds} round(s), {kills} kill-resumes, {timeouts} "
+          f"watchdog interrupts, {retried} stage retries, {quarantined} "
+          f"quarantine verdicts — fleet completed, {ident}/{tot} "
+          f"artifacts byte-identical to clean ({clean_s:.1f}s clean, "
+          f"{chaos_s:.1f}s under chaos)", file=sys.stderr)
+    return {
+        "metric": "chaos_fleet_recovery",
+        "value": round(ident / max(tot, 1), 3),
+        "unit": (f"fraction of artifacts byte-identical to a clean run "
+                 f"after an {n_obs}-obs x {len(stages)}-stage fleet "
+                 f"survived {n_faults} injected faults (seeded chaos "
+                 f"{seed}:{rate} + one armed fault per family) via "
+                 f"watchdog-driven retries, kill-restarts with --resume "
+                 f"and quarantine-resume — asserted 1.0, plus a final "
+                 f"no-chaos resume validating 0 stages re-run"),
+        "vs_baseline": 1.0,
+        "chaos_seed": seed,
+        "chaos_rate": rate,
+        "chaos_n_obs": n_obs,
+        "chaos_n_stages": len(stages),
+        "chaos_faults_fired": fired,
+        "chaos_rounds": rounds,
+        "chaos_kill_resumes": kills,
+        "chaos_watchdog_interrupts": timeouts,
+        "chaos_stage_retries": retried,
+        "chaos_quarantine_verdicts": quarantined,
+        "chaos_stall_timeout_s": stall_s,
+        "chaos_artifacts_identical": f"{ident}/{tot}",
+        "chaos_clean_seconds": round(clean_s, 2),
+        "chaos_seconds": round(chaos_s, 2),
+        "chaos_nsamp": T,
+        "chaos_nchan": C,
+    }
 
 
 def run_waterfall(args):
@@ -1927,9 +2156,13 @@ def run_child(args, cpu: bool, timeout: float):
         if args.stream_window is not None:
             argv += ["--stream-window", str(args.stream_window)]
     for flag in ("quick", "profile", "ab", "accel", "fold", "waterfall",
-                 "prepass", "survey"):
+                 "prepass", "survey", "chaos"):
         if getattr(args, flag):
             argv.append("--" + flag)
+    if args.chaos:
+        argv += ["--chaos-seed", str(args.chaos_seed)]
+        if args.chaos_rate is not None:
+            argv += ["--chaos-rate", str(args.chaos_rate)]
     proc = subprocess.run(argv, env=env, capture_output=True, text=True,
                           timeout=timeout)
     sys.stderr.write(proc.stderr[-6000:])
@@ -1961,6 +2194,7 @@ def main():
     if (args.stream is None and not args.child
             and not (args.quick or args.ab or args.accel or args.fold
                      or args.waterfall or args.prepass or args.survey
+                     or args.chaos
                      or args.cpu_fallback or args.nsamp or args.nchan)
             and os.path.exists(DEFAULT_STREAM_FIL)):
         # the north-star workload exists on disk: measure THAT (streamed,
@@ -1991,6 +2225,8 @@ def main():
                 record = run_waterfall(args)
             elif args.survey:
                 record = run_survey(args)
+            elif args.chaos:
+                record = run_chaos(args)
             elif args.prepass:
                 record = run_prepass(args)
             elif args.stream:

@@ -355,6 +355,13 @@ def _run(args, cfg):
                         all_cands = accel_search_batch(
                             np.stack([g[1] for g in group]), T, cfg)
             except Exception as e:  # noqa: BLE001 - fall back to serial:
+                from pypulsar_tpu.resilience import health
+
+                if health.no_degrade(e):
+                    # watchdog interrupts, chip-indicting and injected
+                    # faults escalate to the caller's retry machinery
+                    # instead of degrading to the serial path
+                    raise
                 # one poison spectrum must fail alone, not take down (and,
                 # under --skip-existing restarts, permanently wedge) its
                 # whole group

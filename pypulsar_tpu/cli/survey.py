@@ -74,9 +74,38 @@ def build_parser():
                         "each decision is recorded in the fleet trace "
                         "(survey.gang_decision)")
     p.add_argument("--retries", type=int, default=1,
-                   help="bounded per-stage retries (exponential backoff) "
-                        "before the observation is quarantined "
+                   help="bounded per-stage retries (jittered exponential "
+                        "backoff) before the observation is quarantined "
                         "(default 1)")
+    g = p.add_argument_group(
+        "fleet health (deadlines, heartbeats, device strikes, admission)")
+    g.add_argument("--stall-timeout", type=float, default=None,
+                   metavar="S",
+                   help="heartbeat-silence bound: a stage recording no "
+                        "telemetry activity for S seconds is interrupted "
+                        "by the watchdog and retried/quarantined like "
+                        "any other failure (also PYPULSAR_TPU_STALL_S; "
+                        "default off)")
+    g.add_argument("--stage-deadline", type=float, default=None,
+                   metavar="S",
+                   help="uniform wall-clock deadline applied to EVERY "
+                        "stage, overriding the per-stage "
+                        "deadline_s/deadline_per_mb declarations "
+                        "(default: per-stage declarations only)")
+    g.add_argument("--strike-limit", type=int, default=None, metavar="K",
+                   help="quarantine a device lease out of the pool after "
+                        "K OOM/device-fault strikes; in-flight gangs "
+                        "retry shrunk to the surviving chips (also "
+                        "PYPULSAR_TPU_DEVICE_STRIKES; default 3)")
+    g.add_argument("--min-free-mb", type=float, default=None, metavar="MB",
+                   help="admission gate: pause launching new stages while "
+                        "free disk under --outdir is below MB (in-flight "
+                        "stages continue; also PYPULSAR_TPU_MIN_FREE_MB; "
+                        "default 32, 0 disables)")
+    g.add_argument("--max-pending", type=float, default=None, metavar="N",
+                   help="admission gate: pause launching new stages while "
+                        "any ship-ahead *.pending_depth gauge exceeds N "
+                        "(default: off)")
     p.add_argument("--telemetry-dir", default=None, metavar="DIR",
                    help="write one JSONL trace per observation plus one "
                         "fleet trace (fleet.jsonl) here; summarize "
@@ -115,17 +144,24 @@ def build_parser():
         p, what="fleet trace: per-stage spans + scheduler counters; "
                 "--telemetry-dir is the multi-trace form")
     faultinject.add_fault_flag(p)
+    faultinject.add_chaos_flag(p)
     return p
 
 
 def _status(outdir: str) -> int:
-    from pypulsar_tpu.survey.state import MANIFEST_SUFFIX, format_status, status_rows
+    from pypulsar_tpu.survey.state import (
+        MANIFEST_SUFFIX,
+        format_status,
+        read_fleet_health,
+        status_rows,
+    )
 
     paths = sorted(glob.glob(os.path.join(outdir, "*" + MANIFEST_SUFFIX)))
     if not paths:
         print(f"# no survey manifests under {outdir!r}", file=sys.stderr)
         return 1
-    print(format_status(status_rows(paths)))
+    print(format_status(status_rows(paths),
+                        health=read_fleet_health(outdir)))
     return 0
 
 
@@ -159,6 +195,12 @@ def main(argv=None):
     faultinject.configure_from_env()
     if args.fault_inject:
         faultinject.configure(args.fault_inject)
+    if args.fault_chaos:
+        try:
+            faultinject.configure_chaos(args.fault_chaos)
+        except ValueError as e:
+            print(f"survey: {e}", file=sys.stderr)
+            return 2
     os.makedirs(args.outdir, exist_ok=True)
     fleet_trace = args.telemetry
     if args.telemetry_dir:
@@ -206,7 +248,10 @@ def _run(args) -> int:
     sched = FleetScheduler(
         obs, cfg, max_host_workers=args.max_host_workers,
         devices=args.devices, retries=args.retries, resume=args.resume,
-        telemetry_dir=args.telemetry_dir, gang=gang, verbose=True)
+        telemetry_dir=args.telemetry_dir, gang=gang,
+        stall_s=args.stall_timeout, stage_deadline=args.stage_deadline,
+        strike_limit=args.strike_limit, min_free_mb=args.min_free_mb,
+        max_pending=args.max_pending, verbose=True)
     result = sched.run()
     n_stages = len(sched.stages)
     print(f"# survey: {len(obs)} observations x {n_stages} stages in "
@@ -214,6 +259,14 @@ def _run(args) -> int:
           f"{len(result.skipped)} skipped (validated), "
           f"{result.retried} retried, "
           f"{len(result.quarantined)} observations quarantined")
+    if result.timeouts:
+        print(f"#   watchdog interrupts: {result.timeouts} "
+              f"(deadline/stall; see survey.deadline_exceeded / "
+              f"survey.stage_stalled events in the traces)")
+    if result.evicted_devices:
+        print(f"#   device leases QUARANTINED mid-fleet: "
+              f"{sorted(result.evicted_devices)} (see "
+              f"_fleet_health.json / survey --status)")
     for name, q in sorted(result.quarantined.items()):
         print(f"#   QUARANTINED {name} at {q['stage']}: {q['error']}")
     if not result.ok:

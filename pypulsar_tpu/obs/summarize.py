@@ -262,7 +262,28 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
             if cs:
                 line += "  " + "  ".join(
                     f"{k}={_fmt_count(v)}" for k, v in sorted(cs.items()))
+            if cs.get("quarantined"):
+                # the chip-health verdict, spelled out: strikes past the
+                # limit evicted this lease from the pool mid-fleet
+                line += "  [QUARANTINED]"
             p(line)
+    health_bits = []
+    for key, label in (("survey.watchdog_interrupts", "watchdog interrupts"),
+                       ("survey.admission_pauses", "admission pauses"),
+                       ("resilience.faults_injected", "injected faults")):
+        v = s.counters.get(key)
+        if v:
+            health_bits.append(f"{label}={_fmt_count(v)}")
+    for key, label in (("survey.deadline_exceeded", "deadlines exceeded"),
+                       ("survey.stage_stalled", "stalls"),
+                       ("mesh.device_strike", "device strikes"),
+                       ("mesh.device_quarantined", "devices quarantined"),
+                       ("survey.device_evicted", "lease evictions")):
+        n = s.events.get(key)
+        if n:
+            health_bits.append(f"{label}={n}")
+    if health_bits:
+        p("#\n# fleet health: " + "  ".join(health_bits))
     if s.last_device is not None:
         p(f"#\n# device snapshot ({s.last_device.get('tag', '?')}):")
         for d in s.last_device.get("devices", []):

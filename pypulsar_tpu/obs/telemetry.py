@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional
 
 __all__ = [
     "Telemetry",
+    "add_activity_hook",
     "counter",
     "current",
     "device_snapshot",
@@ -50,12 +51,44 @@ __all__ = [
     "gauge",
     "is_active",
     "record_span",
+    "remove_activity_hook",
     "session",
     "session_from_flag",
     "span",
 ]
 
 _session: Optional["Telemetry"] = None  # None = inactive (the one branch)
+
+# liveness hooks: zero-arg callables fired on every span entry / counter
+# bump / gauge / event, REGARDLESS of whether a session is active — the
+# survey watchdog's heartbeat channel (resilience.health): a stage that
+# is making progress is a stage that is recording telemetry, so the
+# instrumentation the hot paths already carry doubles as the liveness
+# signal. Empty list (the default) costs one truthiness check.
+_activity_hooks: List[Any] = []
+
+
+def add_activity_hook(fn) -> None:
+    """Register a zero-arg callable fired on every telemetry entry
+    point (spans, counters, gauges, events), active session or not.
+    Hooks must be cheap and never raise (exceptions are swallowed)."""
+    if fn not in _activity_hooks:
+        _activity_hooks.append(fn)
+
+
+def remove_activity_hook(fn) -> None:
+    try:
+        _activity_hooks.remove(fn)
+    except ValueError:
+        pass
+
+
+def _notify_activity() -> None:
+    for fn in tuple(_activity_hooks):
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 - liveness must never break work
+            pass
 
 SCHEMA_VERSION = 1
 
@@ -325,6 +358,8 @@ def span(name: str, *, aggregate: bool = True, **attrs):
     double-count the nested wall time and break the non-overlapping
     accounting ``stage_report``'s ``(untracked)`` line and tlmsum's
     percentages rely on."""
+    if _activity_hooks:
+        _notify_activity()
     if _session is None:
         return _NULL_SPAN
     return _live_span(name, attrs, aggregate)
@@ -363,6 +398,8 @@ def record_span(name: str, seconds: float) -> None:
 
 def counter(name: str, inc: float = 1) -> None:
     """Add ``inc`` to the monotonic counter ``name`` (no-op inactive)."""
+    if _activity_hooks:
+        _notify_activity()
     s = _session
     if s is None:
         return
@@ -372,6 +409,8 @@ def counter(name: str, inc: float = 1) -> None:
 
 def gauge(name: str, value: float) -> None:
     """Record an instantaneous level; the session keeps last and max."""
+    if _activity_hooks:
+        _notify_activity()
     s = _session
     if s is None:
         return
@@ -388,6 +427,8 @@ def gauge(name: str, value: float) -> None:
 def event(name: str, **attrs) -> None:
     """One-shot record (e.g. a serial-fallback, a per-chunk milestone):
     counted in the session and appended to the sink with attributes."""
+    if _activity_hooks:
+        _notify_activity()
     s = _session
     if s is None:
         return

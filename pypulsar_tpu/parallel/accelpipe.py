@@ -45,7 +45,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from pypulsar_tpu.obs import telemetry
-from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.resilience import faultinject, health
 from pypulsar_tpu.resilience.journal import RunJournal, candfile_complete
 from pypulsar_tpu.resilience.retry import halving_dispatch
 
@@ -447,6 +447,11 @@ def sweep_accel_stream(
                     # below stops at the real trials
                     all_cands = search_halved(payload, n_padded)
             except Exception as e:  # noqa: BLE001 - poison-spectrum
+                if health.no_degrade(e):
+                    # watchdog interrupts, chip-indicting and injected
+                    # faults escalate to the stage retry (lease
+                    # reclaim / device strike) instead of degrading
+                    raise
                 # contract of the batched CLI: degrade to per-spectrum
                 # serial host-prep searches, never fail the whole batch
                 fallbacks += 1
@@ -474,6 +479,8 @@ def sweep_accel_stream(
                                     schedule)[0],
                                 T_sec, config))
                         except Exception as e1:  # noqa: BLE001
+                            if health.no_degrade(e1):
+                                raise  # see the batch handler above
                             all_cands.append(None)
                             n_failed += 1
                             print(f"# trial DM{dms[i]:.2f} FAILED "

@@ -48,7 +48,7 @@ import numpy as np
 
 from pypulsar_tpu.core import psrmath
 from pypulsar_tpu.obs import telemetry
-from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.resilience import faultinject, health
 from pypulsar_tpu.resilience.journal import RunJournal
 from pypulsar_tpu.resilience.retry import halving_dispatch
 
@@ -552,6 +552,11 @@ def fold_pipeline(
             dm, series, dt, meta, members = group
             K = len(members)
             if prep_err is not None:
+                if health.no_degrade(prep_err):
+                    # injected/chip-indicting prep failures escalate to
+                    # the stage retry: marking the group failed would
+                    # record the stage done MINUS its archives
+                    raise prep_err
                 summary["n_failed"] += K
                 telemetry.event("fold.group_prep_failed", dm=dm, n=K,
                                 error=type(prep_err).__name__)
@@ -593,6 +598,13 @@ def fold_pipeline(
                     chi2 = (np.concatenate([p[2][1] for p in parts])
                             if refine else None)
                 except Exception as e:  # noqa: BLE001 - degrade, don't die
+                    if health.no_degrade(e):
+                        # a watchdog interrupt, chip-indicting or
+                        # injected fault: the retry machinery owns this
+                        # (lease reclaim, device strike, on-device
+                        # retry — the twin's floats are not
+                        # byte-identical to the device fold)
+                        raise
                     summary["numpy_fallbacks"] += 1
                     telemetry.counter("fold.numpy_fallbacks")
                     telemetry.event("fold.numpy_fallback", dm=dm, n=K,

@@ -14,6 +14,18 @@ observations would silently build meshes over the SAME chips 0..k-1.
 through (the active lease first, then the thread's ``jax.default_device``
 as the root of the local-device ring, then plain ``jax.local_devices()``),
 so a mesh built inside a lease can only address the leased chips.
+
+The lease registry also carries **device health** (round 12): a
+process-global :class:`~pypulsar_tpu.resilience.health.DeviceHealth`
+strike account (:func:`device_health`), keyed by REAL jax device ids.
+The survey scheduler shares this account (``reset_device_health`` per
+fleet) and charges OOMs, collective failures and injected device
+faults against the real chips the failing execution was pinned to; a
+chip past ``PYPULSAR_TPU_DEVICE_STRIKES`` is quarantined, the
+scheduler evicts every lease mapping to it from the pool mid-fleet
+(in-flight gangs retry shrunk to the surviving chips), and the
+non-leased resolver path here skips quarantined chips
+(:func:`healthy_devices`).
 """
 
 from __future__ import annotations
@@ -27,7 +39,35 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
+from pypulsar_tpu.resilience.health import DeviceHealth
+
 _tls = threading.local()
+
+# process-global strike account, keyed by device/lease id; reset per
+# fleet by the survey scheduler (and per test via reset_device_health)
+_device_health = DeviceHealth()
+
+
+def device_health() -> DeviceHealth:
+    """The process-global per-device strike/quarantine registry."""
+    return _device_health
+
+
+def reset_device_health(limit: Optional[int] = None) -> DeviceHealth:
+    """Fresh strike account (new fleet / test isolation); ``limit``
+    overrides ``PYPULSAR_TPU_DEVICE_STRIKES``."""
+    global _device_health
+    _device_health = DeviceHealth(limit)
+    return _device_health
+
+
+def healthy_devices(devices) -> list:
+    """``devices`` minus the quarantined ones — unless that empties the
+    list (an all-quarantined host must stay usable: degraded beats
+    dead)."""
+    kept = [d for d in devices
+            if not _device_health.is_quarantined(int(getattr(d, "id", -1)))]
+    return kept if kept else list(devices)
 
 
 @contextlib.contextmanager
@@ -67,9 +107,11 @@ def lease_devices(k: Optional[int] = None) -> list:
     addressable — a gang must never silently spill past its lease."""
     lease = current_lease()
     if lease:
+        # a lease is the scheduler's verdict: it already excluded
+        # quarantined chips, so the gang is taken as granted
         devs = list(lease)
     else:
-        devs = list(jax.local_devices())
+        devs = healthy_devices(jax.local_devices())
         default = None
         try:
             default = jax.config.jax_default_device

@@ -13,18 +13,36 @@ survey pass. The submodules divide the problem:
 - :mod:`~pypulsar_tpu.resilience.faultinject` — deterministic, named
   fault points (env/CLI-armed) that make every recovery path above
   testable down to byte-identical candidate tables
-  (``tests/test_resilience.py``, ``make test-faults``).
+  (``tests/test_resilience.py``, ``make test-faults``), plus the seeded
+  probabilistic chaos mode ``bench.py --chaos`` drives;
+- :mod:`~pypulsar_tpu.resilience.health` — the fleet health layer:
+  stage heartbeats + deadlines with a watchdog that interrupts wedged
+  workers, per-device strike/quarantine accounting, and the
+  disk/backpressure admission gate the survey scheduler consults.
 
 The failure model itself (what is retried, what is journaled, what is
 fatal) is documented in docs/ARCHITECTURE.md "Failure model & recovery".
 """
 
 from pypulsar_tpu.resilience.faultinject import (  # noqa: F401
+    InjectedDeviceFault,
     InjectedFault,
     InjectedIOError,
     InjectedKill,
     InjectedOOM,
     trip,
+)
+from pypulsar_tpu.resilience.health import (  # noqa: F401
+    DeviceHealth,
+    HeartbeatRegistry,
+    ResourceGuard,
+    StageDeadlineExceeded,
+    StageStalled,
+    StageTimeout,
+    Watchdog,
+    is_device_fault,
+    must_propagate,
+    no_degrade,
 )
 from pypulsar_tpu.resilience.journal import (  # noqa: F401
     RunJournal,

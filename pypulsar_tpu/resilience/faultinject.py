@@ -17,7 +17,14 @@ in the pipeline:
   ``except Exception`` recovery code cannot swallow it, so it unwinds the
   run like a SIGINT) at the Nth hit of a kill point;
 - ``exit`` — ``os._exit(137)``: the true SIGKILL-equivalent (no finally
-  blocks, no atexit, no flushing) for subprocess-based tests.
+  blocks, no atexit, no flushing) for subprocess-based tests;
+- ``hang`` — stop making progress: sleep in small interruptible
+  increments (so the survey watchdog's async interrupt can land between
+  bytecodes) for up to ``PYPULSAR_TPU_HANG_S`` seconds (default 30 —
+  the bound keeps an UNwatched hang from wedging a test run forever);
+- ``device`` — raise :class:`InjectedDeviceFault`: a chip-indicting
+  failure (``resilience.health.is_device_fault``) that feeds the
+  device strike/quarantine accounting.
 
 Spec grammar (``PYPULSAR_TPU_FAULTS`` env var or the CLIs'
 ``--fault-inject``)::
@@ -30,6 +37,16 @@ each armed fault fires exactly once. Instrumented points call
 :func:`trip` — a no-op single dict check when nothing is armed, so the
 hooks are free in production.
 
+**Chaos mode** (``--fault-chaos`` / ``PYPULSAR_TPU_CHAOS``) is the
+probabilistic complement: ``SEED:RATE[:kind+kind...]`` sprays faults
+across ALL registered points. Each decision is a pure hash of
+``(seed, point, cumulative hit index)`` — deterministic per (point, hit)
+no matter how threads interleave, yet different on every retry of the
+same point (the hit index keeps counting), so a chaos fleet that
+resumes long enough always completes. ``exit`` is excluded from the
+chaos kinds: the harness asserting recovery must survive its own
+faults. ``bench.py --chaos`` is the committed harness over this mode.
+
 Every firing emits a ``resilience.fault_injected`` telemetry event, so a
 fault-injection run's trace shows both the failure and the recovery it
 provoked.
@@ -37,19 +54,26 @@ provoked.
 
 from __future__ import annotations
 
+import hashlib
 import os
+import time
 from typing import Dict, Optional, Tuple
 
 from pypulsar_tpu.obs import telemetry
 
 __all__ = [
+    "InjectedDeviceFault",
     "InjectedFault",
     "InjectedIOError",
     "InjectedKill",
     "InjectedOOM",
+    "add_chaos_flag",
     "add_fault_flag",
+    "chaos_active",
     "configure",
+    "configure_chaos",
     "configure_from_env",
+    "fired_counts",
     "hits",
     "is_armed",
     "reset",
@@ -57,8 +81,14 @@ __all__ = [
 ]
 
 ENV_FAULTS = "PYPULSAR_TPU_FAULTS"
+ENV_CHAOS = "PYPULSAR_TPU_CHAOS"
+ENV_HANG_S = "PYPULSAR_TPU_HANG_S"
 
-KINDS = ("oom", "io", "kill", "exit")
+KINDS = ("oom", "io", "kill", "exit", "hang", "device")
+
+# chaos never draws `exit`: os._exit would kill the very harness that
+# must resume the fleet and assert parity
+CHAOS_KINDS = ("oom", "io", "kill", "hang", "device")
 
 
 class InjectedFault:
@@ -92,9 +122,27 @@ class InjectedKill(InjectedFault, BaseException):
         super().__init__(f"injected kill at {point!r}")
 
 
+class InjectedDeviceFault(InjectedFault, RuntimeError):
+    """A chip-indicting failure (dead device, failed collective): the
+    message carries DEVICE_FAULT so ``resilience.health.is_device_fault``
+    classifies it like the real thing and the survey scheduler charges a
+    strike against the leased chip(s)."""
+
+    def __init__(self, point: str):
+        super().__init__(
+            f"DEVICE_FAULT: injected device failure at {point!r}")
+
+
 # (kind, point) -> 1-based hit index at which to fire (popped once fired)
 _armed: Dict[Tuple[str, str], int] = {}
 _hits: Dict[str, int] = {}
+
+# chaos mode: None, or (seed, rate, kinds tuple)
+_chaos: Optional[Tuple[int, float, Tuple[str, ...]]] = None
+
+# kind -> times fired (armed + chaos): the chaos harness's receipt that
+# every fault family it claims to have survived actually fired
+_fired: Dict[str, int] = {}
 
 
 def parse_spec(spec: str) -> Dict[Tuple[str, str], int]:
@@ -125,34 +173,90 @@ def parse_spec(spec: str) -> Dict[Tuple[str, str], int]:
 
 
 def configure(spec: Optional[str]) -> None:
-    """Arm the faults in ``spec`` (replacing any armed set); None or an
-    empty string clears everything."""
-    reset()
+    """Arm the faults in ``spec`` (replacing any armed set and zeroing
+    the hit/fired counters); None or an empty string clears the armed
+    set. Chaos mode is configured independently (:func:`configure_chaos`)
+    and survives — only :func:`reset` clears both, so arming a
+    deterministic fault on top of an active chaos spray composes instead
+    of silently disarming it."""
+    _armed.clear()
+    _hits.clear()
+    _fired.clear()
     if spec:
         _armed.update(parse_spec(spec))
+
+
+def parse_chaos_spec(spec: str) -> Tuple[int, float, Tuple[str, ...]]:
+    """Parse ``SEED:RATE[:kind+kind...]``; raises ValueError on a
+    malformed spec (same loud contract as :func:`parse_spec`)."""
+    fields = spec.split(":")
+    if len(fields) not in (2, 3):
+        raise ValueError(f"bad chaos spec {spec!r}; expected "
+                         f"SEED:RATE[:kind+kind...]")
+    seed = int(fields[0])
+    rate = float(fields[1])
+    if not 0.0 <= rate <= 1.0:
+        raise ValueError(f"chaos rate must be in [0, 1]; got {rate}")
+    kinds = CHAOS_KINDS
+    if len(fields) == 3 and fields[2]:
+        kinds = tuple(k.strip() for k in fields[2].split("+") if k.strip())
+        for k in kinds:
+            if k not in CHAOS_KINDS:
+                raise ValueError(f"unknown chaos kind {k!r}; expected "
+                                 f"some of {CHAOS_KINDS}")
+    return seed, rate, kinds
+
+
+def configure_chaos(spec: Optional[str]) -> None:
+    """Arm (or, with None/empty, disarm) seeded probabilistic chaos:
+    every :func:`trip` point rolls ``hash(seed, point, hit)`` against
+    ``rate`` and fires a hash-chosen kind on success. Composes with the
+    deterministic armed set (which wins at its exact (point, N))."""
+    global _chaos
+    _chaos = parse_chaos_spec(spec) if spec else None
 
 
 def configure_from_env() -> None:
-    """Arm faults from ``PYPULSAR_TPU_FAULTS`` (the subprocess-test
-    channel; unset leaves the armed set alone so a CLI flag survives)."""
+    """Arm faults from ``PYPULSAR_TPU_FAULTS`` and chaos from
+    ``PYPULSAR_TPU_CHAOS`` (the subprocess-test channels; unset leaves
+    the armed set alone so a CLI flag survives)."""
     spec = os.environ.get(ENV_FAULTS)
     if spec:
         _armed.update(parse_spec(spec))
+    chaos = os.environ.get(ENV_CHAOS)
+    if chaos:
+        configure_chaos(chaos)
 
 
 def reset() -> None:
-    """Clear armed faults and hit counters (test isolation)."""
+    """Clear armed faults, chaos mode, hit and fired counters (test
+    isolation)."""
+    global _chaos
     _armed.clear()
     _hits.clear()
+    _fired.clear()
+    _chaos = None
 
 
 def is_armed() -> bool:
     return bool(_armed)
 
 
+def chaos_active() -> bool:
+    return _chaos is not None
+
+
 def hits(point: str) -> int:
     """How many times ``point`` has tripped (diagnostics/tests)."""
     return _hits.get(point, 0)
+
+
+def fired_counts() -> Dict[str, int]:
+    """``{kind: times fired}`` since the last :func:`reset` — armed and
+    chaos firings combined. The chaos harness's receipt: a run that
+    claims to have survived kills, OOMs, IO errors, hangs and device
+    faults proves each family actually fired."""
+    return dict(_fired)
 
 
 def add_fault_flag(parser):
@@ -161,18 +265,80 @@ def add_fault_flag(parser):
     parser.add_argument(
         "--fault-inject", default=None, metavar="SPEC",
         help="arm deterministic faults for resilience testing: "
-             "kind:point[:N],... with kinds oom|io|kill|exit (e.g. "
-             "oom:accel.batch_dispatch:2 injects a device OOM on the "
-             "2nd batched accel dispatch); also via the "
+             "kind:point[:N],... with kinds oom|io|kill|exit|hang|device "
+             "(e.g. oom:accel.batch_dispatch:2 injects a device OOM on "
+             "the 2nd batched accel dispatch); also via the "
              f"{ENV_FAULTS} env var")
     return parser
 
 
+def add_chaos_flag(parser):
+    """Install the shared ``--fault-chaos`` CLI option (the seeded
+    probabilistic mode; see module docstring)."""
+    parser.add_argument(
+        "--fault-chaos", default=None, metavar="SEED:RATE[:KINDS]",
+        help="spray seeded probabilistic faults across every registered "
+             "fault point: each (point, hit) rolls hash(seed, point, "
+             "hit) against RATE and fires a hash-chosen kind (from "
+             "oom|io|kill|hang|device, or the +-separated KINDS "
+             "subset); deterministic per seed, fresh on every retry; "
+             f"also via the {ENV_CHAOS} env var")
+    return parser
+
+
+def _hang(point: str) -> None:
+    """Stop making progress, interruptibly: sleep in 50 ms slices so an
+    async watchdog interrupt lands between bytecodes (one long
+    ``sleep`` would pin the exception until it returned), bounded by
+    ``PYPULSAR_TPU_HANG_S`` so an unwatched hang ends on its own."""
+    try:
+        bound = float(os.environ.get(ENV_HANG_S, "") or 30.0)
+    except ValueError:
+        bound = 30.0
+    deadline = time.monotonic() + bound
+    while time.monotonic() < deadline:
+        time.sleep(0.05)
+
+
+def _fire(kind: str, point: str, n: int, mode: str) -> None:
+    _fired[kind] = _fired.get(kind, 0) + 1
+    telemetry.counter("resilience.faults_injected")
+    telemetry.event("resilience.fault_injected", kind=kind, point=point,
+                    hit=n, mode=mode)
+    if kind == "oom":
+        raise InjectedOOM(point)
+    if kind == "io":
+        raise InjectedIOError(point)
+    if kind == "kill":
+        raise InjectedKill(point)
+    if kind == "device":
+        raise InjectedDeviceFault(point)
+    if kind == "hang":
+        _hang(point)
+        return
+    os._exit(137)  # "exit": SIGKILL-equivalent, no cleanup at all
+
+
+def _chaos_roll(point: str, n: int) -> Optional[str]:
+    """The chaos decision for the Nth hit of ``point``: None, or the
+    kind to fire. A pure function of (seed, point, n) — thread
+    interleaving cannot change any individual decision, and the
+    cumulative hit index means a REDONE unit re-rolls fresh instead of
+    replaying the same fault forever."""
+    seed, rate, kinds = _chaos
+    h = hashlib.sha256(f"{seed}:{point}:{n}".encode()).digest()
+    u = int.from_bytes(h[:8], "big") / float(1 << 64)
+    if u >= rate:
+        return None
+    return kinds[int.from_bytes(h[8:12], "big") % len(kinds)]
+
+
 def trip(point: str) -> None:
     """Hook call at an instrumented point: fire the armed fault for this
-    point when its 1-based hit index is reached, else no-op. The
-    nothing-armed fast path is one dict truthiness check."""
-    if not _armed:
+    point when its 1-based hit index is reached (or, in chaos mode, on
+    a seeded roll), else no-op. The nothing-armed fast path is two
+    truthiness checks."""
+    if not _armed and _chaos is None:
         return
     n = _hits.get(point, 0) + 1
     _hits[point] = n
@@ -180,13 +346,9 @@ def trip(point: str) -> None:
         key = (kind, point)
         if _armed.get(key) == n:
             del _armed[key]
-            telemetry.counter("resilience.faults_injected")
-            telemetry.event("resilience.fault_injected", kind=kind,
-                            point=point, hit=n)
-            if kind == "oom":
-                raise InjectedOOM(point)
-            if kind == "io":
-                raise InjectedIOError(point)
-            if kind == "kill":
-                raise InjectedKill(point)
-            os._exit(137)  # "exit": SIGKILL-equivalent, no cleanup at all
+            _fire(kind, point, n, "armed")
+            return
+    if _chaos is not None:
+        kind = _chaos_roll(point, n)
+        if kind is not None:
+            _fire(kind, point, n, "chaos")

@@ -1,0 +1,439 @@
+"""Fleet health primitives: heartbeats, deadlines, watchdog interrupts,
+device strike accounting, resource admission.
+
+PRs 3/5/6 made single *failures* survivable; this module covers the
+failures that never raise at all — the ones a long-running survey daemon
+(ROADMAP "service mode") meets first:
+
+- a **wedged stage** holds its device lease forever. Stages emit
+  *heartbeats* as a side effect of the telemetry they already record
+  (``obs.telemetry`` activity hooks, see
+  :func:`telemetry.add_activity_hook`): every span entry, counter bump
+  or event fired on the stage's thread refreshes its
+  :class:`HeartbeatRegistry` entry. A scheduler-side :class:`Watchdog`
+  thread interrupts the stage worker — via
+  :func:`interrupt_thread`, the async-exception channel, raising
+  :class:`StageDeadlineExceeded` / :class:`StageStalled` (ordinary
+  Exceptions) — when the stage outruns its declared deadline or stops
+  heartbeating, so a hung stage becomes just another retryable fault
+  for the existing retry -> quarantine policy;
+- a **flaky chip** fails gang after gang with no memory of its strikes.
+  :class:`DeviceHealth` counts strikes per device (OOMs, collective
+  failures, injected device faults — :func:`is_device_fault`) and
+  quarantines a device past ``PYPULSAR_TPU_DEVICE_STRIKES`` (default
+  3); the survey scheduler evicts it from the lease pool mid-fleet and
+  retries in-flight gangs shrunk to the surviving chips (placement is
+  excluded from fingerprints, so artifacts stay byte-identical at the
+  new width);
+- a **full disk / saturated pipeline** crashes mid-write instead of
+  waiting. :class:`ResourceGuard` is the admission gate the scheduler
+  consults before launching new work: low free disk under the artifact
+  root (``PYPULSAR_TPU_MIN_FREE_MB``) or a ship-ahead
+  ``*.pending_depth`` gauge past its bound pauses *scheduling*, never
+  the work already in flight.
+
+Everything here is dependency-light (no jax import): the survey
+scheduler, ``parallel/mesh.py`` and the tests share one implementation.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from pypulsar_tpu.obs import telemetry
+
+__all__ = [
+    "DeviceHealth",
+    "HeartbeatEntry",
+    "HeartbeatRegistry",
+    "ResourceGuard",
+    "StageDeadlineExceeded",
+    "StageStalled",
+    "StageTimeout",
+    "Watchdog",
+    "interrupt_thread",
+    "is_device_fault",
+    "must_propagate",
+    "no_degrade",
+]
+
+# strikes before a device is quarantined out of the lease pool
+ENV_DEVICE_STRIKES = "PYPULSAR_TPU_DEVICE_STRIKES"
+DEFAULT_DEVICE_STRIKES = 3
+
+# heartbeat-silence timeout (seconds) applied to every survey stage when
+# the CLI/env does not set one explicitly; unset = stall detection off
+ENV_STALL_S = "PYPULSAR_TPU_STALL_S"
+
+# admission-gate floor for free disk under the artifact root, in MB
+# (0 disables the check)
+ENV_MIN_FREE_MB = "PYPULSAR_TPU_MIN_FREE_MB"
+DEFAULT_MIN_FREE_MB = 32.0
+
+
+def env_float(name: str, default: Optional[float]) -> Optional[float]:
+    """Float env knob; unset/empty/garbage -> ``default`` (a typo'd
+    knob must never abort a fleet)."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+class StageTimeout(RuntimeError):
+    """Base of the watchdog's interrupts. An ordinary Exception BY
+    DESIGN: the scheduler's bounded retry -> quarantine policy owns a
+    hung stage exactly like any other stage failure."""
+
+
+class StageDeadlineExceeded(StageTimeout):
+    """The stage outran its declared wall-clock deadline."""
+
+
+class StageStalled(StageTimeout):
+    """The stage stopped heartbeating for longer than the stall bound."""
+
+
+def interrupt_thread(thread_id: int, exc_type: type) -> bool:
+    """Raise ``exc_type`` asynchronously in the thread ``thread_id``
+    (CPython's ``PyThreadState_SetAsyncExc``). The exception lands at
+    the thread's next bytecode boundary — which is why the injected
+    ``hang`` fault sleeps in small increments instead of one long
+    ``sleep``. Returns False when the thread is gone (raced with
+    completion); a result > 1 means the interpreter refused and the
+    request is withdrawn."""
+    res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(thread_id), ctypes.py_object(exc_type))
+    if res > 1:  # pragma: no cover - interpreter refused: undo
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(thread_id), None)
+        return False
+    return res == 1
+
+
+class HeartbeatEntry:
+    """One running stage's liveness record (created by
+    :meth:`HeartbeatRegistry.start`)."""
+
+    __slots__ = ("label", "thread_id", "started", "deadline_s",
+                 "stall_s", "last_beat", "fired", "payload")
+
+    def __init__(self, label: str, thread_id: int,
+                 deadline_s: Optional[float], stall_s: Optional[float],
+                 payload=None):
+        now = time.monotonic()
+        self.label = label
+        self.thread_id = thread_id
+        self.deadline_s = deadline_s
+        self.stall_s = stall_s
+        self.started = now
+        self.last_beat = now
+        self.fired = False  # the watchdog interrupts an entry ONCE
+        self.payload = payload
+
+
+class HeartbeatRegistry:
+    """Thread-safe registry of running stages. ``beat_thread`` is the
+    hot path (called from the telemetry activity hook on every span
+    entry / counter bump): one dict get + one float store, no lock —
+    heartbeats may be arbitrarily slightly stale, the watchdog's poll
+    interval dwarfs any race window.
+
+    Liveness is attributed PER THREAD: telemetry recorded by a stage's
+    helper threads (prefetch producers) beats those threads, not the
+    stage's entry, and jit compilation records nothing at all — so a
+    stall bound must exceed the stage's longest legitimately silent
+    window. A false stall costs one retry (ordinary Exception into the
+    retry -> quarantine policy), never artifacts."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[int, HeartbeatEntry] = {}  # id(entry) keyed
+        self._by_thread: Dict[int, HeartbeatEntry] = {}
+
+    def start(self, label: str, *, thread_id: Optional[int] = None,
+              deadline_s: Optional[float] = None,
+              stall_s: Optional[float] = None,
+              payload=None) -> HeartbeatEntry:
+        tid = thread_id if thread_id is not None else threading.get_ident()
+        entry = HeartbeatEntry(label, tid, deadline_s, stall_s, payload)
+        with self._lock:
+            self._entries[id(entry)] = entry
+            self._by_thread[tid] = entry
+        return entry
+
+    def beat_thread(self, thread_id: Optional[int] = None) -> None:
+        tid = thread_id if thread_id is not None else threading.get_ident()
+        entry = self._by_thread.get(tid)
+        if entry is not None:
+            entry.last_beat = time.monotonic()
+
+    def finish(self, entry: HeartbeatEntry) -> None:
+        with self._lock:
+            self._entries.pop(id(entry), None)
+            if self._by_thread.get(entry.thread_id) is entry:
+                del self._by_thread[entry.thread_id]
+
+    def active(self) -> List[HeartbeatEntry]:
+        with self._lock:
+            return list(self._entries.values())
+
+    def is_active(self, entry: HeartbeatEntry) -> bool:
+        """True while ``entry`` has not been finished — the check a
+        watchdog must make immediately before an async interrupt, so a
+        stage that completed since :meth:`expired` is never shot at."""
+        with self._lock:
+            return id(entry) in self._entries
+
+    def expired(self, now: Optional[float] = None) \
+            -> List[Tuple[HeartbeatEntry, str]]:
+        """Entries past their deadline ('deadline') or heartbeat-silent
+        past their stall bound ('stall'), each returned AT MOST ONCE
+        (marked fired) — the watchdog must not re-interrupt a stage
+        that is already unwinding."""
+        now = time.monotonic() if now is None else now
+        out: List[Tuple[HeartbeatEntry, str]] = []
+        with self._lock:
+            for entry in self._entries.values():
+                if entry.fired:
+                    continue
+                if entry.deadline_s is not None \
+                        and now - entry.started > entry.deadline_s:
+                    entry.fired = True
+                    out.append((entry, "deadline"))
+                elif entry.stall_s is not None \
+                        and now - entry.last_beat > entry.stall_s:
+                    entry.fired = True
+                    out.append((entry, "stall"))
+        return out
+
+
+class Watchdog:
+    """Scheduler-side liveness poller: every ``interval`` seconds, hand
+    each newly expired :class:`HeartbeatRegistry` entry to
+    ``on_expire(entry, reason)`` (the scheduler's callback emits the
+    telemetry verdict and interrupts the stage's worker thread). A
+    daemon thread: a fleet that unwinds abruptly must not block on
+    it."""
+
+    def __init__(self, registry: HeartbeatRegistry,
+                 on_expire: Callable[[HeartbeatEntry, str], None],
+                 interval: float = 0.05):
+        self.registry = registry
+        self.interval = interval
+        self._on_expire = on_expire
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop,
+                                        name="survey-watchdog",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            for entry, reason in self.registry.expired():
+                try:
+                    self._on_expire(entry, reason)
+                except Exception:  # noqa: BLE001 - watchdog never dies
+                    pass
+
+
+# -- device health -----------------------------------------------------------
+
+
+def is_device_fault(e: BaseException) -> bool:
+    """True for a failure that indicts the DEVICE rather than the work:
+    an injected device fault, or an XLA/runtime message that names a
+    dead chip, a failed collective or a wedged transfer. Deliberately
+    narrow — an OOM is accounted separately (``retry.is_oom_error``),
+    and an ordinary pipeline exception must never cost a chip a
+    strike."""
+    from pypulsar_tpu.resilience import faultinject
+
+    if isinstance(e, faultinject.InjectedDeviceFault):
+        return True
+    if not isinstance(e, Exception):
+        return False
+    msg = str(e)
+    return any(pat in msg for pat in (
+        "DEVICE_FAULT", "device failure", "failed to execute replicated",
+        "collective operation", "NCCL", "slice_index",
+        "failed to enqueue", "Device or resource busy"))
+
+
+def must_propagate(e: BaseException) -> bool:
+    """True for failures that in-pipeline degradation handlers (serial
+    fallbacks, NumPy twins, skip-this-item loops) must RE-RAISE instead
+    of absorbing:
+
+    - a :class:`StageTimeout` — the watchdog already charged the
+      verdict and the scheduler is reclaiming the lease; a handler that
+      swallows the interrupt leaves a condemned stage running (and a
+      per-item handler would silently drop the item's artifacts from a
+      stage then recorded done);
+    - a chip-indicting fault (:func:`is_device_fault`) — degrading
+      in-place hides the strike from the device-health accounting and
+      keeps dispatching to a chip that should be quarantined.
+
+    Ordinary failures still degrade locally, exactly as before."""
+    return isinstance(e, StageTimeout) or is_device_fault(e)
+
+
+def no_degrade(e: BaseException) -> bool:
+    """:func:`must_propagate` plus ANY injected fault: handlers whose
+    degraded path is not byte-identical to the healthy one (a NumPy
+    twin, a skip-this-item loop that drops artifacts) must re-raise
+    these instead of degrading. An injected fault is retryable BY
+    CONSTRUCTION (armed faults fire once; chaos re-rolls each hit), so
+    escalating it to the stage-level retry recovers through the exact
+    same bytes — which is precisely what the chaos harness asserts.
+    Genuine environmental failures keep the degrade paths: approximate
+    science still beats no science on a real broken night."""
+    from pypulsar_tpu.resilience import faultinject
+
+    return must_propagate(e) or isinstance(e, faultinject.InjectedFault)
+
+
+class DeviceHealth:
+    """Per-device strike accounting with quarantine past ``limit``
+    strikes (``PYPULSAR_TPU_DEVICE_STRIKES``, default 3). Ids are the
+    caller's device axis — the survey scheduler counts LEASE ids (the
+    operator's ``--devices`` pool), ``parallel.mesh`` mirrors real jax
+    device ids. Thread-safe; every strike/quarantine lands in telemetry
+    as ``mesh.device_strike`` / ``mesh.device_quarantined`` events plus
+    ``device{N}.strikes`` counters, so ``tlmsum``'s per-device roll-up
+    shows chip health next to chip utilization."""
+
+    def __init__(self, limit: Optional[int] = None):
+        if limit is None:
+            limit = int(env_float(ENV_DEVICE_STRIKES,
+                                  DEFAULT_DEVICE_STRIKES))
+        self.limit = max(1, int(limit))
+        self._lock = threading.Lock()
+        self._strikes: Dict[int, int] = {}
+        self._quarantined: set = set()
+        self._last_error: Dict[int, str] = {}
+
+    def strike(self, dev_id: int, kind: str = "device", error: str = "",
+               allow_quarantine: bool = True) -> bool:
+        """Record one strike against ``dev_id``; returns True when this
+        strike NEWLY quarantines the device. ``allow_quarantine=False``
+        counts the strike but defers the verdict — how the scheduler
+        protects the last healthy lease (an empty pool is a hung fleet,
+        strictly worse than a flaky one)."""
+        dev_id = int(dev_id)
+        with self._lock:
+            n = self._strikes.get(dev_id, 0) + 1
+            self._strikes[dev_id] = n
+            if error:
+                self._last_error[dev_id] = error[:200]
+            newly = (allow_quarantine and n >= self.limit
+                     and dev_id not in self._quarantined)
+            if newly:
+                self._quarantined.add(dev_id)
+        telemetry.counter(f"device{dev_id}.strikes")
+        telemetry.event("mesh.device_strike", dev=dev_id, kind=kind,
+                        strikes=n)
+        if newly:
+            telemetry.counter(f"device{dev_id}.quarantined")
+            telemetry.event("mesh.device_quarantined", dev=dev_id,
+                            strikes=n, kind=kind)
+        return newly
+
+    def is_quarantined(self, dev_id: int) -> bool:
+        with self._lock:
+            return int(dev_id) in self._quarantined
+
+    def quarantined(self) -> set:
+        with self._lock:
+            return set(self._quarantined)
+
+    def strikes(self, dev_id: int) -> int:
+        with self._lock:
+            return self._strikes.get(int(dev_id), 0)
+
+    def snapshot(self) -> Dict[int, dict]:
+        """Per-device view for ``survey --status`` / fleet-health JSON:
+        ``{id: {strikes, quarantined, last_error}}``."""
+        with self._lock:
+            ids = set(self._strikes) | self._quarantined
+            return {i: {"strikes": self._strikes.get(i, 0),
+                        "quarantined": i in self._quarantined,
+                        "last_error": self._last_error.get(i, "")}
+                    for i in sorted(ids)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._strikes.clear()
+            self._quarantined.clear()
+            self._last_error.clear()
+
+
+# -- resource admission ------------------------------------------------------
+
+
+class ResourceGuard:
+    """The scheduler's admission gate: ``admit()`` returns None when new
+    work may launch, else a short reason string. Checks, in order:
+
+    - free disk under ``path`` >= ``min_free_bytes``
+      (``PYPULSAR_TPU_MIN_FREE_MB``, default 32 MB; 0 disables) — the
+      preflight that turns a mid-write ENOSPC crash into a pause;
+    - no live ``*.pending_depth`` gauge above ``max_pending`` (when
+      set) — the ship-ahead depth gauges the prefetch pipelines
+      already publish double as the backpressure signal: a consumer
+      that stopped draining means admitting more observations only
+      deepens the pile.
+
+    The gate pauses *scheduling*; stages already running always
+    continue (they are what frees the resource)."""
+
+    def __init__(self, path: str,
+                 min_free_bytes: Optional[float] = None,
+                 max_pending: Optional[float] = None):
+        if min_free_bytes is None:
+            mb = env_float(ENV_MIN_FREE_MB, DEFAULT_MIN_FREE_MB)
+            min_free_bytes = (mb or 0.0) * 1e6
+        self.path = path
+        self.min_free_bytes = float(min_free_bytes)
+        self.max_pending = max_pending
+
+    def free_bytes(self) -> Optional[float]:
+        try:
+            return float(shutil.disk_usage(self.path).free)
+        except OSError:
+            return None  # an unstatable root is not a reason to pause
+
+    def admit(self) -> Optional[str]:
+        if self.min_free_bytes > 0:
+            free = self.free_bytes()
+            if free is not None and free < self.min_free_bytes:
+                return (f"low disk: {free / 1e6:.0f} MB free under "
+                        f"{self.path!r} < {self.min_free_bytes / 1e6:.0f}"
+                        f" MB floor")
+        if self.max_pending is not None:
+            s = telemetry.current()
+            if s is not None:
+                for name, g in s.gauge_values().items():
+                    if name.endswith(".pending_depth") \
+                            and g.get("last", 0) > self.max_pending:
+                        return (f"backpressure: {name} = "
+                                f"{g.get('last', 0):.0f} > "
+                                f"{self.max_pending:.0f}")
+        return None

@@ -30,13 +30,15 @@ degraded run survived.
 
 from __future__ import annotations
 
+import random
 import time
-from typing import Callable, List, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.resilience import faultinject
 
-__all__ = ["halving_dispatch", "is_oom_error", "retry_transient"]
+__all__ = ["backoff_delay", "halving_dispatch", "is_oom_error",
+           "retry_transient"]
 
 # bounded backoff before re-dispatching after an OOM: gives the allocator
 # (and any neighbour briefly holding the memory) time to settle, without
@@ -55,10 +57,31 @@ RETRY_BACKOFF_MAX_S = 5.0
 NON_TRANSIENT_OS_ERRORS = (FileNotFoundError, PermissionError,
                            IsADirectoryError, NotADirectoryError)
 
+# process-default jitter source for backoff delays; tests inject their
+# own seeded random.Random for determinism
+_JITTER_RNG = random.Random()
+
+
+def backoff_delay(base: float, attempt: int, cap: float,
+                  rng: Optional[random.Random] = None) -> float:
+    """Jittered bounded exponential backoff: ``base * 2^(attempt-1)``
+    (capped at ``cap``) scaled by a uniform factor in [0.5, 1.0).
+
+    The jitter is the point, not a refinement: the pure deterministic
+    schedule retries *in lockstep* — N leases that fail together (one
+    flaky chip, one NFS blip) all come back at exactly base, 2*base,
+    4*base and collide again, the classic thundering-herd retry storm.
+    ``rng`` is injectable so tests stay deterministic
+    (``random.Random(seed)``); None uses the process-default source."""
+    delay = min(base * (2 ** (max(1, attempt) - 1)), cap)
+    r = rng if rng is not None else _JITTER_RNG
+    return delay * (0.5 + 0.5 * r.random())
+
 
 def retry_transient(fn, *, retries: int = 2, backoff: float = 0.1,
                     retry_on: Tuple[type, ...] = (OSError,),
-                    what: str = "io"):
+                    what: str = "io",
+                    rng: Optional[random.Random] = None):
     """Run ``fn()`` retrying ``retry_on`` failures with bounded
     exponential backoff — the transient-IO policy of the prefetch
     workers, usable at any read site (a survey pass must not abort over
@@ -76,7 +99,8 @@ def retry_transient(fn, *, retries: int = 2, backoff: float = 0.1,
             if attempt >= retries:
                 raise
             attempt += 1
-            delay = min(backoff * (2 ** (attempt - 1)), RETRY_BACKOFF_MAX_S)
+            delay = backoff_delay(backoff, attempt, RETRY_BACKOFF_MAX_S,
+                                  rng)
             telemetry.counter("resilience.worker_retries")
             telemetry.event("resilience.worker_retry", pipeline=what,
                             attempt=attempt, error=type(e).__name__,
@@ -145,7 +169,7 @@ def halving_dispatch(
         telemetry.counter("resilience.oom_backoffs")
         telemetry.event("resilience.oom_backoff", what=what, size=size,
                         new_size=half, error=type(err).__name__)
-        delay = min(BACKOFF_BASE_S * (2 ** (halvings - 1)), BACKOFF_MAX_S)
+        delay = backoff_delay(BACKOFF_BASE_S, halvings, BACKOFF_MAX_S)
         print(f"# {what}: device OOM at size {size}; backing off "
               f"{delay:.2f}s and retrying as {half} + {size - half}")
         time.sleep(delay)

@@ -98,7 +98,15 @@ class StageSpec:
     chips (the sweep stage adds ``--mesh k``). Gang size is a PLACEMENT
     choice, never science: a gang-aware stage must produce byte-
     identical artifacts at any k, so manifests resume across gang
-    changes (the fingerprint deliberately excludes placement)."""
+    changes (the fingerprint deliberately excludes placement).
+
+    ``deadline_s`` / ``deadline_per_mb`` declare the stage's wall-clock
+    budget for the fleet watchdog: a flat bound, a bound scaled by the
+    observation's input size in MB, or their sum (both set). None/None
+    (the default) means no deadline — heartbeat stall detection
+    (``--stall-timeout``) still covers the truly wedged case. Like
+    placement, deadlines are runtime policy, not science: they are NOT
+    part of the manifest fingerprint."""
 
     name: str
     tool: str
@@ -111,6 +119,25 @@ class StageSpec:
     devices_max: int = 1
     gang_argv: Optional[Callable[[Observation, SurveyConfig, int],
                                  List[str]]] = field(default=None)
+    deadline_s: Optional[float] = None
+    deadline_per_mb: Optional[float] = None
+
+    def deadline_for(self, obs: Observation) -> Optional[float]:
+        """This stage's wall-clock deadline for ``obs`` in seconds, or
+        None when the spec declares no bound. The size-derived term
+        uses the INPUT file (the one size known before the stage runs);
+        an unstatable input contributes nothing rather than failing —
+        the stage itself will report the missing file properly."""
+        if self.deadline_s is None and self.deadline_per_mb is None:
+            return None
+        total = self.deadline_s or 0.0
+        if self.deadline_per_mb:
+            try:
+                mb = os.path.getsize(obs.infile) / 1e6
+            except OSError:
+                mb = 0.0
+            total += self.deadline_per_mb * mb
+        return total if total > 0 else None
 
     def execute(self, obs: Observation, cfg: SurveyConfig,
                 gang: int = 1) -> None:

@@ -34,13 +34,35 @@ stage DAG (:mod:`.dag`) over the whole fleet with two execution lanes:
 
 Failure policy: a stage that raises an ordinary Exception (including a
 nonzero CLI exit, an injected IO fault, an OOM that escaped the in-stage
-halving) retries up to ``retries`` times with bounded exponential
-backoff; past that the OBSERVATION is quarantined — recorded in its
-manifest, its remaining stages cancelled, the fleet continues — instead
-of aborting the run. A BaseException (``faultinject.InjectedKill``,
-KeyboardInterrupt) unwinds the whole fleet like a signal: nothing is
-marked done that did not finish, and a ``--resume`` replans from the
-manifests.
+halving) retries up to ``retries`` times with bounded, seeded-jitter
+exponential backoff (lockstep retries of leases that failed together
+would collide again; ``resilience.retry.backoff_delay``); past that the
+OBSERVATION is quarantined — recorded in its manifest, its remaining
+stages cancelled, the fleet continues — instead of aborting the run. A
+BaseException (``faultinject.InjectedKill``, KeyboardInterrupt) unwinds
+the whole fleet like a signal: nothing is marked done that did not
+finish, and a ``--resume`` replans from the manifests.
+
+Fleet health (round 12, ``resilience.health``): stages heartbeat
+through the telemetry they already record (activity hooks); a watchdog
+thread interrupts a stage that outruns its declared deadline
+(``StageSpec.deadline_s``/``deadline_per_mb``, or the uniform
+``stage_deadline`` override) or stops heartbeating for ``stall_s``
+(``--stall-timeout`` / ``PYPULSAR_TPU_STALL_S``) — the interrupt is an
+ordinary Exception, so a hung stage lands in the same retry ->
+quarantine path, with ``survey.deadline_exceeded`` /
+``survey.stage_stalled`` events in the fleet and obs traces and its
+lease(s) reclaimed. Device-fault/OOM failures charge strikes against
+the leased chips (``parallel.mesh.device_health``); a chip past K
+strikes is evicted from the pool mid-fleet (never the last healthy
+one) and retried gangs shrink to the survivors — placement is excluded
+from fingerprints, so the shrunk retry's artifacts stay byte-identical.
+Before launching new work the scheduler consults the
+``resilience.health.ResourceGuard`` admission gate (free disk under the
+artifact root, ship-ahead ``*.pending_depth`` backpressure): a failing
+gate pauses *scheduling* (``survey.admission_paused``), never the
+stages in flight. Per-device verdicts are mirrored to
+``<outdir>/_fleet_health.json`` for ``survey --status``.
 
 Fault points (``--fault-inject`` / PYPULSAR_TPU_FAULTS), armed at stage
 boundaries: ``survey.stage_start`` / ``survey.stage_done`` (any stage,
@@ -61,27 +83,34 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.resilience import health as health_mod
+from pypulsar_tpu.resilience.retry import backoff_delay, is_oom_error
 from pypulsar_tpu.survey.dag import StageSpec, SurveyConfig, build_dag, stage_names
 from pypulsar_tpu.survey.state import (
     Observation,
     ObsManifest,
     ObsTrace,
     fleet_fingerprint,
+    write_fleet_health,
 )
 
 __all__ = ["FleetResult", "FleetScheduler"]
 
-# bounded backoff between retries of a failed stage (base * 2^attempt,
-# capped): the delay runs on a timer thread, NOT the lane worker, so a
-# backing-off observation never stalls the device lease or a host slot
+# bounded, jittered backoff between retries of a failed stage (base *
+# 2^attempt capped, then scaled by seeded jitter — see
+# resilience.retry.backoff_delay): the delay runs on a timer thread,
+# NOT the lane worker, so a backing-off observation never stalls the
+# device lease or a host slot
 RETRY_BACKOFF_BASE_S = 0.25
 RETRY_BACKOFF_MAX_S = 5.0
 
 # auto-gang cost gate: a gang-able stage whose measured mean cost is
 # under this share of the whole device chain runs 1-chip even when
 # chips idle — k chips on a minor stage buys k x the lease churn for a
-# sliver of wall time
-GANG_COST_MIN_FRAC = 0.25
+# sliver of wall time (env-overridable: a fleet of near-equal stages
+# may want a lower bar)
+GANG_COST_MIN_FRAC = health_mod.env_float(
+    "PYPULSAR_TPU_GANG_COST_MIN_FRAC", 0.25)
 
 _UNSET = object()  # _n_jax_devices cache sentinel (None = no backend)
 
@@ -99,6 +128,8 @@ class FleetResult:
     skipped: List[Tuple[str, str]] = field(default_factory=list)
     quarantined: Dict[str, Dict[str, str]] = field(default_factory=dict)
     retried: int = 0
+    timeouts: int = 0  # watchdog interrupts (deadline + stall)
+    evicted_devices: List[int] = field(default_factory=list)
     wall: float = 0.0
 
     @property
@@ -107,7 +138,9 @@ class FleetResult:
 
 
 class _Task:
-    __slots__ = ("obs_i", "stage", "state", "attempts", "seq")
+    __slots__ = ("obs_i", "stage", "state", "attempts", "seq",
+                 "last_dev_ids", "last_real_dev_ids", "last_error",
+                 "done_recorded")
 
     def __init__(self, obs_i: int, stage: StageSpec):
         self.obs_i = obs_i
@@ -115,6 +148,13 @@ class _Task:
         self.state = _PENDING
         self.attempts = 0
         self.seq = -1
+        self.last_dev_ids: Optional[List[int]] = None
+        self.last_real_dev_ids: Optional[List[int]] = None
+        self.last_error = ""
+        # set the instant the manifest records this execution done: a
+        # watchdog interrupt landing after that point must finish the
+        # task, not retry it
+        self.done_recorded = False
 
 
 class FleetScheduler:
@@ -128,6 +168,12 @@ class FleetScheduler:
                  retries: int = 1, resume: bool = False,
                  telemetry_dir: Optional[str] = None,
                  gang="auto",
+                 stall_s: Optional[float] = None,
+                 stage_deadline: Optional[float] = None,
+                 strike_limit: Optional[int] = None,
+                 min_free_mb: Optional[float] = None,
+                 max_pending: Optional[float] = None,
+                 jitter_rng=None,
                  verbose: bool = False):
         self.cfg = cfg if cfg is not None else SurveyConfig()
         self.stages = list(stages) if stages is not None \
@@ -166,6 +212,25 @@ class FleetScheduler:
         self.gang = gang
         self.verbose = verbose
 
+        # fleet health: heartbeats + watchdog, device strikes, admission
+        if stall_s is None:
+            stall_s = health_mod.env_float(health_mod.ENV_STALL_S, None)
+        self.stall_s = stall_s
+        self.stage_deadline = stage_deadline
+        self.jitter_rng = jitter_rng
+        self._hb = health_mod.HeartbeatRegistry()
+        self._watchdog: Optional[health_mod.Watchdog] = None
+        self._health = self._make_device_health(strike_limit)
+        root = (os.path.dirname(self.obs[0].outbase) or "."
+                if self.obs else ".")
+        self._health_dir = root if self.obs else None
+        self._guard = health_mod.ResourceGuard(
+            root,
+            min_free_bytes=(min_free_mb * 1e6
+                            if min_free_mb is not None else None),
+            max_pending=max_pending)
+        self._admission_blocked = False  # one event per pause episode
+
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._device_q: "queue.PriorityQueue" = queue.PriorityQueue()
@@ -179,7 +244,7 @@ class FleetScheduler:
         # the device POOL gangs draw from (lease ids 0..devices-1) and
         # the FIFO claim line that keeps wide gangs starvation-free
         self._free_ids = set(range(self.devices))
-        self._claims: List[Tuple[object, int]] = []
+        self._claims: List[Tuple[object, List[int]]] = []
         self._stage_cost: Dict[str, List[float]] = {}  # name -> [s, n]
         self.result = FleetResult()
         self._manifests: List[ObsManifest] = []
@@ -251,15 +316,175 @@ class FleetScheduler:
         return all(t.state in (_DONE, _QUARANTINED)
                    for t in self._tasks.values())
 
+    # -- fleet health -------------------------------------------------------
+
+    @staticmethod
+    def _make_device_health(strike_limit):
+        """The process-global mesh registry when jax is importable (so
+        mesh-building code and the scheduler share one account), a
+        local one otherwise — either way FRESH per fleet: strikes are
+        runtime state, not survey state, and a resumed fleet gives
+        every chip a clean slate."""
+        try:
+            from pypulsar_tpu.parallel import mesh as mesh_mod
+
+            return mesh_mod.reset_device_health(strike_limit)
+        except Exception:  # noqa: BLE001 - no jax backend: local account
+            return health_mod.DeviceHealth(strike_limit)
+
+    def _lease_real(self, i: int) -> int:
+        """The REAL jax device id lease ``i`` pins by default (leases
+        wrap modulo the chip count on an oversubscribed pool). Strikes
+        are charged against real chips — the account `parallel.mesh`
+        reads — so health checks must translate lease ids the same
+        way."""
+        n = self._n_jax_devices()
+        return i % n if n else i
+
+    def _healthy_ids(self) -> List[int]:
+        return [i for i in range(self.devices)
+                if not self._health.is_quarantined(self._lease_real(i))]
+
+    def _deadline_for(self, stage: StageSpec, obs: Observation):
+        if self.stage_deadline is not None:
+            return self.stage_deadline
+        return stage.deadline_for(obs)
+
+    def _needs_watchdog(self) -> bool:
+        return (self.stall_s is not None
+                or self.stage_deadline is not None
+                or any(s.deadline_s is not None
+                       or s.deadline_per_mb is not None
+                       for s in self.stages))
+
+    def _on_stage_expired(self, entry, reason: str) -> None:
+        """Watchdog callback: record the verdict, then interrupt the
+        stage's worker thread (StageDeadlineExceeded / StageStalled are
+        ordinary Exceptions — the worker's retry/quarantine policy owns
+        the rest, and its finally blocks release the lease)."""
+        task = entry.payload
+        obs = self.obs[task.obs_i]
+        now = time.monotonic()
+        if reason == "deadline":
+            name = "survey.deadline_exceeded"
+            after = now - entry.started
+            exc = health_mod.StageDeadlineExceeded
+        else:
+            name = "survey.stage_stalled"
+            after = now - entry.last_beat
+            exc = health_mod.StageStalled
+        # interrupt FIRST, and only while the entry is still live: if
+        # the stage finished between expired() and here, the async
+        # exception would land wherever that worker thread is NEXT —
+        # outside _execute's try, killing the worker and hanging the
+        # fleet. (The remaining finish-vs-raise race is closed by the
+        # worker loop's StageTimeout catch and the done_recorded
+        # guard in _handle_failure.)
+        if not self._hb.is_active(entry) \
+                or not health_mod.interrupt_thread(entry.thread_id, exc):
+            telemetry.event("survey.late_interrupt", obs=obs.name,
+                            stage=task.stage.name)
+            return
+        with self._lock:
+            self.result.timeouts += 1
+        telemetry.counter("survey.watchdog_interrupts")
+        telemetry.event(name, obs=obs.name, stage=task.stage.name,
+                        after_s=round(after, 3))
+        trace = self._traces[task.obs_i]
+        if trace is not None:
+            trace.event(name, stage=task.stage.name,
+                        after_s=round(after, 3))
+        if self.verbose:
+            print(f"# survey: WATCHDOG {obs.name}: {task.stage.name} "
+                  f"{reason} after {after:.1f}s; interrupting worker")
+
+    def _strike_leases(self, task: "_Task", err: Exception) -> None:
+        """Charge the failed execution's leased chips when the error
+        indicts the DEVICE (OOM that escaped in-stage halving, dead
+        chip, failed collective, injected device fault). Eviction
+        spares the last healthy lease — an empty pool is a hung fleet
+        — and every verdict lands in the fleet-health JSON."""
+        ids = task.last_dev_ids
+        if not ids:
+            return
+        oom = is_oom_error(err)
+        if not oom and not health_mod.is_device_fault(err):
+            return
+        kind = "oom" if oom else "device"
+        # charge the REAL chips the execution was pinned to (the id
+        # space `parallel.mesh` filters by); on an oversubscribed pool
+        # a quarantined chip takes EVERY lease that maps to it
+        reals = task.last_real_dev_ids \
+            or [self._lease_real(i) for i in ids]
+        evicted: List[int] = []
+        for r in reals:
+            allow = len(self._healthy_ids()) > 1
+            if self._health.strike(r, kind=kind, error=str(err)[:200],
+                                   allow_quarantine=allow):
+                evicted.extend(i for i in range(self.devices)
+                               if self._lease_real(i) == r)
+        if evicted:
+            with self._cv:
+                self._free_ids.difference_update(evicted)
+                self.result.evicted_devices.extend(evicted)
+                self._cv.notify_all()
+            telemetry.event("survey.device_evicted", devs=evicted,
+                            stage=task.stage.name,
+                            obs=self.obs[task.obs_i].name,
+                            healthy=len(self._healthy_ids()))
+            print(f"# survey: QUARANTINED device lease(s) {evicted} "
+                  f"after {self._health.limit} strikes "
+                  f"({type(err).__name__}); pool shrinks to "
+                  f"{len(self._healthy_ids())} chips, gangs retry "
+                  f"shrunk")
+        self._write_health_json()
+
+    def _write_health_json(self) -> None:
+        """Mirror the per-device verdicts next to the manifests so
+        ``survey --status`` (a different process, maybe much later)
+        can render chip health alongside observation progress."""
+        if self._health_dir is None:
+            return
+        snap = self._health.snapshot()
+        if not snap and not self.result.evicted_devices:
+            return
+        write_fleet_health(self._health_dir, {
+            "pool": self.devices,
+            "strike_limit": self._health.limit,
+            "devices": {str(i): v for i, v in snap.items()},
+        })
+
+    def _wait_admission(self) -> None:
+        """Block until the resource gate admits new work (or the fleet
+        stops). Pauses are episodes: one ``survey.admission_paused``
+        event when the gate first refuses, one ``..._resumed`` when it
+        clears — not one per poll."""
+        reason = self._guard.admit()
+        if reason is None:
+            return
+        with self._lock:
+            first = not self._admission_blocked
+            self._admission_blocked = True
+        if first:
+            telemetry.counter("survey.admission_pauses")
+            telemetry.event("survey.admission_paused", reason=reason)
+            print(f"# survey: admission paused ({reason}); in-flight "
+                  f"stages continue, new launches wait")
+        while not self._stop:
+            time.sleep(0.2)
+            reason = self._guard.admit()
+            if reason is None:
+                with self._lock:
+                    self._admission_blocked = False
+                telemetry.event("survey.admission_resumed")
+                return
+
     # -- execution ----------------------------------------------------------
 
     def _execute(self, task: _Task, gang: int = 1,
                  dev_ids: Optional[List[int]] = None) -> None:
         obs = self.obs[task.obs_i]
         stage = task.stage
-        faultinject.trip("survey.stage_start")
-        faultinject.trip(f"survey.stage_start.{stage.name}")
-        telemetry.counter("survey.stages_run")
         span_attrs = {"obs": obs.name}
         if dev_ids is not None:
             span_attrs["dev"] = dev_ids
@@ -267,13 +492,31 @@ class FleetScheduler:
             span_attrs["gang"] = gang
         t_rel = time.perf_counter() - self._t0
         t0 = time.perf_counter()
-        with telemetry.span(f"survey.stage.{stage.name}", **span_attrs):
-            stage.execute(obs, self.cfg, gang=gang)
-        dur = time.perf_counter() - t0
-        faultinject.trip("survey.stage_done")
-        faultinject.trip(f"survey.stage_done.{stage.name}")
-        outputs = stage.outputs(obs, self.cfg)
-        self._manifests[task.obs_i].mark_done(stage.name, outputs)
+        # liveness entry: the watchdog interrupts this thread on
+        # deadline/stall; any telemetry the stage records (spans,
+        # counters — chunk cadence on every hot path) beats it. The
+        # entry covers the stage_start/stage_done fault boundaries and
+        # the manifest append too — a hang at a boundary must not sleep
+        # in a window the watchdog cannot see (it holds the lease).
+        task.done_recorded = False
+        hb = self._hb.start(f"{obs.name}:{stage.name}",
+                            deadline_s=self._deadline_for(stage, obs),
+                            stall_s=self.stall_s, payload=task)
+        try:
+            faultinject.trip("survey.stage_start")
+            faultinject.trip(f"survey.stage_start.{stage.name}")
+            telemetry.counter("survey.stages_run")
+            with telemetry.span(f"survey.stage.{stage.name}",
+                                **span_attrs):
+                stage.execute(obs, self.cfg, gang=gang)
+            dur = time.perf_counter() - t0
+            faultinject.trip("survey.stage_done")
+            faultinject.trip(f"survey.stage_done.{stage.name}")
+            outputs = stage.outputs(obs, self.cfg)
+            self._manifests[task.obs_i].mark_done(stage.name, outputs)
+            task.done_recorded = True
+        finally:
+            self._hb.finish(hb)
         trace = self._traces[task.obs_i]
         if trace is not None:
             tr_attrs = {"outputs": len(outputs)}
@@ -319,14 +562,45 @@ class FleetScheduler:
                 # another stage of this observation quarantined it while
                 # this one was running: its failure is already verdict
                 return
+            if task.state == _DONE:
+                # a watchdog interrupt that landed AFTER the stage
+                # completed (the unavoidable async-exc race window):
+                # the work is done and recorded; nothing to retry
+                telemetry.event("survey.late_interrupt", obs=obs.name,
+                                stage=stage.name)
+                return
+        if task.done_recorded:
+            # the interrupt landed between the manifest's done record
+            # and the task-state update in _execute's tail: the work
+            # IS complete — finish the task instead of re-running (or
+            # phantom-quarantining) a stage whose artifacts validate
+            telemetry.event("survey.late_interrupt", obs=obs.name,
+                            stage=stage.name)
+            with self._cv:
+                if task.state != _DONE:
+                    task.state = _DONE
+                    self.result.ran.append((obs.name, stage.name))
+                    self._promote_locked(task.obs_i)
+                    if self._finished_locked():
+                        self._stop = True
+                    self._cv.notify_all()
+            return
+        self._strike_leases(task, err)
+        error = f"{type(err).__name__}: {err}"
+        task.last_error = error
         telemetry.counter("survey.stage_failures")
         telemetry.event("survey.stage_failed", obs=obs.name,
                         stage=stage.name, error=type(err).__name__)
         if task.attempts < self.retries:
             task.attempts += 1
             self.result.retried += 1
-            delay = min(RETRY_BACKOFF_BASE_S * (2 ** (task.attempts - 1)),
-                        RETRY_BACKOFF_MAX_S)
+            delay = backoff_delay(RETRY_BACKOFF_BASE_S, task.attempts,
+                                  RETRY_BACKOFF_MAX_S, self.jitter_rng)
+            # the attempt + error excerpt land in the manifest so
+            # --status (any process, any time) can show WHY a stage is
+            # retrying, not just that it is slow
+            self._manifests[task.obs_i].note_retry(
+                stage.name, task.attempts, error)
             telemetry.event("survey.stage_retry", obs=obs.name,
                             stage=stage.name, attempt=task.attempts)
             if self.verbose:
@@ -344,7 +618,6 @@ class FleetScheduler:
         # bounded retries exhausted: quarantine the OBSERVATION — the
         # fleet continues, the verdict is recorded, and a later resume
         # may try again (the operator explicitly asked)
-        error = f"{type(err).__name__}: {err}"
         self._manifests[task.obs_i].quarantine(stage.name, error)
         telemetry.event("survey.quarantine", obs=obs.name,
                         stage=stage.name, error=type(err).__name__)
@@ -380,11 +653,21 @@ class FleetScheduler:
             # lease pool (--devices > real devices) may only widen up
             # to the real count
             gmax = min(gmax, njax)
+        # a quarantined chip is out of the pool: gangs SHRINK to the
+        # surviving leases (placement is not science — artifacts stay
+        # byte-identical at the new width)
+        healthy = len(self._healthy_ids())
+        if healthy < self.devices:
+            gmax = min(gmax, max(1, healthy))
         if gmax <= 1:
-            return 1, "single-device stage"
+            return 1, ("single-device stage" if healthy >= self.devices
+                       else f"shrunk to {healthy} healthy chip(s)")
         if self.gang != "auto":
             k = min(int(self.gang), gmax)
-            return k, f"fixed --gang {self.gang}"
+            reason = f"fixed --gang {self.gang}"
+            if k < int(self.gang):
+                reason += f" shrunk to {k} ({healthy} healthy chips)"
+            return k, reason
         with self._lock:
             other_ready = sum(
                 1 for t in self._tasks.values()
@@ -415,32 +698,44 @@ class FleetScheduler:
         full reservation: an older waiting claim reserves freed chips
         (up to its need) before any younger claim may take them, so a
         wide gang cannot starve behind 1-chip traffic. Returns None
-        when the fleet is unwinding (fatal)."""
+        when the fleet is unwinding (fatal).
+
+        The claim SHRINKS if devices are quarantined while it waits —
+        a gang asking for chips that no longer exist must retry at the
+        surviving width, not park forever (``need`` is a mutable cell
+        so older claims' reservations shrink with them)."""
         ticket = object()
+        need = [k]
         with self._cv:
-            self._claims.append((ticket, k))
+            self._claims.append((ticket, need))
             try:
                 while True:
                     if self._stop and self._fatal is not None:
                         return None
+                    need[0] = min(need[0],
+                                  max(1, len(self._healthy_ids())))
                     rem = len(self._free_ids)
                     grant = False
-                    for t, need in self._claims:
+                    for t, n in self._claims:
                         if t is ticket:
-                            grant = rem >= k
+                            grant = rem >= need[0]
                             break
-                        rem -= min(need, rem)  # older claims reserve
+                        rem -= min(n[0], rem)  # older claims reserve
                     if grant:
-                        ids = sorted(self._free_ids)[:k]
+                        ids = sorted(self._free_ids)[:need[0]]
                         self._free_ids.difference_update(ids)
                         return ids
                     self._cv.wait(0.1)
             finally:
-                self._claims.remove((ticket, k))
+                self._claims.remove((ticket, need))
 
     def _release_devices(self, ids: List[int]) -> None:
         with self._cv:
-            self._free_ids.update(ids)
+            # a lease quarantined while this execution held it never
+            # returns to the pool
+            self._free_ids.update(
+                i for i in ids
+                if not self._health.is_quarantined(self._lease_real(i)))
             self._cv.notify_all()
 
     def _n_jax_devices(self) -> Optional[int]:
@@ -500,6 +795,11 @@ class FleetScheduler:
         ids = self._acquire_devices(k)
         if ids is None:  # fleet unwinding while we waited
             return
+        if len(ids) < k:  # pool shrank while waiting: gang shrinks too
+            k = len(ids)
+            reason += f"; shrunk to {k} while waiting"
+        task.last_dev_ids = list(ids)
+        task.last_real_dev_ids = None
         try:
             telemetry.event("survey.gang_decision", obs=obs.name,
                             stage=task.stage.name, k=k, chips=ids,
@@ -509,6 +809,10 @@ class FleetScheduler:
                 trace.event("survey.gang_decision", stage=task.stage.name,
                             k=k, chips=ids, reason=reason)
             gang_devs = self._jax_gang(ids)
+            if gang_devs is not None:
+                task.last_real_dev_ids = [
+                    int(getattr(d, "id", i))
+                    for i, d in zip(ids, gang_devs)]
             if gang_devs is not None:
                 import jax
 
@@ -526,31 +830,51 @@ class FleetScheduler:
                 device_lane: bool = False) -> None:
         while True:
             try:
-                _, _, task = q.get(timeout=0.05)
-            except queue.Empty:
-                if self._stop:
-                    return
-                continue
-            with self._lock:
-                if self._stop and self._fatal is not None:
-                    continue  # fleet is unwinding: drop queued work
-                if task.state == _QUARANTINED:
-                    continue  # cancelled while queued
-                task.state = _RUNNING
-            try:
-                if device_lane:
-                    self._run_device_task(task)
-                else:
-                    self._execute(task)
-            except Exception as e:  # noqa: BLE001 - retry/quarantine policy
-                self._handle_failure(task, e)
-            except BaseException as e:  # injected kill / interrupt
-                with self._cv:
-                    if self._fatal is None:
-                        self._fatal = e
-                    self._stop = True
-                    self._cv.notify_all()
+                self._worker_step(q, device_lane)
+            except StopIteration:
                 return
+            except health_mod.StageTimeout:
+                # an async watchdog interrupt that lost the race with
+                # stage completion and landed between tasks: the
+                # verdict was already withdrawn (late_interrupt); the
+                # worker must survive, or its queue lane dies and the
+                # fleet hangs
+                telemetry.event("survey.late_interrupt")
+
+    def _worker_step(self, q: "queue.PriorityQueue",
+                     device_lane: bool) -> None:
+        """One take-a-task-and-run-it iteration; raises StopIteration
+        to shut the worker down."""
+        try:
+            _, _, task = q.get(timeout=0.05)
+        except queue.Empty:
+            if self._stop:
+                raise StopIteration
+            return
+        # resource preflight: low disk / backpressure pauses the
+        # LAUNCH of this stage (in-flight work keeps running and is
+        # what frees the resource); re-checked after the pause
+        self._wait_admission()
+        with self._lock:
+            if self._stop and self._fatal is not None:
+                return  # fleet is unwinding: drop queued work
+            if task.state == _QUARANTINED:
+                return  # cancelled while queued
+            task.state = _RUNNING
+        try:
+            if device_lane:
+                self._run_device_task(task)
+            else:
+                self._execute(task)
+        except Exception as e:  # noqa: BLE001 - retry/quarantine policy
+            self._handle_failure(task, e)
+        except BaseException as e:  # injected kill / interrupt
+            with self._cv:
+                if self._fatal is None:
+                    self._fatal = e
+                self._stop = True
+                self._cv.notify_all()
+            raise StopIteration
 
     # -- entry point --------------------------------------------------------
 
@@ -560,6 +884,14 @@ class FleetScheduler:
         kill, KeyboardInterrupt) after the in-flight stages settle."""
         self._t0 = time.perf_counter()
         self._open_manifests()
+        if self._needs_watchdog():
+            # heartbeats ride the telemetry the stages already record;
+            # the hook is process-global, so it is installed only for
+            # the run and removed in the finally below
+            telemetry.add_activity_hook(self._hb.beat_thread)
+            self._watchdog = health_mod.Watchdog(self._hb,
+                                                 self._on_stage_expired)
+            self._watchdog.start()
         try:
             with self._cv:
                 for i in range(len(self.obs)):
@@ -585,12 +917,27 @@ class FleetScheduler:
                    for h in range(self.max_host_workers)])
             for w in workers:
                 w.start()
-            with self._cv:
-                while not self._stop:
-                    self._cv.wait(0.1)
+            try:
+                with self._cv:
+                    while not self._stop:
+                        self._cv.wait(0.1)
+            except BaseException as e:  # Ctrl+C lands HERE, not in a worker
+                # stop + fatal so workers drop queued work (and an
+                # admission-paused worker wakes) instead of polling
+                # forever under a join() that never returns
+                with self._cv:
+                    if self._fatal is None:
+                        self._fatal = e
+                    self._stop = True
+                    self._cv.notify_all()
             for w in workers:
                 w.join()
         finally:
+            if self._watchdog is not None:
+                self._watchdog.stop()
+                self._watchdog = None
+                telemetry.remove_activity_hook(self._hb.beat_thread)
+            self._write_health_json()
             self.result.wall = time.perf_counter() - self._t0
             for m in self._manifests:
                 m.close()

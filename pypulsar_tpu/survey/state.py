@@ -29,20 +29,59 @@ import time
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence
 
-from pypulsar_tpu.resilience.journal import RunJournal
+from pypulsar_tpu.resilience.journal import RunJournal, atomic_write_text
 
 __all__ = [
     "ObsManifest",
     "ObsTrace",
     "Observation",
     "fleet_fingerprint",
+    "fleet_health_path",
     "format_status",
     "load_manifest_records",
     "manifest_path",
+    "read_fleet_health",
     "status_rows",
+    "write_fleet_health",
 ]
 
 MANIFEST_SUFFIX = ".survey.jsonl"
+
+# per-device health mirror next to the manifests (see write_fleet_health)
+FLEET_HEALTH_NAME = "_fleet_health.json"
+
+# --status truncates last-error excerpts to this many characters: the
+# table must stay a table, the full string is in the manifest
+ERROR_EXCERPT_LEN = 60
+
+
+def fleet_health_path(outdir: str) -> str:
+    return os.path.join(outdir, FLEET_HEALTH_NAME)
+
+
+def write_fleet_health(outdir: str, payload: Dict) -> None:
+    """Atomically mirror the scheduler's per-device strike/quarantine
+    verdicts to ``<outdir>/_fleet_health.json`` so ``survey --status``
+    (a different process, maybe much later) renders chip health next to
+    observation progress. Observability is a passenger: an unwritable
+    outdir drops the mirror, never the fleet."""
+    try:
+        atomic_write_text(fleet_health_path(outdir),
+                          json.dumps(payload, indent=2, sort_keys=True)
+                          + "\n")
+    except OSError:
+        pass
+
+
+def read_fleet_health(outdir: str) -> Optional[Dict]:
+    """The last fleet-health mirror under ``outdir``, or None (no file,
+    torn file — the writer is atomic, so torn means not ours)."""
+    try:
+        with open(fleet_health_path(outdir)) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None
 
 
 @dataclass(frozen=True)
@@ -123,6 +162,15 @@ class ObsManifest:
         with self._lock:
             self._journal.note(event="quarantine", stage=stage, error=error)
 
+    def note_retry(self, stage: str, attempt: int, error: str) -> None:
+        """Record one retry verdict (attempt number + the error that
+        provoked it) so ``--status`` can show WHY a stage is retrying,
+        not just that it is slow. Watchdog interrupts land here too —
+        a deadline/stall verdict reads like any other stage error."""
+        with self._lock:
+            self._journal.note(event="retry", stage=stage,
+                               attempt=int(attempt), error=error)
+
     def close(self) -> None:
         self._journal.close()
 
@@ -163,6 +211,7 @@ def status_rows(manifest_paths: Sequence[str]) -> List[Dict]:
         stages: List[str] = []
         done: List[str] = []
         quarantine = None
+        retries: Dict[str, Dict] = {}
         for rec in recs:
             if rec.get("type") == "note" and rec.get("event") == "plan":
                 stages = list(rec.get("stages", []))
@@ -182,28 +231,69 @@ def status_rows(manifest_paths: Sequence[str]) -> List[Dict]:
             elif rec.get("type") == "note" and rec.get("event") == "quarantine":
                 quarantine = {"stage": rec.get("stage", "?"),
                               "error": rec.get("error", "?")}
+            elif rec.get("type") == "note" and rec.get("event") == "retry":
+                # last verdict per stage wins: attempts is the running
+                # count, the error excerpt is the freshest reason
+                retries[rec.get("stage", "?")] = {
+                    "attempts": int(rec.get("attempt", 0) or 0),
+                    "error": str(rec.get("error", ""))}
         rows.append({"obs": obs, "manifest": path, "stages": stages,
-                     "done": done, "quarantine": quarantine})
+                     "done": done, "quarantine": quarantine,
+                     "retries": retries})
     return rows
 
 
-def format_status(rows: Sequence[Dict]) -> str:
-    """Render the --status progress table."""
-    lines = [f"# {'observation':<20s} {'progress':<10s} state"]
+def _excerpt(error: str, limit: int = ERROR_EXCERPT_LEN) -> str:
+    error = " ".join(str(error).split())  # tracebacks flatten to one line
+    return error if len(error) <= limit else error[: limit - 1] + "…"
+
+
+def format_status(rows: Sequence[Dict],
+                  health: Optional[Dict] = None) -> str:
+    """Render the --status progress table (plus, with a fleet-health
+    mirror, the per-device strike/quarantine block under it)."""
+    lines = [f"# {'observation':<20s} {'progress':<10s} {'retries':<8s} "
+             f"state"]
     for r in rows:
         total = len(r["stages"]) or "?"
         done = r["done"]
         prog = f"{len(done)}/{total}"
+        retries = r.get("retries", {})
+        n_retries = sum(v.get("attempts", 0) for v in retries.values())
         if r["quarantine"] is not None:
             q = r["quarantine"]
-            state = f"QUARANTINED at {q['stage']} ({q['error']})"
+            state = (f"QUARANTINED at {q['stage']} "
+                     f"({_excerpt(q['error'])})")
         elif r["stages"] and len(done) == len(r["stages"]):
             state = "complete"
         else:
             pend = [s for s in r["stages"] if s not in done]
             state = ("next: " + pend[0]) if pend else \
                 ("done: " + ",".join(done) if done else "pending")
-        lines.append(f"# {r['obs']:<20s} {prog:<10s} {state}")
+        # surviving retry verdicts annotate an otherwise-bare state:
+        # "WHY is this stage still pending" is the question --status
+        # exists to answer
+        if retries and r["quarantine"] is None:
+            worst = max(retries.items(),
+                        key=lambda kv: kv[1].get("attempts", 0))
+            state += (f" [retried {worst[0]} x{worst[1]['attempts']}: "
+                      f"{_excerpt(worst[1].get('error', ''))}]")
+        lines.append(f"# {r['obs']:<20s} {prog:<10s} {n_retries:<8d} "
+                     f"{state}")
+    if health:
+        devices = health.get("devices", {})
+        if devices:
+            lines.append(f"# devices (pool {health.get('pool', '?')}, "
+                         f"quarantine at "
+                         f"{health.get('strike_limit', '?')} strikes):")
+            for dev_id in sorted(devices, key=lambda s: int(s)):
+                d = devices[dev_id]
+                verdict = "QUARANTINED" if d.get("quarantined") else "ok"
+                err = d.get("last_error", "")
+                tail = f" ({_excerpt(err)})" if err else ""
+                lines.append(f"#   device {dev_id}: "
+                             f"{d.get('strikes', 0)} strike(s), "
+                             f"{verdict}{tail}")
     return "\n".join(lines)
 
 

@@ -708,3 +708,328 @@ def test_sift_journal_and_truncated_input(tmp_path, monkeypatch):
     rec2 = json.loads(open("sift.jsonl").readline())
     assert rec2["fingerprint"] != rec["fingerprint"]
     assert ref  # sanity: the sift produced output
+
+
+# ---------------------------------------------------------------------------
+# fleet health primitives (round 12): heartbeats, deadlines, strikes,
+# admission, jittered backoff, seeded chaos
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_delay_jitter_range_and_determinism():
+    import random
+
+    from pypulsar_tpu.resilience.retry import backoff_delay
+
+    # seeded rng -> reproducible delays, each in [0.5*d, d) of the
+    # deterministic schedule min(base * 2^(attempt-1), cap)
+    for attempt, full in ((1, 0.25), (2, 0.5), (3, 1.0), (10, 5.0)):
+        a = backoff_delay(0.25, attempt, 5.0, random.Random(7))
+        b = backoff_delay(0.25, attempt, 5.0, random.Random(7))
+        assert a == b
+        assert 0.5 * full <= a < full
+    # different seeds decorrelate: N leases that failed together must
+    # NOT come back in lockstep (the satellite's whole point)
+    d1 = [backoff_delay(0.25, 2, 5.0, random.Random(s)) for s in range(16)]
+    assert len(set(d1)) > 8
+    # default (process) rng path stays in range too
+    assert 0.25 <= backoff_delay(0.25, 2, 5.0) < 0.5
+
+
+def test_chaos_spec_parsing():
+    good = faultinject.parse_chaos_spec("42:0.1")
+    assert good == (42, 0.1, faultinject.CHAOS_KINDS)
+    assert "exit" not in faultinject.CHAOS_KINDS  # never self-kill the harness
+    seed, rate, kinds = faultinject.parse_chaos_spec("7:0.5:oom+io")
+    assert (seed, rate, kinds) == (7, 0.5, ("oom", "io"))
+    for bad in ("42", "x:0.1", "42:1.5", "42:-0.1", "42:0.1:boom",
+                "42:0.1:oom:extra", "42:0.1:exit"):
+        with pytest.raises(ValueError):
+            faultinject.parse_chaos_spec(bad)
+
+
+def test_chaos_roll_deterministic_and_rate_bounded():
+    """The chaos decision is a pure function of (seed, point, hit):
+    thread interleaving cannot change it, and a re-rolled retry draws a
+    FRESH decision (the cumulative hit index keeps counting)."""
+    faultinject.configure_chaos("11:0.3:oom")
+    fired_at = []
+    for i in range(1, 201):
+        try:
+            faultinject.trip("chaos.point")
+        except faultinject.InjectedOOM:
+            fired_at.append(i)
+    # seeded: the exact same firing pattern on a fresh armed state
+    faultinject.reset()
+    faultinject.configure_chaos("11:0.3:oom")
+    fired_again = []
+    for i in range(1, 201):
+        try:
+            faultinject.trip("chaos.point")
+        except faultinject.InjectedOOM:
+            fired_again.append(i)
+    assert fired_at == fired_again
+    # rate ~0.3 over 200 rolls: some fired, most did not
+    assert 20 <= len(fired_at) <= 120
+    assert faultinject.fired_counts() == {"oom": len(fired_at)}
+    # a different seed draws a different pattern
+    faultinject.reset()
+    faultinject.configure_chaos("12:0.3:oom")
+    other = []
+    for i in range(1, 201):
+        try:
+            faultinject.trip("chaos.point")
+        except faultinject.InjectedOOM:
+            other.append(i)
+    assert other != fired_at
+    # rate 0 never fires; disarm clears
+    faultinject.reset()
+    faultinject.configure_chaos("11:0.0")
+    for _ in range(50):
+        faultinject.trip("chaos.point")
+    assert faultinject.fired_counts() == {}
+
+
+def test_chaos_composes_with_armed_and_device_kind():
+    """The deterministic armed set wins at its exact (point, N); the
+    injected device fault classifies as chip-indicting."""
+    from pypulsar_tpu.resilience import health
+
+    faultinject.configure_chaos("1:0.0")  # chaos armed but silent
+    faultinject.configure("device:p:2")
+    # arming a deterministic fault must NOT disarm the chaos spray
+    # (bench --chaos arms one guaranteed fault per family on top of it)
+    assert faultinject.chaos_active()
+    faultinject.trip("p")
+    with pytest.raises(faultinject.InjectedDeviceFault) as ei:
+        faultinject.trip("p")
+    assert health.is_device_fault(ei.value)
+    assert health.no_degrade(ei.value)
+    assert faultinject.fired_counts() == {"device": 1}
+
+
+def test_injected_hang_is_bounded_and_interruptible(monkeypatch):
+    """An unwatched hang ends on its own (PYPULSAR_TPU_HANG_S bound) —
+    and sleeps in small slices so a watchdog interrupt can land."""
+    import time as _time
+
+    monkeypatch.setenv(faultinject.ENV_HANG_S, "0.3")
+    faultinject.configure("hang:h:1")
+    t0 = _time.monotonic()
+    faultinject.trip("h")  # returns (no exception): progress resumed
+    took = _time.monotonic() - t0
+    assert 0.2 <= took < 2.0
+    assert faultinject.fired_counts() == {"hang": 1}
+
+
+def test_heartbeat_registry_deadline_and_stall():
+    from pypulsar_tpu.resilience import health
+
+    reg = health.HeartbeatRegistry()
+    e_dl = reg.start("a", thread_id=1, deadline_s=10.0)
+    e_st = reg.start("b", thread_id=2, stall_s=5.0)
+    now = e_dl.started
+    assert reg.expired(now + 1.0) == []
+    # stall fires on heartbeat silence; a beat resets the clock
+    e_st.last_beat = now  # pin, then advance past the bound
+    out = reg.expired(now + 6.0)
+    assert [(e.label, r) for e, r in out] == [("b", "stall")]
+    # fired entries are returned AT MOST once (no re-interrupt)
+    assert reg.expired(now + 7.0) == []
+    # deadline fires from start time regardless of beats
+    reg.beat_thread(1)
+    out = reg.expired(now + 11.0)
+    assert [(e.label, r) for e, r in out] == [("a", "deadline")]
+    reg.finish(e_dl)
+    reg.finish(e_st)
+    assert reg.active() == []
+
+
+def test_interrupt_thread_lands_mid_sleep():
+    from pypulsar_tpu.resilience import health
+
+    caught = []
+    started = threading.Event()
+
+    def victim():
+        started.set()
+        try:
+            for _ in range(600):  # ~30 s of interruptible sleeping
+                __import__("time").sleep(0.05)
+        except health.StageStalled as e:
+            caught.append(e)
+
+    t = threading.Thread(target=victim)
+    t.start()
+    started.wait(5.0)
+    assert health.interrupt_thread(t.ident, health.StageStalled)
+    t.join(timeout=10.0)
+    assert not t.is_alive() and len(caught) == 1
+    # a gone thread is reported, not raised
+    assert not health.interrupt_thread(t.ident, health.StageStalled) \
+        or True  # CPython may reuse idents; only the call contract matters
+
+
+def test_device_health_strikes_and_quarantine():
+    from pypulsar_tpu.resilience import health
+
+    dh = health.DeviceHealth(limit=2)
+    assert not dh.strike(3, kind="oom", error="RESOURCE_EXHAUSTED hbm")
+    assert dh.strikes(3) == 1 and not dh.is_quarantined(3)
+    # allow_quarantine=False counts but defers the verdict (the
+    # scheduler's last-healthy-lease protection)
+    assert not dh.strike(3, kind="device", allow_quarantine=False)
+    assert dh.strikes(3) == 2 and not dh.is_quarantined(3)
+    # next allowed strike quarantines (>= limit), exactly once
+    assert dh.strike(3, kind="device", error="DEVICE_FAULT: chip 3")
+    assert dh.is_quarantined(3) and dh.quarantined() == {3}
+    assert not dh.strike(3)  # already quarantined: not "newly"
+    snap = dh.snapshot()
+    assert snap[3]["quarantined"] and snap[3]["strikes"] == 4
+    assert "DEVICE_FAULT" in snap[3]["last_error"]
+    dh.reset()
+    assert dh.snapshot() == {} and not dh.is_quarantined(3)
+
+
+def test_is_device_fault_classification():
+    from pypulsar_tpu.resilience import health
+
+    assert health.is_device_fault(faultinject.InjectedDeviceFault("p"))
+    assert health.is_device_fault(
+        RuntimeError("collective operation failed on slice"))
+    # OOMs are accounted separately; ordinary errors never cost a strike
+    assert not health.is_device_fault(RuntimeError("RESOURCE_EXHAUSTED"))
+    assert not health.is_device_fault(ValueError("bad dm"))
+    # BaseExceptions (kills) are unwinding, not chip verdicts
+    assert not health.is_device_fault(faultinject.InjectedKill("p"))
+
+
+def test_must_propagate_and_no_degrade():
+    from pypulsar_tpu.resilience import health
+
+    assert health.must_propagate(health.StageDeadlineExceeded("late"))
+    assert health.must_propagate(health.StageStalled("silent"))
+    assert health.must_propagate(faultinject.InjectedDeviceFault("p"))
+    assert not health.must_propagate(faultinject.InjectedOOM("p"))
+    # no_degrade adds EVERY injected fault: byte-divergent degrade
+    # paths must not absorb what the chaos harness asserts recovers
+    # byte-identically
+    assert health.no_degrade(faultinject.InjectedOOM("p"))
+    assert health.no_degrade(faultinject.InjectedIOError("p"))
+    assert not health.no_degrade(ValueError("poison spectrum"))
+
+
+def test_resource_guard_disk_and_backpressure(tmp_path, monkeypatch):
+    from pypulsar_tpu.resilience import health
+
+    g = health.ResourceGuard(str(tmp_path), min_free_bytes=64e6,
+                             max_pending=4)
+    monkeypatch.setattr(health.ResourceGuard, "free_bytes",
+                        lambda self: 32e6)
+    reason = g.admit()
+    assert reason is not None and "low disk" in reason
+    monkeypatch.setattr(health.ResourceGuard, "free_bytes",
+                        lambda self: 128e6)
+    assert g.admit() is None
+    # a live pending_depth gauge above the bound pauses admission
+    with telemetry.session():
+        telemetry.gauge("accel.pending_depth", 9)
+        reason = g.admit()
+        assert reason is not None and "backpressure" in reason
+        telemetry.gauge("accel.pending_depth", 1)
+        assert g.admit() is None
+    # disabled floor + no session: always admits
+    g2 = health.ResourceGuard(str(tmp_path), min_free_bytes=0,
+                              max_pending=None)
+    assert g2.admit() is None
+    # an unstatable root is not a reason to pause
+    g3 = health.ResourceGuard(str(tmp_path / "missing"),
+                              min_free_bytes=64e6)
+    assert g3.free_bytes() is None or g3.admit() is None
+
+
+def test_env_float_tolerates_garbage(monkeypatch):
+    from pypulsar_tpu.resilience import health
+
+    monkeypatch.setenv("X_KNOB", "not-a-float")
+    assert health.env_float("X_KNOB", 3.0) == 3.0
+    monkeypatch.setenv("X_KNOB", "1.5")
+    assert health.env_float("X_KNOB", 3.0) == 1.5
+    monkeypatch.delenv("X_KNOB")
+    assert health.env_float("X_KNOB", None) is None
+
+
+def test_survey_manifest_torn_tail_on_done_and_quarantine(tmp_path):
+    """Satellite: RunJournal torn-tail recovery on SURVEY manifests — a
+    kill mid-append of a `done` or `quarantine` note leaves a torn
+    trailing line that resume and --status must treat as never written
+    (the chaos harness's kill faults land exactly in these windows)."""
+    from pypulsar_tpu.survey.state import (
+        ObsManifest,
+        Observation,
+        status_rows,
+    )
+
+    art = str(tmp_path / "obs0_rfifind.mask")
+    with open(art, "wb") as f:
+        f.write(b"m" * 128)
+    obs = Observation("obs0", str(tmp_path / "obs0.fil"),
+                      str(tmp_path / "obs0"))
+    mpath = obs.manifest
+
+    m = ObsManifest(mpath, "fp-torn")
+    m.plan(obs, ["mask", "sweep", "sift"])
+    m.mark_done("mask", [art])
+    m.close()
+
+    # kill mid-append of the NEXT stage's done record: torn tail
+    with open(mpath, "a") as f:
+        f.write('{"type": "done", "unit": "stage:sweep", "outpu')
+    m2 = ObsManifest(mpath, "fp-torn")
+    assert m2.done_stages() == {"mask"}  # sweep's torn done: not done
+    # the recovered journal stays appendable and the torn line is
+    # superseded, not resurrected
+    m2.mark_done("sweep", [art])
+    m2.close()
+    assert ObsManifest(mpath, "fp-torn").done_stages() == {"mask", "sweep"}
+
+    # kill mid-append of a QUARANTINE note: --status must not show a
+    # phantom quarantine (nor crash on the torn record)
+    with open(mpath, "a") as f:
+        f.write('{"type": "note", "event": "quarantine", "stage": "si')
+    rows = status_rows([mpath])
+    assert rows[0]["quarantine"] is None
+    assert rows[0]["done"] == ["mask", "sweep"]
+    # a whole quarantine note written after recovery IS the verdict
+    m3 = ObsManifest(mpath, "fp-torn")
+    m3.quarantine("sift", "boom")
+    m3.close()
+    rows = status_rows([mpath])
+    assert rows[0]["quarantine"] == {"stage": "sift", "error": "boom"}
+
+
+def test_survey_manifest_torn_retry_note(tmp_path):
+    """A torn retry note (the new --status annotation channel) is
+    dropped like any torn tail; whole notes accumulate attempts."""
+    from pypulsar_tpu.survey.state import (
+        ObsManifest,
+        Observation,
+        status_rows,
+    )
+
+    obs = Observation("obs1", str(tmp_path / "obs1.fil"),
+                      str(tmp_path / "obs1"))
+    m = ObsManifest(obs.manifest, "fp-r")
+    m.plan(obs, ["mask"])
+    m.note_retry("mask", 1, "InjectedOOM: injected oom at 'x'")
+    m.close()
+    with open(obs.manifest, "a") as f:
+        f.write('{"type": "note", "event": "retry", "stage": "mask", "att')
+    rows = status_rows([obs.manifest])
+    assert rows[0]["retries"]["mask"]["attempts"] == 1
+    m2 = ObsManifest(obs.manifest, "fp-r")
+    m2.note_retry("mask", 2, "StageStalled: no heartbeat for 8.0s")
+    m2.close()
+    rows = status_rows([obs.manifest])
+    assert rows[0]["retries"]["mask"]["attempts"] == 2
+    assert "StageStalled" in rows[0]["retries"]["mask"]["error"]

@@ -846,3 +846,310 @@ def test_status_rows_and_render_from_raw_manifests(tmp_path):
     rows = status_rows([p1, p2])
     assert rows[1]["quarantine"] is None
     assert "complete" in format_status([rows[1]])
+
+
+# ---------------------------------------------------------------------------
+# fleet health (round 12): watchdog, device strikes, admission, chaos
+# ---------------------------------------------------------------------------
+
+
+def test_stalled_stage_interrupted_and_retried(tmp_path, monkeypatch):
+    """Acceptance: a stage that stops heartbeating is detected within
+    its bound, its worker is interrupted, the lease is reclaimed and
+    the observation RETRIES — the fleet completes, the verdict is a
+    survey.stage_stalled event, and the other observation is never
+    stalled behind the wedged one."""
+    monkeypatch.setenv(faultinject.ENV_HANG_S, "30")  # hang >> stall
+    # the stub pipeline trips a fault point per loop like the real hot
+    # paths do; the armed hang wedges attempt 1 of ONE observation
+    faultinject.configure("hang:stub.step:1")
+
+    def body(obs, cfg):
+        for _ in range(3):
+            faultinject.trip("stub.step")
+            telemetry.counter("stub.steps")  # heartbeat
+        with open(f"{obs.outbase}.dev1.out", "w") as f:
+            f.write(f"dev1 {obs.name}\n")
+        return 0
+
+    stages = [StageSpec("dev1", "stub", True, (), lambda o, c: [],
+                        _stub_outputs("dev1"), run=body)]
+    obs = [Observation(n, str(tmp_path / f"{n}.raw"), str(tmp_path / n))
+           for n in ("a", "b")]
+    t0 = time.monotonic()
+    with telemetry.session() as tlm:
+        result = FleetScheduler(obs, SurveyConfig(), stages=stages,
+                                retries=1, stall_s=0.5).run()
+        assert tlm.event_counts.get("survey.stage_stalled") == 1
+        assert tlm.event_counts.get("survey.stage_retry") == 1
+        assert tlm.counters.get("survey.watchdog_interrupts") == 1
+    took = time.monotonic() - t0
+    assert result.ok and result.timeouts == 1 and result.retried == 1
+    assert took < 20.0  # interrupted within the bound, not HANG_S
+    for n in ("a", "b"):
+        assert os.path.exists(str(tmp_path / n) + ".dev1.out")
+    # the retry verdict (attempt + stall excerpt) landed in the
+    # manifest for --status
+    from pypulsar_tpu.survey.state import status_rows
+
+    rows = status_rows(sorted(glob.glob(str(tmp_path / "*.survey.jsonl"))))
+    stalled = [r for r in rows if r["retries"]]
+    assert len(stalled) == 1
+    assert stalled[0]["retries"]["dev1"]["attempts"] == 1
+    assert "StageStalled" in stalled[0]["retries"]["dev1"]["error"]
+
+
+def test_deadline_exceeded_quarantines_without_stalling_fleet(tmp_path):
+    """A stage that heartbeats but outruns its declared deadline is
+    interrupted every attempt and the observation quarantines; the
+    other observation completes and the fleet returns promptly."""
+
+    def slow_body(obs, cfg):
+        if obs.name == "a":
+            for _ in range(100):  # ~5 s, beating the whole way
+                time.sleep(0.05)
+                telemetry.counter("stub.steps")
+        with open(f"{obs.outbase}.dev1.out", "w") as f:
+            f.write(f"dev1 {obs.name}\n")
+        return 0
+
+    stages = [StageSpec("dev1", "stub", True, (), lambda o, c: [],
+                        _stub_outputs("dev1"), run=slow_body,
+                        deadline_s=0.4)]
+    obs = [Observation(n, str(tmp_path / f"{n}.raw"), str(tmp_path / n))
+           for n in ("a", "b")]
+    with telemetry.session() as tlm:
+        result = FleetScheduler(obs, SurveyConfig(), stages=stages,
+                                retries=1, stall_s=30.0).run()
+        assert tlm.event_counts.get("survey.deadline_exceeded") == 2
+        assert not tlm.event_counts.get("survey.stage_stalled")
+    assert not result.ok
+    assert set(result.quarantined) == {"a"}
+    assert "StageDeadlineExceeded" in result.quarantined["a"]["error"]
+    assert result.timeouts == 2  # first attempt + the retry
+    assert ("b", "dev1") in result.ran
+    assert os.path.exists(str(tmp_path / "b") + ".dev1.out")
+
+
+def test_stage_deadline_per_mb_and_uniform_override(tmp_path):
+    """deadline_for composes the flat and size-derived terms; the
+    scheduler-level --stage-deadline overrides both."""
+    raw = tmp_path / "o.raw"
+    raw.write_bytes(b"\0" * 2_000_000)  # 2 MB
+    obs = Observation("o", str(raw), str(tmp_path / "o"))
+    s = StageSpec("x", "stub", True, (), lambda o, c: [],
+                  _stub_outputs("x"), deadline_s=10.0,
+                  deadline_per_mb=2.0)
+    assert s.deadline_for(obs) == pytest.approx(14.0)
+    s2 = StageSpec("x", "stub", True, (), lambda o, c: [],
+                   _stub_outputs("x"), deadline_per_mb=3.0)
+    assert s2.deadline_for(obs) == pytest.approx(6.0)
+    # unstatable input contributes nothing (the stage reports it)
+    gone = Observation("g", str(tmp_path / "gone.raw"),
+                       str(tmp_path / "g"))
+    assert s.deadline_for(gone) == pytest.approx(10.0)
+    assert s2.deadline_for(gone) is None
+    s3 = StageSpec("x", "stub", True, (), lambda o, c: [],
+                   _stub_outputs("x"))
+    assert s3.deadline_for(obs) is None
+    sched = FleetScheduler([obs], SurveyConfig(), stages=[s],
+                           stage_deadline=99.0)
+    assert sched._deadline_for(s, obs) == 99.0
+
+
+def test_device_fault_strikes_evict_lease_mid_fleet(tmp_path):
+    """A lease past K strikes is quarantined OUT of the pool mid-fleet:
+    the fleet completes on the survivors, the verdict is mirrored to
+    _fleet_health.json, and survey --status renders it."""
+    from pypulsar_tpu.survey.state import (
+        format_status,
+        read_fleet_health,
+        status_rows,
+    )
+
+    flaky = {"n": 0}
+
+    def body(obs, cfg):
+        if obs.name == "a" and flaky["n"] < 1:
+            flaky["n"] += 1
+            raise faultinject.InjectedDeviceFault("stub.dispatch")
+        with open(f"{obs.outbase}.dev1.out", "w") as f:
+            f.write(f"dev1 {obs.name}\n")
+        return 0
+
+    stages = [StageSpec("dev1", "stub", True, (), lambda o, c: [],
+                        _stub_outputs("dev1"), run=body)]
+    obs = [Observation(n, str(tmp_path / f"{n}.raw"), str(tmp_path / n))
+           for n in ("a", "b", "c")]
+    with telemetry.session() as tlm:
+        result = FleetScheduler(obs, SurveyConfig(), stages=stages,
+                                devices=2, retries=2,
+                                strike_limit=1).run()
+        assert tlm.event_counts.get("survey.device_evicted") == 1
+        assert tlm.event_counts.get("mesh.device_quarantined") == 1
+    assert result.ok and len(result.evicted_devices) == 1
+    evicted = result.evicted_devices[0]
+    health = read_fleet_health(str(tmp_path))
+    assert health is not None and health["strike_limit"] == 1
+    dev = health["devices"][str(evicted)]
+    assert dev["quarantined"] and dev["strikes"] >= 1
+    assert "DEVICE_FAULT" in dev["last_error"]
+    rendered = format_status(
+        status_rows(sorted(glob.glob(str(tmp_path / "*.survey.jsonl")))),
+        health=health)
+    assert "QUARANTINED" in rendered and f"device {evicted}" in rendered
+    for n in ("a", "b", "c"):
+        assert os.path.exists(str(tmp_path / n) + ".dev1.out")
+
+
+def test_last_healthy_lease_never_evicted(tmp_path):
+    """Strikes on the only healthy lease are counted but the verdict is
+    deferred: an empty pool is a hung fleet, strictly worse than a
+    flaky one."""
+    flaky = {"n": 0}
+
+    def body(obs, cfg):
+        if flaky["n"] < 2:
+            flaky["n"] += 1
+            raise faultinject.InjectedDeviceFault("stub.dispatch")
+        with open(f"{obs.outbase}.dev1.out", "w") as f:
+            f.write(f"dev1 {obs.name}\n")
+        return 0
+
+    stages = [StageSpec("dev1", "stub", True, (), lambda o, c: [],
+                        _stub_outputs("dev1"), run=body)]
+    obs = [Observation("a", str(tmp_path / "a.raw"), str(tmp_path / "a"))]
+    result = FleetScheduler(obs, SurveyConfig(), stages=stages,
+                            devices=1, retries=3, strike_limit=1).run()
+    assert result.ok and result.evicted_devices == []
+    assert result.retried == 2
+
+
+def test_admission_gate_pauses_scheduling_not_inflight(tmp_path):
+    """Backpressure (a pending_depth gauge above --max-pending) pauses
+    LAUNCHING new stages; when the gauge drains the fleet resumes and
+    completes. One paused + one resumed event per episode."""
+    stages = _stub_stages()
+    obs = [Observation(f"o{i}", str(tmp_path / f"o{i}.raw"),
+                       str(tmp_path / f"o{i}")) for i in range(2)]
+    with telemetry.session() as tlm:
+        telemetry.gauge("stub.pending_depth", 10)
+        sched = FleetScheduler(obs, SurveyConfig(), stages=stages,
+                               max_pending=5)
+        t = threading.Thread(target=sched.run)
+        t.start()
+        for _ in range(100):
+            if tlm.event_counts.get("survey.admission_paused"):
+                break
+            time.sleep(0.05)
+        assert tlm.event_counts.get("survey.admission_paused") == 1
+        assert not sched.result.ran  # nothing launched while paused
+        telemetry.gauge("stub.pending_depth", 0)  # the consumer drained
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+        assert tlm.event_counts.get("survey.admission_resumed") == 1
+    assert sched.result.ok and len(sched.result.ran) == 4
+
+
+def test_tlmsum_renders_fleet_health_rollup(tmp_path):
+    """The fleet-health verdicts are visible in tlmsum: watchdog
+    interrupts, deadline/stall events, device strikes/quarantines and
+    injected-fault counts roll up into one `fleet health:` line."""
+    import io
+
+    from pypulsar_tpu.obs.summarize import load_records, render, summarize
+
+    path = str(tmp_path / "t.jsonl")
+    with telemetry.session(path):
+        telemetry.counter("survey.watchdog_interrupts", 2)
+        telemetry.event("survey.deadline_exceeded", obs="a", stage="sweep")
+        telemetry.event("survey.stage_stalled", obs="b", stage="fold")
+        telemetry.event("mesh.device_strike", dev=1, kind="oom", strikes=1)
+        telemetry.event("mesh.device_quarantined", dev=1, strikes=3)
+        telemetry.event("survey.device_evicted", devs=[1], stage="sweep")
+        telemetry.counter("resilience.faults_injected", 4)
+    buf = io.StringIO()
+    render(summarize(load_records(path)), buf)
+    out = buf.getvalue()
+    assert "fleet health:" in out
+    for bit in ("watchdog interrupts=2", "deadlines exceeded=1",
+                "stalls=1", "device strikes=1", "devices quarantined=1",
+                "lease evictions=1", "injected faults=4"):
+        assert bit in out, bit
+
+
+def test_gang_shrinks_after_eviction_byte_identical(fleet):
+    """Acceptance: a chip-indicting fault mid-gang evicts the struck
+    lease and the retried gang SHRINKS to the survivors — with the
+    final artifacts byte-identical to the serial 1-chip chain, because
+    placement is excluded from every fingerprint."""
+    _require_virtual_mesh(2)
+    cfg = SurveyConfig(**CFG_KW)
+    outdir = str(fleet["root"] / "shrink")
+    obs = _fleet_obs(fleet["fils"][:1], outdir)
+    # the device fault escapes the accel batch dispatch mid-sweep (the
+    # no_degrade contract forbids the serial fallback from absorbing
+    # it), indicts the whole gang, and the strike evicts one lease
+    faultinject.configure("device:accel.batch_dispatch:1")
+    trace = str(fleet["root"] / "shrink_trace.jsonl")
+    with telemetry.session(trace) as tlm:
+        result = FleetScheduler(obs, cfg, devices=2, gang=2,
+                                retries=2, strike_limit=1).run()
+        assert tlm.event_counts.get("survey.device_evicted") == 1
+    assert result.ok and result.retried >= 1
+    assert len(result.evicted_devices) == 1
+    # the sweep gang ran wide first, then retried shrunk (the decision
+    # trail is in the trace, attrs and all)
+    decisions = [r["attrs"] for r in map(json.loads, open(trace))
+                 if r.get("type") == "event"
+                 and r.get("name") == "survey.gang_decision"]
+    sweep_ks = [d["k"] for d in decisions if d["stage"] == "sweep"]
+    assert sweep_ks[0] == 2 and sweep_ks[-1] == 1
+    # the shrunk retry ran on the SURVIVING chip, and said why
+    last = [d for d in decisions if d["stage"] == "sweep"][-1]
+    assert result.evicted_devices[0] not in last["chips"]
+    assert "healthy" in last["reason"]
+    _assert_matches_reference(fleet, outdir, stems=("psr0",))
+
+
+@pytest.mark.slow
+def test_seeded_chaos_fleet_recovers_byte_identical(fleet, monkeypatch):
+    """The chaos harness's contract at pytest scale (bench.py --chaos is
+    the committed record): a seeded probabilistic fault spray across
+    every registered point, plus armed kill/hang faults in the nastiest
+    windows, resumed until the fleet completes — with every artifact
+    byte-identical to the serial chain. Marked slow: tier-1 runs with
+    -m 'not slow'; `make test-chaos` runs the bench harness."""
+    import random
+
+    monkeypatch.setenv(faultinject.ENV_HANG_S, "12")
+    cfg = SurveyConfig(**CFG_KW)
+    outdir = str(fleet["root"] / "chaos")
+    obs = _fleet_obs(fleet["fils"], outdir)
+    faultinject.configure_chaos("3:0.004")
+    faultinject.configure("kill:survey.stage_done.sweep:1,"
+                          "hang:sweep.chunk_dispatch:2")
+    result = None
+    rounds = kills = 0
+    while rounds < 15:
+        rounds += 1
+        sched = FleetScheduler(obs, cfg, max_host_workers=2,
+                               retries=2, resume=(rounds > 1),
+                               stall_s=8.0,
+                               jitter_rng=random.Random(rounds))
+        try:
+            result = sched.run()
+        except faultinject.InjectedKill:
+            kills += 1
+            continue
+        if result.ok:
+            break
+    fired = faultinject.fired_counts()
+    assert result is not None and result.ok, (rounds, fired)
+    assert fired.get("kill", 0) >= 1 and fired.get("hang", 0) >= 1
+    # the final no-chaos resume validates everything and runs NOTHING
+    faultinject.reset()
+    final = FleetScheduler(obs, cfg, max_host_workers=2,
+                           resume=True).run()
+    assert final.ok and len(final.ran) == 0
+    _assert_matches_reference(fleet, outdir)
