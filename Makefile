@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier test-faults test-fold test-survey dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-multichip bench-telemetry native clean
+.PHONY: test test-fourier test-faults test-fold test-survey test-corruption dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-multichip bench-telemetry native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -27,7 +27,7 @@ test-fourier:
 # survey orchestrator's kill/resume/quarantine and fleet-health
 # (watchdog, device-strike, admission) cases, and the seeded chaos
 # fleet
-test-faults: test-chaos
+test-faults: test-chaos test-corruption
 	$(CPU_ENV) $(PY) -m pytest tests/test_resilience.py -q
 	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -k "kill or resume or quarantine or retry or stall or deadline or evict or admission or chaos"
 
@@ -39,6 +39,15 @@ test-faults: test-chaos
 test-chaos:
 	$(CPU_ENV) $(PY) bench.py --chaos --quick
 	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -m slow -k chaos
+
+# the data-integrity suite: the checked-in corrupted-fixture corpus
+# against every reader, salvage/scrub/finite-gate contracts, the
+# degrade-vs-quarantine survey policy, and the acceptance-scale reader
+# fuzz (500 seeded mutations per format, marked `slow` so tier-1 runs
+# only the 60-mutation slice) — the committed record is CORRUPT_r01.json
+test-corruption:
+	$(CPU_ENV) $(PY) -m pytest tests/test_dataguard.py -q
+	$(CPU_ENV) $(PY) -m pytest tests/test_dataguard.py -q -m slow -k fuzz
 
 # the survey orchestrator suite: fleet-vs-serial byte parity, device
 # lease exclusivity / host overlap, kill+resume at every stage

@@ -50,6 +50,11 @@ def combine_fil(infiles: List[str], outname: str,
     nsamples = min(fb.nspec for fb in fbs)
     header = dict(fbs[0].header)
     header["nchans"] = int(sum(fb.header["nchans"] for fb in fbs))
+    # re-stamp the sample count: file 0's header value describes file 0,
+    # not the min-length combination — a stale count would read back as
+    # a bogus truncation-salvage report downstream
+    if "nsamples" in header:
+        header["nsamples"] = int(nsamples)
     with open(outname, "wb") as out:
         out.write(sigproc.pack_header(header))
         pos = 0

@@ -290,6 +290,19 @@ def _append_archive_row(args, pfd, pfdfn: str, rows: list) -> None:
     print("SNR: %.3f" % result["snr"])
     if result["smean"] is not None:
         print("Mean flux density (mJy): %.4f" % result["smean"])
+    if not np.isfinite(result["snr"]):
+        # finite-output gate: a pathological archive (zero variance,
+        # corrupted stats block) must surface as an ERROR row, never as
+        # a NaN in the survey's machine-readable summary
+        from pypulsar_tpu.obs import telemetry
+
+        telemetry.counter("data.nonfinite_cands_dropped")
+        rows.append({"pfd": pfdfn, "name": pfd.candnm,
+                     "best_dm": float(pfd.bestdm),
+                     "period": float(pfd.curr_p1), "snr": None,
+                     "weq_bins": None, "smean_mjy": None,
+                     "error": "non-finite SNR"})
+        return
     rows.append({
         "pfd": pfdfn,
         "name": pfd.candnm,

@@ -106,6 +106,16 @@ def build_parser():
                    help="admission gate: pause launching new stages while "
                         "any ship-ahead *.pending_depth gauge exceeds N "
                         "(default: off)")
+    g.add_argument("--max-bad-frac", type=float, default=None,
+                   metavar="FRAC",
+                   help="ingest data-quality threshold: an observation "
+                        "whose input reports more than FRAC of its "
+                        "samples missing/invalid is quarantined with "
+                        "reason 'data' (distinct from runtime "
+                        "quarantine) instead of running degraded; "
+                        "salvageable inputs below the bar run on their "
+                        "valid prefix (also PYPULSAR_TPU_MAX_BAD_FRAC; "
+                        "default 0.5)")
     p.add_argument("--telemetry-dir", default=None, metavar="DIR",
                    help="write one JSONL trace per observation plus one "
                         "fleet trace (fleet.jsonl) here; summarize "
@@ -251,7 +261,8 @@ def _run(args) -> int:
         telemetry_dir=args.telemetry_dir, gang=gang,
         stall_s=args.stall_timeout, stage_deadline=args.stage_deadline,
         strike_limit=args.strike_limit, min_free_mb=args.min_free_mb,
-        max_pending=args.max_pending, verbose=True)
+        max_pending=args.max_pending, max_bad_frac=args.max_bad_frac,
+        verbose=True)
     result = sched.run()
     n_stages = len(sched.stages)
     print(f"# survey: {len(obs)} observations x {n_stages} stages in "
@@ -268,7 +279,9 @@ def _run(args) -> int:
               f"{sorted(result.evicted_devices)} (see "
               f"_fleet_health.json / survey --status)")
     for name, q in sorted(result.quarantined.items()):
-        print(f"#   QUARANTINED {name} at {q['stage']}: {q['error']}")
+        tag = ("DATA-QUARANTINED" if q.get("reason") == "data"
+               else "QUARANTINED")
+        print(f"#   {tag} {name} at {q['stage']}: {q['error']}")
     if not result.ok:
         return 1
     return 0

@@ -35,9 +35,15 @@ def _open_reader(fn: str):
 def _write_cands(path, cands, extra_cols=()):
     """Write candidate/event/pulse rows atomically (tmp + os.replace —
     downstream consumers must never see a truncated table); ``extra_cols``
-    appends (header, key, fmt) columns after the shared six."""
+    appends (header, key, fmt) columns after the shared six. The finite
+    gate drops any row with a non-finite DM/SNR/time (counted in
+    ``data.nonfinite_cands_dropped``): garbage in the stream can degrade
+    a run, never poison its published tables."""
+    from pypulsar_tpu.resilience.dataguard import finite_rows
     from pypulsar_tpu.resilience.journal import atomic_write_text
 
+    cands = finite_rows(cands, ("dm", "snr", "time_sec"),
+                        what=os.path.basename(path))
     lines = ["# DM      SNR      time_s       sample    width_bins  "
              "downsamp" + "".join("  " + h for h, _, _ in extra_cols)
              + "\n"]

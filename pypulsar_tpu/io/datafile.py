@@ -229,10 +229,15 @@ class MultiplexedWappData(WappData):
 
     def __init__(self, wappfns, beamnum):
         super().__init__(wappfns, beamnum)
-        self.data_size = int(sum(w.data_size / 2.0 for w in self.wapps))
+        # byte/sample counts split exactly with floor division — the
+        # py2-era float `/ 2.0` sums lose integer exactness past 2**53
+        # and leak floats into fields used as counts (SURVEY.md py2-
+        # heritage audit, round 13)
+        self.data_size = sum(w.data_size // 2 for w in self.wapps)
         self.file_size = int(sum(w.file_size for w in self.wapps))
         self.observation_time = sum(w.obs_time / 2.0 for w in self.wapps)
-        self.num_samples = sum(w.number_of_samples / 2.0 for w in self.wapps)
+        self.num_samples = sum(
+            w.number_of_samples // 2 for w in self.wapps)
         self.num_samples_per_record = self.num_samples
 
 
@@ -247,7 +252,10 @@ class DumpOfWappData(WappData):
         self.data_size = -1
         self.file_size = -1
         self.observation_time = self.wapps[0].header["obs_time"]
-        self.num_samples = self.observation_time / (self.sample_time * 1e-6)
+        # a sample COUNT: round the float quotient instead of carrying
+        # a fractional py2-heritage value downstream
+        self.num_samples = int(
+            round(self.observation_time / (self.sample_time * 1e-6)))
         self.num_samples_per_record = self.num_samples
 
 
@@ -280,9 +288,9 @@ class PsrfitsData(Data):
         self.file_size = int(sum(os.path.getsize(fn) for fn in fitsfns))
         self.observation_time = self.specinfo.T
         self.num_samples = self.specinfo.N
-        self.data_size = (self.num_samples *
-                          self.specinfo.bits_per_sample / 8.0 *
-                          self.num_channels_per_record)
+        self.data_size = (int(self.num_samples) *
+                          int(self.specinfo.bits_per_sample) *
+                          int(self.num_channels_per_record) // 8)
         self.num_samples_per_record = self.specinfo.spectra_per_subint
 
     def _start_ast_from_mjd(self):

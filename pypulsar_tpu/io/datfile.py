@@ -18,6 +18,7 @@ from typing import Callable, Iterator, Optional
 import numpy as np
 
 from pypulsar_tpu.core.psrmath import SECPERDAY
+from pypulsar_tpu.io.errors import DataFormatError
 from pypulsar_tpu.io.infodata import InfoData
 
 DTYPE = np.dtype("float32")
@@ -33,10 +34,53 @@ class Datfile:
         self.basefn = datfn[:-4]
         self.datfile = open(datfn, "rb")
         self.inffn = f"{self.basefn}.inf"
-        self.infdata = InfoData(self.inffn)
+        try:
+            self.infdata = InfoData(self.inffn)
+        except ValueError as e:
+            raise DataFormatError(datfn, f"unreadable .inf sidecar "
+                                         f"({e})") from e
         self.inf = self.infdata
+        self._validate_and_salvage()
         correct_infdata(self.infdata)
         self.rewind()
+
+    def _validate_and_salvage(self) -> None:
+        """Cross-check the .inf metadata against the actual byte stream.
+
+        A garbage sidecar (missing/non-positive N or dt) raises
+        :class:`DataFormatError`; a .dat shorter than the sidecar claims
+        is SALVAGED — N clamps to the whole samples actually on disk and
+        ``self.salvage`` reports the missing span (the reference trusted
+        inf.N blindly, so a truncated file returned None from every read
+        past the real tail with no diagnosis)."""
+        inf = self.infdata
+        N = getattr(inf, "N", None)
+        dt = getattr(inf, "dt", None)
+        if not isinstance(N, int) or N < 0:
+            raise DataFormatError(
+                self.datfn, f".inf sidecar N={N!r} missing or invalid")
+        if not isinstance(dt, float) or not np.isfinite(dt) or dt <= 0:
+            raise DataFormatError(
+                self.datfn, f".inf sidecar dt={dt!r} missing or invalid")
+        size = os.path.getsize(self.datfn)
+        actual = size // self.bytes_per_sample
+        partial_tail = size % self.bytes_per_sample
+        self.salvage = None
+        if actual < N or partial_tail:
+            self.salvage = {
+                "read_samples": int(min(actual, N)),
+                "expected_samples": int(N),
+                "missing_samples": int(max(N - actual, 0)),
+                "partial_tail_bytes": int(partial_tail),
+            }
+            import warnings
+
+            warnings.warn(
+                f"{self.datfn}: truncated tail salvaged — {actual} whole "
+                f"samples on disk of {N} expected"
+                + (f" ({partial_tail} partial-sample bytes dropped)"
+                   if partial_tail else ""))
+            inf.N = int(min(actual, N))
 
     def close(self):
         self.datfile.close()

@@ -20,6 +20,7 @@ import numpy as np
 
 from pypulsar_tpu.core.spectra import Spectra
 from pypulsar_tpu.io import sigproc
+from pypulsar_tpu.io.errors import DataFormatError
 
 
 class FilterbankFile:
@@ -41,14 +42,15 @@ class FilterbankFile:
             raise ValueError(f"File does not exist: {filfn}")
         self.filfile = open(filfn, "rb")
         self.header, self.header_params, self.header_size = sigproc.read_header(
-            self.filfile
+            self.filfile, path=filfn
         )
+        sigproc.validate_header(self.header, filfn)
         nbits = int(self.header["nbits"])
         if nbits == 32:
             self.dtype = np.dtype("float32")
         elif nbits in (8, 16):
             self.dtype = np.dtype(f"uint{nbits}")
-        elif nbits in (4, 2, 1):
+        else:
             # sub-byte: 8//nbits channels per byte, low bits = lower
             # channel index (the PSRFITS convention, io/psrfits.py:55-81;
             # reference formats/psrfits.py:48-50). Raw blocks stay PACKED
@@ -56,20 +58,39 @@ class FilterbankFile:
             # host->device wire (the streamed sweep's measured
             # bottleneck); unpack happens on device (parallel/staged.
             # _ingest_tc) or on host in get_samples.
+            # (validate_header already rejected anything outside
+            # {1, 2, 4, 8, 16, 32})
             if self.nchans % (8 // nbits):
-                raise ValueError(
-                    f"nbits={nbits} requires nchans divisible by "
-                    f"{8 // nbits}; got {self.nchans}")
+                raise DataFormatError(
+                    filfn, f"nbits={nbits} requires nchans divisible by "
+                           f"{8 // nbits}; got {self.nchans}")
             self.dtype = np.dtype("uint8")
-        else:
-            raise ValueError(
-                f"unsupported nbits={nbits} (supported: 1, 2, 4, 8, 16, 32)")
         self.nbits = nbits
         self.bytes_per_spectrum = self.nchans * nbits // 8
         self.data_size = os.stat(filfn).st_size - self.header_size
-        if self.data_size % self.bytes_per_spectrum:
-            warnings.warn("Not an integer number of samples in file.")
         self.number_of_samples = self.data_size // self.bytes_per_spectrum
+        # truncated-tail salvage: the whole valid prefix is readable and
+        # the missing span is REPORTED (reader.salvage feeds the survey's
+        # per-obs data-quality report) — a dropped network copy or a
+        # recorder kill must degrade, not crash
+        partial_tail = self.data_size % self.bytes_per_spectrum
+        expected = int(self.header.get("nsamples", 0) or 0)
+        missing = (max(expected - self.number_of_samples, 0)
+                   if expected > 0 else 0)
+        self.salvage = None
+        if partial_tail or missing:
+            self.salvage = {
+                "read_samples": int(self.number_of_samples),
+                "expected_samples": int(expected) or None,
+                "missing_samples": int(missing),
+                "partial_tail_bytes": int(partial_tail),
+            }
+            warnings.warn(
+                f"{filfn}: truncated tail salvaged — reading "
+                f"{self.number_of_samples} whole samples"
+                + (f" of {expected} expected" if expected else "")
+                + (f" ({partial_tail} partial-spectrum bytes dropped)"
+                   if partial_tail else ""))
         self.frequencies = self.fch1 + self.foff * np.arange(self.nchans)
         self.freqs = self.frequencies
         self.is_hifreq_first = self.foff < 0
@@ -267,6 +288,10 @@ def write_filterbank(filfn: str, header: Dict[str, object], data: np.ndarray):
     for key in ("fch1", "foff", "nchans", "tsamp"):
         if key not in hdr:
             raise ValueError(f"header missing required key {key!r}")
+    # stamp the sample count: readers cross-check it against the actual
+    # file size, which is what turns a truncated copy into a REPORTED
+    # salvaged span instead of a silently shorter observation
+    hdr.setdefault("nsamples", int(np.asarray(data).shape[0]))
     nbits = int(hdr["nbits"])
     if nbits == 32:
         dtype = np.dtype("float32")

@@ -60,7 +60,10 @@ class InfoData:
         if not os.path.isfile(inffn):
             raise ValueError(f"No such .inf file: {inffn}")
         in_notes = False
-        with open(inffn) as f:
+        # errors="replace": a corrupted sidecar must surface as missing/
+        # invalid FIELDS (the reader's DataFormatError cross-checks),
+        # never as a UnicodeDecodeError mid-parse
+        with open(inffn, errors="replace") as f:
             for line in f:
                 if in_notes:
                     if line.strip():

@@ -20,8 +20,10 @@ design:
 
 from __future__ import annotations
 
+import math
 import os
 import re
+import struct
 import warnings
 from typing import Dict, List, Optional, Sequence
 
@@ -30,6 +32,7 @@ import numpy as np
 from pypulsar_tpu.astro import calendar, protractor
 from pypulsar_tpu.core import psrmath
 from pypulsar_tpu.core.spectra import Spectra
+from pypulsar_tpu.io.errors import DataFormatError
 
 date_obs_re = re.compile(
     r"^(?P<year>[0-9]{4})-(?P<month>[0-9]{2})-(?P<day>[0-9]{2})T"
@@ -133,6 +136,22 @@ class SpectraInfo:
     """
 
     def __init__(self, filenames: Sequence[str]):
+        try:
+            self._init(filenames)
+        except DataFormatError:
+            raise
+        except Exception as e:  # noqa: BLE001 - see below
+            # the FITS codecs (astropy or our fitsio) surface truncation
+            # and garbage as a zoo of exception types (ValueError,
+            # KeyError, struct.error, even AttributeError from a
+            # column-less table stub); the reader-fuzz contract is ONE
+            # located taxonomy — the original type survives in the
+            # detail and the chained __cause__
+            raise DataFormatError(
+                filenames[0] if filenames else "<none>",
+                f"malformed PSRFITS ({type(e).__name__}: {e})") from e
+
+    def _init(self, filenames: Sequence[str]):
         self.filenames = list(filenames)
         self.num_files = len(self.filenames)
         self.N = 0
@@ -226,6 +245,7 @@ class SpectraInfo:
         self.dt = subint["TBIN"]
         self.num_channels = subint["NCHAN"]
         self.num_polns = subint["NPOL"]
+        self._validate_subint(ii, subint)
 
         # PSRFITS_POLN env override (reference :275-282)
         envval = os.getenv("PSRFITS_POLN")
@@ -312,6 +332,38 @@ class SpectraInfo:
             self.N += self.num_pad[ii - 1]
         self.N += self.num_spec[ii]
 
+    def _validate_subint(self, ii: int, subint) -> None:
+        """Sanity-bound the SUBINT geometry before any derived math
+        trusts it: a bit-flipped NBITS of 0 divides by zero in
+        bytes_per_spectra, a garbage NCHAN of 2**30 allocates gigabyte
+        tables, a non-finite TBIN poisons every timestamp."""
+        path = self.filenames[ii]
+
+        def bad(detail):
+            raise DataFormatError(path, f"insane SUBINT header: {detail}")
+
+        try:
+            dt = float(self.dt)
+            nchan = int(self.num_channels)
+            npol = int(self.num_polns)
+            nsblk = int(subint["NSBLK"])
+            nbits = int(subint["NBITS"])
+            nrows = int(subint["NAXIS2"])
+        except (TypeError, ValueError) as e:
+            bad(f"non-numeric geometry field ({e})")
+        if not (math.isfinite(dt) and dt > 0):
+            bad(f"TBIN={self.dt!r} not a positive finite float")
+        if not 1 <= nchan <= (1 << 20):
+            bad(f"NCHAN={nchan} outside [1, 2**20]")
+        if not 1 <= npol <= 8:
+            bad(f"NPOL={npol} outside [1, 8]")
+        if not 1 <= nsblk <= (1 << 24):
+            bad(f"NSBLK={nsblk} outside [1, 2**24]")
+        if nbits not in (1, 2, 4, 8, 16, 32):
+            bad(f"NBITS={nbits} not one of (1, 2, 4, 8, 16, 32)")
+        if nrows < 0:
+            bad(f"NAXIS2={nrows} negative")
+
     def __getitem__(self, key):
         return getattr(self, key)
 
@@ -357,6 +409,17 @@ class PsrfitsFile:
         if not os.path.isfile(psrfitsfn):
             raise ValueError(f"ERROR: File does not exist!\n\t({psrfitsfn})")
         self.filename = psrfitsfn
+        try:
+            self._open(psrfitsfn)
+        except DataFormatError:
+            raise
+        except Exception as e:  # noqa: BLE001 - one taxonomy (see
+            # SpectraInfo.__init__)
+            raise DataFormatError(
+                psrfitsfn,
+                f"malformed PSRFITS ({type(e).__name__}: {e})") from e
+
+    def _open(self, psrfitsfn: str):
         self.fits = _fits().open(psrfitsfn, mode="readonly", memmap=True)
         self.specinfo = SpectraInfo([psrfitsfn])
         self.header = self.fits[0].header
@@ -443,17 +506,33 @@ class PsrfitsFile:
 
     def get_spectra(self, startsamp: int, N: int) -> Spectra:
         """[chan, time] Spectra spanning subints, truncated to exactly N
-        samples, flipped to high-frequency-first (reference :143-183)."""
+        samples, flipped to high-frequency-first (reference :143-183).
+        Garbage payload bytes (a DATA cell whose length no longer
+        matches the declared geometry) surface as a located
+        :class:`DataFormatError`, not a reshape ValueError."""
         startsamp = int(startsamp)
         N = int(N)
-        startsub = startsamp // self.nsamp_per_subint
-        skip = startsamp - startsub * self.nsamp_per_subint
-        endsub = (startsamp + N - 1) // self.nsamp_per_subint
+        # range check OUTSIDE the wrapper: a caller bug, not bad data
         if startsamp < 0 or startsamp + N > self.nspec:
             raise ValueError(
                 f"requested samples [{startsamp}, {startsamp + N}) outside "
                 f"file range [0, {self.nspec})"
             )
+        try:
+            return self._get_spectra(startsamp, N)
+        except DataFormatError:
+            raise
+        except Exception as e:  # noqa: BLE001 - one taxonomy (see
+            # SpectraInfo.__init__)
+            raise DataFormatError(
+                self.filename,
+                f"malformed SUBINT payload ({type(e).__name__}: "
+                f"{e})") from e
+
+    def _get_spectra(self, startsamp: int, N: int) -> Spectra:
+        startsub = startsamp // self.nsamp_per_subint
+        skip = startsamp - startsub * self.nsamp_per_subint
+        endsub = (startsamp + N - 1) // self.nsamp_per_subint
         blocks = [self.read_subint(isub) for isub in range(startsub, endsub + 1)]
         data = np.concatenate(blocks) if len(blocks) > 1 else blocks[0]
         data = data.T[:, skip : skip + N]

@@ -10,8 +10,11 @@ Little-endian throughout (SIGPROC convention on all modern hardware).
 
 from __future__ import annotations
 
+import math
 import struct
 from typing import BinaryIO, Dict, List, Tuple
+
+from pypulsar_tpu.io.errors import DataFormatError, read_exact
 
 # keyword -> struct code ('str' for length-prefixed strings)
 HEADER_TYPES: Dict[str, str] = {
@@ -80,46 +83,120 @@ ids_to_machine = {
 machine_to_ids = {v: k for k, v in ids_to_machine.items()}
 
 
-def _read_string(f: BinaryIO) -> str:
-    (n,) = struct.unpack("<i", f.read(4))
+# upper bound on header entries: a real header holds ~25 keywords; a
+# garbage stream that keeps yielding decodable strings must terminate
+# with a clean error, not walk megabytes of payload as "header"
+MAX_HEADER_KEYS = 512
+
+# sanity bounds for validate_header: (min, max) inclusive
+_NCHANS_MAX = 1 << 20
+_NIFS_MAX = 64
+_SUPPORTED_NBITS = (1, 2, 4, 8, 16, 32)
+
+
+def _path_of(f: BinaryIO, path: str = None) -> str:
+    return path if path is not None else getattr(f, "name", "<stream>")
+
+
+def _read_string(f: BinaryIO, path: str = None) -> str:
+    path = _path_of(f, path)
+    pos = f.tell()
+    (n,) = struct.unpack("<i", read_exact(f, 4, path,
+                                          "header string length"))
     if not 0 < n < 256:
-        raise ValueError(f"invalid SIGPROC header string length {n}")
-    return f.read(n).decode("ascii", errors="replace")
+        raise DataFormatError(
+            path, f"invalid SIGPROC header string length {n}", offset=pos)
+    return read_exact(f, n, path, "header string").decode(
+        "ascii", errors="replace")
 
 
-def read_hdr_val(f: BinaryIO) -> Tuple[str, object]:
-    """Read one (keyword, value) pair; value is None for START/END markers."""
-    key = _read_string(f)
+def read_hdr_val(f: BinaryIO, path: str = None) -> Tuple[str, object]:
+    """Read one (keyword, value) pair; value is None for START/END markers.
+
+    Truncated or malformed fields raise :class:`DataFormatError` with the
+    file path and byte offset (never a bare ``struct.error``)."""
+    path = _path_of(f, path)
+    pos = f.tell()
+    key = _read_string(f, path)
     if key in ("HEADER_START", "HEADER_END"):
         return key, None
     code = HEADER_TYPES.get(key)
     if code is None:
-        raise ValueError(f"unknown SIGPROC header keyword {key!r}")
+        raise DataFormatError(
+            path, f"unknown SIGPROC header keyword {key!r}", offset=pos)
     if code == "str":
-        return key, _read_string(f)
+        return key, _read_string(f, path)
     size = struct.calcsize("<" + code)
-    (val,) = struct.unpack("<" + code, f.read(size))
+    (val,) = struct.unpack(
+        "<" + code, read_exact(f, size, path, f"value of {key!r}"))
     return key, val
 
 
-def read_header(f: BinaryIO) -> Tuple[Dict[str, object], List[str], int]:
+def read_header(f: BinaryIO, path: str = None
+                ) -> Tuple[Dict[str, object], List[str], int]:
     """Read a full header from an open file positioned at 0.
 
     Returns (header dict, keyword order, header size in bytes).
+    Malformed/truncated headers raise :class:`DataFormatError`.
     """
+    path = _path_of(f, path)
     f.seek(0)
-    key, _ = read_hdr_val(f)
+    key, _ = read_hdr_val(f, path)
     if key != "HEADER_START":
-        raise ValueError("not a SIGPROC filterbank file (missing HEADER_START)")
+        raise DataFormatError(
+            path, "not a SIGPROC filterbank file (missing HEADER_START)",
+            offset=0)
     header: Dict[str, object] = {}
     order: List[str] = []
     while True:
-        key, val = read_hdr_val(f)
+        if len(order) > MAX_HEADER_KEYS:
+            raise DataFormatError(
+                path, f"runaway header: more than {MAX_HEADER_KEYS} "
+                      f"keywords without HEADER_END", offset=f.tell())
+        key, val = read_hdr_val(f, path)
         if key == "HEADER_END":
             break
         header[key] = val
         order.append(key)
     return header, order, f.tell()
+
+
+def validate_header(header: Dict[str, object], path: str) -> None:
+    """Sanity-check a parsed header before ANY geometry math trusts it.
+
+    A bit-flipped nchans of 2**30 would otherwise allocate gigabyte
+    frequency tables; nbits=0 would divide by zero; a NaN tsamp would
+    poison every derived time. Raises :class:`DataFormatError` naming
+    the offending field."""
+    def bad(detail):
+        raise DataFormatError(path, f"insane header: {detail}")
+
+    # nbits is required too: FilterbankFile indexes it unconditionally,
+    # and a mutation that drops the key must be a DATA error, not a
+    # KeyError escaping the parse-or-DataFormatError contract
+    for key in ("nchans", "tsamp", "fch1", "foff", "nbits"):
+        if key not in header:
+            bad(f"required key {key!r} missing")
+    nchans = header["nchans"]
+    if not isinstance(nchans, int) or not 1 <= nchans <= _NCHANS_MAX:
+        bad(f"nchans={nchans!r} outside [1, {_NCHANS_MAX}]")
+    nbits = header["nbits"]
+    if nbits not in _SUPPORTED_NBITS:
+        bad(f"nbits={nbits!r} not one of {_SUPPORTED_NBITS}")
+    tsamp = header["tsamp"]
+    if not (isinstance(tsamp, float) and math.isfinite(tsamp)
+            and tsamp > 0):
+        bad(f"tsamp={tsamp!r} not a positive finite float")
+    for key in ("fch1", "foff"):
+        v = header[key]
+        if not (isinstance(v, (int, float)) and math.isfinite(v)):
+            bad(f"{key}={v!r} not finite")
+    nifs = header.get("nifs", 1)
+    if not isinstance(nifs, int) or not 1 <= nifs <= _NIFS_MAX:
+        bad(f"nifs={nifs!r} outside [1, {_NIFS_MAX}]")
+    nsamples = header.get("nsamples", 0)
+    if not isinstance(nsamples, int) or nsamples < 0:
+        bad(f"nsamples={nsamples!r} negative or non-integer")
 
 
 def addto_hdr(key: str, value) -> bytes:
@@ -147,20 +224,28 @@ def pack_header(header: Dict[str, object], order=None) -> bytes:
 
 
 def ra_to_hms_string(src_raj: float) -> str:
-    """SIGPROC src_raj double (HHMMSS.S) -> 'HH:MM:SS.SSSS'."""
+    """SIGPROC src_raj double (HHMMSS.S) -> 'HH:MM:SS.SSSS'.
+
+    Field splits use floor division on the integer part (the py2-era
+    ``int(v / 10000)`` truncated through a float quotient, which loses
+    at values like 235959.9999 where v/100 rounds up past the field
+    boundary)."""
     sign = "-" if src_raj < 0 else ""
     v = abs(src_raj)
-    hh = int(v / 10000)
-    mm = int((v - hh * 10000) / 100)
+    whole = int(v)
+    hh = whole // 10000
+    mm = (whole - hh * 10000) // 100
     ss = v - hh * 10000 - mm * 100
     return f"{sign}{hh:02d}:{mm:02d}:{ss:07.4f}"
 
 
 def dec_to_dms_string(src_dej: float) -> str:
-    """SIGPROC src_dej double (DDMMSS.S) -> 'DD:MM:SS.SSSS'."""
+    """SIGPROC src_dej double (DDMMSS.S) -> 'DD:MM:SS.SSSS' (floor-split
+    like :func:`ra_to_hms_string`)."""
     sign = "-" if src_dej < 0 else ""
     v = abs(src_dej)
-    dd = int(v / 10000)
-    mm = int((v - dd * 10000) / 100)
+    whole = int(v)
+    dd = whole // 10000
+    mm = (whole - dd * 10000) // 100
     ss = v - dd * 10000 - mm * 100
     return f"{sign}{dd:02d}:{mm:02d}:{ss:07.4f}"

@@ -284,6 +284,29 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
             health_bits.append(f"{label}={n}")
     if health_bits:
         p("#\n# fleet health: " + "  ".join(health_bits))
+    # data-quality roll-up: what the dataguard scrub and the finite
+    # gates did to this run's bytes (round 13)
+    data_bits = []
+    cells = s.counters.get("data.cells", 0)
+    bad = s.counters.get("data.nonfinite_cells", 0)
+    if bad:
+        frac = bad / cells if cells else 0.0
+        data_bits.append(f"nonfinite cells scrubbed={_fmt_count(bad)} "
+                         f"({frac:.3%} of {_fmt_count(cells)})")
+    elif cells:
+        data_bits.append(f"cells checked={_fmt_count(cells)} (all "
+                         f"finite)")
+    for key, label in (
+            ("data.nonfinite_cands_dropped", "non-finite rows gated"),
+            ("survey.data_quarantines", "data quarantines")):
+        v = s.counters.get(key)
+        if v:
+            data_bits.append(f"{label}={_fmt_count(v)}")
+    n_salv = s.events.get("data.nonfinite_scrubbed")
+    if n_salv:
+        data_bits.append(f"scrub events={n_salv}")
+    if data_bits:
+        p("#\n# data quality: " + "  ".join(data_bits))
     if s.last_device is not None:
         p(f"#\n# device snapshot ({s.last_device.get('tag', '?')}):")
         for d in s.last_device.get("devices", []):

@@ -77,8 +77,12 @@ def write_candfiles(candfn: str, txtfn: str, cands, T: float,
     the pair's header/row-count agreement to tell a legitimately empty
     result from a killed run's debris."""
     from pypulsar_tpu.io.prestocand import write_rzwcands
+    from pypulsar_tpu.resilience.dataguard import finite_cands
     from pypulsar_tpu.resilience.journal import atomic_write_text
 
+    # finite gate BEFORE the cap: a NaN-sigma row must not occupy one of
+    # the max_cands slots, and no non-finite value may reach the tables
+    cands = finite_cands(cands, T, what=os.path.basename(candfn))
     cands = cands[:max_cands]
     lines = ["# cand   sigma    power  numharm          r          z"
              "        freq(Hz)       fdot(Hz/s)      period(s)\n"]

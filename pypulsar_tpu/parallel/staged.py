@@ -356,8 +356,13 @@ def _masked_block(data, table, base, rem, pts: int):
 
 
 def _make_source(source, rfimask=None):
+    from pypulsar_tpu.resilience import dataguard
+
     src = (_SpectraSource(source) if hasattr(source, "numspectra")
            else _ReaderSource(source))
+    # dataguard INSIDE the mask wrapper: the mask fill's channel medians
+    # must never see a NaN (it would poison the whole channel's fill)
+    src = dataguard.guard_source(src)
     if rfimask is not None:
         src = _MaskedSource(src, rfimask)
     return src
@@ -562,10 +567,23 @@ def _reroot_source(src, start_raw: int):
     """A view of ``src`` whose blocks begin at raw sample ``start_raw``
     (same end bound), or None when the source cannot seek. Positions stay
     file-absolute, so the resumed stream's chunks carry the same
-    coordinates they had in the original run."""
+    coordinates they had in the original run. (One public entry point:
+    the wrapper recursion lives in :func:`_reroot_impl`.)"""
+    return _reroot_impl(src, start_raw)
+
+
+def _reroot_impl(src, start_raw: int):
+    from pypulsar_tpu.resilience.dataguard import GuardedSource
+
     if isinstance(src, _MaskedSource):
-        inner = _reroot_source(src._src, start_raw)
+        inner = _reroot_impl(src._src, start_raw)
         return None if inner is None else _MaskedSource(inner, src._mask)
+    if isinstance(src, GuardedSource):
+        # rewrap sharing the SAME quality account: the resumed stream's
+        # scrub continues the original tally instead of forking it
+        inner = _reroot_impl(src._src, start_raw)
+        return None if inner is None else GuardedSource(inner,
+                                                        stats=src.stats)
     if isinstance(src, _ReaderSource):
         end = src.end if src.end < src.total else None
         return _ReaderSource(src.reader, start_raw, end)
@@ -987,6 +1005,9 @@ def iter_dedispersed_chunks(
         raise ValueError(f"bad window [{s0}, {s1}) of {T}")
     src = _ReaderSource(reader, s0 * factor,
                         min(s1 * factor, probe.total) if s1 < T else None)
+    from pypulsar_tpu.resilience import dataguard
+
+    src = dataguard.guard_source(src)
     if rfimask is not None:
         src = _MaskedSource(src, rfimask)
     s1b = jnp.asarray(plan.stage1_bins)

@@ -73,11 +73,14 @@ __all__ = [
     "configure",
     "configure_chaos",
     "configure_from_env",
+    "corrupt_array",
+    "data_faults_armed",
     "fired_counts",
     "hits",
     "is_armed",
     "reset",
     "trip",
+    "trip_data",
 ]
 
 ENV_FAULTS = "PYPULSAR_TPU_FAULTS"
@@ -85,6 +88,14 @@ ENV_CHAOS = "PYPULSAR_TPU_CHAOS"
 ENV_HANG_S = "PYPULSAR_TPU_HANG_S"
 
 KINDS = ("oom", "io", "kill", "exit", "hang", "device")
+
+# DATA fault kinds (round 13): not exceptions but *mutations* — an armed
+# data fault at a read-time point corrupts the block flowing through it
+# (``trip_data``), exercising the dataguard scrub + finite-output gates
+# the way a real bit-flipped recording would. ``truncate`` at block
+# granularity zeroes the block tail (mid-stream shapes are static; the
+# file-level truncation lives in resilience.dataguard.corrupt_file).
+DATA_KINDS = ("nanburst", "dropblock", "dcjump", "bitflip", "truncate")
 
 # chaos never draws `exit`: os._exit would kill the very harness that
 # must resume the fleet and assert parity
@@ -135,6 +146,8 @@ class InjectedDeviceFault(InjectedFault, RuntimeError):
 
 # (kind, point) -> 1-based hit index at which to fire (popped once fired)
 _armed: Dict[Tuple[str, str], int] = {}
+# same grammar, DATA kinds: fired by trip_data (mutation, not raise)
+_armed_data: Dict[Tuple[str, str], int] = {}
 _hits: Dict[str, int] = {}
 
 # chaos mode: None, or (seed, rate, kinds tuple)
@@ -163,9 +176,9 @@ def parse_spec(spec: str) -> Dict[Tuple[str, str], int]:
         else:
             raise ValueError(f"bad fault spec entry {part!r}; expected "
                              f"kind:point[:N]")
-        if kind not in KINDS:
+        if kind not in KINDS and kind not in DATA_KINDS:
             raise ValueError(f"unknown fault kind {kind!r}; expected one "
-                             f"of {KINDS}")
+                             f"of {KINDS + DATA_KINDS}")
         if n < 1:
             raise ValueError(f"fault hit index must be >= 1; got {n}")
         out[(kind, point)] = n
@@ -180,10 +193,18 @@ def configure(spec: Optional[str]) -> None:
     deterministic fault on top of an active chaos spray composes instead
     of silently disarming it."""
     _armed.clear()
+    _armed_data.clear()
     _hits.clear()
     _fired.clear()
     if spec:
-        _armed.update(parse_spec(spec))
+        _arm(parse_spec(spec))
+
+
+def _arm(parsed: Dict[Tuple[str, str], int]) -> None:
+    """Route parsed spec entries to the exception-armed or data-armed
+    set by kind (one grammar, two firing mechanisms)."""
+    for (kind, point), n in parsed.items():
+        (_armed_data if kind in DATA_KINDS else _armed)[(kind, point)] = n
 
 
 def parse_chaos_spec(spec: str) -> Tuple[int, float, Tuple[str, ...]]:
@@ -222,7 +243,7 @@ def configure_from_env() -> None:
     the armed set alone so a CLI flag survives)."""
     spec = os.environ.get(ENV_FAULTS)
     if spec:
-        _armed.update(parse_spec(spec))
+        _arm(parse_spec(spec))
     chaos = os.environ.get(ENV_CHAOS)
     if chaos:
         configure_chaos(chaos)
@@ -233,6 +254,7 @@ def reset() -> None:
     isolation)."""
     global _chaos
     _armed.clear()
+    _armed_data.clear()
     _hits.clear()
     _fired.clear()
     _chaos = None
@@ -240,6 +262,12 @@ def reset() -> None:
 
 def is_armed() -> bool:
     return bool(_armed)
+
+
+def data_faults_armed() -> bool:
+    """True when any DATA fault kind is armed (the dataguard wraps even
+    integer sources then, so the injection has somewhere to land)."""
+    return bool(_armed_data)
 
 
 def chaos_active() -> bool:
@@ -267,7 +295,10 @@ def add_fault_flag(parser):
         help="arm deterministic faults for resilience testing: "
              "kind:point[:N],... with kinds oom|io|kill|exit|hang|device "
              "(e.g. oom:accel.batch_dispatch:2 injects a device OOM on "
-             "the 2nd batched accel dispatch); also via the "
+             "the 2nd batched accel dispatch) or the DATA kinds "
+             "nanburst|dropblock|dcjump|bitflip|truncate, which corrupt "
+             "the block at a read-time point (e.g. nanburst:data.block:2) "
+             "instead of raising; also via the "
              f"{ENV_FAULTS} env var")
     return parser
 
@@ -352,3 +383,75 @@ def trip(point: str) -> None:
         kind = _chaos_roll(point, n)
         if kind is not None:
             _fire(kind, point, n, "chaos")
+
+
+def trip_data(point: str, arr):
+    """Data-fault hook at a read-time point: return ``arr``, corrupted
+    when an armed DATA fault's 1-based hit index is reached, else
+    unchanged. Corruption is deterministic — the RNG seeds from
+    (kind, point, hit) — so a redone unit replays the identical bytes
+    (the recovery-parity contract the exception kinds already honor).
+    The nothing-armed fast path is one truthiness check."""
+    if not _armed_data:
+        return arr
+    n = _hits.get(point, 0) + 1
+    _hits[point] = n
+    for kind in DATA_KINDS:
+        key = (kind, point)
+        if _armed_data.get(key) == n:
+            del _armed_data[key]
+            _fired[kind] = _fired.get(kind, 0) + 1
+            telemetry.counter("resilience.faults_injected")
+            telemetry.event("resilience.fault_injected", kind=kind,
+                            point=point, hit=n, mode="armed")
+            return corrupt_array(arr, kind, _data_rng(kind, point, n))
+    return arr
+
+
+def _data_rng(kind: str, point: str, n: int):
+    import numpy as np
+
+    h = hashlib.sha256(f"data:{kind}:{point}:{n}".encode()).digest()
+    return np.random.Generator(np.random.SFC64(
+        list(h[:16])))
+
+
+def corrupt_array(arr, kind: str, rng):
+    """Apply one DATA fault kind to a block (any array; returns a host
+    numpy copy — the dataguard scrub downstream re-ships it). Spans are
+    ~5%% of the last axis at a seeded offset."""
+    import numpy as np
+
+    a = np.array(arr)  # host copy (syncs a device block; faults are rare)
+    flat = a.reshape(-1)
+    size = flat.size
+    if size == 0:
+        return a
+    span = max(1, size // 20)
+    start = int(rng.integers(0, max(size - span, 1)))
+    if kind == "nanburst":
+        if not np.issubdtype(a.dtype, np.floating):
+            a = a.astype(np.float32)
+            flat = a.reshape(-1)
+        flat[start:start + span] = np.nan
+        flat[start] = np.inf
+    elif kind == "dropblock":
+        flat[start:start + span] = 0
+    elif kind == "truncate":
+        flat[size - span:] = 0  # block tails are static-shaped: zero them
+    elif kind == "dcjump":
+        if np.issubdtype(a.dtype, np.floating):
+            flat[start:start + span] += np.float32(1e4)
+        else:
+            info = np.iinfo(a.dtype)
+            seg = flat[start:start + span].astype(np.int64) + info.max // 2
+            flat[start:start + span] = np.clip(seg, info.min,
+                                               info.max).astype(a.dtype)
+    elif kind == "bitflip":
+        view = a.view(np.uint8).reshape(-1)
+        offs = rng.integers(0, view.size, size=min(64, view.size))
+        bits = rng.integers(0, 8, size=offs.size)
+        view[offs] ^= (np.uint8(1) << bits.astype(np.uint8))
+    else:
+        raise ValueError(f"unknown data fault kind {kind!r}")
+    return a

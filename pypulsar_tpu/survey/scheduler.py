@@ -173,6 +173,7 @@ class FleetScheduler:
                  strike_limit: Optional[int] = None,
                  min_free_mb: Optional[float] = None,
                  max_pending: Optional[float] = None,
+                 max_bad_frac: Optional[float] = None,
                  jitter_rng=None,
                  verbose: bool = False):
         self.cfg = cfg if cfg is not None else SurveyConfig()
@@ -229,6 +230,15 @@ class FleetScheduler:
             min_free_bytes=(min_free_mb * 1e6
                             if min_free_mb is not None else None),
             max_pending=max_pending)
+        # degrade-vs-quarantine threshold for the INGEST data-quality
+        # verdict (resilience.dataguard): an observation whose input
+        # reports more than this fraction of its samples missing/invalid
+        # is data-quarantined before burning any device time
+        if max_bad_frac is None:
+            from pypulsar_tpu.resilience import dataguard
+
+            max_bad_frac = dataguard.max_bad_frac_default()
+        self.max_bad_frac = float(max_bad_frac)
         self._admission_blocked = False  # one event per pause episode
 
         self._lock = threading.Lock()
@@ -291,6 +301,67 @@ class FleetScheduler:
                     os.path.join(self.telemetry_dir, f"{obs.name}.jsonl"),
                     obs.name, append=self.resume)
             self._traces.append(trace)
+
+    # -- ingest data validation ---------------------------------------------
+
+    def _validate_ingest(self) -> None:
+        """Validate every observation's INPUT before any stage runs
+        (resilience.dataguard.validate_input): a recognized-but-broken
+        file, or one whose data-quality report exceeds --max-bad-frac,
+        is quarantined with reason ``"data"`` — distinct from runtime
+        quarantine, because the fix is a re-transfer, not a retry.
+        Salvageable inputs record their report in the manifest (the
+        --status / tlmsum denominators) and DEGRADE: the readers carry
+        the valid prefix through the chain."""
+        from pypulsar_tpu.io.errors import DataFormatError
+        from pypulsar_tpu.resilience import dataguard
+
+        for i, obs in enumerate(self.obs):
+            try:
+                report = dataguard.validate_input(obs.infile)
+            except DataFormatError as e:
+                self._quarantine_data(i, f"{type(e).__name__}: {e}")
+                continue
+            except Exception as e:  # noqa: BLE001 - see below
+                # an unexpected validation failure (OSError on a flaky
+                # mount, a codec corner the wrappers missed) must not
+                # abort the WHOLE fleet at startup — admit the obs and
+                # let the stage machinery's retry->quarantine own it
+                print(f"# survey: {obs.name}: ingest validation failed "
+                      f"({type(e).__name__}: {e}); admitting unchecked")
+                continue
+            if report is None:
+                continue  # unrecognized/missing: the stage reports it
+            self._manifests[i].note_data_quality(report)
+            bad = float(report.get("bad_frac", 0.0) or 0.0)
+            if bad > self.max_bad_frac:
+                self._quarantine_data(
+                    i, f"data-quality bad_frac {bad:.3f} exceeds "
+                       f"--max-bad-frac {self.max_bad_frac:.3f}")
+            elif bad and self.verbose:
+                print(f"# survey: {obs.name}: degraded input admitted "
+                      f"(bad_frac {bad:.3f} <= {self.max_bad_frac:.3f})")
+
+    def _quarantine_data(self, obs_i: int, error: str) -> None:
+        obs = self.obs[obs_i]
+        self._manifests[obs_i].quarantine("ingest", error, reason="data")
+        telemetry.counter("survey.data_quarantines")
+        telemetry.event("survey.quarantine", obs=obs.name,
+                        stage="ingest", reason="data")
+        trace = self._traces[obs_i]
+        if trace is not None:
+            trace.event("survey.quarantine", stage="ingest",
+                        reason="data")
+        print(f"# survey: DATA-QUARANTINED {obs.name} at ingest: {error} "
+              f"(fleet continues)")
+        with self._cv:
+            for s in self.stages:
+                t = self._tasks[(obs_i, s.name)]
+                if t.state != _DONE:
+                    t.state = _QUARANTINED
+            self.result.quarantined[obs.name] = {
+                "stage": "ingest", "error": error, "reason": "data"}
+            self._cv.notify_all()
 
     # -- scheduling core ----------------------------------------------------
 
@@ -884,6 +955,7 @@ class FleetScheduler:
         kill, KeyboardInterrupt) after the in-flight stages settle."""
         self._t0 = time.perf_counter()
         self._open_manifests()
+        self._validate_ingest()
         if self._needs_watchdog():
             # heartbeats ride the telemetry the stages already record;
             # the hook is process-global, so it is installed only for

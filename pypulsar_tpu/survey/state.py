@@ -158,9 +158,24 @@ class ObsManifest:
         with self._lock:
             self._journal.done(f"stage:{stage}", outputs)
 
-    def quarantine(self, stage: str, error: str) -> None:
+    def quarantine(self, stage: str, error: str,
+                   reason: Optional[str] = None) -> None:
+        """``reason="data"`` marks an INPUT verdict (ingest validation,
+        --max-bad-frac) as distinct from a runtime quarantine — the
+        operator's fix is a re-transfer, not a retry."""
         with self._lock:
-            self._journal.note(event="quarantine", stage=stage, error=error)
+            rec = {"event": "quarantine", "stage": stage, "error": error}
+            if reason:
+                rec["reason"] = reason
+            self._journal.note(**rec)
+
+    def note_data_quality(self, report: Dict) -> None:
+        """Record the ingest data-quality report once per manifest (the
+        denominators --status and the tlmsum roll-up render: fraction
+        masked/missing, salvaged span, fault kinds seen)."""
+        with self._lock:
+            if not self._journal.notes(event="data_quality"):
+                self._journal.note(event="data_quality", **report)
 
     def note_retry(self, stage: str, attempt: int, error: str) -> None:
         """Record one retry verdict (attempt number + the error that
@@ -211,6 +226,7 @@ def status_rows(manifest_paths: Sequence[str]) -> List[Dict]:
         stages: List[str] = []
         done: List[str] = []
         quarantine = None
+        data_quality = None
         retries: Dict[str, Dict] = {}
         for rec in recs:
             if rec.get("type") == "note" and rec.get("event") == "plan":
@@ -231,6 +247,13 @@ def status_rows(manifest_paths: Sequence[str]) -> List[Dict]:
             elif rec.get("type") == "note" and rec.get("event") == "quarantine":
                 quarantine = {"stage": rec.get("stage", "?"),
                               "error": rec.get("error", "?")}
+                if rec.get("reason"):
+                    quarantine["reason"] = rec["reason"]
+            elif (rec.get("type") == "note"
+                  and rec.get("event") == "data_quality"):
+                data_quality = {k: rec.get(k) for k in
+                                ("format", "nsamples", "bad_frac",
+                                 "salvage") if k in rec}
             elif rec.get("type") == "note" and rec.get("event") == "retry":
                 # last verdict per stage wins: attempts is the running
                 # count, the error excerpt is the freshest reason
@@ -239,7 +262,7 @@ def status_rows(manifest_paths: Sequence[str]) -> List[Dict]:
                     "error": str(rec.get("error", ""))}
         rows.append({"obs": obs, "manifest": path, "stages": stages,
                      "done": done, "quarantine": quarantine,
-                     "retries": retries})
+                     "data_quality": data_quality, "retries": retries})
     return rows
 
 
@@ -262,7 +285,9 @@ def format_status(rows: Sequence[Dict],
         n_retries = sum(v.get("attempts", 0) for v in retries.values())
         if r["quarantine"] is not None:
             q = r["quarantine"]
-            state = (f"QUARANTINED at {q['stage']} "
+            tag = ("DATA-QUARANTINED" if q.get("reason") == "data"
+                   else "QUARANTINED")
+            state = (f"{tag} at {q['stage']} "
                      f"({_excerpt(q['error'])})")
         elif r["stages"] and len(done) == len(r["stages"]):
             state = "complete"
@@ -278,6 +303,18 @@ def format_status(rows: Sequence[Dict],
                         key=lambda kv: kv[1].get("attempts", 0))
             state += (f" [retried {worst[0]} x{worst[1]['attempts']}: "
                       f"{_excerpt(worst[1].get('error', ''))}]")
+        dq = r.get("data_quality")
+        if dq:
+            bits = []
+            if dq.get("bad_frac"):
+                bits.append(f"bad {100.0 * dq['bad_frac']:.1f}%")
+            salv = dq.get("salvage")
+            if salv and salv.get("missing_samples"):
+                bits.append(f"salvaged {salv.get('read_samples', '?')}"
+                            f"/{salv.get('expected_samples', '?')} "
+                            f"samples")
+            if bits:
+                state += " [data: " + ", ".join(bits) + "]"
         lines.append(f"# {r['obs']:<20s} {prog:<10s} {n_retries:<8d} "
                      f"{state}")
     if health:

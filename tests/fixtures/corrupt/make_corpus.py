@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Regenerate the checked-in corrupted-fixture corpus.
+
+Every fixture is built deterministically from a small VALID file plus
+ONE corruption from the shared recipe (``resilience.dataguard.
+corrupt_file`` / targeted byte surgery) — never hand-hexed bytes, so
+the corpus can always be regenerated and audited:
+
+    python tests/fixtures/corrupt/make_corpus.py
+
+The filename prefix encodes the reader contract tests/test_dataguard.py
+asserts for each file:
+
+- ``err_``  — the reader must raise ``DataFormatError`` (located: path
+  in the message), never a raw ``struct.error``/``IndexError``/hang;
+- ``salv_`` — the reader must OPEN the file, expose a non-None
+  ``salvage`` report, and read the whole valid prefix;
+- ``ok_``   — the reader must parse cleanly (the damage is payload-
+  level and the dataguard scrub downstream owns it).
+
+Extensions map to readers: ``.fil`` -> FilterbankFile, ``.fits`` ->
+PsrfitsFile, ``.dat`` (+ ``.inf`` sidecar) -> Datfile.
+"""
+
+import os
+import struct
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, "..", "..", ".."))
+
+from pypulsar_tpu.io import sigproc  # noqa: E402
+from pypulsar_tpu.io.datfile import write_dat  # noqa: E402
+from pypulsar_tpu.io.filterbank import write_filterbank  # noqa: E402
+from pypulsar_tpu.io.infodata import InfoData  # noqa: E402
+from pypulsar_tpu.io.psrfits import write_psrfits  # noqa: E402
+from pypulsar_tpu.resilience.dataguard import corrupt_file  # noqa: E402
+
+C, T = 8, 64  # tiny: the whole corpus stays a few KB
+
+
+def _base_fil(fn):
+    rng = np.random.default_rng(11)
+    data = rng.standard_normal((T, C)).astype(np.float32)
+    write_filterbank(fn, dict(nchans=C, tsamp=1e-3, fch1=1500.0,
+                              foff=-1.0, nbits=32,
+                              source_name="CORPUS"), data)
+    return fn
+
+
+def _patched_fil(fn, **patch):
+    """A .fil whose header carries GARBAGE field values: pack_header
+    writes what validate_header must reject (a writer round-trip cannot
+    produce these, so the corpus patches the packed bytes directly)."""
+    _base_fil(fn)
+    with open(fn, "rb") as f:
+        hdr, order, hsize = sigproc.read_header(f, path=fn)
+        payload = f.read()
+    hdr.update(patch)
+    with open(fn, "wb") as f:
+        f.write(sigproc.pack_header(hdr, order))
+        f.write(payload)
+
+
+def main():
+    # --- filterbank ---
+    f = _base_fil(os.path.join(HERE, "err_truncated_header.fil"))
+    os.truncate(f, 30)  # mid-keyword: read_exact must locate the cut
+    f = _base_fil(os.path.join(HERE, "salv_truncated_payload.fil"))
+    corrupt_file(f, "truncate", seed=1)
+    _patched_fil(os.path.join(HERE, "err_garbage_nbits.fil"), nbits=7)
+    _patched_fil(os.path.join(HERE, "err_garbage_nchans.fil"),
+                 nchans=1 << 30)
+    f = _base_fil(os.path.join(HERE, "err_garbage_keywords.fil"))
+    corrupt_file(f, "header", seed=2)
+    open(os.path.join(HERE, "err_zero_length.fil"), "wb").close()
+    with open(os.path.join(HERE, "err_not_sigproc.fil"), "wb") as fh:
+        fh.write(b"\x2a\x00\x00\x00NOT_A_HEADER" * 4)
+    f = _base_fil(os.path.join(HERE, "ok_nanburst_payload.fil"))
+    corrupt_file(f, "nanburst", seed=3)
+
+    # --- psrfits ---
+    rng = np.random.default_rng(13)
+    fits_data = rng.integers(0, 40, size=(C, T)).astype(np.float32)
+    freqs = 1500.0 - np.arange(float(C))
+    base = os.path.join(HERE, "err_truncated_payload.fits")
+    write_psrfits(base, fits_data, freqs, 1e-3, nsamp_per_subint=16,
+                  nbits=8)
+    os.truncate(base, os.path.getsize(base) * 2 // 3)
+    base = os.path.join(HERE, "err_garbage_subint.fits")
+    write_psrfits(base, fits_data, freqs, 1e-3, nsamp_per_subint=16,
+                  nbits=8)
+    # overwrite the SUBINT NSBLK card's value with an insane one:
+    # _validate_subint must reject the geometry with a located error
+    with open(base, "r+b") as fh:
+        img = fh.read()
+        at = img.index(b"NSBLK")
+        fh.seek(at)
+        fh.write(f"{'NSBLK':<8s}= {-5:>20d}".encode("ascii"))
+    open(os.path.join(HERE, "err_zero_length.fits"), "wb").close()
+
+    # --- .dat/.inf ---
+    inf = InfoData()
+    inf.epoch = 55000.0
+    inf.dt = 1e-3
+    inf.DM = 10.0
+    series = np.random.default_rng(17).standard_normal(T).astype(
+        np.float32)
+    b = os.path.join(HERE, "salv_truncated")
+    write_dat(b, series, inf)
+    os.truncate(b + ".dat", T * 4 * 2 // 3 + 2)  # mid-sample cut
+    b = os.path.join(HERE, "err_garbage_inf")
+    write_dat(b, series, inf)
+    with open(b + ".inf", "wb") as fh:
+        fh.write(b"\x00\xff" * 200)
+    # a zero-length .dat under a sidecar claiming T samples SALVAGES
+    # (reads the empty valid prefix, reports all T missing)
+    b = os.path.join(HERE, "salv_zero_length")
+    write_dat(b, series, inf)
+    open(b + ".dat", "wb").close()
+
+    names = sorted(n for n in os.listdir(HERE)
+                   if not n.endswith((".py", ".md")))
+    total = sum(os.path.getsize(os.path.join(HERE, n)) for n in names)
+    print(f"corpus: {len(names)} files, {total} bytes")
+    for n in names:
+        print(f"  {n}")
+
+
+if __name__ == "__main__":
+    main()

@@ -67,6 +67,15 @@ def parse_args(argv=None):
                          "observations")
     ap.add_argument("--start-mjd", type=float, default=60000.0,
                     help="header tstart MJD (default 60000.0)")
+    ap.add_argument("--corrupt", default=None, metavar="KIND[:SEED]",
+                    help="deterministically corrupt the written file "
+                         "in place (resilience.dataguard.corrupt_file: "
+                         "truncate|bitflip|dropblock|nanburst|dcjump|"
+                         "header) — bench and tests generate corrupted "
+                         "fixtures from this ONE code path instead of "
+                         "hand-hexed files. nanburst/dcjump need an "
+                         "f32 payload (this tool writes uint), so they "
+                         "are rejected here; SEED defaults to 0")
     return ap.parse_args(argv)
 
 
@@ -98,6 +107,10 @@ def main(argv=None):
     hdr = {
         "source_name": a.src_name or f"SYNTH_DM{a.dm:g}_P{P}",
         "fch1": a.fch1, "foff": foff, "nchans": C, "tsamp": a.tsamp,
+        # the sample count lets readers cross-check the file size and
+        # salvage (+ report) a truncated tail instead of silently
+        # shortening the observation
+        "nsamples": nsamp,
         "nbits": a.nbits, "nifs": 1, "tstart": a.start_mjd, "data_type": 1,
         "telescope_id": 0, "machine_id": 0, "barycentric": 0,
         "src_raj": 0.0, "src_dej": 0.0, "az_start": 0.0, "za_start": 0.0,
@@ -136,6 +149,17 @@ def main(argv=None):
           f"{total_bytes/1e9:.1f} GB in {time.time()-t0:.0f}s; injected "
           f"DM={a.dm} P={P*a.tsamp*1e3:.3f} ms ({P} samples) "
           f"width={a.width} amp={a.amp}")
+    if a.corrupt:
+        from pypulsar_tpu.resilience import dataguard
+
+        kind, _, seed = a.corrupt.partition(":")
+        if kind in ("nanburst", "dcjump"):
+            raise SystemExit(f"--corrupt {kind} needs an f32 payload; "
+                             f"this tool writes {a.nbits}-bit uints "
+                             f"(use truncate/bitflip/dropblock/header)")
+        desc = dataguard.corrupt_file(a.out, kind,
+                                      seed=int(seed) if seed else 0)
+        print(f"corrupted {a.out}: {desc}", file=sys.stderr)
 
 
 if __name__ == "__main__":
