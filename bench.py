@@ -153,7 +153,7 @@ def acquire_backend(retries=3, backoff=20.0):
         try:
             import jax
 
-            devs = jax.devices()
+            devs = jax.devices()  # psrlint: ignore[PL002] -- this IS the raw liveness probe the lease registry sits above
             # a device list can exist while the tunnel is wedged; prove
             # liveness with a tiny round-trip before committing to the run
             import jax.numpy as jnp
@@ -1525,7 +1525,7 @@ def run_survey(args):
         if args.devices > 1:
             import jax
 
-            ndev = len(jax.devices())
+            ndev = len(jax.devices())  # psrlint: ignore[PL002] -- fleet capacity check against the REAL inventory, outside any lease
             assert ndev >= args.devices, (
                 f"--devices {args.devices} needs that many JAX devices, "
                 f"have {ndev} (CPU recipe: XLA_FLAGS="
@@ -1670,7 +1670,7 @@ def run_survey(args):
         try:
             import jax
 
-            platform = jax.devices()[0].platform
+            platform = jax.devices()[0].platform  # psrlint: ignore[PL002] -- record annotation, runs after the fleet (no lease)
         except Exception:  # noqa: BLE001 - note is best-effort
             platform = "?"
         if platform == "cpu":

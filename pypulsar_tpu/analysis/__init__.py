@@ -1,0 +1,48 @@
+"""``pypulsar_tpu.analysis`` — psrlint, the project-invariant static
+analyzer (docs/ARCHITECTURE.md "Static analysis").
+
+Each rule locks in a bug class a past PR fixed by hand; the catalog
+lives in :mod:`pypulsar_tpu.analysis.rules`, the engine (AST walk,
+suppressions, select/ignore, JSON report) in
+:mod:`pypulsar_tpu.analysis.engine`.  The analysis modules themselves
+use only the stdlib (``ast`` + ``tokenize``) — no jax/numpy dependency
+of their own, though reaching them via ``pypulsar_tpu.cli`` still runs
+the normal parent-package import.
+
+>>> from pypulsar_tpu.analysis import run_psrlint
+>>> report = run_psrlint(["pypulsar_tpu"], root=".")
+>>> report.findings
+[]
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from pypulsar_tpu.analysis.engine import (  # noqa: F401
+    Finding, Report, run,
+)
+from pypulsar_tpu.analysis.rules import ALL_RULES, all_rules  # noqa: F401
+
+__all__ = ["Finding", "Report", "run_psrlint", "all_rules", "ALL_RULES"]
+
+
+def run_psrlint(paths: Sequence[str], root: str,
+                readme_path: Optional[str] = None,
+                select: Optional[str] = None,
+                ignore: Optional[str] = None,
+                baseline: Optional[dict] = None,
+                project_paths: Optional[Sequence[str]] = None) -> Report:
+    """Run the full rule catalog over ``paths`` (repo-relative unless
+    absolute).  ``readme_path`` defaults to ``<root>/README.md`` when
+    present (the PL004 registry side); pass ``project_paths`` (the full
+    default scope) when ``paths`` is a subset so cross-file rules keep
+    whole-tree context."""
+    import os
+
+    if readme_path is None:
+        cand = os.path.join(root, "README.md")
+        readme_path = cand if os.path.exists(cand) else None
+    return run(all_rules(), paths, root, readme_path=readme_path,
+               select=select, ignore=ignore, baseline=baseline,
+               project_paths=project_paths)

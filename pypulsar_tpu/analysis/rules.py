@@ -1,0 +1,689 @@
+"""psrlint's rule catalog — one rule per bug class this repo has
+already paid to fix by hand.  Each docstring cites the PR that fixed
+the class; the rule exists so the NEXT PR cannot reintroduce it.
+
+Scopes are deliberate: a rule runs only where its invariant holds
+(PL002 outside the lease registry, PL006 inside ``io/``, PL009 in the
+resilience-adjacent modules), so a clean run means the invariant holds
+where it matters, not that the rule was too timid to fire.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from pypulsar_tpu.analysis.engine import (
+    FileContext, Finding, ProjectContext, ProjectRule, Rule,
+)
+
+__all__ = ["ALL_RULES", "all_rules"]
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+
+def _is_test(ctx: FileContext) -> bool:
+    return (ctx.relpath.startswith("tests/")
+            or ctx.relpath.rsplit("/", 1)[-1].startswith("test_"))
+
+
+def _in_package(ctx: FileContext) -> bool:
+    return ctx.relpath.startswith("pypulsar_tpu/")
+
+
+def _call_name(node: ast.Call) -> str:
+    """Dotted-ish name of a call target: 'os.environ.get', 'range'."""
+    parts: List[str] = []
+    cur = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    return ".".join(reversed(parts))
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# PL001 — py2 truediv feeding an index/size context
+
+class TruedivIndexRule(Rule):
+    """``x[a / b]`` / ``range(a / b)``: the reference's py2 heritage
+    defect (PAPER.md; last hand-audit in PR 8's division sweep).  In
+    py3 ``/`` is float division, so an index/size built from it either
+    crashes or — worse, via downstream ``int()`` — silently truncates
+    differently than the py2 original.  Use ``//``.
+
+    Contexts covered: subscript indices/slice bounds and direct
+    ``range(...)`` arguments.  Climbing stops at any other call
+    boundary (``a[int(x / y)]`` is an explicit, visible coercion)."""
+
+    code = "PL001"
+    name = "py2-truediv-index"
+    summary = "true division feeding an index/size context; use //"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        parents = ctx.parents
+        for node in ctx.walk():
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Div)):
+                continue
+            cur = node
+            while True:
+                parent_entry = parents.get(cur)
+                if parent_entry is None:
+                    break
+                parent, field = parent_entry
+                if isinstance(parent, ast.Call):
+                    if (isinstance(parent.func, ast.Name)
+                            and parent.func.id == "range"
+                            and field == "args"):
+                        yield self.finding(
+                            ctx, node,
+                            "true division result used as a range() "
+                            "bound; use // (py2-heritage defect)")
+                    break
+                if isinstance(parent, ast.Subscript) and field == "slice":
+                    yield self.finding(
+                        ctx, node,
+                        "true division result used as a subscript "
+                        "index; use // (py2-heritage defect)")
+                    break
+                if isinstance(parent, ast.stmt):
+                    break
+                cur = parent
+
+
+# ---------------------------------------------------------------------------
+# PL002 — bare jax.devices() outside the lease registry
+
+class BareJaxDevicesRule(Rule):
+    """``jax.devices()`` anywhere but ``parallel/mesh.py`` bypasses the
+    gang-lease registry PR 6 introduced: a stage running under a lease
+    that probes raw device 0 can address a chip another gang owns.
+    Resolve through ``parallel.mesh.lease_devices()`` (lease first,
+    then default_device, then local devices)."""
+
+    code = "PL002"
+    name = "bare-jax-devices"
+    summary = "bare jax.devices() outside parallel/mesh.py"
+
+    _EXEMPT = "pypulsar_tpu/parallel/mesh.py"
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        if ctx.relpath == self._EXEMPT or _is_test(ctx):
+            return False
+        return (_in_package(ctx) or ctx.relpath.startswith("tools/")
+                or ctx.relpath == "bench.py")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ctx.walk():
+            if (isinstance(node, ast.Call)
+                    and _call_name(node) == "jax.devices"):
+                yield self.finding(
+                    ctx, node,
+                    "bare jax.devices() bypasses the gang-lease "
+                    "registry; use parallel.mesh.lease_devices() "
+                    "(PR 6 invariant)")
+
+
+# ---------------------------------------------------------------------------
+# PL003 — non-atomic artifact write
+
+_ARTIFACT_EXTS = (
+    ".dat", ".inf", ".cand", ".cands", ".txtcand", ".pfd", ".fil",
+    ".fits", ".sub", ".events", ".pulses", ".mask", ".json", ".jsonl",
+)
+_TMP_MARK = re.compile(r"\.tmp|tmp$|^tmp", re.IGNORECASE)
+_OUT_NAME = re.compile(r"^(out|dest|dst)[a-z_]*$")
+
+
+class NonAtomicWriteRule(Rule):
+    """A resumable pipeline's artifacts are validated by size/sha256
+    (PR 3): an ``open(path, 'w'/'wb')`` straight onto an artifact path
+    leaves a torn file behind a kill that later validation may accept.
+    Write ``path + '.tmp'`` and ``os.replace`` it, or use
+    ``resilience.journal.atomic_write_bytes/_text``.
+
+    Heuristic scope — flags a write-mode ``open`` whose path expression
+    names an artifact extension or an out-ish variable, unless the path
+    carries a tmp marker or the enclosing function calls
+    ``os.replace`` (the tmp+rename idiom in place)."""
+
+    code = "PL003"
+    name = "non-atomic-artifact-write"
+    summary = "write-mode open() on an artifact path without tmp+os.replace"
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return _in_package(ctx) and not _is_test(ctx)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        parents = ctx.parents
+        replace_scopes = self._os_replace_scopes(ctx)
+        for node in ctx.walk():
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "open" and node.args):
+                continue
+            mode = self._write_mode(node)
+            if mode is None:
+                continue
+            path_expr = node.args[0]
+            if not self._artifactish(path_expr):
+                continue
+            if self._tmp_marked(path_expr):
+                continue
+            if self._enclosing_function(node, parents) in replace_scopes:
+                continue
+            yield self.finding(
+                ctx, node,
+                f"open(..., {mode!r}) writes an artifact path in place; "
+                "write a '.tmp' sibling and os.replace() it (or use "
+                "resilience.journal.atomic_write_*) so a kill cannot "
+                "leave a torn artifact (PR 3 invariant)")
+
+    @staticmethod
+    def _write_mode(node: ast.Call) -> Optional[str]:
+        mode_node = None
+        if len(node.args) >= 2:
+            mode_node = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode_node = kw.value
+        mode = _const_str(mode_node)
+        if mode and any(c in mode for c in "wax"):
+            return mode
+        return None
+
+    @staticmethod
+    def _artifactish(expr) -> bool:
+        for sub in ast.walk(expr):
+            s = _const_str(sub)
+            if s and any(s.endswith(ext) or ext + "." in s
+                         for ext in _ARTIFACT_EXTS):
+                return True
+            if isinstance(sub, ast.Name) and _OUT_NAME.match(sub.id):
+                return True
+        return False
+
+    @staticmethod
+    def _tmp_marked(expr) -> bool:
+        for sub in ast.walk(expr):
+            s = _const_str(sub)
+            if s and _TMP_MARK.search(s):
+                return True
+            if isinstance(sub, ast.Name) and "tmp" in sub.id.lower():
+                return True
+        return False
+
+    @staticmethod
+    def _enclosing_function(node, parents):
+        cur = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            entry = parents.get(cur)
+            cur = entry[0] if entry else None
+        return None
+
+    def _os_replace_scopes(self, ctx: FileContext) -> Set[ast.AST]:
+        scopes: Set[ast.AST] = set()
+        parents = ctx.parents
+        for node in ctx.walk():
+            if (isinstance(node, ast.Call)
+                    and _call_name(node) in ("os.replace", "os.rename")):
+                fn = self._enclosing_function(node, parents)
+                if fn is not None:
+                    scopes.add(fn)
+        return scopes
+
+
+# ---------------------------------------------------------------------------
+# PL004 — env-knob registry drift (code vs README "Runtime knobs")
+
+_KNOB_RE = re.compile(r"PYPULSAR_TPU_[A-Z0-9_]+")
+
+
+class KnobRegistryDriftRule(ProjectRule):
+    """Every ``PYPULSAR_TPU_*`` env knob the code reads must have a row
+    in the README "Runtime knobs" table, and every row must name a knob
+    the code still reads (PR 7 added the table; PR 8's knobs drifted —
+    an operator cannot tune what the registry does not list)."""
+
+    code = "PL004"
+    name = "knob-registry-drift"
+    summary = "env knob missing from the README table (or vice versa)"
+
+    _ENV_CALLS = ("os.environ.get", "environ.get", "os.getenv", "getenv")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        accesses: Dict[str, Tuple[str, int, int]] = {}
+        for ctx in project.contexts:
+            if _is_test(ctx):
+                continue
+            if not (_in_package(ctx) or ctx.relpath.startswith("tools/")
+                    or ctx.relpath == "bench.py"):
+                continue
+            for name, node in self._env_reads(ctx):
+                accesses.setdefault(
+                    name, (ctx.relpath, node.lineno, node.col_offset + 1))
+
+        if project.readme_text is None:
+            return
+        documented: Dict[str, int] = {}
+        in_section = False
+        for i, line in enumerate(project.readme_text.splitlines(), 1):
+            if line.startswith("## "):
+                in_section = line.strip().lower() == "## runtime knobs"
+                continue
+            if in_section and line.lstrip().startswith("|"):
+                for m in _KNOB_RE.finditer(line):
+                    documented.setdefault(m.group(0), i)
+
+        for name in sorted(set(accesses) - set(documented)):
+            path, line, col = accesses[name]
+            yield Finding(
+                self.code, path, line, col,
+                f"env knob {name} is read here but has no row in the "
+                f"README 'Runtime knobs' table (registry drift, PR 7/8)")
+        for name in sorted(set(documented) - set(accesses)):
+            yield Finding(
+                self.code, project.readme_rel or "README.md",
+                documented[name], 1,
+                f"README 'Runtime knobs' documents {name} but no code "
+                f"reads it (stale row, registry drift)")
+
+    def _env_reads(self, ctx: FileContext):
+        for node in ctx.walk():
+            if isinstance(node, ast.Call):
+                cn = _call_name(node)
+                # os.environ/getenv plus the repo's typo-tolerant
+                # env_float/env_int helpers (resilience.health)
+                if ((cn in self._ENV_CALLS
+                     or cn.split(".")[-1].startswith("env_"))
+                        and node.args):
+                    s = _const_str(node.args[0])
+                    if s and s.startswith("PYPULSAR_TPU_"):
+                        yield s, node
+            elif isinstance(node, ast.Subscript):
+                if (_attr_chain(node.value) in ("os.environ", "environ")):
+                    s = _const_str(node.slice)
+                    if s and s.startswith("PYPULSAR_TPU_"):
+                        yield s, node
+            elif isinstance(node, ast.Assign):
+                # ENV_FAULTS = "PYPULSAR_TPU_FAULTS" constant bindings:
+                # the binding site IS the knob's in-code registration
+                # (the read goes through the constant).  Only the ENV_*
+                # naming convention counts, and the value must be
+                # EXACTLY one knob token — a doc/message string or a
+                # stray constant that merely mentions a knob must not
+                # mask real drift
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Name)
+                            and tgt.id.startswith("ENV_")):
+                        s = _const_str(node.value)
+                        if s and _KNOB_RE.fullmatch(s):
+                            yield s, node
+
+
+def _attr_chain(node) -> str:
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# PL005 — fault-point literal in tests/bench with no defining trip site
+
+_FAULT_KINDS = {"oom", "io", "kill", "exit", "hang", "device",
+                "nanburst", "dropblock", "dcjump", "bitflip", "truncate"}
+_POINT_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_.]*$")
+
+
+class DeadFaultPointRule(ProjectRule):
+    """A fault spec in a test/bench naming a point no ``trip``/
+    ``trip_data`` call site defines arms a fault that never fires: the
+    test silently stops covering its failure path (the cousin of PR 7's
+    ``configure()`` chaos-wipe bug).  A point counts as defined by a
+    production literal, a production f-string prefix/suffix (dynamic
+    stage points), a ``*POINT*`` string constant, or a trip call in the
+    referencing test file itself (machinery self-tests)."""
+
+    code = "PL005"
+    name = "dead-fault-point"
+    summary = "fault-point literal with no defining trip()/trip_data() site"
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        exact: Set[str] = set()
+        prefixes: Set[str] = set()
+        suffixes: Set[str] = set()
+        per_file_exact: Dict[str, Set[str]] = {}
+        per_file_prefix: Dict[str, Set[str]] = {}
+
+        for ctx in project.contexts:
+            fe, fp, fs = self._defined_points(ctx)
+            if _in_package(ctx) and not _is_test(ctx):
+                exact |= fe
+                prefixes |= fp
+                suffixes |= fs
+            per_file_exact[ctx.relpath] = fe
+            per_file_prefix[ctx.relpath] = fp
+
+        for ctx in project.contexts:
+            if not (_is_test(ctx) or ctx.relpath == "bench.py"):
+                continue
+            for point, node in self._referenced_points(ctx):
+                if point in exact or point in per_file_exact[ctx.relpath]:
+                    continue
+                if any(point.startswith(p) for p in
+                       prefixes | per_file_prefix[ctx.relpath] if p):
+                    continue
+                if any(point.endswith(s) for s in suffixes if s):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"fault point '{point}' is armed/inspected here but "
+                    f"no trip()/trip_data() call site defines it — the "
+                    f"fault can never fire (dead chaos coverage)")
+
+    # -- definitions --------------------------------------------------
+    def _defined_points(self, ctx: FileContext
+                        ) -> Tuple[Set[str], Set[str], Set[str]]:
+        exact: Set[str] = set()
+        prefixes: Set[str] = set()
+        suffixes: Set[str] = set()
+        for node in ctx.walk():
+            if isinstance(node, ast.Call):
+                cn = _call_name(node)
+                if cn.split(".")[-1] in ("trip", "trip_data") and node.args:
+                    arg = node.args[0]
+                    s = _const_str(arg)
+                    if s is not None:
+                        exact.add(s)
+                    elif isinstance(arg, ast.JoinedStr) and arg.values:
+                        first, last = arg.values[0], arg.values[-1]
+                        fs = _const_str(first)
+                        ls = _const_str(last)
+                        if fs:
+                            prefixes.add(fs)
+                        elif ls:
+                            suffixes.add(ls)
+            elif isinstance(node, ast.Assign):
+                # FAULT_POINT = "data.block" style registered constants
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Name) and "POINT" in tgt.id):
+                        s = _const_str(node.value)
+                        if s:
+                            exact.add(s)
+        return exact, prefixes, suffixes
+
+    # -- references ---------------------------------------------------
+    def _referenced_points(self, ctx: FileContext):
+        seen: Set[Tuple[str, int]] = set()
+        for node in ctx.walk():
+            if isinstance(node, ast.Call):
+                cn = _call_name(node)
+                if cn.split(".")[-1] == "hits" and node.args:
+                    s = _const_str(node.args[0])
+                    if s and _POINT_RE.match(s):
+                        key = (s, node.lineno)
+                        if key not in seen:
+                            seen.add(key)
+                            yield s, node
+            s = _const_str(node)
+            if s is None:
+                continue
+            for part in s.split(","):
+                fields = part.strip().split(":")
+                if len(fields) < 2 or fields[0] not in _FAULT_KINDS:
+                    continue
+                if len(fields) >= 3 and not fields[2].isdigit():
+                    continue
+                point = fields[1]
+                if not _POINT_RE.match(point):
+                    continue
+                key = (point, node.lineno)
+                if key not in seen:
+                    seen.add(key)
+                    yield point, node
+
+
+# ---------------------------------------------------------------------------
+# PL006 — raw header reads in io/ bypassing read_exact
+
+class RawHeaderReadRule(Rule):
+    """``struct.unpack(fmt, f.read(n))`` trusts a short read: at EOF
+    ``read`` returns ``b''`` and unpack raises a bare struct.error with
+    no path/offset — the exact failure shape PR 8's DataFormatError
+    taxonomy (``io/errors.py``) exists to locate.  Use
+    ``read_exact(f, n, path, what)``.  Same for ``.read(n).decode()``
+    header chains."""
+
+    code = "PL006"
+    name = "raw-header-read"
+    summary = "struct.unpack / .read().decode() bypassing read_exact"
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return (ctx.relpath.startswith("pypulsar_tpu/io/")
+                and ctx.relpath != "pypulsar_tpu/io/errors.py")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            cn = _call_name(node)
+            if cn.split(".")[-1] in ("unpack", "unpack_from") \
+                    and cn.split(".")[0] == "struct":
+                if any(self._is_read_call(sub)
+                       for a in node.args for sub in ast.walk(a)):
+                    yield self.finding(
+                        ctx, node,
+                        "struct.unpack over a raw .read(): a short read "
+                        "at EOF raises an unlocated struct.error — use "
+                        "io.errors.read_exact (PR 8 taxonomy)")
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "decode"
+                    and self._is_read_call(node.func.value)):
+                yield self.finding(
+                    ctx, node,
+                    ".read(n).decode() header chain trusts a short "
+                    "read — use io.errors.read_exact (PR 8 taxonomy)")
+
+    @staticmethod
+    def _is_read_call(node) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "read"
+                and bool(node.args))
+
+
+# ---------------------------------------------------------------------------
+# PL007 — mutable default argument
+
+class MutableDefaultRule(Rule):
+    """A ``def f(x, acc=[])`` default is created once and shared across
+    calls — in a fleet runtime that means cross-observation state
+    bleed.  Default to ``None`` and materialize inside."""
+
+    code = "PL007"
+    name = "mutable-default-argument"
+    summary = "mutable default argument ([], {}, set(), ...)"
+
+    _MUTABLE_CALLS = {"list", "dict", "set", "bytearray", "defaultdict",
+                      "OrderedDict", "Counter", "deque"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ctx.walk():
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                continue
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]
+            for d in defaults:
+                if self._mutable(d):
+                    name = getattr(node, "name", "<lambda>")
+                    yield self.finding(
+                        ctx, d,
+                        f"mutable default argument in {name}(); the "
+                        f"object is shared across calls — default to "
+                        f"None and materialize inside")
+
+    def _mutable(self, node) -> bool:
+        if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            return _call_name(node).split(".")[-1] in self._MUTABLE_CALLS
+        return False
+
+
+# ---------------------------------------------------------------------------
+# PL008 — telemetry span opened outside a with/finally discipline
+
+class SpanLeakRule(Rule):
+    """``telemetry.span()`` is a context manager; calling it without
+    entering it records nothing (and an enter without a guaranteed exit
+    corrupts span nesting for the whole thread — PR 1's discipline).
+    Compliant shapes: ``with span(...)``, ``stack.enter_context(
+    span(...))``, or returning the manager to the caller."""
+
+    code = "PL008"
+    name = "span-not-context-managed"
+    summary = "telemetry span opened without with/enter_context"
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return not _is_test(ctx) and (
+            _in_package(ctx) or ctx.relpath.startswith("tools/")
+            or ctx.relpath == "bench.py")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        parents = ctx.parents
+        for node in ctx.walk():
+            if not (isinstance(node, ast.Call) and self._is_span(node)):
+                continue
+            entry = parents.get(node)
+            parent = entry[0] if entry else None
+            if isinstance(parent, ast.withitem):
+                continue
+            if isinstance(parent, ast.Return):
+                continue
+            if (isinstance(parent, ast.Call)
+                    and isinstance(parent.func, ast.Attribute)
+                    and parent.func.attr == "enter_context"):
+                continue
+            yield self.finding(
+                ctx, node,
+                "telemetry span created outside a with/enter_context — "
+                "it either never records or can leak its nesting level "
+                "on an exception (PR 1 discipline)")
+
+    @staticmethod
+    def _is_span(node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Name):
+            return f.id == "span"
+        if isinstance(f, ast.Attribute) and f.attr == "span":
+            return (isinstance(f.value, ast.Name)
+                    and f.value.id in ("telemetry", "_telemetry", "obs"))
+        return False
+
+
+# ---------------------------------------------------------------------------
+# PL009 — except Exception swallowing must_propagate faults
+
+class SwallowedFaultRule(Rule):
+    """In the resilience-adjacent modules an ``except Exception`` that
+    degrades silently can swallow a watchdog interrupt, a chip-indicting
+    fault, or an injected fault — hiding a device strike and defeating
+    the retry->quarantine path (PR 7's no_degrade contract).  Compliant
+    handlers re-raise, gate on ``health.no_degrade``/``must_propagate``,
+    propagate the exception as a value, or carry a reasoned trailing
+    comment (the ``# noqa: BLE001 - why`` idiom) explaining why broad
+    capture is safe HERE."""
+
+    code = "PL009"
+    name = "swallowed-propagating-fault"
+    summary = "except Exception without no_degrade gate / reason"
+
+    _SCOPES = ("pypulsar_tpu/parallel/", "pypulsar_tpu/survey/",
+               "pypulsar_tpu/resilience/")
+    # the reason marker is a space-delimited dash ("# noqa: BLE001 - why"
+    # / "# — why"): a hyphenATED word ("# best-effort") must not count
+    # as a reason, or the rule goes vacuous
+    _REASON_RE = re.compile(r"#.*(?:\s|^)[-—]\s+\S")
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return any(ctx.relpath.startswith(s) for s in self._SCOPES)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ctx.walk():
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._catches_exception(node.type):
+                continue
+            if self._compliant(node, ctx):
+                continue
+            yield self.finding(
+                ctx, node,
+                "except Exception here can swallow must_propagate "
+                "faults (watchdog interrupts, chip strikes, injected "
+                "faults); gate with health.no_degrade(e)/re-raise, or "
+                "justify with a reasoned trailing comment (PR 7 "
+                "no_degrade contract)")
+
+    @staticmethod
+    def _catches_exception(type_node) -> bool:
+        def _is_exc(n):
+            return ((isinstance(n, ast.Name) and n.id == "Exception")
+                    or (isinstance(n, ast.Attribute)
+                        and n.attr == "Exception"))
+        if _is_exc(type_node):
+            return True
+        if isinstance(type_node, ast.Tuple):
+            return any(_is_exc(e) for e in type_node.elts)
+        return False
+
+    def _compliant(self, handler: ast.ExceptHandler,
+                   ctx: FileContext) -> bool:
+        if self._REASON_RE.search(ctx.line_text(handler.lineno)):
+            return True
+        bound = handler.name
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                if _call_name(node).split(".")[-1] in (
+                        "no_degrade", "must_propagate"):
+                    return True
+            if (bound and isinstance(node, ast.Name)
+                    and node.id == bound
+                    and isinstance(node.ctx, ast.Load)):
+                return True  # exception propagated as a value
+        return False
+
+
+# ---------------------------------------------------------------------------
+
+ALL_RULES: Tuple[type, ...] = (
+    TruedivIndexRule, BareJaxDevicesRule, NonAtomicWriteRule,
+    KnobRegistryDriftRule, DeadFaultPointRule, RawHeaderReadRule,
+    MutableDefaultRule, SpanLeakRule, SwallowedFaultRule,
+)
+
+
+def all_rules() -> List[Rule]:
+    """Fresh instances of the full catalog, code order."""
+    return [cls() for cls in ALL_RULES]

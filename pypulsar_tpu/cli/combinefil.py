@@ -17,6 +17,7 @@ import numpy as np
 
 from pypulsar_tpu.io import sigproc
 from pypulsar_tpu.io.filterbank import FilterbankFile
+from pypulsar_tpu.resilience.journal import atomic_open
 
 SAMPLES_PER_READ = 256
 
@@ -55,7 +56,9 @@ def combine_fil(infiles: List[str], outname: str,
     # a bogus truncation-salvage report downstream
     if "nsamples" in header:
         header["nsamples"] = int(nsamples)
-    with open(outname, "wb") as out:
+    # atomic (PL003): a kill mid-combine must not leave a torn .fil
+    # that looks complete
+    with atomic_open(outname, "wb") as out:
         out.write(sigproc.pack_header(header))
         pos = 0
         while pos < nsamples:

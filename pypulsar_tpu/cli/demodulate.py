@@ -28,6 +28,7 @@ import numpy as np
 from pypulsar_tpu.core.psrmath import SECPERDAY
 from pypulsar_tpu.fold.polycos import create_polycos_from_inf
 from pypulsar_tpu.io.datfile import Datfile
+from pypulsar_tpu.resilience.journal import atomic_open
 
 # parfile keys replaced by the scratch ephemeris (spin + astrometry)
 _REPLACED_KEYS = {
@@ -125,7 +126,9 @@ def write_resampled(indat: Datfile, outname: str,
 
     indat.rewind()
     nwritten = 0
-    with open(outname + ".dat", "wb") as outff:
+    # atomic (PL003): a kill mid-resample must not leave a torn .dat
+    # that looks complete
+    with atomic_open(outname + ".dat", "wb") as outff:
         for ind, isdrop in zip(samps, isdrops):
             data = indat.read_to(int(ind))
             if data is None:

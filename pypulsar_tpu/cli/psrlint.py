@@ -1,0 +1,110 @@
+"""``python -m pypulsar_tpu.cli psrlint`` — the project-invariant
+static-analysis gate (docs/ARCHITECTURE.md "Static analysis").
+
+Exit codes: 0 clean, 1 findings, 2 usage error — the same contract as
+the other tools, so `make lint` and the survey driver can tell a dirty
+tree from a broken invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_PATHS = ("pypulsar_tpu", "tools", "tests", "bench.py")
+
+
+def _find_root(start: str) -> str:
+    """Nearest ancestor carrying the package (where the default paths
+    and README.md resolve); falls back to ``start``."""
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.isdir(os.path.join(cur, "pypulsar_tpu")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="psrlint",
+        description="project-invariant static analysis: each rule locks "
+                    "in a bug class a past PR fixed by hand")
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to scan (default: "
+                             + " ".join(DEFAULT_PATHS) + ")")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: auto-detected from cwd)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--select", default=None, metavar="CODES",
+                        help="comma list of rule codes to run (others off)")
+    parser.add_argument("--ignore", default=None, metavar="CODES",
+                        help="comma list of rule codes to skip")
+    parser.add_argument("--baseline", default=None, metavar="PATH",
+                        help="checked-in known-violations JSON "
+                             "({rule: [{path, line}]}); matches are "
+                             "dropped — this repo's baseline is empty")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    from pypulsar_tpu.analysis import all_rules, run_psrlint
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.code}  {rule.name:<30} {rule.summary}")
+        return 0
+
+    root = args.root or _find_root(os.getcwd())
+    default_scope = [p for p in DEFAULT_PATHS
+                     if os.path.exists(os.path.join(root, p))]
+    paths = args.paths or default_scope
+    if not paths:
+        print("psrlint: nothing to scan under %r" % root, file=sys.stderr)
+        return 2
+    # a gate must fail loudly on a typo'd path, not report 'clean: 0
+    # file(s)' and wave the commit through
+    missing = [p for p in paths if not os.path.exists(
+        p if os.path.isabs(p) else os.path.join(root, p))]
+    if missing:
+        print("psrlint: path(s) not found under %r: %s"
+              % (root, ", ".join(missing)), file=sys.stderr)
+        return 2
+
+    baseline = None
+    if args.baseline:
+        try:
+            with open(args.baseline, encoding="utf-8") as f:
+                baseline = json.load(f)
+        except (OSError, ValueError) as e:
+            print("psrlint: cannot read baseline %s: %s"
+                  % (args.baseline, e), file=sys.stderr)
+            return 2
+        # tools/lint_baseline.json nests the psrlint debt under a
+        # "psrlint" key beside the ruff leg's; a bare {RULE: [...]}
+        # mapping is also accepted
+        if isinstance(baseline, dict) and isinstance(
+                baseline.get("psrlint"), dict):
+            baseline = baseline["psrlint"]
+
+    # cross-file rules (knob drift, dead fault points) always see the
+    # whole default scope, even when linting one file: a partial view
+    # would report every unscanned definition site as drift
+    report = run_psrlint(paths, root, select=args.select,
+                         ignore=args.ignore, baseline=baseline,
+                         project_paths=default_scope)
+    if report.files_scanned == 0:
+        print("psrlint: the requested paths contain no Python files",
+              file=sys.stderr)
+        return 2
+    print(report.to_json() if args.json else report.to_text())
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -19,6 +19,7 @@ import numpy as np
 
 from pypulsar_tpu.core.psrmath import SECPERDAY
 from pypulsar_tpu.io.datfile import Datfile
+from pypulsar_tpu.resilience.journal import atomic_open
 
 
 def stitch_dats(infiles: List[str], outname: str, debug: bool = False) -> int:
@@ -27,7 +28,9 @@ def stitch_dats(infiles: List[str], outname: str, debug: bool = False) -> int:
     datfiles = sorted((Datfile(fn) for fn in infiles),
                       key=lambda d: d.infdata.epoch)
     numsamps = 0
-    with open(outname + ".dat", "wb") as out:
+    # atomic (PL003): a kill mid-stitch must not leave a torn .dat
+    # that looks complete
+    with atomic_open(outname + ".dat", "wb") as out:
         print("Working on", os.path.split(datfiles[0].datfn)[1])
         data = datfiles[0].read_all()
         datfiles[0].close()

@@ -270,7 +270,11 @@ def _main_multi(args, ap, widths):
     rows = [dict(dm=m[1], snr=m[2], sample=int(m[4]),
                  width_bins=int(m[3]), downsamp=int(m[5]),
                  file=files[int(m[0])]) for m in merged]
-    with open(outbase + "_merged.cands", "w") as f:
+    from pypulsar_tpu.resilience.journal import atomic_open
+
+    # atomic (PL003): the merged table is the multi-host run's one
+    # artifact — a kill mid-write must not leave a torn table
+    with atomic_open(outbase + "_merged.cands", "w") as f:
         f.write("# DM      SNR      sample    width_bins  downsamp  file\n")
         for r in rows:
             f.write(f"{r['dm']:<9.4f} {r['snr']:<8.3f} {r['sample']:<9d} "
@@ -295,6 +299,7 @@ def _write_dats_timeshard(outbase, reader, dms, args, rfimask, dist):
     assumption the merged .cands artifact already makes."""
     from pypulsar_tpu.parallel.staged import (dats_geometry, write_dat_infs,
                                               write_dats_streamed)
+    from pypulsar_tpu.resilience.journal import atomic_open
 
     rank, count = dist.process_index(), dist.process_count()
     plan, payload, T = dats_geometry(reader, dms, downsamp=args.downsamp,
@@ -318,7 +323,10 @@ def _write_dats_timeshard(outbase, reader, dms, args, rfimask, dist):
 
     for dm in dms:
         base = f"{outbase}_DM{dm:.2f}"
-        with open(base + ".dat", "wb") as out:
+        # atomic concat (PL003): a kill mid-concat must not leave a
+        # torn .dat posing as the full observation; each segment is
+        # dropped as it is consumed so peak disk stays ~1x
+        with atomic_open(base + ".dat", "wb") as out:
             for r in range(count):
                 seg = f"{base}.w{r}.dat"
                 if os.path.exists(seg):

@@ -18,6 +18,7 @@ import sys
 import numpy as np
 
 from pypulsar_tpu.io import sigproc
+from pypulsar_tpu.resilience.journal import atomic_open
 from pypulsar_tpu.io.filterbank import FilterbankFile
 
 BLOCK_SAMPLES = 1 << 16
@@ -38,7 +39,9 @@ def filter(data: np.ndarray) -> np.ndarray:  # noqa: A001 - reference name
 
 def zero_dm_file(infile: str, outfile: str,
                  block_samples: int = BLOCK_SAMPLES) -> None:
-    with FilterbankFile(infile) as infb, open(outfile, "wb") as out:
+    # atomic (PL003): a kill mid-filter must not leave a torn .fil
+    # that looks complete
+    with FilterbankFile(infile) as infb, atomic_open(outfile, "wb") as out:
         out.write(sigproc.pack_header(infb.header))
         pos = 0
         total = infb.nspec

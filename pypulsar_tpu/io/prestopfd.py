@@ -24,12 +24,18 @@ fft_rotate); the dedispersion ref is the highest subband, as prepfold.
 
 from __future__ import annotations
 
+import os
 import struct
 from typing import Optional
 
 import numpy as np
 
 from pypulsar_tpu.core import psrmath
+from pypulsar_tpu.io.errors import DataFormatError, read_exact
+
+# sanity cap on header-declared string lengths: a corrupt length field
+# must raise a located error, not slurp the rest of the file
+_MAX_HDR_STR = 1 << 16
 
 
 def fft_rotate(arr: np.ndarray, bins: float) -> np.ndarray:
@@ -43,9 +49,13 @@ def fft_rotate(arr: np.ndarray, bins: float) -> np.ndarray:
     return np.fft.irfft(np.fft.rfft(arr) * phasor, n)
 
 
-def _read_str(f) -> str:
-    (n,) = struct.unpack("<i", f.read(4))
-    return f.read(n).decode("ascii", errors="replace").rstrip("\x00")
+def _read_str(f, path: str, what: str) -> str:
+    (n,) = struct.unpack("<i", read_exact(f, 4, path, what + " length"))
+    if not 0 <= n <= _MAX_HDR_STR:
+        raise DataFormatError(
+            path, f"implausible {what} length {n}", offset=f.tell() - 4)
+    return read_exact(f, n, path, what).decode(
+        "ascii", errors="replace").rstrip("\x00")
 
 
 def _write_str(f, s: str):
@@ -64,43 +74,70 @@ class PfdFile:
     def _read(self, pfdfn: str):
         self.pfd_filename = pfdfn
         with open(pfdfn, "rb") as f:
+            fsize = os.fstat(f.fileno()).st_size
             (self.numdms, self.numperiods, self.numpdots, self.nsub,
              self.npart, self.proflen, self.numchan, self.pstep,
              self.pdstep, self.dmstep, self.ndmfact, self.npfact
-             ) = struct.unpack("<12i", f.read(48))
-            self.filenm = _read_str(f)
-            self.candnm = _read_str(f)
-            self.telescope = _read_str(f)
-            self.pgdev = _read_str(f)
-            test = f.read(16)
+             ) = struct.unpack("<12i", read_exact(f, 48, pfdfn,
+                                                  "pfd geometry header"))
+
+            def _f8(count: int, what: str) -> np.ndarray:
+                # a corrupt count must raise a located error: negative
+                # makes np.fromfile slurp the file, huge short-reads
+                # silently and misaligns every later field
+                if not 0 <= count or count * 8 > fsize:
+                    raise DataFormatError(
+                        pfdfn, f"implausible {what} count {count}",
+                        offset=f.tell())
+                arr = np.fromfile(f, "<f8", count)
+                if arr.size != count:
+                    raise DataFormatError(
+                        pfdfn, f"truncated while reading {what}: wanted "
+                              f"{count} doubles, got {arr.size}",
+                        offset=f.tell())
+                return arr
+            self.filenm = _read_str(f, pfdfn, "filenm")
+            self.candnm = _read_str(f, pfdfn, "candnm")
+            self.telescope = _read_str(f, pfdfn, "telescope")
+            self.pgdev = _read_str(f, pfdfn, "pgdev")
+            test = read_exact(f, 16, pfdfn, "rastr")
             if b":" in test:
                 self.rastr = test[: test.find(b"\x00")].decode()
-                d = f.read(16)
+                d = read_exact(f, 16, pfdfn, "decstr")
                 self.decstr = d[: d.find(b"\x00")].decode()
             else:
                 self.rastr = self.decstr = "Unknown"
                 f.seek(-16, 1)
             (self.dt, self.startT, self.endT, self.tepoch, self.bepoch,
              self.avgvoverc, self.lofreq, self.chan_wid, self.bestdm
-             ) = struct.unpack("<9d", f.read(72))
+             ) = struct.unpack("<9d", read_exact(f, 72, pfdfn,
+                                                 "timing header"))
             for pre in ("topo", "bary", "fold"):
-                pow_, _tmp = struct.unpack("<2f", f.read(8))
-                p1, p2, p3 = struct.unpack("<3d", f.read(24))
+                pow_, _tmp = struct.unpack(
+                    "<2f", read_exact(f, 8, pfdfn, pre + " power"))
+                p1, p2, p3 = struct.unpack(
+                    "<3d", read_exact(f, 24, pfdfn, pre + " p/pd/pdd"))
                 setattr(self, pre + "_pow", pow_)
                 setattr(self, pre + "_p1", p1)
                 setattr(self, pre + "_p2", p2)
                 setattr(self, pre + "_p3", p3)
             (self.orb_p, self.orb_e, self.orb_x, self.orb_w, self.orb_t,
-             self.orb_pd, self.orb_wd) = struct.unpack("<7d", f.read(56))
-            self.dms = np.fromfile(f, "<f8", self.numdms)
-            self.periods = np.fromfile(f, "<f8", self.numperiods)
-            self.pdots = np.fromfile(f, "<f8", self.numpdots)
+             self.orb_pd, self.orb_wd) = struct.unpack(
+                "<7d", read_exact(f, 56, pfdfn, "orbital params"))
+            self.dms = _f8(self.numdms, "dms")
+            self.periods = _f8(self.numperiods, "periods")
+            self.pdots = _f8(self.numpdots, "pdots")
+            if min(self.npart, self.nsub, self.proflen) < 0:
+                raise DataFormatError(
+                    pfdfn, f"implausible profile geometry "
+                           f"{self.npart}x{self.nsub}x{self.proflen}",
+                    offset=f.tell())
             nprof = self.npart * self.nsub * self.proflen
-            self.profs = np.fromfile(f, "<f8", nprof).reshape(
+            self.profs = _f8(nprof, "profs").reshape(
                 self.npart, self.nsub, self.proflen
             )
-            self.stats = np.fromfile(f, "<f8", self.npart * self.nsub * 7
-                                     ).reshape(self.npart, self.nsub, 7)
+            self.stats = _f8(self.npart * self.nsub * 7, "stats"
+                             ).reshape(self.npart, self.nsub, 7)
         self._finish_setup()
 
     def _finish_setup(self):

@@ -19,10 +19,13 @@ high-frequency-first orientation our Spectra uses (the reference flips with
 
 from __future__ import annotations
 
+import os
 import struct
 from typing import List, Sequence
 
 import numpy as np
+
+from pypulsar_tpu.io.errors import DataFormatError, read_exact
 
 
 def build_zap_table(nint: int, nchan: int, zap_chans, zap_ints,
@@ -53,6 +56,24 @@ class RfifindMask:
     def __init__(self, maskfn: str):
         self.basefn = maskfn[: -len(".mask")] if maskfn.endswith(".mask") else maskfn
         with open(maskfn, "rb") as f:
+            fsize = os.fstat(f.fileno()).st_size
+
+            def _i4(count: int, what: str) -> np.ndarray:
+                # corrupt counts must raise a located error: negative
+                # makes np.fromfile slurp the file, huge short-reads
+                # silently and misaligns every later field
+                if not 0 <= count or count * 4 > fsize:
+                    raise DataFormatError(
+                        maskfn, f"implausible {what} count {count}",
+                        offset=f.tell())
+                arr = np.fromfile(f, "<i4", count)
+                if arr.size != count:
+                    raise DataFormatError(
+                        maskfn, f"truncated while reading {what}: wanted "
+                               f"{count} ints, got {arr.size}",
+                        offset=f.tell())
+                return arr
+
             (
                 self.time_sigma,
                 self.freq_sigma,
@@ -60,16 +81,21 @@ class RfifindMask:
                 self.dtint,
                 self.lofreq,
                 self.df,
-            ) = struct.unpack("<6d", f.read(48))
-            self.nchan, self.nint, self.ptsperint = struct.unpack("<3i", f.read(12))
-            nzap = struct.unpack("<i", f.read(4))[0]
-            self.mask_zap_chans = np.fromfile(f, "<i4", nzap)
-            nzap = struct.unpack("<i", f.read(4))[0]
-            self.mask_zap_ints = np.fromfile(f, "<i4", nzap)
-            nzap_per_int = np.fromfile(f, "<i4", self.nint)
+            ) = struct.unpack("<6d", read_exact(f, 48, maskfn,
+                                                "mask sigma/geometry header"))
+            self.nchan, self.nint, self.ptsperint = struct.unpack(
+                "<3i", read_exact(f, 12, maskfn, "mask dimensions"))
+            nzap = struct.unpack(
+                "<i", read_exact(f, 4, maskfn, "zap-channel count"))[0]
+            self.mask_zap_chans = _i4(nzap, "zap channels")
+            nzap = struct.unpack(
+                "<i", read_exact(f, 4, maskfn, "zap-interval count"))[0]
+            self.mask_zap_ints = _i4(nzap, "zap intervals")
+            nzap_per_int = _i4(self.nint, "per-interval zap counts")
             self.mask_zap_chans_per_int: List[np.ndarray] = []
             for n in nzap_per_int:
-                self.mask_zap_chans_per_int.append(np.fromfile(f, "<i4", n))
+                self.mask_zap_chans_per_int.append(
+                    _i4(int(n), "per-interval zap channels"))
         self.mask_zap_chans_set = set(int(c) for c in self.mask_zap_chans)
         self._zap_table = build_zap_table(
             self.nint, self.nchan, self.mask_zap_chans, self.mask_zap_ints,

@@ -181,7 +181,13 @@ def _lax_boxcar_stats(ts, widths: Tuple[int, ...], stat_len: int):
 
 def _on_tpu() -> bool:
     try:
-        return jax.devices()[0].platform == "tpu"
+        # lazy import: parallel.sweep imports this module at load time,
+        # so a module-level ops -> parallel.mesh import would cycle;
+        # resolving through the lease registry (PL002) keeps the
+        # backend probe honest under a gang lease
+        from pypulsar_tpu.parallel.mesh import lease_devices
+
+        return lease_devices()[0].platform == "tpu"
     except Exception:
         return False
 

@@ -91,7 +91,12 @@ def resolve_engine(engine: str = "auto") -> str:
     if env and env != "auto":  # "auto" in the env var falls through
         return resolve_engine(env)
     try:
-        platform = jax.devices()[0].platform
+        # resolve through the gang-lease registry (PL002): under a
+        # lease the engine choice must reflect the leased chip, not
+        # whatever backend device 0 happens to be
+        from pypulsar_tpu.parallel.mesh import lease_devices
+
+        platform = lease_devices()[0].platform
     except Exception:  # noqa: BLE001 - backend probing must not fail
         platform = "cpu"
     return "fourier" if platform == "tpu" else "gather"

@@ -46,6 +46,7 @@ from pypulsar_tpu.resilience.health import (  # noqa: F401
 )
 from pypulsar_tpu.resilience.journal import (  # noqa: F401
     RunJournal,
+    atomic_open,
     atomic_write_bytes,
     atomic_write_text,
     candfile_complete,

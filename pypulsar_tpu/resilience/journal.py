@@ -29,6 +29,7 @@ pipeline output).
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
@@ -38,6 +39,7 @@ from pypulsar_tpu.obs import telemetry
 
 __all__ = [
     "RunJournal",
+    "atomic_open",
     "atomic_write_bytes",
     "atomic_write_text",
     "candfile_complete",
@@ -61,6 +63,38 @@ def atomic_write_bytes(path: str, data: bytes) -> str:
 
 def atomic_write_text(path: str, text: str) -> str:
     return atomic_write_bytes(path, text.encode())
+
+
+@contextlib.contextmanager
+def atomic_open(path: str, mode: str = "wb"):
+    """Streaming sibling of :func:`atomic_write_bytes`: yields a file
+    handle on ``path + '.tmp'`` and renames it into place only when the
+    block exits cleanly.  On ANY exception (including injected kills)
+    the tmp is removed and ``path`` is untouched — so a torn stream can
+    never pose as the finished artifact, and no `.tmp` debris outlives
+    the failure.
+
+    Fresh-write modes only: with append/read/update modes the final
+    rename would REPLACE the artifact with just the tmp's bytes —
+    silent data loss, so the entry point refuses them."""
+    if "a" in mode or "r" in mode or "+" in mode or not (
+            "w" in mode or "x" in mode):
+        raise ValueError(
+            f"atomic_open mode {mode!r} is not a fresh write; the "
+            f"tmp+replace idiom would clobber the existing artifact")
+    tmp = path + TMP_SUFFIX
+    f = open(tmp, mode)
+    try:
+        yield f
+    except BaseException:
+        f.close()
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    f.close()
+    os.replace(tmp, path)
 
 
 def file_digest(path: str) -> Tuple[int, str]:

@@ -34,7 +34,7 @@ def old_detrend(ydata, xdata=None, mask=None, order=1):
     return ydata - np.dot(A, coeffs)
 
 
-def detrend(ydata, xdata=None, order=1, bp=[], numpieces=None):
+def detrend(ydata, xdata=None, order=1, bp=None, numpieces=None):
     """Piecewise polynomial detrend of a (possibly masked) 1D array.
 
     ``bp`` lists indices where new independently-detrended segments start
@@ -50,7 +50,7 @@ def detrend(ydata, xdata=None, order=1, bp=[], numpieces=None):
     detrended = ymasked.copy()
 
     if numpieces is None:
-        edges = [0] + list(bp) + [len(ydata)]
+        edges = [0] + list(bp if bp is not None else []) + [len(ydata)]
     else:
         edges = np.round(np.linspace(0, len(ydata), numpieces + 1, endpoint=1)).astype(int)
     for start, stop in zip(edges[:-1], edges[1:]):

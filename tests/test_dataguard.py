@@ -721,3 +721,44 @@ def test_pfd_snr_gates_nonfinite_row(monkeypatch):
                      "error": "non-finite SNR"}]
     assert totals["data.nonfinite_cands_dropped"] == 1
     assert json.dumps(rows)  # the summary stays serializable
+
+
+def test_pfd_corrupt_string_length_is_located(tmp_path):
+    """A corrupt negative/huge header string length in a .pfd must
+    raise a located DataFormatError instead of slurping the file."""
+    import struct as _struct
+
+    from pypulsar_tpu.io.prestopfd import PfdFile
+
+    for bad_len in (-5, 1 << 30):
+        fn = tmp_path / f"bad_{bad_len & 0xffffffff}.pfd"
+        fn.write_bytes(_struct.pack("<12i", *([4] * 12))
+                       + _struct.pack("<i", bad_len) + b"x" * 8)
+        with pytest.raises(DataFormatError) as ei:
+            PfdFile(str(fn))
+        assert "implausible" in str(ei.value) and str(fn) in str(ei.value)
+
+
+def test_pfd_and_mask_corrupt_counts_are_located(tmp_path):
+    """Corrupt negative/huge array counts in .pfd/.mask headers must
+    raise located DataFormatErrors — np.fromfile would otherwise slurp
+    the file (negative) or silently short-read and misalign (huge)."""
+    import struct as _struct
+
+    from pypulsar_tpu.io.prestopfd import PfdFile
+    from pypulsar_tpu.io.rfimask import RfifindMask
+
+    # .pfd: numdms = -1 with an otherwise readable fixed header
+    fn = tmp_path / "negdms.pfd"
+    fn.write_bytes(_struct.pack("<12i", -1, *([1] * 11)) + b"\x00" * 240)
+    with pytest.raises(DataFormatError) as ei:
+        PfdFile(str(fn))
+    assert "implausible dms count" in str(ei.value)
+
+    # .mask: zap-channel count corrupted negative
+    mf = tmp_path / "neg.mask"
+    mf.write_bytes(b"\x00" * 48 + _struct.pack("<3i", 4, 2, 10)
+                   + _struct.pack("<i", -7))
+    with pytest.raises(DataFormatError) as ei:
+        RfifindMask(str(mf))
+    assert "implausible zap channels count" in str(ei.value)

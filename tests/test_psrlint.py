@@ -1,0 +1,505 @@
+"""psrlint: fixture pair (true positive + near-miss true negative) per
+rule, the suppression/select/ignore machinery, and the repo-wide smoke
+gate (`psrlint --json` exits 0 on HEAD — the same invariant `make lint`
+enforces).
+
+Fixtures are written into a tmp project tree so per-rule path scopes
+(PL002 outside mesh.py, PL006 inside io/, PL009 in the resilience
+modules) are exercised exactly as the real gate sees them.
+"""
+
+import json
+import os
+
+import pytest
+
+from pypulsar_tpu.analysis import all_rules, run_psrlint
+from pypulsar_tpu.analysis.engine import run as engine_run
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(tmp_path, files, readme=None, **kw):
+    """Write {relpath: source} under tmp_path and lint the tree."""
+    for rel, src in files.items():
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(src)
+    if readme is not None:
+        (tmp_path / "README.md").write_text(readme)
+    paths = sorted({rel.split("/")[0] if "/" in rel else rel
+                    for rel in files})
+    return engine_run(all_rules(), paths, str(tmp_path),
+                      readme_path=str(tmp_path / "README.md")
+                      if readme is not None else None, **kw)
+
+
+def codes(report):
+    return [f.rule for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# PL001 py2 truediv in index/size context
+
+def test_pl001_true_positive(tmp_path):
+    rep = lint(tmp_path, {"pypulsar_tpu/a.py":
+                          "def f(a, n):\n"
+                          "    x = a[n / 2]\n"
+                          "    for i in range(n / 4):\n"
+                          "        x += i\n"
+                          "    return x\n"}, select="PL001")
+    assert codes(rep) == ["PL001", "PL001"]
+    assert {f.line for f in rep.findings} == {2, 3}
+
+
+def test_pl001_near_miss(tmp_path):
+    # floor division, an explicit int() coercion, and a float-context
+    # division must all stay silent
+    rep = lint(tmp_path, {"pypulsar_tpu/a.py":
+                          "def f(a, n):\n"
+                          "    x = a[n // 2] + a[int(n / 2)]\n"
+                          "    mean = x / n\n"
+                          "    return x[: n // 4], mean\n"}, select="PL001")
+    assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# PL002 bare jax.devices()
+
+def test_pl002_true_positive(tmp_path):
+    rep = lint(tmp_path, {"pypulsar_tpu/work.py":
+                          "import jax\n"
+                          "def chips():\n"
+                          "    return jax.devices()\n"}, select="PL002")
+    assert codes(rep) == ["PL002"]
+
+
+def test_pl002_near_miss(tmp_path):
+    # the registry's own module is exempt; call sites that resolve
+    # through the lease helper are the sanctioned shape; tests are out
+    # of scope (capability asserts)
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/parallel/mesh.py":
+            "import jax\n"
+            "def lease_devices():\n"
+            "    return jax.devices()\n",
+        "pypulsar_tpu/work.py":
+            "from pypulsar_tpu.parallel.mesh import lease_devices\n"
+            "def chips():\n"
+            "    return lease_devices()\n",
+        "tests/test_caps.py":
+            "import jax\n"
+            "def test_n():\n"
+            "    assert len(jax.devices()) == 8\n",
+    }, select="PL002")
+    assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# PL003 non-atomic artifact write
+
+def test_pl003_true_positive(tmp_path):
+    rep = lint(tmp_path, {"pypulsar_tpu/writer.py":
+                          "def save(outname, rows):\n"
+                          "    with open(outname + '.cands', 'w') as f:\n"
+                          "        f.write(str(rows))\n"}, select="PL003")
+    assert codes(rep) == ["PL003"]
+
+
+def test_pl003_near_miss(tmp_path):
+    # tmp+os.replace idiom, a read-mode open, and a non-artifact path
+    # all stay silent
+    rep = lint(tmp_path, {"pypulsar_tpu/writer.py":
+                          "import os\n"
+                          "def save(outname, rows):\n"
+                          "    with open(outname + '.cands.tmp', 'w') as f:\n"
+                          "        f.write(str(rows))\n"
+                          "    os.replace(outname + '.cands.tmp',\n"
+                          "               outname + '.cands')\n"
+                          "def load(outname):\n"
+                          "    with open(outname + '.cands') as f:\n"
+                          "        return f.read()\n"
+                          "def note(logdir):\n"
+                          "    open(logdir + '/notes.txt', 'w').close()\n"},
+               select="PL003")
+    assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# PL004 knob registry drift
+
+_README = ("# x\n\n## Runtime knobs\n\n"
+           "| env var | default | what |\n|---|---|---|\n"
+           "| `PYPULSAR_TPU_DOCUMENTED` | 1 | a knob |\n"
+           "\n## Next section\n")
+
+
+def test_pl004_code_without_table_row(tmp_path):
+    rep = lint(tmp_path, {"pypulsar_tpu/mod.py":
+                          "import os\n"
+                          "A = os.environ.get('PYPULSAR_TPU_DOCUMENTED')\n"
+                          "B = os.environ.get('PYPULSAR_TPU_SECRET')\n"},
+               readme=_README, select="PL004")
+    assert codes(rep) == ["PL004"]
+    assert "PYPULSAR_TPU_SECRET" in rep.findings[0].message
+    assert rep.findings[0].path == "pypulsar_tpu/mod.py"
+
+
+def test_pl004_stale_table_row_and_helper_reads(tmp_path):
+    # the env_float helper and ENV_* constant-binding idioms both count
+    # as in-code registration; a row nothing reads is the finding
+    readme = _README.replace(
+        "\n## Next section\n",
+        "| `PYPULSAR_TPU_VIA_HELPER` | 2 | helper knob |\n"
+        "| `PYPULSAR_TPU_VIA_CONST` | 3 | const knob |\n"
+        "| `PYPULSAR_TPU_GONE` | 4 | removed knob |\n"
+        "\n## Next section\n")
+    rep = lint(tmp_path, {"pypulsar_tpu/mod.py":
+                          "import os\n"
+                          "from pypulsar_tpu.resilience.health import env_float\n"
+                          "A = os.environ.get('PYPULSAR_TPU_DOCUMENTED')\n"
+                          "B = env_float('PYPULSAR_TPU_VIA_HELPER', 2.0)\n"
+                          "ENV_C = 'PYPULSAR_TPU_VIA_CONST'\n"},
+               readme=readme, select="PL004")
+    assert codes(rep) == ["PL004"]
+    assert "PYPULSAR_TPU_GONE" in rep.findings[0].message
+    assert rep.findings[0].path == "README.md"
+
+
+# ---------------------------------------------------------------------------
+# PL005 dead fault point
+
+def test_pl005_true_positive(tmp_path):
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/prod.py":
+            "from pypulsar_tpu.resilience import faultinject\n"
+            "def work():\n"
+            "    faultinject.trip('real.point')\n",
+        "tests/test_faults.py":
+            "from pypulsar_tpu.resilience import faultinject\n"
+            "def test_ghost():\n"
+            "    faultinject.configure('oom:ghost.point:1')\n",
+    }, select="PL005")
+    assert codes(rep) == ["PL005"]
+    assert "ghost.point" in rep.findings[0].message
+    assert rep.findings[0].path == "tests/test_faults.py"
+
+
+def test_pl005_near_miss(tmp_path):
+    # covered shapes: an exact production literal, a dynamic-prefix
+    # f-string (stage points), and a machinery self-test tripping its
+    # own ad-hoc point
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/prod.py":
+            "from pypulsar_tpu.resilience import faultinject\n"
+            "def work(stage):\n"
+            "    faultinject.trip('real.point')\n"
+            "    faultinject.trip(f'survey.stage_start.{stage}')\n",
+        "tests/test_faults.py":
+            "from pypulsar_tpu.resilience import faultinject\n"
+            "def test_real():\n"
+            "    faultinject.configure(\n"
+            "        'oom:real.point:1, io:survey.stage_start.sweep')\n"
+            "def test_selfmade():\n"
+            "    faultinject.configure('io:mine:1')\n"
+            "    faultinject.trip('mine')\n",
+    }, select="PL005")
+    assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# PL006 raw header read in io/
+
+def test_pl006_true_positive(tmp_path):
+    rep = lint(tmp_path, {"pypulsar_tpu/io/fmt.py":
+                          "import struct\n"
+                          "def header(f):\n"
+                          "    (n,) = struct.unpack('<i', f.read(4))\n"
+                          "    return f.read(n).decode('ascii')\n"},
+               select="PL006")
+    assert codes(rep) == ["PL006", "PL006"]
+
+
+def test_pl006_near_miss(tmp_path):
+    # read_exact-mediated reads are the sanctioned shape, and the rule
+    # only patrols io/ (a tool doing raw reads of its own scratch files
+    # is out of scope)
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/io/fmt.py":
+            "import struct\n"
+            "from pypulsar_tpu.io.errors import read_exact\n"
+            "def header(f, path):\n"
+            "    (n,) = struct.unpack('<i', read_exact(f, 4, path, 'len'))\n"
+            "    return read_exact(f, n, path, 'name').decode('ascii')\n",
+        "pypulsar_tpu/utils/scratch.py":
+            "import struct\n"
+            "def peek(f):\n"
+            "    return struct.unpack('<i', f.read(4))\n",
+    }, select="PL006")
+    assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# PL007 mutable default
+
+def test_pl007_true_positive(tmp_path):
+    rep = lint(tmp_path, {"pypulsar_tpu/mod.py":
+                          "def f(x, acc=[], opts={}):\n"
+                          "    return x, acc, opts\n"}, select="PL007")
+    assert codes(rep) == ["PL007", "PL007"]
+
+
+def test_pl007_near_miss(tmp_path):
+    rep = lint(tmp_path, {"pypulsar_tpu/mod.py":
+                          "def f(x, acc=None, opts=(), name=''):\n"
+                          "    acc = [] if acc is None else acc\n"
+                          "    return x, acc, opts, name\n"}, select="PL007")
+    assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# PL008 span leak
+
+def test_pl008_true_positive(tmp_path):
+    rep = lint(tmp_path, {"pypulsar_tpu/mod.py":
+                          "from pypulsar_tpu.obs import telemetry\n"
+                          "def work():\n"
+                          "    telemetry.span('stage')\n"
+                          "    return 1\n"}, select="PL008")
+    assert codes(rep) == ["PL008"]
+
+
+def test_pl008_near_miss(tmp_path):
+    # with-block, ExitStack.enter_context, and returning the manager to
+    # the caller are the sanctioned shapes; an ObsTrace-style record
+    # call on another object is a different API
+    rep = lint(tmp_path, {"pypulsar_tpu/mod.py":
+                          "import contextlib\n"
+                          "from pypulsar_tpu.obs import telemetry\n"
+                          "def work(trace):\n"
+                          "    with telemetry.span('stage'):\n"
+                          "        pass\n"
+                          "    with contextlib.ExitStack() as es:\n"
+                          "        es.enter_context(telemetry.span('s2'))\n"
+                          "    trace.span('done', 0.0, 1.0)\n"
+                          "def shim(name):\n"
+                          "    return telemetry.span(name)\n"}, select="PL008")
+    assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# PL009 swallowed fault
+
+def test_pl009_true_positive(tmp_path):
+    rep = lint(tmp_path, {"pypulsar_tpu/parallel/stage.py":
+                          "def run(fn):\n"
+                          "    try:\n"
+                          "        return fn()\n"
+                          "    except Exception:\n"
+                          "        return None\n"}, select="PL009")
+    assert codes(rep) == ["PL009"]
+
+
+def test_pl009_hyphenated_word_is_not_a_reason(tmp_path):
+    # "# best-effort" has a hyphen but no space-delimited dash marker:
+    # it must NOT count as a reasoned comment
+    rep = lint(tmp_path, {"pypulsar_tpu/survey/util.py":
+                          "def run(fn):\n"
+                          "    try:\n"
+                          "        return fn()\n"
+                          "    except Exception:  # best-effort\n"
+                          "        return None\n"}, select="PL009")
+    assert codes(rep) == ["PL009"]
+
+
+def test_pl009_near_miss(tmp_path):
+    # a no_degrade gate, a reasoned trailing comment, and propagating
+    # the exception as a value are all compliant; modules outside the
+    # resilience-adjacent set are out of scope
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/parallel/stage.py":
+            "from pypulsar_tpu.resilience import health\n"
+            "def run(fn):\n"
+            "    try:\n"
+            "        return fn()\n"
+            "    except Exception as e:\n"
+            "        if health.no_degrade(e):\n"
+            "            raise\n"
+            "        return None\n"
+            "def probe(fn):\n"
+            "    try:\n"
+            "        return fn()\n"
+            "    except Exception:  # noqa: BLE001 - probe is best-effort\n"
+            "        return None\n"
+            "def ferry(fn):\n"
+            "    try:\n"
+            "        return fn(), None\n"
+            "    except Exception as e:\n"
+            "        return None, e\n",
+        "pypulsar_tpu/astro/coords.py":
+            "def parse(s):\n"
+            "    try:\n"
+            "        return float(s)\n"
+            "    except Exception:\n"
+            "        return None\n",
+    }, select="PL009")
+    assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions / select / ignore / baseline / output
+
+def test_suppression_silences_and_unused_is_flagged(tmp_path):
+    rep = lint(tmp_path, {"pypulsar_tpu/mod.py":
+                          "def f(acc=[]):  # psrlint: ignore[PL007] -- fixture\n"
+                          "    return acc\n"
+                          "def g():  # psrlint: ignore[PL007] -- stale\n"
+                          "    return 1\n"})
+    assert codes(rep) == ["PL010"]
+    assert rep.findings[0].line == 3
+
+
+def test_suppression_comma_list(tmp_path):
+    rep = lint(tmp_path, {"pypulsar_tpu/mod.py":
+                          "def f(a, n, acc=[]):  # psrlint: ignore[PL007, PL001]\n"
+                          "    return a[n / 2], acc\n"})
+    # the PL001 is on line 2, not the suppressed line 1 — so that
+    # half of the comma list is an unused suppression
+    assert sorted(codes(rep)) == ["PL001", "PL010"]
+
+
+def test_select_and_ignore(tmp_path):
+    files = {"pypulsar_tpu/mod.py":
+             "import jax\n"
+             "def f(a, n, acc=[]):\n"
+             "    return a[n / 2], acc, jax.devices()\n"}
+    assert sorted(codes(lint(tmp_path, dict(files)))) == [
+        "PL001", "PL002", "PL007"]
+    assert sorted(codes(lint(tmp_path, dict(files),
+                             select="PL001,PL007"))) == ["PL001", "PL007"]
+    assert sorted(codes(lint(tmp_path, dict(files),
+                             ignore="PL002"))) == ["PL001", "PL007"]
+
+
+def test_pl004_message_string_is_not_a_registration(tmp_path):
+    # a constant that merely MENTIONS a knob inside prose must not
+    # register it (the row-less "knob" would be pure noise), and a
+    # knob-valued constant outside the ENV_* convention must not mask
+    # drift (a stale README row stays reported)
+    readme = _README.replace(
+        "\n## Next section\n",
+        "| `PYPULSAR_TPU_GONE` | 4 | removed knob |\n\n## Next section\n")
+    rep = lint(tmp_path, {"pypulsar_tpu/mod.py":
+                          "import os\n"
+                          "A = os.environ.get('PYPULSAR_TPU_DOCUMENTED')\n"
+                          "HINT = 'PYPULSAR_TPU_FAULTS is unset'\n"
+                          "OLD_NAME = 'PYPULSAR_TPU_GONE'\n"},
+               readme=readme, select="PL004")
+    assert codes(rep) == ["PL004"]
+    assert "PYPULSAR_TPU_GONE" in rep.findings[0].message
+    assert rep.findings[0].path == "README.md"
+
+
+def test_cli_unwraps_nested_baseline(tmp_path):
+    """The committed tools/lint_baseline.json nests the psrlint debt
+    under a 'psrlint' key; the CLI must unwrap it before the engine."""
+    from pypulsar_tpu.cli import psrlint as cli
+
+    pkg = tmp_path / "pypulsar_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("def f(acc=[]):\n    return acc\n")
+    basefn = tmp_path / "base.json"
+    basefn.write_text(json.dumps({
+        "psrlint": {"PL007": [{"path": "pypulsar_tpu/mod.py", "line": 1}]},
+        "ruff": []}))
+    assert cli.main(["--root", str(tmp_path), "pypulsar_tpu",
+                     "--select", "PL007"]) == 1
+    assert cli.main(["--root", str(tmp_path), "pypulsar_tpu",
+                     "--select", "PL007",
+                     "--baseline", str(basefn)]) == 0
+
+
+def test_baseline_drops_known_findings(tmp_path):
+    files = {"pypulsar_tpu/mod.py": "def f(acc=[]):\n    return acc\n"}
+    dirty = lint(tmp_path, dict(files), select="PL007")
+    assert codes(dirty) == ["PL007"]
+    base = {"PL007": [{"path": "pypulsar_tpu/mod.py", "line": 1}]}
+    assert codes(lint(tmp_path, dict(files), select="PL007",
+                      baseline=base)) == []
+
+
+def test_parse_error_is_a_finding_not_a_crash(tmp_path):
+    rep = lint(tmp_path, {"pypulsar_tpu/bad.py": "def f(:\n    pass\n"})
+    assert codes(rep) == ["PL100"]
+    # tokenize raises IndentationError (not TokenError) on a bad
+    # dedent — the gate must still report, not traceback (bad.py from
+    # above is still in the tree, so both parse failures show)
+    rep = lint(tmp_path, {"pypulsar_tpu/dedent.py":
+                          "def f():\n    x = 1\n   y = 2\n"})
+    assert codes(rep) == ["PL100", "PL100"]
+    assert {f.path for f in rep.findings} == {
+        "pypulsar_tpu/bad.py", "pypulsar_tpu/dedent.py"}
+
+
+def test_cli_missing_path_is_loud(tmp_path):
+    """A typo'd path must exit 2, never 'clean: 0 file(s)' + exit 0."""
+    from pypulsar_tpu.cli import psrlint as cli
+
+    (tmp_path / "pypulsar_tpu").mkdir()
+    assert cli.main(["--root", str(tmp_path), "no_such_file.py"]) == 2
+    # an existing dir with no Python files is equally suspicious
+    (tmp_path / "empty").mkdir()
+    assert cli.main(["--root", str(tmp_path), "empty"]) == 2
+
+
+def test_report_json_schema(tmp_path):
+    rep = lint(tmp_path, {"pypulsar_tpu/mod.py":
+                          "def f(acc=[]):\n    return acc\n"}, select="PL007")
+    doc = json.loads(rep.to_json())
+    assert doc["files"] == 1 and doc["counts"] == {"PL007": 1}
+    (finding,) = doc["findings"]
+    assert set(finding) == {"rule", "path", "line", "col", "message"}
+    assert finding["rule"] == "PL007" and finding["line"] == 1
+
+
+def test_rule_catalog_complete():
+    got = {r.code for r in all_rules()}
+    assert got == {f"PL00{i}" for i in range(1, 10)}
+    assert all(r.summary and r.name for r in all_rules())
+
+
+# ---------------------------------------------------------------------------
+# the repo-wide gate
+
+def test_repo_is_clean_smoke():
+    """`psrlint --json` exits 0 on HEAD — the `make lint` invariant.
+    Every suppression in the tree must also be in use (PL010 runs)."""
+    from pypulsar_tpu.cli import psrlint as cli
+
+    rc = cli.main(["--root", REPO_ROOT, "--json"])
+    assert rc == 0
+
+
+def test_single_file_scan_keeps_project_context():
+    """Linting ONE file must not report the unscanned rest of the tree
+    as knob drift / dead fault points: the CLI hands cross-file rules
+    the whole default scope and clips their findings to the request."""
+    from pypulsar_tpu.cli import psrlint as cli
+
+    for target in ("pypulsar_tpu/io/sigproc.py", "tests/test_resilience.py"):
+        assert cli.main(["--root", REPO_ROOT, target]) == 0
+
+
+def test_repo_baseline_is_empty():
+    """The checked-in third-party baseline carries zero violations —
+    landing debt there needs a conscious diff, not a silent append."""
+    with open(os.path.join(REPO_ROOT, "tools", "lint_baseline.json")) as f:
+        base = json.load(f)
+    assert all(not v for k, v in base.items()
+               if not k.startswith("_")), base
+
+
+def test_cli_registered():
+    from pypulsar_tpu.cli.__main__ import TOOLS
+
+    assert "psrlint" in TOOLS

@@ -48,7 +48,7 @@ def _clean_faults():
 def test_fault_spec_parsing():
     spec = faultinject.parse_spec("oom:sweep.chunk_dispatch:2, io:x.produce")
     assert spec == {("oom", "sweep.chunk_dispatch"): 2, ("io", "x.produce"): 1}
-    for bad in ("boom:x:1", "oom:x:0", "oom:x:1:2"):
+    for bad in ("boom:x:1", "oom:x:0", "oom:x:1:2"):  # psrlint: ignore[PL005] -- grammar-rejection fixtures, never armed
         with pytest.raises(ValueError):
             faultinject.parse_spec(bad)
 
@@ -1033,3 +1033,36 @@ def test_survey_manifest_torn_retry_note(tmp_path):
     rows = status_rows([obs.manifest])
     assert rows[0]["retries"]["mask"]["attempts"] == 2
     assert "StageStalled" in rows[0]["retries"]["mask"]["error"]
+
+
+def test_atomic_open_success_and_failure(tmp_path):
+    """The streaming atomic-write helper: on clean exit the artifact
+    appears whole and the tmp is gone; on ANY exception (including
+    BaseException kills) the target is untouched and no tmp debris
+    survives."""
+    from pypulsar_tpu.resilience.journal import atomic_open
+
+    out = tmp_path / "obs.dat"
+    with atomic_open(str(out), "wb") as f:
+        f.write(b"abc")
+        assert not out.exists()  # nothing visible until the rename
+    assert out.read_bytes() == b"abc"
+    assert not (tmp_path / "obs.dat.tmp").exists()
+
+    class _Kill(BaseException):
+        pass
+
+    with pytest.raises(_Kill):
+        with atomic_open(str(out), "wb") as f:
+            f.write(b"torn")
+            raise _Kill()
+    assert out.read_bytes() == b"abc"  # old artifact untouched
+    assert not (tmp_path / "obs.dat.tmp").exists()
+
+    # append/read/update modes would silently REPLACE the artifact
+    # with just the tmp's bytes: refused at the entry point
+    for bad_mode in ("ab", "a", "r+b", "rb"):
+        with pytest.raises(ValueError):
+            with atomic_open(str(out), bad_mode):
+                pass
+    assert out.read_bytes() == b"abc"

@@ -37,7 +37,7 @@ def liveness():
     import jax
     import jax.numpy as jnp
 
-    dev = jax.devices()[0]
+    dev = jax.devices()[0]  # psrlint: ignore[PL002] -- raw-inventory smoke: proves the backend exists BELOW the lease registry
     assert float(jnp.ones((128, 128)).sum()) == 128 * 128
     print(f"#     device: {dev} ({dev.platform})")
 
