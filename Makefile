@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier test-faults test-fold test-survey test-corruption lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-multichip bench-telemetry native clean
+.PHONY: test test-fourier test-faults test-fold test-survey test-corruption lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-multichip bench-specfuse bench-telemetry native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -124,6 +124,15 @@ bench-multichip:
 	$(CPU_ENV) $(PY) -m pytest tests/test_accel_pipeline.py -q -k "sharded or lease"
 	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -k "gang"
 	$(CPU_ENV) $(PY) bench.py --survey --devices 4 --out BENCH_r09_multichip.json
+
+# spectral fusion (round 15): the fused-path parity suite (stitched
+# byte-identity at awkward geometries + mesh + kill/resume, decimate
+# circular-reference + counters), then the 3-way pipeline A/B (.dat
+# chain vs streamed handoff vs --spectral fused, plus the opt-in
+# decimate leg) -> BENCH_r10_specfuse.json
+bench-specfuse:
+	$(CPU_ENV) $(PY) -m pytest tests/test_accel_pipeline.py -q -k "spectral"
+	$(CPU_ENV) $(PY) bench.py --accel --spectral --out BENCH_r10_specfuse.json
 
 native:
 	$(PY) -c "from pypulsar_tpu import native; assert native.available(); print('native codec OK')"

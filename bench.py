@@ -67,6 +67,15 @@ def parse_args(argv=None):
                     help="with --accel: also measure the BATCHED search "
                          "(this many spectra against the shared template "
                          "bank in one dispatch per stage)")
+    ap.add_argument("--spectral", action="store_true",
+                    help="with --accel: run the round-10 spectral-fusion "
+                         "pipeline A/B instead of the raw engine bench — "
+                         "the SAME toy pulsar through all three handoff "
+                         "paths (.dat round trip, streamed, --spectral "
+                         "fused) plus the opt-in decimate regime, with "
+                         "sift parity asserted and the per-trial "
+                         "transform counts taken from the telemetry "
+                         "counters (BENCH_r10_specfuse.json)")
     ap.add_argument("--fold", action="store_true",
                     help="benchmark the folding engine (configs[3]) "
                          "instead of the DM sweep")
@@ -1076,6 +1085,180 @@ def run_accel(args):
         **scale_fields,
         "n_candidates": len(cands),
         **batch_extras,
+    }
+
+
+def run_specfuse(args):
+    """Spectral-fusion pipeline A/B (round 10 / ISSUE 10 acceptance):
+    one toy pulsar observation through every sweep->accel handoff path
+    under the SAME engine ('fourier', the TPU default — the decimate
+    leg requires it and cross-engine series differ by design):
+
+    - ``dat``:      sweep --write-dats (streamed writer) -> batched
+                    accelsearch over the .dat files (the classic chain)
+    - ``streamed``: the round-6 in-RAM handoff (irfft -> D2H -> H2D ->
+                    rfft per trial)
+    - ``fused``:    --spectral, stitched regime (series stays on
+                    device; candidate tables asserted BYTE-identical to
+                    the streamed leg, and the streamed leg to the .dat
+                    leg — the full parity chain)
+    - ``decimate``: --spectral + PYPULSAR_TPU_SPECFUSE_MODE=decimate
+                    (zero transforms per trial; circular boundary
+                    semantics, so parity is reported as measured, not
+                    asserted byte-identical)
+
+    The STRUCTURAL claim is the gate (MULTICHIP_r* methodology): the
+    per-trial transform counts come from the telemetry counters
+    (``specfuse.fft_pairs_elided`` = one irfft+rfft pair per trial on
+    this single-chunk geometry), and the CPU-toy wall times are
+    reported honestly as CPU-toy wall times."""
+    acquire_backend()
+    import glob as _glob
+    import tempfile
+
+    from pypulsar_tpu.obs import telemetry as _tlm
+
+    C = 32
+    # --quick only (NOT cpu_fallback: the whole A/B is a CPU-scale toy
+    # by design, so the fallback path measures the same record)
+    T = 1 << 13 if args.quick else 1 << 15
+    dtp = 5e-4
+    D = 16
+    freqs = 1500.0 - 4.0 * np.arange(C)
+    sweep_args = ["--lodm", "0", "--dmstep", "5", "--numdms", str(D),
+                  "-s", "8", "--group-size", "4", "--threshold", "8",
+                  "--engine", "fourier"]
+    accel_cfg = ["--accel-zmax", "20", "--accel-numharm", "2",
+                 "--accel-sigma", "3", "--accel-batch", "8"]
+    handoff = [*accel_cfg, "--accel-search", "--accel-only"]
+
+    def cands(prefix):
+        return {os.path.basename(f)[len(prefix):]: open(f, "rb").read()
+                for f in sorted(_glob.glob(f"{prefix}_DM*_ACCEL_20.*cand"))}
+
+    olddir = os.getcwd()
+    # env knobs are pinned for the run and RESTORED after (pop would
+    # clobber a user's preset; an inherited decimate mode would break
+    # the stitched legs' byte-parity assertion spuriously)
+    env_save = {k: os.environ.get(k) for k in
+                ("PYPULSAR_TPU_DATS_RESIDENT_LIMIT",
+                 "PYPULSAR_TPU_SPECFUSE_MODE")}
+    with tempfile.TemporaryDirectory() as td:
+        os.chdir(td)
+        try:
+            fil = _synth_survey_fil("psr.fil", 5, C, T, dtp, freqs,
+                                    "SPECFUSE")
+            from pypulsar_tpu.cli import accelsearch as cli_accel
+            from pypulsar_tpu.cli import sweep as cli_sweep
+
+            os.environ["PYPULSAR_TPU_DATS_RESIDENT_LIMIT"] = "0"
+            os.environ["PYPULSAR_TPU_SPECFUSE_MODE"] = "stitch"
+
+            # per-leg counters come from SNAPSHOT DIFFS of one shared
+            # session: nested telemetry sessions reuse the outer
+            # collector (the run_corruption pitfall), so per-leg trace
+            # files would silently stay empty under an outer
+            # --telemetry session
+            with _tlm.session(tool="bench-specfuse") as tlm:
+                def leg_counters(fn):
+                    before = dict(tlm.counter_totals())
+                    wall = fn()
+                    after = tlm.counter_totals()
+                    return wall, {k: v - before.get(k, 0)
+                                  for k, v in after.items()
+                                  if v != before.get(k, 0)}
+
+                def run_dat(tag):
+                    t0 = time.perf_counter()
+                    assert cli_sweep.main([fil, "-o", tag, *sweep_args,
+                                           "--write-dats"]) == 0
+                    dats = sorted(_glob.glob(f"{tag}_DM*.dat"))
+                    assert cli_accel.main([*dats, "--batch", "8", "-z",
+                                           "20", "-n", "2", "-s", "3"]) == 0
+                    return time.perf_counter() - t0
+
+                def run_handoff(tag, extra=()):
+                    def go():
+                        t0 = time.perf_counter()
+                        assert cli_sweep.main([fil, "-o", tag,
+                                               *sweep_args, *handoff,
+                                               *extra]) == 0
+                        return time.perf_counter() - t0
+                    return leg_counters(go)
+
+                # each leg runs twice: the first pass compiles that
+                # leg's kernels (jit caches are shared in-process), the
+                # second is the measured wall — the same
+                # warm-at-real-shape discipline every other bench leg
+                # applies
+                run_dat("wdat")
+                wall_dat = run_dat("dat")
+                run_handoff("wstr")
+                wall_streamed, str_counters = run_handoff("str")
+                run_handoff("wfus", ["--spectral"])
+                wall_fused, fus_counters = run_handoff("fus",
+                                                       ["--spectral"])
+                os.environ["PYPULSAR_TPU_SPECFUSE_MODE"] = "decimate"
+                run_handoff("wdec", ["--spectral"])
+                wall_dec, dec_counters = run_handoff("dec",
+                                                     ["--spectral"])
+
+            c_dat, c_str = cands("dat"), cands("str")
+            c_fus, c_dec = cands("fus"), cands("dec")
+            assert c_str == c_dat, "streamed vs .dat parity broke"
+            assert c_fus == c_str, "fused(stitched) vs streamed parity broke"
+            dec_identical = sum(c_dec[k] == c_str[k] for k in c_str)
+        finally:
+            for k, v in env_save.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            os.chdir(olddir)
+
+    pairs_elided = dec_counters.get("specfuse.fft_pairs_elided", 0)
+    unit = (f"fused vs streamed vs .dat walls, CPU-toy geometry "
+            f"({C}-chan x {T}-samp x {D} trials, zmax=20, H<=2, "
+            f"engine=fourier); the GATE is structural: transforms/trial "
+            f"from telemetry counters, sift parity asserted")
+    if args.cpu_fallback:
+        unit += " [CPU FALLBACK: accelerator backend unavailable]"
+    return {
+        "metric": "specfuse_ab",
+        # headline: the fused stitched path vs the streamed handoff
+        "value": round(wall_streamed / wall_fused, 3),
+        "unit": unit,
+        "wall_dat_chain_s": round(wall_dat, 2),
+        "wall_streamed_s": round(wall_streamed, 2),
+        "wall_fused_s": round(wall_fused, 2),
+        "wall_decimate_s": round(wall_dec, 2),
+        "parity": {
+            "streamed_vs_dat": "byte-identical (asserted)",
+            "fused_vs_streamed": "byte-identical (asserted)",
+            "decimate_vs_streamed": f"{dec_identical}/{len(c_str)} tables "
+                                    f"byte-identical (circular boundary "
+                                    f"semantics; opt-in regime, see "
+                                    f"specfuse docstring)",
+        },
+        "transforms_per_trial": {
+            # single-chunk geometry: the streamed path pays one sweep
+            # irfft + one prep rfft per trial; fused(stitched) pays the
+            # same two but keeps the series on device; decimate pays 0
+            "streamed": 2,
+            "fused_stitched": 2,
+            "fused_decimate": 0,
+        },
+        "fft_pairs_elided_decimate": int(pairs_elided),
+        "series_bytes_kept_on_device_fused": int(
+            fus_counters.get("specfuse.bytes_on_device", 0)),
+        "chunks_stitched_fused": int(
+            fus_counters.get("specfuse.chunks_stitched", 0)),
+        "d2h_bytes": {
+            "streamed": int(str_counters.get("d2h.bytes", 0)),
+            "fused": int(fus_counters.get("d2h.bytes", 0)),
+            "decimate": int(dec_counters.get("d2h.bytes", 0)),
+        },
+        "n_trials": D,
     }
 
 
@@ -2395,8 +2578,8 @@ def run_child(args, cpu: bool, timeout: float):
         argv += ["--stream", args.stream]
         if args.stream_window is not None:
             argv += ["--stream-window", str(args.stream_window)]
-    for flag in ("quick", "profile", "ab", "accel", "fold", "waterfall",
-                 "prepass", "survey", "chaos", "corruption"):
+    for flag in ("quick", "profile", "ab", "accel", "spectral", "fold",
+                 "waterfall", "prepass", "survey", "chaos", "corruption"):
         if getattr(args, flag):
             argv.append("--" + flag)
     if args.corruption:
@@ -2459,6 +2642,8 @@ def main():
                                          tool="bench") as tlm:
             if args.ab:
                 record = run_ab(args)
+            elif args.accel and args.spectral:
+                record = run_specfuse(args)
             elif args.accel:
                 record = run_accel(args)
             elif args.fold:

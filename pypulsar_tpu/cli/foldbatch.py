@@ -86,6 +86,12 @@ def build_parser():
                    help="stream source: subbands (default 64)")
     p.add_argument("--group-size", type=int, default=0,
                    help="stream source: stage-1 DM group size (0 = auto)")
+    p.add_argument("--mask", dest="maskfile", default=None,
+                   help="stream source: rfifind .mask (ours or PRESTO's) "
+                        "applied per block with median-mid80 fill, so the "
+                        "folded series reflect the same zapped stream the "
+                        "search ran on (raw-file streaming only: .dat/"
+                        "--datbase series were masked when written)")
     telemetry.add_telemetry_flag(
         p, what="foldpipe spans + fold.cands_folded / fold.pending_depth")
     faultinject.add_fault_flag(p)
@@ -98,6 +104,12 @@ def main(argv=None):
     if (args.infile is None) == (args.datbase is None):
         parser.error("give exactly one series source: a raw/.dat infile "
                      "OR --datbase")
+    if args.maskfile and (args.datbase is not None
+                          or args.infile.endswith(".dat")):
+        parser.error("--mask applies to the raw-stream source only "
+                     "(.dat/--datbase series were masked when written); "
+                     "a silently ignored mask would fold a different "
+                     "stream than requested")
     from pypulsar_tpu.obs import telemetry
     from pypulsar_tpu.resilience import faultinject
 
@@ -152,11 +164,16 @@ def _run(args):
     else:
         from pypulsar_tpu.cli import open_data_file
 
+        rfimask = None
+        if args.maskfile:
+            from pypulsar_tpu.io.rfimask import RfifindMask
+
+            rfimask = RfifindMask(args.maskfile)
         reader = open_data_file(args.infile)
         summary = fold_pipeline(
             cands, outbase, source="stream", reader=reader,
             downsamp=args.downsamp, nsub=args.nsub,
-            group_size=args.group_size, **kwargs)
+            group_size=args.group_size, rfimask=rfimask, **kwargs)
 
     print_fold_results(summary)
     print(f"# folded {summary['n_folded']} candidates "

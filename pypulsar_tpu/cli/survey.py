@@ -142,6 +142,14 @@ def build_parser():
                    choices=(1, 2, 4, 8))
     g.add_argument("--accel-sigma", type=float, default=2.0)
     g.add_argument("--accel-batch", type=int, default=32)
+    g.add_argument("--spectral", action="store_true",
+                   help="spectral fusion (round 15): the sweep stage "
+                        "serves accel-search from device-resident fused "
+                        "spectra (sweep --spectral) instead of teeing "
+                        "per-DM .dats, and the fold stage streams the "
+                        "raw file. A science knob (it is part of the "
+                        "manifest fingerprint): changing it restarts "
+                        "affected manifests")
     g = p.add_argument_group("sift stage")
     g.add_argument("--sift-sigma", type=float, default=4.0)
     g.add_argument("--sift-min-hits", type=int, default=2)
@@ -238,7 +246,7 @@ def _run(args) -> int:
         threshold=args.threshold,
         accel_zmax=args.accel_zmax, accel_dz=args.accel_dz,
         accel_numharm=args.accel_numharm, accel_sigma=args.accel_sigma,
-        accel_batch=args.accel_batch,
+        accel_batch=args.accel_batch, accel_spectral=args.spectral,
         sift_sigma=args.sift_sigma, sift_min_hits=args.sift_min_hits,
         sift_min_dm=args.sift_min_dm,
         fold_nbins=args.fold_nbins, fold_npart=args.fold_npart,

@@ -506,6 +506,21 @@ def main(argv=None):
                     help="with --accel-search: skip the single-pulse "
                          "sweep pass and its .cands, running only the "
                          "dedisperse->accel handoff")
+    ap.add_argument("--spectral", action="store_true",
+                    help="with --accel-search: serve the accel search "
+                         "from device-resident fused spectra "
+                         "(parallel.specfuse) — the per-trial series "
+                         "never round-trips through the host and prep "
+                         "collapses to one dispatch per DM slice, with "
+                         "candidate tables BIT-identical to the "
+                         "streamed device-prep handoff; "
+                         "PYPULSAR_TPU_SPECFUSE_MODE=decimate "
+                         "additionally elides the per-trial "
+                         "irfft+rfft pair outright on single-chunk "
+                         "power-of-two geometries (circular boundary "
+                         "semantics, opt-in). Excludes --write-dats "
+                         "(no series to tee) and "
+                         "--no-accel-device-prep")
     ap.add_argument("--accel-zmax", type=float, default=200.0,
                     help="accel handoff: max drift in Fourier bins "
                          "(default 200)")
@@ -627,6 +642,16 @@ def _main_parsed(args, ap):
             ap.error("--accel-search streams ONE file on this host")
     if args.accel_only and not args.accel_search:
         ap.error("--accel-only requires --accel-search")
+    if args.spectral:
+        if not args.accel_search:
+            ap.error("--spectral requires --accel-search (it is the "
+                     "fused sweep->accel handoff)")
+        if args.write_dats:
+            ap.error("--spectral has no time series to tee: drop "
+                     "--write-dats or use the streamed handoff")
+        if not args.accel_device_prep:
+            ap.error("--spectral IS device prep: it cannot combine "
+                     "with --no-accel-device-prep")
     if args.journal and (args.ddplan or args.time_shard
                          or len(args.infile) > 1):
         ap.error("--journal is a flat single-file option (the journal "
@@ -748,7 +773,8 @@ def _main_parsed(args, ap):
                 # --mesh now spans the WHOLE chain: the handoff shards
                 # the (dm x spectrum) axes over the same devices the
                 # sweep pass used (artifacts byte-identical at any k)
-                journal=journal, mesh=mesh, verbose=True)
+                journal=journal, mesh=mesh, spectral=args.spectral,
+                verbose=True)
             print(f"# accel handoff: {summary['n_searched']} trials "
                   f"searched, {summary['n_skipped']} skipped"
                   + (f", {summary['serial_fallbacks']} serial fallbacks"
@@ -789,8 +815,10 @@ def _journal_fingerprint(args, dms, widths, outbase) -> str:
                        args.accel_max_cands,
                        # device- and host-prep candidates only match
                        # within tolerance, not bit-identically: a resume
-                       # must not mix prep provenances in one run
-                       int(bool(args.accel_device_prep))]).tobytes())
+                       # must not mix prep provenances in one run (the
+                       # spectral fused path is a third provenance)
+                       int(bool(args.accel_device_prep)),
+                       int(bool(args.spectral))]).tobytes())
     h.update((args.infile + "|" + (args.maskfile or "")
               + "|" + outbase).encode())
     return h.hexdigest()

@@ -249,8 +249,23 @@ def _prep_spectra_kernel(series, starts, lens, elem_block, elem_off, maxlen):
     )(re, im, powers, starts, lens, elem_block, elem_off, maxlen)
 
 
-def prep_spectra_batch(series, schedule: DereddenSchedule | None = None,
-                       mesh=None):
+@partial(jax.jit, static_argnames=("maxlen",))
+def _prep_transformed_kernel(re, im, starts, lens, elem_block, elem_off,
+                             maxlen):
+    """Deredden-only half of :func:`_prep_spectra_kernel` for input that
+    is ALREADY in the Fourier domain — the prep of the spectral-fusion
+    path (round 15), which hands over per-trial spectra with no time
+    series to rfft. Mean subtraction is re-expressed spectrally: the
+    series mean lives entirely in bin 0, which ``_deredden_body``
+    overwrites with 1+0j, so nothing remains to subtract."""
+    powers = re * re + im * im
+    return jax.vmap(
+        _deredden_body, in_axes=(0, 0, 0, None, None, None, None, None)
+    )(re, im, powers, starts, lens, elem_block, elem_off, maxlen)
+
+
+def prep_spectra_batch(series=None, schedule: DereddenSchedule | None = None,
+                       mesh=None, spectra=None):
     """rfft + deredden a batch of time series in ONE device program.
 
     ``series`` is [B, n] float; returns device-resident ``(re, im)``
@@ -263,6 +278,15 @@ def prep_spectra_batch(series, schedule: DereddenSchedule | None = None,
     is float32 end-to-end, so candidate sigmas agree to ~1e-6 relative
     (inside the documented 2e-6 SNR contract), not bitwise.
 
+    ``spectra`` (exclusive with ``series``) is a ``(re, im)`` tuple of
+    real [B, F] planes that are ALREADY the one-sided transforms — the
+    spectral-fusion handoff (parallel/specfuse.py), whose sweep kernel
+    never leaves the Fourier domain. Only the red-noise normalization
+    runs (``_prep_transformed_kernel``); the per-series mean
+    subtraction is spectrally a bin-0 edit that deredden's DC overwrite
+    subsumes, so the elided rfft is the ONLY difference from the
+    series path.
+
     ``mesh`` shards the batch axis over its 'dm' devices (B must be a
     multiple of the 'dm' size): each device rffts + dereddens only its
     local spectra — every op
@@ -270,6 +294,32 @@ def prep_spectra_batch(series, schedule: DereddenSchedule | None = None,
     unsharded dispatch and stay resident for the equally-sharded
     ``accel_search_batch`` (the multi-chip handoff's prep half).
     """
+    if (series is None) == (spectra is None):
+        raise ValueError("give exactly one of series= or spectra=")
+    if spectra is not None:
+        re, im = (jnp.asarray(spectra[0]), jnp.asarray(spectra[1]))
+        if re.ndim != 2 or re.shape != im.shape:
+            raise ValueError(f"spectra planes must be two [B, F] arrays; "
+                             f"got {re.shape} / {im.shape}")
+        if schedule is None:
+            schedule = deredden_schedule(re.shape[1])
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            ndm = int(mesh.shape["dm"])
+            if re.shape[0] % ndm:
+                raise ValueError(f"batch {re.shape[0]} must be a multiple "
+                                 f"of the mesh 'dm' axis {ndm}")
+            spec = NamedSharding(mesh, P("dm"))
+            re = jax.device_put(re, spec)
+            im = jax.device_put(im, spec)
+        return _prep_transformed_kernel(
+            re, im,
+            jnp.asarray(schedule.starts), jnp.asarray(schedule.lens),
+            jnp.asarray(schedule.elem_block),
+            jnp.asarray(schedule.elem_off),
+            maxlen=schedule.maxlen,
+        )
     series = jnp.asarray(series)
     if series.ndim != 2:
         raise ValueError(f"series must be [B, n]; got {series.shape}")

@@ -284,6 +284,20 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
             health_bits.append(f"{label}={n}")
     if health_bits:
         p("#\n# fleet health: " + "  ".join(health_bits))
+    # spectral-fusion roll-up (round 15): what the fused sweep->accel
+    # handoff kept off the host link and out of the FFT budget
+    sf_bits = []
+    n_st = s.counters.get("specfuse.chunks_stitched")
+    if n_st:
+        sf_bits.append(f"spectral chunks stitched={_fmt_count(n_st)}")
+    n_el = s.counters.get("specfuse.fft_pairs_elided")
+    if n_el:
+        sf_bits.append(f"irfft+rfft pairs elided={_fmt_count(n_el)}")
+    n_kept = s.counters.get("specfuse.bytes_on_device")
+    if n_kept:
+        sf_bits.append(f"series bytes kept on device={_fmt_bytes(n_kept)}")
+    if sf_bits:
+        p("#\n# spectral fusion: " + "  ".join(sf_bits))
     # data-quality roll-up: what the dataguard scrub and the finite
     # gates did to this run's bytes (round 13)
     data_bits = []

@@ -49,7 +49,8 @@ import jax.numpy as jnp
 
 from pypulsar_tpu.ops.pallas_kernels import boxcar_stats
 
-__all__ = ["sweep_chunk_fourier", "fourier_chunk_len"]
+__all__ = ["sweep_chunk_fourier", "sweep_chunk_spectra",
+           "fourier_chunk_len"]
 
 
 def fourier_chunk_len(min_len: int) -> int:
@@ -272,4 +273,107 @@ def dedisperse_series_fourier_impl(
 dedisperse_series_fourier = jax.jit(
     dedisperse_series_fourier_impl,
     static_argnames=("nsub", "out_len", "n_fft", "phase_mode"),
+)
+
+
+def sweep_chunk_spectra_impl(
+    data,
+    stage1_bins,
+    stage2_bins,
+    nsub: int,
+    n_fft: int,
+    dec_stride: int,
+    dec_len: int,
+    mean_len: int,
+    phase_mode: str = "factored",
+):
+    """Per-trial dedispersed SPECTRA, pre-irfft — the spectral-fusion
+    kernel (round 15). Same two-stage phase math as
+    :func:`dedisperse_series_fourier_impl` with the final irfft DELETED:
+    the per-trial ``Xts`` is kept in the Fourier domain and DECIMATED
+    onto the accel stage's T-point grid (``dec_stride = n_fft // T``,
+    ``dec_len = T//2 + 1``, ``mean_len = T``; requires ``n_fft % T ==
+    0`` and data support confined to ``[0, T)``). Returns ``(re, im)``
+    float32 planes ``[D, dec_len]`` (complex never crosses the jit
+    boundary, ops/transfer.py).
+
+    Boundary semantics — read before trusting parity: decimating by
+    ``n_fft/T`` in frequency is alias-folding the implied frame to
+    period T in time, so the result is EXACTLY the spectrum of the
+    **circularly** dedispersed series ``ts[u] = sum_c x_c[(u + s_c) mod
+    T]`` — the Fourier-domain-dedispersion convention (PAPERS.md
+    2110.03482 applies the chirp to the full-observation spectrum the
+    same way). The framework's time-domain engines use PRESTO's
+    zero-padded LINEAR shifts instead; the two agree everywhere except
+    the final ``max_total_shift`` samples, where linear has partial
+    sums (channels read past the data end into zeros) and circular
+    wraps in each channel's first ``s_c`` samples. No phase trick can
+    reconcile them: every channel's full T samples are present in any
+    phase-shifted frame, and the fold must put the ``s_c`` head samples
+    — which the linear window never reads — SOMEWHERE in the period.
+    This was measured, not guessed (BENCHNOTES round 10): the candidate
+    tables differ at toy scale, which is why parallel/specfuse.py ships
+    this kernel as the opt-in ``decimate`` regime and defaults to the
+    bit-exact stitched regime.
+
+    ``mean_len`` (= T): per-channel means over the real samples are
+    subtracted first, masked so the zero pad stays zero. Each channel's
+    subtracted boxcar spans exactly one fold period, which aliases to a
+    CONSTANT — spectrally a pure bin-0 term, exactly like
+    ``prep_spectra_batch``'s series-mean subtraction (also a bin-0
+    edit), and deredden overwrites bin 0 anyway. Numerically it keeps
+    the f32 butterflies at fluctuation scale instead of the ~100x-sigma
+    DC of 8-bit data.
+    """
+    C, L = data.shape
+    G, g, S = stage2_bins.shape
+    per = C // nsub
+    col = jnp.arange(L, dtype=jnp.int32)
+    live = (col < mean_len).astype(data.dtype)[None, :]
+    mu = (data * live).sum(axis=1, keepdims=True) / jnp.float32(mean_len)
+    data = data - mu * live
+    X = jnp.fft.rfft(data, n=n_fft, axis=1)  # [C, F]
+    F = X.shape[1]
+    k = jnp.arange(F, dtype=jnp.int32)
+    didx = jnp.arange(dec_len, dtype=jnp.int32) * jnp.int32(dec_stride)
+
+    if phase_mode == "factored":
+        M = _fact_split(F)
+        Fh = -(-F // M)
+        k_hi = jnp.arange(Fh, dtype=jnp.int32)
+        k_lo = jnp.arange(M, dtype=jnp.int32)
+        Xp = jnp.pad(X, ((0, 0), (0, Fh * M - F))).reshape(C, Fh, M)
+
+        def body(carry, xs):
+            s1, s2 = xs
+            hi1 = _phase(s1 * jnp.int32(M), k_hi, n_fft)
+            lo1 = _phase(s1, k_lo, n_fft)
+            xsub = (Xp * hi1[:, :, None] * lo1[:, None, :]) \
+                .reshape(nsub, per, Fh, M).sum(axis=1)
+            hi2 = _phase(s2 * jnp.int32(M), k_hi, n_fft)
+            lo2 = _phase(s2, k_lo, n_fft)
+            xts = (xsub[None] * hi2[..., None] * lo2[..., None, :]) \
+                .sum(axis=1)
+            xts = jnp.take(xts.reshape(-1, Fh * M), didx, axis=1)
+            return carry, (xts.real.astype(jnp.float32),
+                           xts.imag.astype(jnp.float32))
+    else:
+        def body(carry, xs):
+            s1, s2 = xs
+            ph1 = _phase(s1, k, n_fft)
+            ph2 = _phase(s2, k, n_fft)
+            xsub = (X * ph1).reshape(nsub, per, F).sum(axis=1)
+            xts = (xsub[None, :, :] * ph2).sum(axis=1)
+            xts = jnp.take(xts, didx, axis=1)
+            return carry, (xts.real.astype(jnp.float32),
+                           xts.imag.astype(jnp.float32))
+
+    _, (re, im) = jax.lax.scan(body, 0, (stage1_bins, stage2_bins))
+    return re.reshape(G * g, dec_len), im.reshape(G * g, dec_len)
+
+
+sweep_chunk_spectra = jax.jit(
+    sweep_chunk_spectra_impl,
+    static_argnames=("nsub", "n_fft", "dec_stride", "dec_len", "mean_len",
+                     "phase_mode"),
 )

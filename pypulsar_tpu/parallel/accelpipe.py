@@ -172,13 +172,15 @@ def _host_prep_rows(rows: np.ndarray, schedule) -> np.ndarray:
 
 def _run_fingerprint(dms, config, outbase: str, downsamp: int, nsub: int,
                      group_size: int, max_cands: int, device_prep: bool,
-                     rfimask) -> str:
+                     rfimask, spectral: bool = False) -> str:
     """Journal fingerprint of everything that determines this handoff's
     artifacts — ``max_cands`` (caps the .cand contents), ``device_prep``
     (host/device candidates match only within tolerance, never
-    bit-identically) and the applied rfimask (a different zap table is a
-    different series). Resuming under different parameters must start
-    over, exactly the SweepCheckpoint contract."""
+    bit-identically), ``spectral`` (the fused path's decimated regime
+    likewise matches only within tolerance) and the applied rfimask (a
+    different zap table is a different series). Resuming under
+    different parameters must start over, exactly the SweepCheckpoint
+    contract."""
     from pypulsar_tpu.parallel.staged import _mask_tag
 
     h = hashlib.sha256()
@@ -187,7 +189,8 @@ def _run_fingerprint(dms, config, outbase: str, downsamp: int, nsub: int,
                          config.wmax, config.dw]).tobytes())
     h.update(np.int64([config.numharm, downsamp, nsub,
                        group_size, max_cands,
-                       int(bool(device_prep))]).tobytes())
+                       int(bool(device_prep)),
+                       int(bool(spectral))]).tobytes())
     h.update(outbase.encode())
     h.update(_mask_tag(rfimask).encode())
     return h.hexdigest()
@@ -213,6 +216,7 @@ def sweep_accel_stream(
     journal_path: Optional[str] = None,
     journal: Optional[RunJournal] = None,
     mesh=None,
+    spectral: bool = False,
     verbose: bool = False,
 ) -> dict:
     """Dedisperse ``dms`` over ``reader`` and accel-search every trial,
@@ -245,7 +249,20 @@ def sweep_accel_stream(
     run, which the multi-chip parity tests and the BENCH_r09 record
     assert. NOTE: ``mesh`` is a placement choice, not science — it is
     deliberately absent from the journal fingerprint, so a gang-leased
-    resume can pick up a 1-chip run's journal and vice versa."""
+    resume can pick up a 1-chip run's journal and vice versa.
+
+    ``spectral`` routes the handoff through the FUSED path
+    (parallel/specfuse.py): per DM slice, every trial's prepped T-point
+    spectrum is built device-resident — the series never crosses the
+    host link and prep collapses to one dispatch per slice, with
+    candidates BIT-identical to this path's device-prep output
+    (stitched regime, the default); ``PYPULSAR_TPU_SPECFUSE_MODE=
+    decimate`` opts eligible geometries into the zero-transforms-per-
+    trial regime (circular boundary semantics — specfuse docstring).
+    Requires ``device_prep`` (the fused spectra ARE the device prep)
+    and excludes ``write_dats`` (the tee would resurrect the time
+    series the fusion exists to skip; use the streamed path when .dats
+    are wanted)."""
     from pypulsar_tpu.fourier.accelsearch import (
         accel_search,
         accel_search_batch,
@@ -255,6 +272,13 @@ def sweep_accel_stream(
         prep_spectra_batch,
     )
 
+    if spectral and write_dats:
+        raise ValueError("spectral fusion has no time series to tee: "
+                         "--write-dats needs the streamed (non-spectral) "
+                         "handoff")
+    if spectral and not device_prep:
+        raise ValueError("spectral fusion IS device prep: host prep "
+                         "(device_prep=False) contradicts spectral=True")
     dms = np.asarray(dms, dtype=np.float64)
     ndm = 1 if mesh is None else int(mesh.shape["dm"])
     mesh_devs = (tuple(mesh.devices.flat) if mesh is not None else None)
@@ -268,7 +292,7 @@ def sweep_accel_stream(
     if own_journal:
         journal = RunJournal(journal_path, _run_fingerprint(
             dms, config, outbase, downsamp, nsub, group_size, max_cands,
-            device_prep, rfimask), tool="sweep-accel")
+            device_prep, rfimask, spectral), tool="sweep-accel")
     journal_done: set = (journal.completed() if journal is not None
                          else set())
 
@@ -318,9 +342,19 @@ def sweep_accel_stream(
     # IO the handoff exists to kill (the tee rewrites them, harmlessly)
     write_dat_infs(outbase, reader, dms, T,
                    _ReaderSource(reader).tsamp * max(1, downsamp))
-    budget = int(float(os.environ.get("PYPULSAR_TPU_ACCEL_STREAM_RAM",
-                                      12e9)))
-    slice_dms = max(batch, int(budget // (4 * max(T, 1))))
+    if spectral:
+        # fused slices live on DEVICE (series buffer + prepped planes),
+        # so the slice budget is HBM, not host RAM
+        from pypulsar_tpu.parallel.specfuse import spectral_trial_bytes
+
+        budget = int(float(os.environ.get("PYPULSAR_TPU_SPECFUSE_HBM",
+                                          8e9)))
+        slice_dms = max(batch,
+                        int(budget // max(spectral_trial_bytes(T), 1)))
+    else:
+        budget = int(float(os.environ.get("PYPULSAR_TPU_ACCEL_STREAM_RAM",
+                                          12e9)))
+        slice_dms = max(batch, int(budget // (4 * max(T, 1))))
     # slices MUST align to stage-1 group boundaries: make_sweep_plan
     # regroups each slice's consecutive DMs from its own start, and a
     # misaligned slice shifts every later trial into a group with a
@@ -344,8 +378,10 @@ def sweep_accel_stream(
     # chip stays inside its own HBM share)
     hbm = int(float(os.environ.get("PYPULSAR_TPU_ACCEL_HBM", 5e9)))
     inflight = prefetch_depth + 2 if prefetch_depth > 0 else 1
+    # spectral: prep already happened (the slice's resident planes), so
+    # a batch holds only its gathered rows — no per-batch prep cap
     unit = (min(batch, max(1, ndm * ((hbm // inflight) // (24 * T))))
-            if device_prep else batch)
+            if device_prep and not spectral else batch)
     if ndm > 1:
         # dispatch batches stay whole device multiples; short tails pad
         # by replicating the last row (dropped after the search)
@@ -360,12 +396,23 @@ def sweep_accel_stream(
         sl_todo = [i for i in todo if dsl.start <= i < dsl.stop]
         if not sl_todo and not write_dats:
             continue
-        series, dt_eff = stream_series(
-            reader, dms[dsl], downsamp=downsamp, nsub=nsub,
-            group_size=group_size, rfimask=rfimask, engine=engine,
-            chunk_payload=chunk_payload,
-            dat_outbase=outbase if write_dats else None,
-            mesh=mesh, verbose=verbose)
+        series = re_pl = im_pl = None
+        if spectral:
+            from pypulsar_tpu.parallel.specfuse import fused_spectra_slice
+
+            fused = fused_spectra_slice(
+                reader, dms[dsl], schedule=schedule, downsamp=downsamp,
+                nsub=nsub, group_size=group_size, rfimask=rfimask,
+                engine=engine, chunk_payload=chunk_payload, mesh=mesh,
+                verbose=verbose)
+            re_pl, im_pl, dt_eff = fused["re"], fused["im"], fused["dt_eff"]
+        else:
+            series, dt_eff = stream_series(
+                reader, dms[dsl], downsamp=downsamp, nsub=nsub,
+                group_size=group_size, rfimask=rfimask, engine=engine,
+                chunk_payload=chunk_payload,
+                dat_outbase=outbase if write_dats else None,
+                mesh=mesh, verbose=verbose)
         faultinject.trip("accel.after_stream")  # kill-point (journal test)
         T_sec = T * dt_eff
 
@@ -384,16 +431,35 @@ def sweep_accel_stream(
             rows pad to a whole device multiple by REPLICATING the last
             row — replication (not zeros) keeps every shard's numerics
             on real data shapes, and the padded results drop before the
-            writers, so padding cannot change any artifact byte."""
+            writers, so padding cannot change any artifact byte.
+
+            Spectral mode: the slice's spectra are ALREADY prepped and
+            device-resident — the worker only gathers the batch's rows
+            of the planes (a device gather, never a host round trip),
+            padding by the same last-row replication."""
             try:
+                prep_attrs = {"batch": len(idxs)}
+                if dev_ids is not None:
+                    prep_attrs["dev"] = dev_ids
+                if spectral:
+                    import jax.numpy as jnp
+
+                    loc = np.asarray([i - d0 for i in idxs],
+                                     dtype=np.int32)
+                    with telemetry.span("accel_prep_fused", **prep_attrs):
+                        rre, rim = re_pl[loc], im_pl[loc]
+                        if ndm > 1 and rre.shape[0] % ndm:
+                            pad = ndm - rre.shape[0] % ndm
+                            rre = jnp.concatenate(
+                                [rre, jnp.repeat(rre[-1:], pad, axis=0)])
+                            rim = jnp.concatenate(
+                                [rim, jnp.repeat(rim[-1:], pad, axis=0)])
+                        return idxs, (rre, rim), None
                 rows = np.ascontiguousarray(series[[i - d0 for i in idxs]])
                 if ndm > 1 and rows.shape[0] % ndm:
                     pad = ndm - rows.shape[0] % ndm
                     rows = np.concatenate(
                         [rows, np.repeat(rows[-1:], pad, axis=0)])
-                prep_attrs = {"batch": len(idxs)}
-                if dev_ids is not None:
-                    prep_attrs["dev"] = dev_ids
                 with telemetry.span("accel_prep_device" if device_prep
                                     else "accel_prep_host",
                                     **prep_attrs):
@@ -475,13 +541,22 @@ def sweep_accel_stream(
                         # one poison spectrum fails ALONE (no .cand
                         # written, so a skip_existing restart retries
                         # it), never the rest of the run — the batched
-                        # CLI's contract
+                        # CLI's contract. Spectral mode falls back on
+                        # the fused spectrum itself (pulled to host for
+                        # the serial search): there is no time series
+                        # to host-prep, and the fused spectrum is the
+                        # run's prep provenance
                         try:
-                            all_cands.append(accel_search(
-                                _host_prep_rows(
+                            if spectral:
+                                fft1 = (np.asarray(re_pl[i - d0])
+                                        + 1j * np.asarray(im_pl[i - d0])
+                                        ).astype(np.complex64)
+                            else:
+                                fft1 = _host_prep_rows(
                                     series[i - d0:i - d0 + 1],
-                                    schedule)[0],
-                                T_sec, config))
+                                    schedule)[0]
+                            all_cands.append(accel_search(
+                                fft1, T_sec, config))
                         except Exception as e1:  # noqa: BLE001
                             if health.no_degrade(e1):
                                 raise  # see the batch handler above
@@ -509,7 +584,9 @@ def sweep_accel_stream(
             if verbose:
                 print(f"# searched trials {idxs[0]}..{idxs[-1]} "
                       f"({n_searched}/{len(todo)})")
-        del series  # free the slice buffer before the next pass
+        # free the slice buffer (host series or device planes) before
+        # the next pass
+        del series, re_pl, im_pl
 
     if journal is not None:
         journal.note(event="accel_stream_done", n_searched=n_searched,

@@ -75,6 +75,11 @@ class SurveyConfig:
     accel_numharm: int = 8
     accel_sigma: float = 2.0
     accel_batch: int = 32
+    # spectral fusion (round 15): the sweep stage hands the accel
+    # search device-resident fused spectra (`sweep --spectral`) instead
+    # of teeing per-DM .dat series; the fold stage then streams the RAW
+    # file (its own one-pass dedispersion) since no .dats exist
+    accel_spectral: bool = False
     # sift
     sift_sigma: float = 4.0
     sift_min_hits: int = 2
@@ -193,12 +198,15 @@ SWEEP_GANG_MAX = 8
 
 
 def _sweep_argv(obs: Observation, cfg: SurveyConfig) -> List[str]:
+    # spectral fusion drops the .dat tee (there is no time series to
+    # tee); the fold stage compensates by streaming the raw file
+    series = (["--spectral"] if cfg.accel_spectral else ["--write-dats"])
     argv = [obs.infile, "-o", obs.outbase,
             "--lodm", str(cfg.lodm), "--dmstep", str(cfg.dmstep),
             "--numdms", str(cfg.numdms), "-s", str(cfg.nsub),
             "--group-size", str(cfg.group_size),
             "--threshold", str(cfg.threshold),
-            "--write-dats", "--accel-search",
+            *series, "--accel-search",
             "--accel-zmax", str(cfg.accel_zmax),
             "--accel-dz", str(cfg.accel_dz),
             "--accel-numharm", str(cfg.accel_numharm),
@@ -249,10 +257,21 @@ def _sift_outputs(obs: Observation, cfg: SurveyConfig) -> List[str]:
 
 
 def _fold_argv(obs: Observation, cfg: SurveyConfig) -> List[str]:
-    return ["--cands", f"{obs.outbase}.accelcands",
-            "--datbase", obs.outbase, "-o", obs.outbase,
+    argv = ["--cands", f"{obs.outbase}.accelcands", "-o", obs.outbase,
             "-n", str(cfg.fold_nbins), "--npart", str(cfg.fold_npart),
             "--batch", str(cfg.fold_batch)]
+    if cfg.accel_spectral:
+        # no .dat tee exists under spectral fusion: fold from the RAW
+        # file (foldbatch's one streamed dedispersion pass), with the
+        # sweep's own series geometry AND mask so the folded series
+        # match what the candidates were found in (a maskless fold
+        # would reintroduce the RFI the search excluded)
+        return ([obs.infile, *argv, "-s", str(cfg.nsub),
+                 "--group-size", str(cfg.group_size)]
+                + (["--downsamp", str(cfg.downsamp)]
+                   if cfg.downsamp != 1 else [])
+                + (["--mask", _mask_file(obs)] if cfg.mask else []))
+    return argv + ["--datbase", obs.outbase]
 
 
 def _fold_outputs(obs: Observation, cfg: SurveyConfig) -> List[str]:

@@ -516,6 +516,293 @@ def test_sharded_handoff_stamps_device_telemetry(tmp_path, monkeypatch):
     assert s.counters.get("device1.accel.stream_batches", 0) >= 1
 
 
+# ---------------------------------------------------------------------------
+# spectral fusion: the fused sweep->accel handoff (round 15)
+# ---------------------------------------------------------------------------
+
+
+SPECTRAL_ARGS = [*HANDOFF_ARGS, "--accel-only", "--spectral"]
+
+
+def _cand_bytes(prefix):
+    return {os.path.basename(f)[len(prefix):]: open(f, "rb").read()
+            for f in sorted(glob.glob(f"{prefix}_DM*_ACCEL_20.*cand"))}
+
+
+@pytest.mark.parametrize("T,extra", [
+    (16384, []),                      # single chunk, power-of-two
+    (15000, ["--chunk", "4096"]),     # non-pow2 out_len + partial tail
+])
+def test_spectral_handoff_bit_identical_to_streamed(tmp_path, monkeypatch,
+                                                    T, extra):
+    """The round-15 parity gate: `--spectral` (stitched regime, the
+    default) writes candidate tables BIT-identical to the streamed
+    device-prep handoff — including a non-power-of-two series length
+    and a trailing partial chunk, the geometries where the decimated
+    shortcut is structurally impossible and the stitch must carry the
+    exact overlap-save windows."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path, T=T)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    assert cli_sweep.main([fil, "-o", "s", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--accel-only", *extra]) == 0
+    assert cli_sweep.main([fil, "-o", "f", *SWEEP_ARGS, *SPECTRAL_ARGS,
+                           *extra]) == 0
+    ref, got = _cand_bytes("s"), _cand_bytes("f")
+    assert len(ref) == 16  # .cand + .txtcand per trial
+    assert got == ref
+
+
+def test_spectral_handoff_fourier_engine_identical(tmp_path, monkeypatch):
+    """Same gate under the TPU-default fourier engine (the stitch
+    consumes the SAME chunk kernel the streamed path pulls to host, so
+    engine choice cannot open a gap)."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    eng = ["--engine", "fourier"]
+    assert cli_sweep.main([fil, "-o", "s", *SWEEP_ARGS, *HANDOFF_ARGS,
+                           "--accel-only", *eng]) == 0
+    assert cli_sweep.main([fil, "-o", "f", *SWEEP_ARGS, *SPECTRAL_ARGS,
+                           *eng]) == 0
+    assert _cand_bytes("f") == _cand_bytes("s")
+
+
+def test_spectral_slice_budget_and_stitch_counters(tmp_path, monkeypatch):
+    """A PYPULSAR_TPU_SPECFUSE_HBM budget below the whole trial set
+    fuses in group-aligned DM slices (one extra raw pass each) with
+    unchanged candidate tables, and the specfuse telemetry counters
+    record the stitched chunks and the series bytes kept on device."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+    from pypulsar_tpu.obs.summarize import load_records, summarize
+    from pypulsar_tpu.parallel.specfuse import spectral_trial_bytes
+
+    assert cli_sweep.main([fil, "-o", "w", *SWEEP_ARGS,
+                           *SPECTRAL_ARGS]) == 0
+    # budget for exactly 4 trials/slice (aligned to --group-size 4)
+    monkeypatch.setenv("PYPULSAR_TPU_SPECFUSE_HBM",
+                       str(4 * spectral_trial_bytes(16384)))
+    assert cli_sweep.main([fil, "-o", "v", *SWEEP_ARGS, *SPECTRAL_ARGS,
+                           "--telemetry", "v.jsonl"]) == 0
+    assert _cand_bytes("v") == _cand_bytes("w")
+    s = summarize(load_records("v.jsonl"))
+    assert s.counters.get("specfuse.chunks_stitched", 0) >= 2  # 2 slices
+    # 8 trials x 16384 samples x 8 B (D2H pull + H2D re-ship elided)
+    assert s.counters.get("specfuse.bytes_on_device") == 8 * 8 * 16384
+
+
+def test_spectral_kill_resume_at_stitch_boundary(tmp_path, monkeypatch):
+    """A kill AT THE NEW STAGE BOUNDARY (the specfuse.after_stitch
+    fault point, second DM slice) resumes with --accel-skip-existing:
+    the first slice's finished .cands are skipped, the rest are fused
+    and searched, and every final table is bit-identical to an
+    uninterrupted run."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+    from pypulsar_tpu.resilience import faultinject
+    from pypulsar_tpu.resilience.faultinject import InjectedKill
+
+    assert cli_sweep.main([fil, "-o", "r", *SWEEP_ARGS,
+                           *SPECTRAL_ARGS]) == 0
+    ref = _cand_bytes("r")
+    assert len(ref) == 16
+
+    from pypulsar_tpu.parallel.specfuse import spectral_trial_bytes
+
+    monkeypatch.setenv("PYPULSAR_TPU_SPECFUSE_HBM",
+                       str(4 * spectral_trial_bytes(16384)))
+    try:
+        with pytest.raises(InjectedKill):
+            cli_sweep.main([fil, "-o", "k", *SWEEP_ARGS, *SPECTRAL_ARGS,
+                            "--fault-inject",
+                            "kill:specfuse.after_stitch:2"])
+    finally:
+        faultinject.reset()
+    done = _cand_bytes("k")
+    assert 0 < len(done) < 16  # first slice landed, second did not
+    assert cli_sweep.main([fil, "-o", "k", *SWEEP_ARGS, *SPECTRAL_ARGS,
+                           "--accel-skip-existing"]) == 0
+    assert _cand_bytes("k") == ref
+
+
+@pytest.mark.parametrize("numdms,mesh_k", [(8, 4), (6, 4)])
+def test_spectral_handoff_sharded_byte_identical(tmp_path, monkeypatch,
+                                                 numdms, mesh_k):
+    """`--spectral --mesh k`: the stitch buffer, the fused prep planes
+    and the search all stay P('dm')-sharded over the k devices, and the
+    candidate tables are BYTE-identical to the 1-device streamed run —
+    including the 6-trials-on-4-chips case where trial groups pad to
+    the device multiple."""
+    require_virtual_mesh(mesh_k)
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+    from pypulsar_tpu.obs.summarize import load_records, summarize
+
+    args = ["--lodm", "0", "--dmstep", "10", "--numdms", str(numdms),
+            "-s", "8", "--group-size", "4", "--threshold", "8"]
+    assert cli_sweep.main([fil, "-o", "s1", *args, *HANDOFF_ARGS,
+                           "--accel-only"]) == 0
+    assert cli_sweep.main([fil, "-o", "sk", *args, *SPECTRAL_ARGS,
+                           "--mesh", str(mesh_k),
+                           "--telemetry", "sk.jsonl"]) == 0
+    ref, got = _cand_bytes("s1"), _cand_bytes("sk")
+    assert len(ref) == 2 * numdms
+    assert got == ref
+    # per-device stamps land on the specfuse counters (PR 6 contract)
+    s = summarize(load_records("sk.jsonl"))
+    assert s.counters.get("device0.specfuse.chunks_stitched", 0) >= 1
+    assert s.counters.get(f"device{mesh_k - 1}.specfuse.chunks_stitched",
+                          0) >= 1
+
+
+def test_spectral_decimate_matches_circular_reference():
+    """The opt-in decimated regime's kernel contract: the per-trial
+    decimated spectrum is EXACTLY (to f32 rounding) the T-point rfft of
+    the two-stage CIRCULARLY dedispersed, mean-subtracted series — the
+    Fourier-domain-dedispersion convention, which differs from the
+    zero-padded linear engines only in the final max-shift samples
+    (why decimate is opt-in rather than the parity default)."""
+    import jax.numpy as jnp
+
+    from pypulsar_tpu.ops.fourier_dedisperse import (
+        fourier_chunk_len,
+        sweep_chunk_spectra,
+    )
+    from pypulsar_tpu.parallel.sweep import make_sweep_plan
+
+    rng = np.random.RandomState(0)
+    C, T, dt = 16, 4096, 5e-4
+    freqs = 1500.0 - 4.0 * np.arange(C)
+    data = rng.randn(C, T).astype(np.float32) * 2.0 + 30.0
+    dms = np.array([0.0, 10.0, 20.0, 30.0])
+    plan = make_sweep_plan(dms, freqs, dt, nsub=8, group_size=2,
+                           widths=(1,))
+    need = T + plan.min_overlap
+    n_fft = fourier_chunk_len(need)
+    block = jnp.pad(jnp.asarray(data), ((0, 0), (0, need - T)))
+    re_f, im_f = sweep_chunk_spectra(
+        block, jnp.asarray(plan.stage1_bins),
+        jnp.asarray(plan.stage2_bins), plan.nsub, n_fft, n_fft // T,
+        T // 2 + 1, T)
+
+    d64 = data.astype(np.float64)
+    d64 = d64 - d64.mean(axis=1, keepdims=True)
+    per = C // plan.nsub
+    for gi in range(plan.stage1_bins.shape[0]):
+        sub = np.zeros((plan.nsub, T))
+        for c in range(C):
+            sub[c // per] += np.roll(d64[c],
+                                     -int(plan.stage1_bins[gi, c]))
+        for ti in range(plan.group_size):
+            d = gi * plan.group_size + ti
+            if d >= len(dms):
+                break
+            ts = np.zeros(T)
+            for sb in range(plan.nsub):
+                ts += np.roll(sub[sb],
+                              -int(plan.stage2_bins[gi, ti, sb]))
+            ref = np.fft.rfft(ts)
+            got = (np.asarray(re_f[d]).astype(np.float64)
+                   + 1j * np.asarray(im_f[d]))
+            err = np.abs(ref - got)
+            err[0] = 0.0  # DC conventions differ; deredden overwrites it
+            rms = np.sqrt((np.abs(ref) ** 2).mean())
+            assert err.max() / rms < 2e-5, (d, err.max() / rms)
+
+
+def test_spectral_decimate_optin_elides_fft_pairs(tmp_path, monkeypatch):
+    """PYPULSAR_TPU_SPECFUSE_MODE=decimate on an eligible geometry
+    (single fourier chunk, power-of-two T): the telemetry counters
+    prove ZERO per-trial transforms (one irfft+rfft pair elided per
+    trial), and the injected pulsar is still recovered at its DM."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+    from pypulsar_tpu.io.prestocand import read_rzwcands
+    from pypulsar_tpu.obs.summarize import load_records, summarize
+
+    monkeypatch.setenv("PYPULSAR_TPU_SPECFUSE_MODE", "decimate")
+    assert cli_sweep.main([fil, "-o", "d", *SWEEP_ARGS, *SPECTRAL_ARGS,
+                           "--engine", "fourier",
+                           "--telemetry", "d.jsonl"]) == 0
+    s = summarize(load_records("d.jsonl"))
+    assert s.counters.get("specfuse.fft_pairs_elided") == 8
+    assert not s.counters.get("specfuse.chunks_stitched")
+    T = 16384 * 5e-4
+    f0 = 1.0 / 0.1024
+    cands = read_rzwcands("d_DM40.00_ACCEL_20.cand")
+
+    def is_harmonic(c):
+        k = (c.r / T) / f0
+        return k > 0.5 and abs(k - round(k)) < 0.02
+
+    assert any(is_harmonic(c) and c.sig > 10 for c in cands[:10])
+
+
+def test_spectral_survey_dag_argv_composition():
+    """The spectral survey DAG: the sweep stage swaps the .dat tee for
+    --spectral, and the fold stage streams the RAW file with the
+    sweep's series geometry AND its rfifind mask — a maskless fold
+    would reintroduce the RFI the search excluded (review catch)."""
+    from pypulsar_tpu.survey.dag import (
+        SurveyConfig,
+        _fold_argv,
+        _mask_file,
+        _sweep_argv,
+    )
+    from pypulsar_tpu.survey.state import Observation
+
+    obs = Observation("b0", "/d/b0.fil", "/o/b0")
+    cfg = SurveyConfig(accel_spectral=True, mask=True)
+    sw = _sweep_argv(obs, cfg)
+    assert "--spectral" in sw and "--write-dats" not in sw
+    fa = _fold_argv(obs, cfg)
+    assert fa[0] == obs.infile and "--datbase" not in fa
+    assert fa[fa.index("--mask") + 1] == _mask_file(obs)
+    assert "--mask" not in _fold_argv(
+        obs, SurveyConfig(accel_spectral=True, mask=False))
+    no_fuse = _fold_argv(obs, SurveyConfig(accel_spectral=False))
+    assert "--datbase" in no_fuse and "--mask" not in no_fuse
+
+
+def test_foldbatch_mask_is_stream_only(tmp_path, monkeypatch):
+    """foldbatch --mask is rejected loudly for .dat/--datbase sources
+    (those series were masked when written; silently ignoring the flag
+    would fold a different stream than requested)."""
+    monkeypatch.chdir(tmp_path)
+    from pypulsar_tpu.cli import foldbatch as cli_fold
+
+    open("c.txt", "w").write("0.1 40.0\n")
+    with pytest.raises(SystemExit):
+        cli_fold.main(["--cands", "c.txt", "--datbase", "x",
+                       "--mask", "m.mask"])
+    with pytest.raises(SystemExit):
+        cli_fold.main(["x.dat", "--cands", "c.txt", "--mask", "m.mask"])
+
+
+def test_spectral_flag_validation(tmp_path, monkeypatch):
+    """--spectral composes only with --accel-search and excludes the
+    flags that contradict fusion (--write-dats, --no-accel-device-prep)."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path, name="sv.fil", T=4096)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    with pytest.raises(SystemExit):
+        cli_sweep.main([fil, "--numdms", "4", "--spectral"])
+    with pytest.raises(SystemExit):
+        cli_sweep.main([fil, "--numdms", "4", *HANDOFF_ARGS,
+                        "--spectral", "--write-dats"])
+    with pytest.raises(SystemExit):
+        cli_sweep.main([fil, "--numdms", "4", *HANDOFF_ARGS,
+                        "--spectral", "--no-accel-device-prep"])
+
+
 def test_lease_devices_resolver_contract():
     """parallel.mesh.lease_devices: inside a device_lease only the
     leased chips are addressable (and over-asking raises); outside, the

@@ -85,6 +85,13 @@ def parse_args(argv=None):
                     help="measured cells/s to place on the roofline "
                          "(default 577M, BENCH r4/r5 dispatch-level; CLI "
                          "level with I/O measured 400M)")
+    ap.add_argument("--fused", action="store_true",
+                    help="model the SPECTRAL-FUSION stage (round 10, "
+                         "parallel/specfuse.py): the per-trial forward "
+                         "FFT of the prep (and the sweep-side inverse "
+                         "that fed it) drop from the per-spectrum "
+                         "budget, so the stage ceiling is restated "
+                         "without the prep transforms")
     ap.add_argument("--json", action="store_true",
                     help="emit the analysis as one JSON line")
     return ap.parse_args(argv)
@@ -143,12 +150,33 @@ def analyze(n, zmax, dz, numharm, segw, min_halfwidth, batch, rlo,
     )
 
 
+def prep_flops_per_spectrum(n: int, fused: bool) -> float:
+    """Per-spectrum transform cost of GETTING the normalized spectrum —
+    the round-10 fusion target. The streamed handoff pays one forward
+    rfft of the 2n-sample series in prep PLUS the sweep-side inverse
+    that produced that series (the irfft->rfft pair specfuse elides);
+    each real transform of length L is ~2.5*L*log2(L) flops under this
+    file's 5*L*log2(L) complex-FFT convention. The fused path pays
+    ZERO per-trial transforms (decimate regime; the stitched regime
+    keeps the pair but off the host link — this model states the
+    transform-count claim, which the specfuse telemetry counters
+    verify at run time)."""
+    if fused:
+        return 0.0
+    L = 2 * n
+    return 2 * 2.5 * L * math.log2(L)
+
+
 def main(argv=None):
     a = parse_args(argv)
     r = analyze(a.n, a.zmax, a.dz, a.numharm, a.segw, a.min_halfwidth,
                 a.batch, a.flo_bins)
+    prep = prep_flops_per_spectrum(a.n, a.fused)
+    prep_per_cell = prep / r["total_cells"]
     fft_ceiling = a.fft_gflops * 1e9 / r["fft_flops_per_cell"]
     fft_floor = a.fft_gflops_lo * 1e9 / r["fft_flops_per_cell"]
+    ceiling_with_prep = a.fft_gflops * 1e9 / (r["fft_flops_per_cell"]
+                                              + prep_per_cell)
     hbm_ceiling_fused = a.hbm_gbs * 1e9 / r["bytes_per_cell_fused"]
     hbm_ceiling_worst = a.hbm_gbs * 1e9 / r["bytes_per_cell_worst"]
     implied_gflops = a.measured * r["fft_flops_per_cell"] / 1e9
@@ -159,6 +187,10 @@ def main(argv=None):
         "fft_rate_band_gflops": [a.fft_gflops_lo, a.fft_gflops],
         "hbm_gbs": a.hbm_gbs,
         "batch": a.batch,
+        "fused": bool(a.fused),
+        "prep_fft_flops_per_spectrum": round(prep, 1),
+        "prep_fft_flops_per_cell": round(prep_per_cell, 4),
+        "ceiling_fft_incl_prep_cells_per_sec": round(ceiling_with_prep, 1),
         "ceiling_fft_cells_per_sec": round(fft_ceiling, 1),
         "ceiling_fft_lo_cells_per_sec": round(fft_floor, 1),
         "ceiling_hbm_fused_cells_per_sec": round(hbm_ceiling_fused, 1),
@@ -197,6 +229,17 @@ def main(argv=None):
           f"{100 * frac:.0f}% of the band-top FFT ceiling (implied FFT "
           f"rate {implied_gflops:.0f} GFLOP/s, inside the measured "
           f"band) -> the stage is {rec['bound'].upper()}-bound")
+    if a.fused:
+        print("# FUSED stage (round 10): per-trial prep transforms "
+              "elided — 0 prep FFT flops/spectrum; the stage ceiling "
+              "is the correlation-only number above")
+    else:
+        print(f"# prep (per-trial irfft+rfft pair the fused path "
+              f"elides): {prep / 1e6:.1f}M flops/spectrum = "
+              f"{prep_per_cell:.2f} flops/cell -> ceiling incl. prep "
+              f"{ceiling_with_prep / 1e6:.0f}M cells/s "
+              f"({100 * (1 - ceiling_with_prep / fft_ceiling):.1f}% "
+              f"below correlation-only; compare --fused)")
     return 0
 
 
