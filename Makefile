@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier test-faults test-fold test-survey test-corruption lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-multichip bench-specfuse bench-telemetry native clean
+.PHONY: test test-fourier test-faults test-fold test-survey test-corruption lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-multichip bench-specfuse bench-telemetry bench-tree native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -133,6 +133,15 @@ bench-multichip:
 bench-specfuse:
 	$(CPU_ENV) $(PY) -m pytest tests/test_accel_pipeline.py -q -k "spectral"
 	$(CPU_ENV) $(PY) bench.py --accel --spectral --out BENCH_r10_specfuse.json
+
+# tree dedispersion (round 16): the tree-engine parity suite (exact
+# snap, mesh bit-identity, chain byte-identity, kill/resume), then the
+# three-engine A/B at the production DM-count geometry — SNR parity
+# asserted in-process, adds/cell from tools/dedisp_roofline.py as the
+# gate -> BENCH_r11_tree.json
+bench-tree:
+	$(CPU_ENV) $(PY) -m pytest tests/test_sweep.py tests/test_accel_pipeline.py -q -k "tree"
+	$(CPU_ENV) $(PY) bench.py --dedisp-tree --out BENCH_r11_tree.json
 
 native:
 	$(PY) -c "from pypulsar_tpu import native; assert native.available(); print('native codec OK')"

@@ -32,6 +32,38 @@ def _open_reader(fn: str):
     return filterbank.FilterbankFile(fn)
 
 
+def _engine_arg(value: str) -> str:
+    """argparse validator for ``--engine``: checked against the ENGINES
+    registry AT PARSE TIME with a difflib closest-match hint (the
+    cli/__main__ unknown-tool pattern) — an unknown engine used to
+    surface as a ValueError deep inside resolve_engine, mid-run, after
+    the reader was already streaming."""
+    from pypulsar_tpu.parallel.sweep import ENGINES
+
+    valid = ("auto",) + ENGINES
+    if value in valid:
+        return value
+    import difflib
+
+    close = difflib.get_close_matches(value, valid, n=1)
+    hint = "; did you mean %r?" % close[0] if close else ""
+    raise argparse.ArgumentTypeError(
+        "unknown sweep engine %r%s (expected one of %s)"
+        % (value, hint, ", ".join(valid)))
+
+
+def _check_engine_env(ap) -> None:
+    """Early validation of PYPULSAR_TPU_SWEEP_ENGINE (consulted only
+    when --engine is 'auto'): same parse-time error + hint as the flag,
+    instead of the mid-run resolve_engine failure."""
+    env = os.environ.get("PYPULSAR_TPU_SWEEP_ENGINE")
+    if env and env != "auto":
+        try:
+            _engine_arg(env)
+        except argparse.ArgumentTypeError as e:
+            ap.error("PYPULSAR_TPU_SWEEP_ENGINE: %s" % e)
+
+
 def _write_cands(path, cands, extra_cols=()):
     """Write candidate/event/pulse rows atomically (tmp + os.replace —
     downstream consumers must never see a truncated table); ``extra_cols``
@@ -476,10 +508,13 @@ def main(argv=None):
                          "device count). Devices come from the active "
                          "gang lease when the survey scheduler placed "
                          "this run, else the local device list")
-    ap.add_argument("--engine", default="auto",
-                    choices=("auto", "gather", "scan", "fourier"),
-                    help="chunk-kernel formulation (auto: fourier on TPU, "
-                         "gather elsewhere)")
+    ap.add_argument("--engine", default="auto", type=_engine_arg,
+                    help="chunk-kernel formulation: auto (fourier on "
+                         "TPU, gather elsewhere), gather, scan, fourier, "
+                         "or tree (log2(nchan) shared-work merge levels "
+                         "— the production-DM-count engine, round 16); "
+                         "validated here against the ENGINES registry "
+                         "with a closest-match hint")
     ap.add_argument("--mask", dest="maskfile", default=None,
                     help="rfifind .mask file (ours or PRESTO's) applied "
                          "per block with median-mid80 fill")
@@ -608,6 +643,8 @@ def main(argv=None):
     faultinject.add_fault_flag(ap)
     args = ap.parse_args(argv)
 
+    if args.engine == "auto":
+        _check_engine_env(ap)
     faultinject.configure_from_env()
     if args.fault_inject:
         faultinject.configure(args.fault_inject)

@@ -53,7 +53,7 @@ from pypulsar_tpu.utils import profiling
 
 DEFAULT_WIDTHS = (1, 2, 4, 8, 16, 32)
 
-ENGINES = ("gather", "scan", "fourier")
+ENGINES = ("gather", "scan", "fourier", "tree")
 
 
 def shard_map_compat(fn, mesh, in_specs, out_specs,
@@ -80,7 +80,11 @@ def resolve_engine(engine: str = "auto") -> str:
     effective (3% of HBM roofline) while the Fourier phase-multiply path
     streams at bandwidth. 'gather' stays the default off-TPU (CPU XLA
     handles the vmapped dynamic_slice fine, and it is the bit-parity
-    reference formulation). Override with PYPULSAR_TPU_SWEEP_ENGINE.
+    reference formulation). 'tree' (ops/tree_dedisperse.py) shares adds
+    between ALL trials through log2(nchan) pairwise merge levels — the
+    production-DM-count engine (round 16); opt-in (never auto-picked:
+    its win depends on trial count/density, see the README engine
+    matrix). Override with PYPULSAR_TPU_SWEEP_ENGINE.
     """
     if engine != "auto":
         if engine not in ENGINES:
@@ -345,6 +349,15 @@ def _sweep_chunk_impl(
     Returns per-trial (sum[D], sumsq[D], maxbox[D, W], argbox[D, W]).
     """
     engine = resolve_engine(engine)
+    if engine == "tree":
+        # the tree engine's merge tables are HOST-built (data-dependent
+        # dedup) — it dispatches from the Python wrappers (sweep_chunk /
+        # dedisperse_series_chunk / the sharded factories), never from
+        # inside a traced impl
+        raise ValueError(
+            "engine='tree' cannot run inside a traced chunk impl; "
+            "dispatch through sweep_chunk/dedisperse_series_chunk or "
+            "the make_sharded_* factories")
     if engine == "fourier":
         from pypulsar_tpu.ops.fourier_dedisperse import (
             fourier_chunk_len,
@@ -393,16 +406,31 @@ def _sweep_chunk_impl(
 
 @partial(jax.jit, static_argnames=("nsub", "out_len", "slack2", "widths",
                                    "stat_len", "engine"))
-def sweep_chunk(data, stage1_bins, stage2_bins, nsub, out_len, slack2, widths,
-                stat_len, engine="gather"):
-    """Single-device chunk sweep (see _sweep_chunk_impl)."""
+def _sweep_chunk_jit(data, stage1_bins, stage2_bins, nsub, out_len, slack2,
+                     widths, stat_len, engine="gather"):
     return _sweep_chunk_impl(
         data, stage1_bins, stage2_bins, nsub, out_len, slack2, widths,
         stat_len, engine=engine
     )
 
 
-@partial(jax.jit, static_argnames=("nsub", "out_len", "slack2", "engine"))
+def sweep_chunk(data, stage1_bins, stage2_bins, nsub, out_len, slack2, widths,
+                stat_len, engine="gather"):
+    """Single-device chunk sweep (see _sweep_chunk_impl). A thin Python
+    dispatcher (not itself jitted): the gather/scan/fourier engines run
+    as one jitted program; the tree engine first builds (cached) host
+    merge tables from the exact shift values, then runs its own jitted
+    scans (ops/tree_dedisperse.py)."""
+    engine = resolve_engine(engine)
+    if engine == "tree":
+        from pypulsar_tpu.ops.tree_dedisperse import sweep_chunk_tree
+
+        return sweep_chunk_tree(data, stage1_bins, stage2_bins, out_len,
+                                tuple(widths), stat_len)
+    return _sweep_chunk_jit(data, stage1_bins, stage2_bins, nsub, out_len,
+                            slack2, widths, stat_len, engine=engine)
+
+
 def dedisperse_series_chunk(data, stage1_bins, stage2_bins, nsub,
                             out_len: int, slack2: int, engine="gather"):
     """Two-stage subband dedispersed SERIES [D, out_len] for one chunk —
@@ -410,7 +438,22 @@ def dedisperse_series_chunk(data, stage1_bins, stage2_bins, nsub,
     raw per-trial time series. The chunk kernel of the streamed .dat
     writer (staged.write_dats_streamed): PRESTO-prepsubband semantics
     (subband dedispersion with the sweep's own stage bins), so the
-    written series is exactly what the sweep's detections saw."""
+    written series is exactly what the sweep's detections saw. Python
+    dispatcher like :func:`sweep_chunk` (the tree engine builds host
+    tables before its jitted scans)."""
+    engine = resolve_engine(engine)
+    if engine == "tree":
+        from pypulsar_tpu.ops.tree_dedisperse import dedisperse_series_tree
+
+        return dedisperse_series_tree(data, stage1_bins, stage2_bins,
+                                      out_len)
+    return _dedisperse_series_jit(data, stage1_bins, stage2_bins, nsub,
+                                  out_len, slack2, engine)
+
+
+@partial(jax.jit, static_argnames=("nsub", "out_len", "slack2", "engine"))
+def _dedisperse_series_jit(data, stage1_bins, stage2_bins, nsub,
+                           out_len: int, slack2: int, engine="gather"):
     engine = resolve_engine(engine)
     if engine == "fourier":
         from pypulsar_tpu.ops.fourier_dedisperse import (
@@ -447,6 +490,16 @@ def make_sharded_sweep_chunk(mesh: Mesh, nsub, out_len, slack2, widths,
     hot loop — candidates are reduced host-side after streaming. The group
     count must divide the 'dm' axis size (use make_sweep_plan(pad_groups_to=...)).
     """
+    engine = resolve_engine(engine)
+    if engine == "tree":
+        # per-device host-built tables (rows bit-identical to the
+        # unsharded tree engine — per-trial merge structure is fixed)
+        from pypulsar_tpu.ops.tree_dedisperse import (
+            make_sharded_tree_sweep_chunk,
+        )
+
+        return make_sharded_tree_sweep_chunk(mesh, out_len, tuple(widths),
+                                             stat_len)
     impl = partial(
         _sweep_chunk_impl,
         nsub=nsub,
@@ -476,6 +529,12 @@ def make_sharded_series_chunk(mesh: Mesh, nsub, out_len, slack2,
     math is device-count independent. The group count must divide the
     'dm' axis size (make_sweep_plan(pad_groups_to=...))."""
     engine = resolve_engine(engine)
+    if engine == "tree":
+        from pypulsar_tpu.ops.tree_dedisperse import (
+            make_sharded_tree_series_chunk,
+        )
+
+        return make_sharded_tree_series_chunk(mesh, out_len)
 
     def impl(data, stage1_bins, stage2_bins):
         return dedisperse_series_chunk(data, stage1_bins, stage2_bins,
@@ -505,6 +564,12 @@ def make_sharded_sweep_chunk_2d(
     Input: data[C, T] sharded as P(None, 'time'); stage tables sharded P('dm').
     T must equal local_payload * mesh.shape['time'].
     """
+    engine = resolve_engine(engine)
+    if engine == "tree":
+        raise ValueError(
+            "engine='tree' supports the 1-D 'dm' mesh only (its merge "
+            "tables are host-built per device); use gather/scan/fourier "
+            "on the dm x time mesh")
     W = max(widths)
     out_len = local_payload + W
     nt = mesh.shape["time"]
@@ -1198,6 +1263,11 @@ def sweep_resident(spectra, dms, nsub=64, group_size=32, widths=DEFAULT_WIDTHS,
     axis inside the same single program.
     """
     engine = resolve_engine(engine)
+    if engine == "tree":
+        raise ValueError(
+            "sweep_resident's single compiled program cannot host the "
+            "tree engine (host-built merge tables); use the streamed "
+            "path (sweep_spectra/sweep_stream) with engine='tree'")
     freqs = np.asarray(spectra.freqs, dtype=np.float64)
     if group_size <= 0:
         group_size = choose_group_size(dms, freqs, spectra.dt, nsub)

@@ -745,6 +745,150 @@ def test_spectral_decimate_optin_elides_fft_pairs(tmp_path, monkeypatch):
     assert any(is_harmonic(c) and c.sig > 10 for c in cands[:10])
 
 
+# ---------------------------------------------------------------------------
+# tree engine through the handoff chain (round 16): the shared-work
+# engine must feed every stage unchanged — same within-engine byte
+# contracts the fourier engine carries
+# ---------------------------------------------------------------------------
+
+
+TREE_SWEEP_ARGS = [*SWEEP_ARGS, "--engine", "tree"]
+
+
+def test_tree_handoff_bit_identical_to_dat_roundtrip(tmp_path,
+                                                     monkeypatch):
+    """The round-6 chain contract under engine='tree': the streamed
+    sweep->accel handoff's candidate tables are BIT-identical to the
+    .dat write + re-read chain (same tree chunk kernel feeds both), and
+    the injected pulsar is recovered."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("PYPULSAR_TPU_DATS_RESIDENT_LIMIT", "0")
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import accelsearch as cli_accel
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    assert cli_sweep.main([fil, "-o", "a", *TREE_SWEEP_ARGS,
+                           "--write-dats"]) == 0
+    dats = sorted(glob.glob("a_DM*.dat"))
+    assert len(dats) == 8
+    assert cli_accel.main([*dats, "--batch", "4", *ACCEL_ARGS]) == 0
+    a_cands = sorted(glob.glob("a_DM*_ACCEL_20.cand"))
+    assert a_cands
+
+    assert cli_sweep.main([fil, "-o", "b", *TREE_SWEEP_ARGS,
+                           *HANDOFF_ARGS, "--accel-only"]) == 0
+    for fa in a_cands:
+        fb = "b" + os.path.basename(fa)[1:]
+        assert open(fa, "rb").read() == open(fb, "rb").read(), fa
+        ta, tb = fa[:-5] + ".txtcand", fb[:-5] + ".txtcand"
+        assert open(ta).read() == open(tb).read(), ta
+
+    from pypulsar_tpu.io.prestocand import read_rzwcands
+
+    T = 16384 * 5e-4
+    cands = read_rzwcands("b_DM40.00_ACCEL_20.cand")
+    f0 = 1.0 / 0.1024
+
+    def is_harmonic(c):
+        k = (c.r / T) / f0
+        return k > 0.5 and abs(k - round(k)) < 0.02
+
+    assert any(is_harmonic(c) and c.sig > 10 for c in cands[:10]), \
+        "injected pulsar not recovered under engine=tree"
+
+
+@pytest.mark.parametrize("T,extra", [
+    (16384, []),                      # single chunk, power-of-two
+    (15000, ["--chunk", "4096"]),     # non-pow2 out_len + partial tail
+])
+def test_tree_spectral_bit_identical_to_streamed(tmp_path, monkeypatch,
+                                                 T, extra):
+    """'tree feeds specfuse unchanged': `--engine tree --spectral`
+    candidate tables are BYTE-identical to the tree-engine streamed
+    handoff at every tested geometry — the same within-engine chain
+    invariance the fourier engine's round-15 gate pinned. (Cross-ENGINE
+    tables differ by f32 summation order for every engine pair — the
+    measured 0/16 finding recorded in BENCHNOTES round 16 — so the byte
+    contract is per engine, as it always was.)"""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path, T=T)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    assert cli_sweep.main([fil, "-o", "s", *TREE_SWEEP_ARGS,
+                           *HANDOFF_ARGS, "--accel-only", *extra]) == 0
+    assert cli_sweep.main([fil, "-o", "f", *TREE_SWEEP_ARGS,
+                           *SPECTRAL_ARGS, *extra]) == 0
+    ref, got = _cand_bytes("s"), _cand_bytes("f")
+    assert len(ref) == 16
+    assert got == ref
+
+
+@pytest.mark.parametrize("numdms,mesh_k", [(8, 4), (6, 4)])
+def test_tree_spectral_sharded_byte_identical(tmp_path, monkeypatch,
+                                              numdms, mesh_k):
+    """`--engine tree --spectral --mesh k`: per-device tree tables,
+    P('dm')-sharded stitch and search — candidate tables BYTE-identical
+    to the 1-device tree streamed run, incl. the 6-trials-on-4-chips
+    padding case; the tree counters land with per-device stamps (the
+    PR 6 lease contract)."""
+    require_virtual_mesh(mesh_k)
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+    from pypulsar_tpu.obs.summarize import load_records, summarize
+
+    args = ["--lodm", "0", "--dmstep", "10", "--numdms", str(numdms),
+            "-s", "8", "--group-size", "4", "--threshold", "8",
+            "--engine", "tree"]
+    assert cli_sweep.main([fil, "-o", "s1", *args, *HANDOFF_ARGS,
+                           "--accel-only"]) == 0
+    assert cli_sweep.main([fil, "-o", "sk", *args, *SPECTRAL_ARGS,
+                           "--mesh", str(mesh_k),
+                           "--telemetry", "sk.jsonl"]) == 0
+    ref, got = _cand_bytes("s1"), _cand_bytes("sk")
+    assert len(ref) == 2 * numdms
+    assert got == ref
+    s = summarize(load_records("sk.jsonl"))
+    assert s.counters.get("tree.adds_total", 0) > 0
+    assert s.counters.get("device0.tree.adds_total", 0) > 0
+    assert s.counters.get(f"device{mesh_k - 1}.tree.adds_total", 0) > 0
+    assert s.gauges.get("tree.merge_levels", {}).get("max", 0) == 5
+
+
+def test_tree_spectral_kill_resume_at_stitch_boundary(tmp_path,
+                                                      monkeypatch):
+    """Kill at the specfuse.after_stitch boundary under engine='tree',
+    resume with --accel-skip-existing: final tables bit-identical to an
+    uninterrupted tree run (the existing harness, new engine)."""
+    monkeypatch.chdir(tmp_path)
+    fil = _pulsar_fil(tmp_path)
+    from pypulsar_tpu.cli import sweep as cli_sweep
+    from pypulsar_tpu.parallel.specfuse import spectral_trial_bytes
+    from pypulsar_tpu.resilience import faultinject
+    from pypulsar_tpu.resilience.faultinject import InjectedKill
+
+    assert cli_sweep.main([fil, "-o", "r", *TREE_SWEEP_ARGS,
+                           *SPECTRAL_ARGS]) == 0
+    ref = _cand_bytes("r")
+    assert len(ref) == 16
+
+    monkeypatch.setenv("PYPULSAR_TPU_SPECFUSE_HBM",
+                       str(4 * spectral_trial_bytes(16384)))
+    try:
+        with pytest.raises(InjectedKill):
+            cli_sweep.main([fil, "-o", "k", *TREE_SWEEP_ARGS,
+                            *SPECTRAL_ARGS, "--fault-inject",
+                            "kill:specfuse.after_stitch:2"])
+    finally:
+        faultinject.reset()
+    done = _cand_bytes("k")
+    assert 0 < len(done) < 16
+    assert cli_sweep.main([fil, "-o", "k", *TREE_SWEEP_ARGS,
+                           *SPECTRAL_ARGS,
+                           "--accel-skip-existing"]) == 0
+    assert _cand_bytes("k") == ref
+
+
 def test_spectral_survey_dag_argv_composition():
     """The spectral survey DAG: the sweep stage swaps the .dat tee for
     --spectral, and the fold stage streams the RAW file with the

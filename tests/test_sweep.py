@@ -235,7 +235,7 @@ def test_shift_segment_sum_matches_slice_rows():
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("engine", ["scan", "fourier"])
+@pytest.mark.parametrize("engine", ["scan", "fourier", "tree"])
 def test_sweep_engine_parity(engine):
     """Every chunk-kernel engine reproduces the gather formulation."""
     import jax.numpy as jnp
@@ -255,8 +255,12 @@ def test_sweep_engine_parity(engine):
             jnp.asarray(plan.stage2_bins))
     kw = dict(nsub=plan.nsub, out_len=out_len, slack2=plan.max_shift2,
               widths=plan.widths, stat_len=1024)
+    from pypulsar_tpu.parallel.sweep import sweep_chunk
+
     ref = [np.asarray(x) for x in _sweep_chunk_impl(*args, **kw)]
-    got = [np.asarray(x) for x in _sweep_chunk_impl(*args, engine=engine, **kw)]
+    # dispatch through the public wrapper: the tree engine builds its
+    # host merge tables there (a traced impl cannot host them)
+    got = [np.asarray(x) for x in sweep_chunk(*args, engine=engine, **kw)]
     for a, b in zip(ref, got):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-4)
 
@@ -673,6 +677,287 @@ def test_multi_event_chunk_peaks():
                         baseline=baseline)
     with pytest.raises(ValueError):
         res2.events(8.0)
+
+
+# ---------------------------------------------------------------------------
+# tree dedispersion engine (round 16): exact-shift merge tree + snap
+# ---------------------------------------------------------------------------
+
+
+def test_tree_engine_snr_tolerance():
+    """The tree engine's PUBLISHED parity contract, pinned at the SAME
+    contract geometry as test_fourier_engine_snr_tolerance: engine=
+    'gather' is the bit-exact-SNR reference; the tree engine's balanced
+    pairwise summation agrees to <=2e-6 relative SNR (measured ~1.0e-6
+    here — tighter than the fourier engine's 2.0e-6 at this geometry,
+    because the per-channel shifts are byte-for-bit the same s1+s2 and
+    only the f32 add ORDER differs)."""
+    from pypulsar_tpu.core.spectra import Spectra
+
+    rng = np.random.RandomState(19)
+    C, T = 64, 8192
+    freqs = 1500.0 - 2.0 * np.arange(C)
+    data = rng.randn(C, T).astype(np.float32)
+    data[:, 4000:4004] += 4.0  # a real pulse so peak SNRs are O(10)
+    dms = np.linspace(0.0, 80.0, 32)
+    spec = Spectra(freqs, 1e-3, data)
+    a = sweep_spectra(spec, dms, nsub=16, group_size=8, engine="gather")
+    b = sweep_spectra(spec, dms, nsub=16, group_size=8, engine="tree")
+    rel = np.abs(b.snr - a.snr) / np.maximum(np.abs(a.snr), 1.0)
+    assert rel.max() <= 2e-6, f"tree SNR rel err {rel.max():.2e} > 2e-6"
+    np.testing.assert_array_equal(b.peak_sample, a.peak_sample)
+
+
+def test_tree_exact_shift_snap():
+    """The tentpole's exactness claim: every trial's tree series applies
+    BYTE-FOR-BIT the same per-channel integer shift s1+s2 the direct
+    engine applies — checked against an f64 direct-shift sum (agreement
+    at f32 rounding of the SUM, with zero shift/index error: a
+    one-sample shift slip would show up as O(1) differences)."""
+    from pypulsar_tpu.parallel.sweep import dedisperse_series_chunk
+
+    rng = np.random.RandomState(7)
+    C, nsub, group = 48, 8, 4  # non-pow2 nchan: odd-carry merge levels
+    freqs = 1500.0 - 4.0 * np.arange(C)
+    dms = np.linspace(0.0, 60.0, 10)  # pads to 12 trials
+    plan = make_sweep_plan(dms, freqs, 1e-3, nsub=nsub, group_size=group)
+    out_len = 512
+    need = out_len + plan.max_shift2 + plan.max_shift1
+    data = rng.randn(C, need).astype(np.float32)
+    got = np.asarray(dedisperse_series_chunk(
+        data, plan.stage1_bins, plan.stage2_bins, plan.nsub, out_len,
+        plan.max_shift2, "tree"))
+    per = C // plan.nsub
+    tot = (plan.stage1_bins[:, None, :]
+           + np.repeat(plan.stage2_bins, per, axis=2)).reshape(-1, C)
+    d64 = data.astype(np.float64)
+    for d in range(plan.n_trials):
+        exact = np.zeros(out_len)
+        for c in range(C):
+            exact += d64[c, tot[d, c]:tot[d, c] + out_len]
+        np.testing.assert_allclose(got[d], exact, rtol=2e-5, atol=2e-4)
+
+
+def test_tree_streamed_nonpow2_chunks_match_gather():
+    """Streamed multi-chunk tree sweep — non-power-of-two chunk payload
+    AND a trailing partial chunk — matches the gather engine within the
+    engine-parity tolerance, with identical peak samples."""
+    from pypulsar_tpu.core.spectra import Spectra
+
+    rng = np.random.RandomState(7)
+    C, T = 32, 6100  # 6100 / 1000 -> trailing partial chunk
+    freqs = 1500.0 - 4.0 * np.arange(C)
+    data = rng.randn(C, T).astype(np.float32)
+    dms = np.linspace(0.0, 60.0, 16)
+    spec = Spectra(freqs, 1e-3, data)
+    a = sweep_spectra(spec, dms, nsub=8, group_size=4, chunk_payload=1000,
+                      engine="gather")
+    b = sweep_spectra(spec, dms, nsub=8, group_size=4, chunk_payload=1000,
+                      engine="tree")
+    np.testing.assert_allclose(b.snr, a.snr, rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(b.peak_sample, a.peak_sample)
+    np.testing.assert_allclose(b.mean, a.mean, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("n_dms", [64, 44])
+def test_tree_sharded_bit_identical(n_dms):
+    """'dm'-mesh tree sweep is BIT-identical to the unsharded tree sweep
+    — a per-trial row's merge structure is fixed, so per-device tables
+    cannot change any value (a stronger contract than the other engines'
+    allclose). n_dms=44 with group 8 exercises the 6-groups-on-4-devices
+    padding case."""
+    import jax
+
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    freqs, data = make_obs()
+    dms = np.linspace(0.0, 120.0, n_dms)
+    spec = Spectra(freqs, 1e-3, data)
+    single = sweep_spectra(spec, dms, nsub=16, group_size=8, engine="tree")
+    mesh = make_mesh([4], ("dm",), devices=jax.devices()[:4])
+    sharded = sweep_spectra(spec, dms, nsub=16, group_size=8,
+                            engine="tree", mesh=mesh)
+    np.testing.assert_array_equal(sharded.snr, single.snr)
+    np.testing.assert_array_equal(sharded.peak_sample, single.peak_sample)
+    np.testing.assert_array_equal(sharded.mean, single.mean)
+
+
+def test_tree_checkpoint_kill_and_resume_bit_exact(tmp_path):
+    """Kill+resume under engine='tree' reproduces the uninterrupted
+    result bit-for-bit through the EXISTING checkpoint machinery (the
+    engine is part of the checkpoint fingerprint context, so a tree
+    checkpoint can only resume a tree run)."""
+    from pypulsar_tpu.parallel.sweep import SweepCheckpoint, sweep_stream
+
+    rng = np.random.RandomState(11)
+    C, T, payload = 32, 9000, 2048
+    freqs = 1500.0 - 4.0 * np.arange(C)
+    data = rng.randn(C, T).astype(np.float32)
+    dms = np.linspace(0.0, 60.0, 16)
+    plan = make_sweep_plan(dms, freqs, 1e-3, nsub=8, group_size=4)
+    baseline = data.mean(axis=1, keepdims=True).astype(np.float32)
+
+    def blocks():
+        ov = plan.min_overlap
+        pos = 0
+        while pos < T:
+            n = min(payload + ov, T - pos)
+            yield pos, data[:, pos:pos + n]
+            pos += payload
+
+    ref = sweep_stream(plan, blocks(), payload, chan_major=True,
+                       baseline=baseline, engine="tree")
+
+    class Killed(Exception):
+        pass
+
+    def killing_blocks(n_before_kill):
+        for i, (pos, blk) in enumerate(blocks()):
+            if i >= n_before_kill:
+                raise Killed()
+            yield pos, blk
+
+    ck_path = str(tmp_path / "tree.ckpt.npz")
+    with pytest.raises(Killed):
+        sweep_stream(plan, killing_blocks(3), payload, chan_major=True,
+                     baseline=baseline, engine="tree", max_pending=1,
+                     checkpoint=SweepCheckpoint(ck_path, every=1))
+    assert os.path.exists(ck_path)
+    # a GATHER run must NOT resume the tree checkpoint (engine is in the
+    # fingerprint context) — it restarts and still matches its own ref
+    g_ref = sweep_stream(plan, blocks(), payload, chan_major=True,
+                         baseline=baseline, engine="gather")
+    g_got = sweep_stream(plan, blocks(), payload, chan_major=True,
+                         baseline=baseline, engine="gather",
+                         checkpoint=SweepCheckpoint(ck_path, every=1,
+                                                    cleanup=False))
+    np.testing.assert_array_equal(g_got.snr, g_ref.snr)
+    res = sweep_stream(plan, blocks(), payload, chan_major=True,
+                       baseline=baseline, engine="tree",
+                       checkpoint=SweepCheckpoint(ck_path, every=1))
+    np.testing.assert_array_equal(res.snr, ref.snr)
+    np.testing.assert_array_equal(res.peak_sample, ref.peak_sample)
+    np.testing.assert_array_equal(res.mean, ref.mean)
+
+
+def test_tree_plan_structure_and_cache():
+    """TreePlan structural invariants: exact add accounting beats the
+    two-stage direct count at a dense trial grid, the level count is
+    ceil(log2(nchan)) with odd carries, and the digest cache returns the
+    SAME object for repeated (even device-array) table inputs."""
+    import jax.numpy as jnp
+
+    from pypulsar_tpu.ops.tree_dedisperse import plan_from_bins
+
+    C = 64
+    freqs = 1500.0 - 2.0 * np.arange(C)
+    dms = np.linspace(0.0, 120.0, 256)  # dense: heavy profile sharing
+    plan = make_sweep_plan(dms, freqs, 1e-3, nsub=16, group_size=8)
+    tp = plan_from_bins(plan.stage1_bins, plan.stage2_bins)
+    assert tp.n_levels == 6  # ceil(log2(64))
+    assert len(tp.rows_per_level) == tp.n_levels
+    assert tp.rows == max(C, max(tp.rows_per_level))
+    G, g, S = plan.stage2_bins.shape
+    direct_adds = G * (C - S) + plan.n_trials * (S - 1)
+    assert 0 < tp.adds_per_sample < direct_adds
+    # snap offsets: within the exact total-shift bound, and the top
+    # reference channel pins the minimum at zero
+    assert tp.trial_off.min() == 0
+    assert tp.trial_off.max() <= tp.pad
+    # digest cache: same tables -> same plan object, device arrays too
+    assert plan_from_bins(plan.stage1_bins, plan.stage2_bins) is tp
+    assert plan_from_bins(jnp.asarray(plan.stage1_bins),
+                          jnp.asarray(plan.stage2_bins)) is tp
+
+
+def test_tree_engine_guards():
+    """The tree engine's explicit non-goals fail loudly: the resident
+    single-program sweep, the dm x time 2-D mesh, and a traced
+    _sweep_chunk_impl all raise instead of silently falling back."""
+    from pypulsar_tpu.parallel.sweep import (
+        _sweep_chunk_impl,
+        make_sharded_sweep_chunk_2d,
+        sweep_resident,
+    )
+
+    freqs, data = make_obs(T=2048)
+    dms = np.linspace(0.0, 120.0, 16)
+    spec = Spectra(freqs, 1e-3, data)
+    with pytest.raises(ValueError, match="streamed"):
+        sweep_resident(spec, dms, nsub=16, group_size=8, engine="tree")
+    mesh = make_mesh([4, 2], ("dm", "time"))
+    plan = make_sweep_plan(dms, freqs, 1e-3, nsub=16, group_size=8,
+                           pad_groups_to=4)
+    with pytest.raises(ValueError, match="1-D 'dm' mesh"):
+        make_sharded_sweep_chunk_2d(mesh, plan.nsub, 1024,
+                                    plan.min_overlap, plan.max_shift2,
+                                    plan.widths, engine="tree")
+    with pytest.raises(ValueError, match="traced"):
+        _sweep_chunk_impl(np.zeros((4, 64), np.float32),
+                          plan.stage1_bins, plan.stage2_bins, nsub=16,
+                          out_len=32, slack2=0, widths=(1,), stat_len=32,
+                          engine="tree")
+
+
+def test_cli_engine_validation(tmp_path, capsys):
+    """--engine is validated at ARGPARSE time against the ENGINES
+    registry with a difflib closest-match hint (the cli/__main__
+    unknown-tool pattern), and PYPULSAR_TPU_SWEEP_ENGINE gets the same
+    early validation — neither reaches resolve_engine mid-run."""
+    from pypulsar_tpu.cli import sweep as cli_sweep
+
+    with pytest.raises(SystemExit) as e:
+        cli_sweep.main(["x.fil", "--numdms", "4", "--engine", "fourrier"])
+    assert e.value.code == 2
+    err = capsys.readouterr().err
+    assert "did you mean 'fourier'?" in err
+    assert "tree" in err  # the registry listing includes the new engine
+
+    os.environ["PYPULSAR_TPU_SWEEP_ENGINE"] = "tre"
+    try:
+        with pytest.raises(SystemExit) as e:
+            cli_sweep.main(["x.fil", "--numdms", "4"])
+        assert e.value.code == 2
+        assert "did you mean 'tree'?" in capsys.readouterr().err
+        # an explicit (valid) --engine never consults the env knob, so
+        # the typo must NOT abort such a run at the parse stage: the run
+        # proceeds PAST argparse and the env check, and dies only when
+        # the (nonexistent) input is opened — anything but exit 2
+        with pytest.raises(Exception) as e:
+            cli_sweep.main(["x.fil", "--numdms", "4", "--engine",
+                            "gather"])
+        assert not isinstance(e.value, SystemExit)
+        assert "SWEEP_ENGINE" not in capsys.readouterr().err
+    finally:
+        del os.environ["PYPULSAR_TPU_SWEEP_ENGINE"]
+
+
+def test_dedisp_roofline_tool():
+    """tools/dedisp_roofline.py (round 16): the structural work
+    accounting behind the BENCHNOTES complexity claims — tree adds/cell
+    beat the two-stage direct engine at a dense grid and grow ~log2
+    with nchan at a fixed DM grid while naive grows ~nchan."""
+    import importlib.util
+    import os as _os
+
+    spec = importlib.util.spec_from_file_location(
+        "dedisp_roofline", _os.path.join(
+            _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+            "tools", "dedisp_roofline.py"))
+    roof = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(roof)
+
+    dm = roof.diagonal_dm(128, 64e-6, 1500.0, 300.0)
+    rec = roof.analyze(128, 256, 4096, dm, nsub=32, group_size=16)
+    a = rec["adds_per_cell"]
+    assert a["tree"] < a["direct_two_stage"] < a["naive"]
+    assert rec["tree"]["merge_levels"] == 7  # ceil(log2(128))
+    assert sum(rec["tree"]["rows_per_level"]) \
+        >= rec["tree"]["adds_per_sample_all_trials"]
+    s = roof.scaling_sweep([64, 128, 256], 256, 4096, dm, 32, 16,
+                           64e-6, 1500.0, 300.0)
+    g = s["growth"]
+    assert g["naive"] > 3.5  # ~nchan over a 4x range
+    assert g["tree"] < 2.0   # ~log2(nchan)
 
 
 def test_default_chunk_payload_bounds():

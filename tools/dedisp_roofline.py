@@ -1,0 +1,231 @@
+"""Per-engine work accounting for the dedispersion sweep (round 16 —
+sibling of tools/accel_roofline.py): adds/cell and bytes/cell for the
+direct (two-stage gather/scan), fourier and tree engines at a given
+(nchan, ndm, nsamp) geometry, so BENCHNOTES complexity claims are
+TOOL-DERIVED, not hand-waved.
+
+A "cell" is one (DM trial, output sample). The counts are STRUCTURAL —
+the direct/naive numbers fall out of the plan shapes, the tree numbers
+are the exact per-level merged-row counts of the host-built
+ops/tree_dedisperse.py tables for the actual trial grid (dedup included;
+no model), and the fourier numbers are flops (its work is transforms +
+complex multiplies, a different currency than adds — reported under its
+own key, never summed against the add counts).
+
+What the accounting shows (committed in BENCH_r11_tree.json / BENCHNOTES
+round 16):
+
+- naive per-channel shifts pay ``C - 1`` adds/cell — linear in nchan;
+- the two-stage direct engine pays ``(C - S)/g + (S - 1)`` adds/cell —
+  affine in nchan with slope 1/group_size (DDplan's economics);
+- the tree engine pays ``sum_l R_l / D`` adds/cell, bounded by
+  ``~max(span, nchan) * log2(nchan) / D``: with the dispersion span and
+  trial count held fixed it scales ~log2(nchan) (--scaling prints the
+  sweep), and at production DM counts it undercuts the two-stage engine
+  by the headline factor bench.py --dedisp-tree measures.
+
+Usage: python tools/dedisp_roofline.py [--nchan 1024] [--ndm 1024]
+           [--nsamp 16384] [--dm-max DIAG] [--nsub 64] [--group-size 32]
+           [--scaling 256,512,1024,2048] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pypulsar_tpu.core import psrmath  # noqa: E402
+from pypulsar_tpu.ops.fourier_dedisperse import fourier_chunk_len  # noqa: E402
+from pypulsar_tpu.ops.tree_dedisperse import plan_from_bins  # noqa: E402
+from pypulsar_tpu.parallel.sweep import make_sweep_plan  # noqa: E402
+
+
+def diagonal_dm(nchan: int, dt: float, f_hi: float, bw: float) -> float:
+    """The FDMT-regime diagonal: the DM whose full-band delay spans
+    ``nchan`` samples — where the tree's delay enumeration and the
+    channel count coincide (PAPERS.md 1201.5380 §2)."""
+    freqs_lo = f_hi - bw
+    unit = psrmath.delay_from_DM(1.0, freqs_lo) - psrmath.delay_from_DM(
+        1.0, f_hi)
+    return nchan * dt / unit
+
+
+def analyze(nchan: int, ndm: int, nsamp: int, dm_max: float,
+            nsub: int = 64, group_size: int = 32, dt: float = 64e-6,
+            f_hi: float = 1500.0, bw: float = 300.0) -> dict:
+    """Structural (adds, bytes) per cell for every engine at one
+    geometry. The tree numbers come from the ACTUAL merge tables."""
+    nsub = min(nsub, nchan)
+    group_size = min(group_size, ndm)
+    freqs = (f_hi - bw / nchan * np.arange(nchan)).astype(np.float64)
+    dms = np.linspace(0.0, dm_max, ndm)
+    plan = make_sweep_plan(dms, freqs, dt, nsub=nsub,
+                           group_size=group_size)
+    G, g, S = plan.stage2_bins.shape
+    C = nchan
+    D = plan.n_trials  # padded to the group multiple, like the engines
+
+    # direct two-stage (gather/scan): stage 1 sums `per` channels into
+    # each subband per group, stage 2 sums S subbands per trial
+    direct_adds = (G * (C - S) + D * (S - 1)) / D
+    naive_adds = C - 1
+    # f32 traffic, fused best case: stage 1 reads C rows + writes S per
+    # group; stage 2 reads S + writes 1 per trial — per sample
+    direct_bytes = 4.0 * (G * (C + S) + D * (S + 1)) / D
+
+    # fourier: transforms + complex multiplies (flops, not adds). One
+    # rfft per channel + one irfft per trial (~2.5 L log2 L real-FFT
+    # flops under accel_roofline's 5 L log2 L complex convention), plus
+    # the stage phase multiply-accumulates (8 flops per complex
+    # multiply+add) over the F-bin spectra
+    n_fft = fourier_chunk_len(nsamp + plan.min_overlap)
+    F = n_fft // 2 + 1
+    fft_flops = 2.5 * n_fft * math.log2(n_fft) * (C + D)
+    mult_flops = 8.0 * F * (G * C + D * S)
+    fourier_flops = (fft_flops + mult_flops) / (D * nsamp)
+    fourier_bytes = (4 * C * n_fft + 8 * F * (C + G * (C + S) + D * (S + 1))
+                     + 4 * D * n_fft) / (D * nsamp)
+
+    # tree: exact per-level merged-row counts for THIS trial grid
+    tplan = plan_from_bins(plan.stage1_bins, plan.stage2_bins)
+    tree_adds = tplan.adds_per_sample / D
+    total_rows = sum(tplan.rows_per_level)
+    # each row: two gathered-row reads + one write, f32
+    tree_bytes = 12.0 * total_rows / D
+
+    return dict(
+        nchan=C, ndm=ndm, n_trials_padded=D, nsamp=nsamp,
+        dm_max=round(float(dm_max), 4),
+        delay_span_bins=int(plan.max_total_shift),
+        nsub=nsub, group_size=g,
+        adds_per_cell=dict(
+            naive=round(naive_adds, 2),
+            direct_two_stage=round(direct_adds, 2),
+            tree=round(tree_adds, 2),
+        ),
+        bytes_per_cell=dict(
+            direct_two_stage=round(direct_bytes, 1),
+            fourier=round(fourier_bytes, 1),
+            tree=round(tree_bytes, 1),
+        ),
+        fourier_flops_per_cell=round(fourier_flops, 1),
+        tree=dict(
+            merge_levels=tplan.n_levels,
+            rows_max=tplan.rows,
+            rows_per_level=list(tplan.rows_per_level),
+            adds_per_sample_all_trials=tplan.adds_per_sample,
+        ),
+        work_ratio_direct_over_tree=round(direct_adds / max(tree_adds,
+                                                            1e-9), 2),
+    )
+
+
+def scaling_sweep(nchans, ndm, nsamp, dm_max, nsub, group_size, dt,
+                  f_hi, bw) -> dict:
+    """adds/cell vs nchan with the DM grid (and so the delay span) held
+    FIXED — the complexity-claim table: tree grows ~log2(nchan), naive
+    grows ~nchan, the two-stage engine grows affinely with slope 1/g."""
+    rows = []
+    for c in nchans:
+        r = analyze(c, ndm, nsamp, dm_max, nsub=nsub,
+                    group_size=group_size, dt=dt, f_hi=f_hi, bw=bw)
+        rows.append(dict(nchan=c, **r["adds_per_cell"],
+                         merge_levels=r["tree"]["merge_levels"]))
+    lo, hi = rows[0], rows[-1]
+    return dict(
+        table=rows,
+        nchan_range=[lo["nchan"], hi["nchan"]],
+        growth=dict(
+            naive=round(hi["naive"] / lo["naive"], 2),
+            direct_two_stage=round(hi["direct_two_stage"]
+                                   / lo["direct_two_stage"], 2),
+            tree=round(hi["tree"] / lo["tree"], 2),
+            log2_levels=round(hi["merge_levels"] / lo["merge_levels"], 2),
+        ),
+    )
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--nchan", type=int, default=1024)
+    ap.add_argument("--ndm", type=int, default=1024)
+    ap.add_argument("--nsamp", type=int, default=1 << 14)
+    ap.add_argument("--dm-max", type=float, default=None,
+                    help="highest trial DM (default: the FDMT-regime "
+                         "diagonal where the full-band delay spans nchan "
+                         "samples)")
+    ap.add_argument("--nsub", type=int, default=64)
+    ap.add_argument("--group-size", type=int, default=32)
+    ap.add_argument("--dt", type=float, default=64e-6)
+    ap.add_argument("--f-hi", type=float, default=1500.0)
+    ap.add_argument("--bw", type=float, default=300.0)
+    ap.add_argument("--scaling", default=None, metavar="C1,C2,...",
+                    help="also sweep adds/cell over these channel counts "
+                         "at the FIXED DM grid (the log2-vs-linear "
+                         "demonstration)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the analysis as one JSON line")
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    a = parse_args(argv)
+    dm_max = a.dm_max if a.dm_max is not None else diagonal_dm(
+        a.nchan, a.dt, a.f_hi, a.bw)
+    rec = analyze(a.nchan, a.ndm, a.nsamp, dm_max, nsub=a.nsub,
+                  group_size=a.group_size, dt=a.dt, f_hi=a.f_hi, bw=a.bw)
+    if a.scaling:
+        nchans = [int(x) for x in a.scaling.split(",")]
+        rec["scaling"] = scaling_sweep(nchans, a.ndm, a.nsamp, dm_max,
+                                       a.nsub, a.group_size, a.dt,
+                                       a.f_hi, a.bw)
+    if a.json:
+        print(json.dumps(rec))
+        return 0
+    ad = rec["adds_per_cell"]
+    print(f"# dedispersion work roofline @ nchan={rec['nchan']}, "
+          f"ndm={rec['ndm']} (padded {rec['n_trials_padded']}), "
+          f"nsamp={rec['nsamp']}, DM 0-{rec['dm_max']:g} "
+          f"(span {rec['delay_span_bins']} bins), nsub={rec['nsub']}, "
+          f"g={rec['group_size']}")
+    print(f"# adds/cell: naive {ad['naive']}  two-stage direct "
+          f"{ad['direct_two_stage']}  tree {ad['tree']}  -> direct/tree "
+          f"= {rec['work_ratio_direct_over_tree']}x")
+    print(f"# fourier: {rec['fourier_flops_per_cell']} flops/cell "
+          f"(transforms + complex multiplies — its own currency, not "
+          f"comparable to add counts)")
+    t = rec["tree"]
+    print(f"# tree: {t['merge_levels']} merge levels, rows/level "
+          f"{t['rows_per_level']} (max {t['rows_max']}), "
+          f"{t['adds_per_sample_all_trials']} adds/sample for ALL "
+          f"trials")
+    bt = rec["bytes_per_cell"]
+    print(f"# bytes/cell (fused best case): direct "
+          f"{bt['direct_two_stage']}  fourier {bt['fourier']}  tree "
+          f"{bt['tree']}")
+    if "scaling" in rec:
+        s = rec["scaling"]
+        print("# scaling at FIXED DM grid (adds/cell):")
+        print("#   nchan    naive   two-stage     tree   levels")
+        for r in s["table"]:
+            print(f"#   {r['nchan']:5d} {r['naive']:8.1f} "
+                  f"{r['direct_two_stage']:11.1f} {r['tree']:8.2f} "
+                  f"{r['merge_levels']:8d}")
+        g = s["growth"]
+        print(f"# growth over {s['nchan_range'][0]}->"
+              f"{s['nchan_range'][1]} chans: naive {g['naive']}x "
+              f"(~nchan), two-stage {g['direct_two_stage']}x, tree "
+              f"{g['tree']}x (~log2: levels grew {g['log2_levels']}x)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
