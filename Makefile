@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier test-faults test-fold test-survey test-corruption lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-multichip bench-specfuse bench-telemetry bench-tree native clean
+.PHONY: test test-fourier test-faults test-fold test-survey test-corruption test-tune lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-multichip bench-specfuse bench-telemetry bench-tree bench-tune native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -18,8 +18,10 @@ test: lint
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
 
 # the static-analysis gate (docs/ARCHITECTURE.md "Static analysis"):
-# psrlint's project-invariant rules PL001-PL009 (each locks in a bug
-# class PRs 1-8 fixed by hand; baseline empty by policy), then the
+# psrlint's project-invariant rules PL001-PL011 (each locks in a bug
+# class an earlier PR fixed by hand — PL011: raw PYPULSAR_TPU_* env
+# reads outside the tune/knobs.py registry; baseline empty by policy),
+# then the
 # third-party ruff pass (pyproject [tool.ruff], crash-bug classes
 # only) when the container ships ruff — the image this repo grows in
 # does not, so the ruff leg degrades to a loud skip, never a pass
@@ -62,6 +64,16 @@ test-chaos:
 test-corruption:
 	$(CPU_ENV) $(PY) -m pytest tests/test_dataguard.py -q
 	$(CPU_ENV) $(PY) -m pytest tests/test_dataguard.py -q -m slow -k fuzz
+
+# the auto-tuning suite (round 17): knob-registry precedence (env >
+# cache > default for every knob), cache durability (corrupt rebuild,
+# key-component re-search, concurrent writers), bounded deterministic
+# search, and the science-invariance gate (candidate/.pfd artifacts
+# byte-identical across tuned configs — docs/ARCHITECTURE.md
+# "Auto-tuning")
+test-tune:
+	$(CPU_ENV) $(PY) -m pytest tests/test_tune.py -q
+	$(CPU_ENV) $(PY) -m pytest tests/test_obs.py -q -k "autotuning"
 
 # the survey orchestrator suite: fleet-vs-serial byte parity, device
 # lease exclusivity / host overlap, kill+resume at every stage
@@ -142,6 +154,13 @@ bench-specfuse:
 bench-tree:
 	$(CPU_ENV) $(PY) -m pytest tests/test_sweep.py tests/test_accel_pipeline.py -q -k "tree"
 	$(CPU_ENV) $(PY) bench.py --dedisp-tree --out BENCH_r11_tree.json
+
+# auto-tuning (round 17): the tune suite, then the bounded-search A/B
+# at 2 geometries (trials <= budget, tuned >= hand-picked baseline,
+# second consult = zero trials via tune.cache_hit, candidate artifacts
+# byte-identical across tuned configs) -> BENCH_r12_tune.json
+bench-tune: test-tune
+	$(CPU_ENV) $(PY) bench.py --tune --out BENCH_r12_tune.json
 
 native:
 	$(PY) -c "from pypulsar_tpu import native; assert native.available(); print('native codec OK')"

@@ -676,11 +676,74 @@ class SwallowedFaultRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# PL011 — raw PYPULSAR_TPU_* env read outside the knob registry
+
+class RawKnobReadRule(Rule):
+    """Round 17 made ``tune/knobs.py`` the single read path for every
+    ``PYPULSAR_TPU_*`` tunable (``trial > env > tuned cache > default``
+    precedence). A raw ``os.environ.get``/``getenv``/``environ[...]``
+    read anywhere else silently bypasses the auto-tuning cache AND the
+    typo-tolerance contract — the knob looks tunable but the tuner can
+    never move it. Route through ``knobs.env_int/env_float/env_str``.
+
+    Flags the constant-indirection idiom too (``os.environ.get(ENV_X)``
+    with an ``ENV_``-named constant). Env *writes* (``os.environ[k] =
+    v`` in bench/tests arming subprocess knobs) are fine — only Load
+    context is a read. Suppressions are reserved for bootstrap probes
+    where the registry genuinely cannot be imported."""
+
+    code = "PL011"
+    name = "raw-knob-read"
+    summary = "raw PYPULSAR_TPU_* env read outside tune/knobs.py"
+
+    _EXEMPT = "pypulsar_tpu/tune/knobs.py"
+    _ENV_CALLS = ("os.environ.get", "environ.get", "os.getenv", "getenv")
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        if ctx.relpath == self._EXEMPT or _is_test(ctx):
+            return False
+        return (_in_package(ctx) or ctx.relpath.startswith("tools/")
+                or ctx.relpath == "bench.py")
+
+    def _knob_name(self, node) -> Optional[str]:
+        s = _const_str(node)
+        if s is not None:
+            return s if s.startswith("PYPULSAR_TPU_") else None
+        if isinstance(node, ast.Name) and node.id.startswith("ENV_"):
+            return node.id
+        return None
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ctx.walk():
+            if (isinstance(node, ast.Call)
+                    and _call_name(node) in self._ENV_CALLS
+                    and node.args):
+                name = self._knob_name(node.args[0])
+                if name:
+                    yield self.finding(
+                        ctx, node,
+                        f"raw env read of {name} bypasses the knob "
+                        f"registry (env > tuned cache > default); use "
+                        f"tune.knobs.env_int/env_float/env_str")
+            elif (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)
+                    and _attr_chain(node.value) in ("os.environ",
+                                                    "environ")):
+                name = self._knob_name(node.slice)
+                if name:
+                    yield self.finding(
+                        ctx, node,
+                        f"raw os.environ[{name!r}] read bypasses the "
+                        f"knob registry; use tune.knobs accessors")
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES: Tuple[type, ...] = (
     TruedivIndexRule, BareJaxDevicesRule, NonAtomicWriteRule,
     KnobRegistryDriftRule, DeadFaultPointRule, RawHeaderReadRule,
     MutableDefaultRule, SpanLeakRule, SwallowedFaultRule,
+    RawKnobReadRule,
 )
 
 

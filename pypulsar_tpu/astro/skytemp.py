@@ -31,6 +31,7 @@ from typing import Optional
 import numpy as np
 
 from pypulsar_tpu.astro import healpix
+from pypulsar_tpu.tune import knobs
 
 HASLAM_FREQ = 408.0  # MHz
 SYNCHROTRON_INDEX = -2.7
@@ -39,7 +40,7 @@ DEGTORAD = np.pi / 180.0
 def _default_paths():
     # env var read at call time, not import time
     return (
-        os.environ.get("PYPULSAR_TPU_HASLAM", ""),
+        knobs.env_str("PYPULSAR_TPU_HASLAM") or "",
         os.path.join(os.path.dirname(os.path.dirname(__file__)), "lib",
                      "lambda_haslam408_dsds.fits"),
     )

@@ -13,7 +13,7 @@ TOOLS = [
     "autozap", "plot_accelcands", "combinefil", "stitchdat",
     "mockspecfil2subbands", "demodulate", "pfd_snr", "pfdinfo",
     "gridding", "fitkepler", "shapiro", "pbdot", "massfunc",
-    "pyppdot", "pyplotres", "coordconv", "tlmsum", "psrlint",
+    "pyppdot", "pyplotres", "coordconv", "tlmsum", "psrlint", "tune",
 ]
 
 

@@ -26,6 +26,7 @@ from pypulsar_tpu.fourier.accelsearch import AccelSearchConfig, accel_search
 from pypulsar_tpu.fourier.kernels import deredden, deredden_schedule
 from pypulsar_tpu.io.infodata import InfoData
 from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.tune import knobs
 
 # sentinel: "this input must take the host prep path" — distinct from None
 # ("skipped") so the batch dispatch below cannot confuse the two (the old
@@ -85,14 +86,18 @@ def build_parser():
     p.add_argument("--skip-existing", action="store_true",
                    help="skip inputs whose candidate file already exists "
                         "(restartable batch runs)")
-    p.add_argument("-b", "--batch", type=int, default=1,
+    p.add_argument("-b", "--batch", type=_batch_arg, default=1,
                    help="search this many same-length spectra per device "
                         "dispatch against the shared template banks "
                         "(fourier.accelsearch.accel_search_batch; measured "
                         "6x the serial rate at batch 32 on a v5e — the "
                         "per-DM spectra of one observation all qualify). "
                         "Inputs whose (bins, T) differ flush the pending "
-                        "group and start a new one. Default 1 = serial")
+                        "group and start a new one. 'auto' takes the "
+                        "tuned default from the PYPULSAR_TPU_ACCEL_BATCH "
+                        "knob (auto-tuning cache > registry default 32; "
+                        "an explicit number here always wins). "
+                        "Default 1 = serial")
     p.add_argument("-z", "--zmax", type=float, default=200.0,
                    help="max drift in Fourier bins over the observation "
                         "(default 200)")
@@ -268,11 +273,48 @@ def search_one(infile, cfg, args):
         return write_results(infile, cands, T, args)
 
 
+def _batch_arg(value: str):
+    """--batch value: an int, or 'auto' for the tuned registry default
+    (resolved AFTER the tuning-cache consult in main, so a cached
+    winner for this geometry takes effect)."""
+    if value == "auto":
+        return "auto"
+    try:
+        return int(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            "--batch expects an integer or 'auto', got %r" % (value,))
+
+
+def _apply_tuning(args) -> None:
+    """Round-17 auto-tuning consult: install the cached throughput
+    config for this stage geometry (tune/cache.py key: nsamp bucket,
+    zmax, backend, jax version), then resolve --batch 'auto' through
+    the registry so a cached winner takes effect. Env vars and explicit
+    flags still win; PYPULSAR_TPU_TUNE=off disables the consult."""
+    from pypulsar_tpu import tune
+
+    nsamp = None
+    try:
+        sz = os.path.getsize(args.infiles[0])
+        # .dat: f32 samples; .fft: N/2+1 complex64 bins of an N-sample
+        # series (prestofft layout) -> N = (bins - 1) * 2, so the key
+        # buckets to the same power of two as the equivalent .dat
+        nsamp = (sz // 4 if not args.infiles[0].endswith(".fft")
+                 else max(1, sz // 8 - 1) * 2)
+    except OSError:
+        pass  # missing input fails later with the real reader error
+    tune.apply_cached("accel", nsamp=nsamp, zmax=int(args.zmax))
+    if args.batch == "auto":
+        args.batch = max(1, knobs.env_int("PYPULSAR_TPU_ACCEL_BATCH"))
+
+
 def main(argv=None):
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.outbase and len(args.infiles) > 1:
         parser.error("-o/--outbase only applies to a single input file")
+    _apply_tuning(args)
     if args.device_prep and args.batch < 2:
         # silently ignoring the flag hid a 2-3x perf knob (ADVICE r5):
         # device prep only exists on the grouped batch dispatch
@@ -335,8 +377,8 @@ def _run(args, cfg):
                     # ~24 bytes/sample per spectrum, and the whole
                     # prepped slice lives in HBM until its search ends
                     n1 = len(group[0][1])
-                    budget = int(float(
-                        os.environ.get("PYPULSAR_TPU_ACCEL_HBM", 5e9)))
+                    budget = int(
+                        knobs.env_float("PYPULSAR_TPU_ACCEL_HBM"))
                     cap = max(1, budget // (24 * n1))
                     all_cands = []
                     for c0 in range(0, len(group), cap):

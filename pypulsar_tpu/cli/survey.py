@@ -141,7 +141,10 @@ def build_parser():
     g.add_argument("--accel-numharm", type=int, default=8,
                    choices=(1, 2, 4, 8))
     g.add_argument("--accel-sigma", type=float, default=2.0)
-    g.add_argument("--accel-batch", type=int, default=32)
+    g.add_argument("--accel-batch", type=int, default=None,
+                   help="spectra per accel dispatch (default: the tuned "
+                        "PYPULSAR_TPU_ACCEL_BATCH knob — env > "
+                        "auto-tuning cache > 32; explicit value wins)")
     g.add_argument("--spectral", action="store_true",
                    help="spectral fusion (round 15): the sweep stage "
                         "serves accel-search from device-resident fused "

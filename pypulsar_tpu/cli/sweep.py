@@ -23,6 +23,8 @@ import os
 
 import numpy as np
 
+from pypulsar_tpu.tune import knobs
+
 
 def _open_reader(fn: str):
     from pypulsar_tpu.io import filterbank, psrfits
@@ -56,12 +58,37 @@ def _check_engine_env(ap) -> None:
     """Early validation of PYPULSAR_TPU_SWEEP_ENGINE (consulted only
     when --engine is 'auto'): same parse-time error + hint as the flag,
     instead of the mid-run resolve_engine failure."""
-    env = os.environ.get("PYPULSAR_TPU_SWEEP_ENGINE")
+    env = knobs.env_str("PYPULSAR_TPU_SWEEP_ENGINE")
     if env and env != "auto":
         try:
             _engine_arg(env)
         except argparse.ArgumentTypeError as e:
             ap.error("PYPULSAR_TPU_SWEEP_ENGINE: %s" % e)
+
+
+def _apply_tuning(args, reader) -> None:
+    """Round-17 auto-tuning consult for the flat single-file path:
+    install the cached throughput config for this run's ACTUAL geometry
+    (tune/cache.py keys: nchan, nsamp bucket, dtype, engine, backend,
+    jax version) before any chunk geometry is resolved. Env vars and
+    explicit flags still win; PYPULSAR_TPU_TUNE=off disables."""
+    from pypulsar_tpu import tune
+    from pypulsar_tpu.parallel.sweep import resolve_engine
+
+    try:
+        nchan = len(np.asarray(reader.frequencies))
+        nsamp = int(getattr(reader, "nsamples", 0) or 0) or None
+        dtype = "nbits%d" % int(getattr(reader, "nbits", 32) or 32)
+        engine = resolve_engine(args.engine)
+    except Exception:  # noqa: BLE001 - tuning is a passenger, never the payload
+        return
+    tune.apply_cached("sweep", nchan=nchan, nsamp=nsamp, dtype=dtype,
+                      engine=engine)
+    if args.accel_search:
+        ds = max(1, int(args.downsamp))
+        tune.apply_cached("accel",
+                          nsamp=(nsamp // ds if nsamp else None),
+                          zmax=int(args.accel_zmax))
 
 
 def _write_cands(path, cands, extra_cols=()):
@@ -102,7 +129,7 @@ def _write_dats_auto(outbase, reader, dms, args, rfimask=None):
 
     T = _make_source(reader).nsamples
     C = len(_np.asarray(reader.frequencies))
-    limit = float(os.environ.get("PYPULSAR_TPU_DATS_RESIDENT_LIMIT", 2e9))
+    limit = float(knobs.env_float("PYPULSAR_TPU_DATS_RESIDENT_LIMIT"))
     if 4.0 * C * T <= limit:
         _write_dats(outbase, reader, dms, args.downsamp, rfimask=rfimask)
     else:
@@ -567,9 +594,12 @@ def main(argv=None):
     ap.add_argument("--accel-sigma", type=float, default=2.0,
                     help="accel handoff: candidate significance floor "
                          "(default 2)")
-    ap.add_argument("--accel-batch", type=int, default=32,
+    ap.add_argument("--accel-batch", type=int, default=None,
                     help="accel handoff: spectra per device dispatch "
-                         "against the shared template banks (default 32)")
+                         "against the shared template banks (default: "
+                         "the tuned PYPULSAR_TPU_ACCEL_BATCH knob — "
+                         "env var > auto-tuning cache > 32; an explicit "
+                         "value here always wins)")
     ap.add_argument("--accel-max-cands", type=int, default=200,
                     help="accel handoff: cap on written candidates per "
                          "trial (default 200)")
@@ -717,6 +747,7 @@ def _main_parsed(args, ap):
         _remove_stale_checkpoints(args.checkpoint)
     reader = _open_reader(args.infile)
     rfimask = _load_mask(args)
+    _apply_tuning(args, reader)
     mesh = None
     if args.mesh:
         # build the mesh from the LEASED device set, never bare

@@ -44,7 +44,6 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
-import os
 from functools import partial
 from typing import Dict, List, Optional, Tuple
 
@@ -57,6 +56,7 @@ from pypulsar_tpu.fourier.zresponse import template_bank_zw
 from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.ops.fourier_dedisperse import fourier_chunk_len
 from pypulsar_tpu.ops.transfer import join_planes, pull_host, split_complex
+from pypulsar_tpu.tune import knobs
 
 __all__ = [
     "AccelSearchConfig",
@@ -871,8 +871,8 @@ def accel_search_batch(
     Z, Wn = len(zs), len(ws)
 
     if hbm_budget_bytes is None:
-        hbm_budget_bytes = int(float(
-            os.environ.get("PYPULSAR_TPU_ACCEL_HBM", 5e9)))
+        hbm_budget_bytes = int(
+            knobs.env_float("PYPULSAR_TPU_ACCEL_HBM"))
 
     # the padded spectra themselves stay device-resident across stages
     # (~8*Np bytes each); a batch large enough to blow half the budget on

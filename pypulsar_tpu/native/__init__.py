@@ -27,6 +27,7 @@ import warnings
 from typing import Optional, Sequence
 
 import numpy as np
+from pypulsar_tpu.tune import knobs
 
 _SRC = os.path.join(os.path.dirname(__file__), "codec.cpp")
 _SRC_PREFETCH = os.path.join(os.path.dirname(__file__), "prefetch.cpp")
@@ -64,7 +65,7 @@ def _load() -> Optional[ctypes.CDLL]:
     if _tried:
         return _lib
     _tried = True
-    if os.environ.get("PYPULSAR_TPU_NO_NATIVE"):
+    if knobs.env_str("PYPULSAR_TPU_NO_NATIVE"):
         return None
     stale = not os.path.isfile(_LIB) or any(
         os.path.isfile(s) and os.path.getmtime(s) > os.path.getmtime(_LIB)

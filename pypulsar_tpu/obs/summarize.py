@@ -74,6 +74,9 @@ class TraceSummary:
         # with a `dev` attribute (the gang-lease / mesh paths) — the
         # per-chip utilization view scaling records need
         self.device_busy: Dict[int, List] = {}
+        # stage -> last tune.winner event attrs (config, trials,
+        # baseline/best seconds) — the auto-tuning roll-up's payload
+        self.tune_winners: Dict[str, dict] = {}
         self._span_stages: Dict[str, List] = {}
         self._t_max = 0.0
 
@@ -113,6 +116,15 @@ class TraceSummary:
             self.n_events += 1
             name = rec.get("name", "?")
             self.events[name] = self.events.get(name, 0) + 1
+            if name in ("tune.winner", "tune.applied"):
+                # keep the winning config per stage (last wins — a
+                # re-search supersedes); `applied` records cache-served
+                # configs so a pure-hit run still renders its winners
+                attrs = rec.get("attrs") or {}
+                stage = attrs.get("stage")
+                if stage and (name == "tune.winner"
+                              or stage not in self.tune_winners):
+                    self.tune_winners[str(stage)] = attrs
             self._t_max = max(self._t_max, float(rec.get("t", 0.0)))
         elif t == "counters":
             self.counters.update(rec.get("counters", {}))
@@ -172,6 +184,7 @@ def combine_summaries(summaries: List[TraceSummary]) -> TraceSummary:
             ent = out.gauges.setdefault(k, dict(g))
             ent["last"] = g.get("last", 0)
             ent["max"] = max(ent.get("max", 0), g.get("max", 0))
+        out.tune_winners.update(s.tune_winners)
         if s.last_device is not None:
             out.last_device = s.last_device
     out.wall = wall
@@ -316,6 +329,34 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
                        f"{_fmt_bytes(n_state)}")
     if tr_bits:
         p("#\n# tree dedispersion: " + "  ".join(tr_bits))
+    # auto-tuning roll-up (round 17): what the bounded search cost and
+    # what the geometry-keyed cache saved — trials run, hit/miss
+    # counts, and the winning config per stage (tune.winner/applied
+    # event attrs)
+    tn_bits = []
+    for key, label in (("tune.trials", "trials"),
+                       ("tune.cache_hit", "cache hits"),
+                       ("tune.cache_miss", "cache misses")):
+        v = s.counters.get(key)
+        if v:
+            tn_bits.append(f"{label}={_fmt_count(v)}")
+    n_corrupt = s.events.get("tune.cache_corrupt")
+    if n_corrupt:
+        tn_bits.append(f"corrupt cache rebuilds={n_corrupt}")
+    if tn_bits or s.tune_winners:
+        p("#\n# auto-tuning: " + "  ".join(tn_bits or ["(cache only)"]))
+        for stage in sorted(s.tune_winners):
+            w = s.tune_winners[stage]
+            cfg = w.get("config") or {}
+            cfg_s = "  ".join(
+                f"{k.replace('PYPULSAR_TPU_', '')}={v}"
+                for k, v in sorted(cfg.items())) or "(defaults won)"
+            extra = ""
+            if w.get("baseline_s") and w.get("best_s"):
+                extra = (f"  [{w['baseline_s']:.4f}s -> "
+                         f"{w['best_s']:.4f}s, "
+                         f"{w.get('n_trials', 0)} trials]")
+            p(f"#   {stage:<10s} {cfg_s}{extra}")
     # data-quality roll-up: what the dataguard scrub and the finite
     # gates did to this run's bytes (round 13)
     data_bits = []

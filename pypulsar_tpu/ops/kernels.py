@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pypulsar_tpu.core.psrmath import DM_CONST_INV
+from pypulsar_tpu.tune import knobs
 
 
 def delay_from_DM(dm, freqs):
@@ -95,7 +96,7 @@ def _resolve_shift_backend(padval, dtype) -> str:
     first-compiled executable."""
     import os
 
-    return os.environ.get("PYPULSAR_TPU_SHIFT_BACKEND") or (
+    return knobs.env_str("PYPULSAR_TPU_SHIFT_BACKEND") or (
         "fourier" if padval != "rotate"
         and jnp.issubdtype(dtype, jnp.floating)
         and jax.default_backend() == "tpu" else "gather")

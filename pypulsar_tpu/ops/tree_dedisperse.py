@@ -76,7 +76,6 @@ inherit).
 from __future__ import annotations
 
 import hashlib
-import os
 from collections import OrderedDict
 from functools import lru_cache, partial
 from typing import Tuple
@@ -87,6 +86,7 @@ import numpy as np
 
 from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.ops.pallas_kernels import boxcar_stats
+from pypulsar_tpu.tune import knobs
 
 __all__ = [
     "TreePlan",
@@ -257,11 +257,8 @@ _PLAN_CACHE: "OrderedDict[bytes, TreePlan]" = OrderedDict()
 
 
 def _plan_cache_size() -> int:
-    try:
-        return max(1, int(os.environ.get("PYPULSAR_TPU_TREE_PLAN_CACHE",
-                                         "8")))
-    except ValueError:  # a bad knob must never abort a run
-        return 8
+    # registry read is typo-tolerant (bad value -> declared default 8)
+    return max(1, int(knobs.env_int("PYPULSAR_TPU_TREE_PLAN_CACHE")))
 
 
 def _digest(s1: np.ndarray, s2: np.ndarray) -> bytes:

@@ -46,6 +46,7 @@ import numpy as np
 
 from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.resilience import faultinject, health
+from pypulsar_tpu.tune import knobs
 from pypulsar_tpu.resilience.journal import RunJournal, candfile_complete
 from pypulsar_tpu.resilience.retry import halving_dispatch
 
@@ -201,7 +202,7 @@ def sweep_accel_stream(
     dms,
     config,
     outbase: str,
-    batch: int = 32,
+    batch: Optional[int] = None,
     downsamp: int = 1,
     nsub: int = 64,
     group_size: int = 32,
@@ -279,6 +280,11 @@ def sweep_accel_stream(
     if spectral and not device_prep:
         raise ValueError("spectral fusion IS device prep: host prep "
                          "(device_prep=False) contradicts spectral=True")
+    if batch is None:
+        # the tuned-default path (round 17): the old hand-pinned 32
+        # now lives in the knob registry, where the geometry-keyed
+        # tuning cache can move it; an explicit batch= / CLI flag wins
+        batch = max(1, knobs.env_int("PYPULSAR_TPU_ACCEL_BATCH"))
     dms = np.asarray(dms, dtype=np.float64)
     ndm = 1 if mesh is None else int(mesh.shape["dm"])
     mesh_devs = (tuple(mesh.devices.flat) if mesh is not None else None)
@@ -347,13 +353,11 @@ def sweep_accel_stream(
         # so the slice budget is HBM, not host RAM
         from pypulsar_tpu.parallel.specfuse import spectral_trial_bytes
 
-        budget = int(float(os.environ.get("PYPULSAR_TPU_SPECFUSE_HBM",
-                                          8e9)))
+        budget = int(knobs.env_float("PYPULSAR_TPU_SPECFUSE_HBM"))
         slice_dms = max(batch,
                         int(budget // max(spectral_trial_bytes(T), 1)))
     else:
-        budget = int(float(os.environ.get("PYPULSAR_TPU_ACCEL_STREAM_RAM",
-                                          12e9)))
+        budget = int(knobs.env_float("PYPULSAR_TPU_ACCEL_STREAM_RAM"))
         slice_dms = max(batch, int(budget // (4 * max(T, 1))))
     # slices MUST align to stage-1 group boundaries: make_sweep_plan
     # regroups each slice's consecutive DMs from its own start, and a
@@ -376,7 +380,7 @@ def sweep_accel_stream(
     # DEVICE: a DM-sharded batch splits across the mesh, so k chips
     # admit k x the spectra per dispatch (the per-shard slice of each
     # chip stays inside its own HBM share)
-    hbm = int(float(os.environ.get("PYPULSAR_TPU_ACCEL_HBM", 5e9)))
+    hbm = int(knobs.env_float("PYPULSAR_TPU_ACCEL_HBM"))
     inflight = prefetch_depth + 2 if prefetch_depth > 0 else 1
     # spectral: prep already happened (the slice's resident planes), so
     # a batch holds only its gathered rows — no per-batch prep cap

@@ -29,6 +29,7 @@ import os
 from typing import List, Optional, Sequence
 
 import numpy as np
+from pypulsar_tpu.tune import knobs
 
 __all__ = [
     "initialize",
@@ -64,13 +65,13 @@ def initialize(
     global _initialized
     if _initialized:
         return True
-    coordinator_address = coordinator_address or os.environ.get(ENV_COORD)
+    coordinator_address = coordinator_address or knobs.env_str(ENV_COORD)
     if not coordinator_address:
         return False
     if num_processes is None:
-        num_processes = int(os.environ.get(ENV_NPROC, "1"))
+        num_processes = int(knobs.env_int(ENV_NPROC))
     if process_id is None:
-        process_id = int(os.environ.get(ENV_PID, "0"))
+        process_id = int(knobs.env_int(ENV_PID))
     if num_processes <= 1:
         return False
     import jax

@@ -51,6 +51,7 @@ from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.resilience import faultinject, health
 from pypulsar_tpu.resilience.journal import RunJournal
 from pypulsar_tpu.resilience.retry import halving_dispatch
+from pypulsar_tpu.tune import knobs
 
 __all__ = [
     "FoldCandidate",
@@ -281,7 +282,7 @@ def iter_groups_stream(groups, reader, downsamp: int = 1, nsub: int = 64,
         telescope=str(getattr(reader, "telescope", "unknown") or "unknown"),
         filenm=os.path.basename(str(getattr(reader, "filename", "stream"))),
     )
-    budget = int(float(os.environ.get(ENV_STREAM_RAM, 12e9)))
+    budget = int(knobs.env_float(ENV_STREAM_RAM))
     slice_dms = max(1, int(budget // (4 * max(T, 1))))
     slice_dms = max(group_size, (slice_dms // group_size) * group_size)
     if slice_dms < len(dms) and verbose:
@@ -413,6 +414,17 @@ def fold_pipeline(
     )
     from pypulsar_tpu.io.prestopfd import make_pfd
 
+    # round-17 auto-tuning consult: install this geometry's cached
+    # throughput config (fold stream/binidx budgets) before the DM
+    # groups are sliced; env vars and explicit args still win
+    from pypulsar_tpu import tune
+
+    tune.apply_cached(
+        "fold",
+        nsamp=int(getattr(reader, "nsamples", 0) or 0) or None,
+        nchan=(len(np.asarray(reader.frequencies))
+               if reader is not None else None))
+
     cands = _named(cands)
     names = [pfd_out_name(outbase, c) for c in cands]
     units = [f"fold:{c.name}" for c in cands]
@@ -491,7 +503,7 @@ def fold_pipeline(
     # the PYPULSAR_TPU_FOLD_BINIDX_RAM budget (default 4 GB) once the
     # series length is known. halving_dispatch shrinks only the DEVICE
     # axis — the host buffer must be bounded before prep ever allocates.
-    binidx_budget = int(float(os.environ.get(ENV_BINIDX_RAM, 4e9)))
+    binidx_budget = int(knobs.env_float(ENV_BINIDX_RAM))
     T_est = None
     if source == "stream" and reader is not None:
         from pypulsar_tpu.parallel.staged import _ReaderSource

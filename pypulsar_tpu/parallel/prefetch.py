@@ -42,7 +42,6 @@ identical either way — threading only moves WHEN work happens.
 
 from __future__ import annotations
 
-import os
 import queue
 import threading
 import time
@@ -50,6 +49,7 @@ from typing import Callable, Iterable, Optional, Tuple
 
 from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.resilience.retry import RETRY_BACKOFF_MAX_S  # noqa: F401
+from pypulsar_tpu.tune import knobs
 
 __all__ = ["prefetch"]
 
@@ -63,7 +63,7 @@ CLEANUP_DEADLINE_S = 5.0
 
 def _resolve_timeout(timeout: Optional[float]) -> Optional[float]:
     if timeout is None:
-        timeout = float(os.environ.get(ENV_TIMEOUT, DEFAULT_TIMEOUT_S))
+        timeout = float(knobs.env_float(ENV_TIMEOUT))
     return None if timeout <= 0 else timeout
 
 
@@ -100,7 +100,7 @@ def prefetch(items: Iterable, depth: int = 2, name: str = "prefetch",
     xf = transform if transform is not None else (lambda it: it)
     gauge_name = f"{name}.pending_depth"
 
-    if os.environ.get("PYPULSAR_TPU_SHIP_AHEAD", "1") == "0":
+    if knobs.env_str("PYPULSAR_TPU_SHIP_AHEAD") == "0":
         for item in items:
             yield _produce(xf, item, name, retries, retry_backoff,
                            retry_on)

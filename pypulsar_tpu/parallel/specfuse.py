@@ -57,7 +57,6 @@ prefetch overlap (batch gathers slice the resident planes).
 
 from __future__ import annotations
 
-import os
 from typing import Optional
 
 import jax
@@ -66,6 +65,7 @@ import numpy as np
 
 from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.tune import knobs
 
 __all__ = ["fused_spectra_slice", "spectral_trial_bytes"]
 
@@ -157,6 +157,12 @@ def fused_spectra_slice(
     factor = max(1, int(downsamp))
     dms = np.asarray(dms, dtype=np.float64)
     probe = _ReaderSource(reader)
+    # round-17 auto-tuning consult at the fused slice's own geometry
+    # (the SPECFUSE_HBM slice budget is this stage's knob); env wins
+    from pypulsar_tpu import tune
+
+    tune.apply_cached("specfuse", nchan=len(probe.frequencies),
+                      nsamp=int(probe.nsamples) // factor)
     plan, payload, T = dats_geometry(reader, dms, downsamp=factor,
                                      nsub=nsub, group_size=group_size,
                                      chunk_payload=chunk_payload)
@@ -181,8 +187,7 @@ def fused_spectra_slice(
     n_chunks = -(-T // payload)
     # decimate is OPT-IN (circular boundary semantics — module
     # docstring) and additionally geometry-gated; anything else stitches
-    decimated = (os.environ.get("PYPULSAR_TPU_SPECFUSE_MODE",
-                                "stitch") == "decimate"
+    decimated = (knobs.env_str("PYPULSAR_TPU_SPECFUSE_MODE") == "decimate"
                  and engine_r == "fourier" and n_chunks == 1
                  and T > 1 and n_fft % T == 0)
     if verbose:

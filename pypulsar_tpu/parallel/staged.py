@@ -28,6 +28,7 @@ import numpy as np
 
 from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.ops import kernels
+from pypulsar_tpu.tune import knobs
 from pypulsar_tpu.parallel.sweep import (
     DEFAULT_WIDTHS,
     SweepCheckpoint,
@@ -434,7 +435,7 @@ def _host_downsample_wins(src, factor: int) -> bool:
         return False
     if nbits > 8 and factor > 256:
         return False  # uint32 sums past f32's 2^24 integer exactness
-    env = os.environ.get("PYPULSAR_TPU_HOST_DOWNSAMP")
+    env = knobs.env_str("PYPULSAR_TPU_HOST_DOWNSAMP")
     if env is not None:
         return env != "0"
     acc_bytes = _host_ds_acc_dtype(nbits, factor)().itemsize
@@ -522,11 +523,15 @@ def _run_step(src, dms, factor: int, nsub: int, group_size: int,
     # default payload is BOUNDED (round 5): the previous whole-file
     # default made a --chunk-less CLI sweep of an hour-scale file try to
     # build one 2^26-sample chunk (a ~275 GB device buffer) — small data
-    # still runs single-chunk via the min()
+    # still runs single-chunk via the min(). tuned=False: the DETECTION
+    # sweep's chunk is part of its results (per-chunk stats, one event
+    # per chunk), so the auto-tuner's overlay must not reach it — only
+    # env/--chunk (explicit, fingerprinted operator choices) move it
     if chunk_payload is None:
         from pypulsar_tpu.parallel.sweep import default_chunk_payload
 
-        chunk_payload = default_chunk_payload(plan.min_overlap)
+        chunk_payload = default_chunk_payload(plan.min_overlap,
+                                              tuned=False)
     payload = min(chunk_payload, n_ds)
     if payload <= plan.min_overlap:
         payload = min(n_ds, 2 * plan.min_overlap + 1)
@@ -709,9 +714,13 @@ def _source_probe(src) -> bytes:
 
 
 def _default_fft_len() -> int:
-    from pypulsar_tpu.parallel.sweep import DEFAULT_CHUNK_FFT_LEN
+    # the DETECTION sweep's effective default (env > 2^18, overlays
+    # excluded — see chunk_fft_len): re-setting the env knob must
+    # invalidate default-using checkpoint markers, while auto-tuning
+    # (which never reaches the detector) must not
+    from pypulsar_tpu.parallel.sweep import chunk_fft_len
 
-    return DEFAULT_CHUNK_FFT_LEN
+    return chunk_fft_len(tuned=False)
 
 
 def _step_fingerprint(src, dms, factor, nsub, group_size, widths,

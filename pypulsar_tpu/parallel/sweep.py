@@ -49,6 +49,7 @@ from pypulsar_tpu.core import psrmath
 from pypulsar_tpu.ops import transfer
 from pypulsar_tpu.ops.pallas_kernels import boxcar_stats
 from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.tune import knobs
 from pypulsar_tpu.utils import profiling
 
 DEFAULT_WIDTHS = (1, 2, 4, 8, 16, 32)
@@ -91,7 +92,7 @@ def resolve_engine(engine: str = "auto") -> str:
             raise ValueError(f"unknown sweep engine {engine!r}; "
                              f"expected one of {ENGINES + ('auto',)}")
         return engine
-    env = os.environ.get("PYPULSAR_TPU_SWEEP_ENGINE")
+    env = knobs.env_str("PYPULSAR_TPU_SWEEP_ENGINE")
     if env and env != "auto":  # "auto" in the env var falls through
         return resolve_engine(env)
     try:
@@ -270,14 +271,37 @@ DEFAULT_CHUNK_FFT_LEN = 1 << 18
 # (1024 chans, 1024 trials) the fourier chunk measures 0.67 G
 # trial-samples/s at n=2^17, 0.95 G at 2^18 (+41%), 0.87 G at 2^19 —
 # the FFT amortizes and the overlap fraction shrinks up to 2^18, then
-# working-set growth wins. 2^18 is the default everywhere a chunk
-# length is not explicitly given.
+# working-set growth wins. 2^18 is the registry default for the
+# PYPULSAR_TPU_SWEEP_CHUNK knob (round 17): anywhere a chunk length is
+# not explicitly given, :func:`chunk_fft_len` resolves env > tuned
+# cache > this constant.
 
 
-def default_chunk_payload(min_overlap: int) -> int:
-    """Default streaming chunk payload: DEFAULT_CHUNK_FFT_LEN grown (by
+def chunk_fft_len(tuned: bool = True) -> int:
+    """The streaming chunk length: the ``PYPULSAR_TPU_SWEEP_CHUNK``
+    knob rounded up to a power of two (the FFT/doubling machinery in
+    :func:`default_chunk_payload` and the checkpoint fingerprints both
+    assume pow2), floored at 2^12 so a typo cannot degenerate the
+    stream to sample-sized dispatches.
+
+    ``tuned=False`` resolves env > default only, skipping the
+    auto-tuning overlays: the single-pulse DETECTION sweep's chunk is
+    part of its results (per-chunk statistics, one event per chunk —
+    the documented streaming semantics ``--chunk`` fingerprints), so
+    the tuner may move the chunk for the byte-invariant series/handoff
+    paths but never for the detector. An env var or ``--chunk`` remains
+    an explicit operator choice either way."""
+    n = int(knobs.env_int("PYPULSAR_TPU_SWEEP_CHUNK", overlays=tuned))
+    n = max(1 << 12, n)
+    if n & (n - 1):
+        n = 1 << n.bit_length()
+    return n
+
+
+def default_chunk_payload(min_overlap: int, tuned: bool = True) -> int:
+    """Default streaming chunk payload: :func:`chunk_fft_len` grown (by
     doubling) until the dedispersion overlap fits in half the FFT."""
-    n = DEFAULT_CHUNK_FFT_LEN
+    n = chunk_fft_len(tuned)
     while min_overlap >= n // 2:
         n <<= 1
     return n - min_overlap

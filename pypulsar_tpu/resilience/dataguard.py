@@ -55,6 +55,7 @@ import numpy as np
 from pypulsar_tpu.io.errors import DataFormatError
 from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.tune import knobs
 
 __all__ = [
     "CORRUPT_KINDS",
@@ -79,15 +80,12 @@ DEFAULT_MAX_BAD_FRAC = 0.5
 
 
 def guard_enabled() -> bool:
-    return os.environ.get(ENV_GUARD, "1") != "0"
+    return knobs.env_str(ENV_GUARD) != "0"
 
 
 def max_bad_frac_default() -> float:
-    try:
-        return float(os.environ.get(ENV_MAX_BAD_FRAC, "")
-                     or DEFAULT_MAX_BAD_FRAC)
-    except ValueError:
-        return DEFAULT_MAX_BAD_FRAC
+    # registry read is typo-tolerant (bad value -> declared default)
+    return float(knobs.env_float(ENV_MAX_BAD_FRAC))
 
 
 # ---------------------------------------------------------------------------

@@ -60,6 +60,7 @@ import time
 from typing import Dict, Optional, Tuple
 
 from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.tune import knobs
 
 __all__ = [
     "InjectedDeviceFault",
@@ -241,10 +242,10 @@ def configure_from_env() -> None:
     """Arm faults from ``PYPULSAR_TPU_FAULTS`` and chaos from
     ``PYPULSAR_TPU_CHAOS`` (the subprocess-test channels; unset leaves
     the armed set alone so a CLI flag survives)."""
-    spec = os.environ.get(ENV_FAULTS)
+    spec = knobs.env_str(ENV_FAULTS)
     if spec:
         _arm(parse_spec(spec))
-    chaos = os.environ.get(ENV_CHAOS)
+    chaos = knobs.env_str(ENV_CHAOS)
     if chaos:
         configure_chaos(chaos)
 
@@ -322,11 +323,8 @@ def _hang(point: str) -> None:
     async watchdog interrupt lands between bytecodes (one long
     ``sleep`` would pin the exception until it returned), bounded by
     ``PYPULSAR_TPU_HANG_S`` so an unwatched hang ends on its own."""
-    try:
-        bound = float(os.environ.get(ENV_HANG_S, "") or 30.0)
-    except ValueError:
-        bound = 30.0
-    deadline = time.monotonic() + bound
+    # registry read is typo-tolerant (garbage -> the declared 30.0)
+    deadline = time.monotonic() + float(knobs.env_float(ENV_HANG_S))
     while time.monotonic() < deadline:
         time.sleep(0.05)
 

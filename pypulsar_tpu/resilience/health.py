@@ -46,6 +46,12 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from pypulsar_tpu.obs import telemetry
+# the historical typo-tolerant helper is now a re-export of the knob
+# registry's read path (round 17): registered knobs resolve env > tuned
+# cache > declared default; unregistered names keep the old
+# (raw env, ``default`` argument) behavior — including every caller's
+# garbage-tolerant contract (a typo'd knob must never abort a fleet)
+from pypulsar_tpu.tune.knobs import env_float  # noqa: F401
 
 __all__ = [
     "DeviceHealth",
@@ -76,16 +82,6 @@ ENV_MIN_FREE_MB = "PYPULSAR_TPU_MIN_FREE_MB"
 DEFAULT_MIN_FREE_MB = 32.0
 
 
-def env_float(name: str, default: Optional[float]) -> Optional[float]:
-    """Float env knob; unset/empty/garbage -> ``default`` (a typo'd
-    knob must never abort a fleet)."""
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        return default
 
 
 class StageTimeout(RuntimeError):

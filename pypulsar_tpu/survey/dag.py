@@ -74,7 +74,10 @@ class SurveyConfig:
     accel_dz: float = 2.0
     accel_numharm: int = 8
     accel_sigma: float = 2.0
-    accel_batch: int = 32
+    # None = the tuned registry default (PYPULSAR_TPU_ACCEL_BATCH: env
+    # > auto-tuning cache > 32), resolved inside the sweep CLI at the
+    # stage's own geometry; an explicit value pins it (round 17)
+    accel_batch: Optional[int] = None
     # spectral fusion (round 15): the sweep stage hands the accel
     # search device-resident fused spectra (`sweep --spectral`) instead
     # of teeing per-DM .dat series; the fold stage then streams the RAW
@@ -211,7 +214,8 @@ def _sweep_argv(obs: Observation, cfg: SurveyConfig) -> List[str]:
             "--accel-dz", str(cfg.accel_dz),
             "--accel-numharm", str(cfg.accel_numharm),
             "--accel-sigma", str(cfg.accel_sigma),
-            "--accel-batch", str(cfg.accel_batch),
+            *(["--accel-batch", str(cfg.accel_batch)]
+              if cfg.accel_batch is not None else []),
             # the chain journal gives the (long) sweep stage its own
             # intra-stage resume: a redone stage skips validated units
             "--journal", f"{obs.outbase}.chain.jsonl"]

@@ -1,0 +1,143 @@
+"""Auto-tuning subsystem (round 17): knob registry + bounded search +
+persisted geometry-keyed cache.
+
+Three layers (each its own module), one public surface (this one):
+
+- :mod:`tune.knobs` — typed declarations for every ``PYPULSAR_TPU_*``
+  tunable; the single read path (``env_int``/``env_float``/``env_str``)
+  with ``trial > env > tuned > default`` precedence;
+- :mod:`tune.search` — deterministic, budgeted coordinate descent over
+  the declared domains, timing real stage dispatches;
+- :mod:`tune.cache` — the persisted JSON cache keyed by (geometry,
+  engine, backend, jax version, schema version).
+
+Entry-point contract: the sweep/accel/fold/specfuse entry points call
+:func:`apply_cached` with their stage + actual run geometry. Mode
+(``PYPULSAR_TPU_TUNE``):
+
+- ``cache`` (default): consult the cache; a hit installs the stored
+  config (``tune.cache_hit``), a miss runs on defaults (no search —
+  a production stage never pays search cost it wasn't asked for);
+- ``search``: a miss additionally runs the bounded on-line search at
+  the stage's geometry and persists the winner (first run pays the
+  bounded trial budget, every later run at that key is a pure hit);
+- ``off`` / ``0``: no consults, no file IO — the pre-round-17 behavior.
+
+Telemetry contract: ``tune.cache_hit`` / ``tune.cache_miss`` /
+``tune.trials`` counters and one ``tune.winner`` event per finished
+search (rolled up by ``tlmsum``'s auto-tuning section).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.tune import knobs
+from pypulsar_tpu.tune.cache import TuneCache, make_key
+
+__all__ = ["apply_cached", "autotune", "tuning_mode", "knobs",
+           "TuneCache", "make_key"]
+
+
+def tuning_mode() -> str:
+    """``cache`` | ``search`` | ``off`` (any disable-flavored value —
+    off/0/none/false — normalizes to ``off``; unknown values fall back
+    to ``cache``, the never-abort knob contract)."""
+    raw = (knobs.env_str("PYPULSAR_TPU_TUNE") or "cache").strip().lower()
+    if raw in ("off", "0", "none", "false", "no"):
+        return "off"
+    if raw not in ("cache", "search"):
+        return "cache"
+    return raw
+
+
+def apply_cached(stage: str, *, nchan: Optional[int] = None,
+                 nsamp: Optional[int] = None,
+                 dtype: Optional[str] = None,
+                 zmax: Optional[int] = None,
+                 engine: Optional[str] = None,
+                 cache: Optional[TuneCache] = None) -> Dict[str, Any]:
+    """The stage entry points' consult: install this geometry's cached
+    config into the registry's tuned overlay. In ``search`` mode a
+    cache miss additionally runs the bounded on-line search (the
+    first run at a new geometry pays the trial budget, every later run
+    is a pure hit); in ``cache`` mode a miss just runs on defaults.
+    Never raises — a broken cache file costs defaults, not the run.
+    Returns the applied config ({} on miss/off)."""
+    mode = tuning_mode()
+    if mode == "off":
+        return {}
+    try:
+        if mode == "search":
+            try:
+                return autotune(stage, nchan=nchan, nsamp=nsamp,
+                                dtype=dtype, zmax=zmax, engine=engine,
+                                cache=cache)
+            except ValueError:
+                pass  # stage has no measure builder: cache-only below
+        c = cache or TuneCache()
+        ent = c.lookup(make_key(stage, nchan=nchan, nsamp=nsamp,
+                                dtype=dtype, zmax=zmax, engine=engine))
+        if ent is None:
+            return {}
+        applied = knobs.apply_tuned(ent["config"])
+        if applied:
+            telemetry.event("tune.applied", stage=stage, config=applied)
+        return applied
+    except Exception:  # noqa: BLE001 - tuning is a passenger, never the payload
+        return {}
+
+
+def autotune(stage: str, *, nchan: Optional[int] = None,
+             nsamp: Optional[int] = None, dtype: Optional[str] = None,
+             zmax: Optional[int] = None, engine: Optional[str] = None,
+             measure=None, cache: Optional[TuneCache] = None,
+             budget: Optional[int] = None,
+             force_search: bool = False,
+             verbose: bool = False) -> Dict[str, Any]:
+    """Cache-or-search: the full consult the ``search`` mode and the
+    ``tune`` CLI/bench use. A cache hit installs and returns the stored
+    config with ZERO trials; a miss (or ``force_search``) runs the
+    bounded search with ``measure`` (built from tune/stages.py when not
+    given), persists the winner, installs it, and emits the
+    ``tune.winner`` event."""
+    if tuning_mode() == "off" and not force_search:
+        return {}
+    c = cache or TuneCache()
+    key = make_key(stage, nchan=nchan, nsamp=nsamp, dtype=dtype,
+                   zmax=zmax, engine=engine)
+    if not force_search:
+        ent = c.lookup(key)
+        if ent is not None:
+            applied = knobs.apply_tuned(ent["config"])
+            if applied:
+                telemetry.event("tune.applied", stage=stage,
+                                config=applied)
+            return applied
+        if tuning_mode() != "search":
+            return {}
+    else:
+        c.lookup(key)  # keep the hit/miss telemetry contract honest
+    from pypulsar_tpu.tune.search import coordinate_search
+    from pypulsar_tpu.tune.stages import measure_for_stage
+
+    if measure is None:
+        measure = measure_for_stage(stage, nchan=nchan, nsamp=nsamp,
+                                    zmax=zmax, engine=engine)
+    with telemetry.span("tune_search", aggregate=False, stage=stage):
+        res = coordinate_search(stage, measure, engine=engine,
+                                budget=budget, verbose=verbose)
+    config = res.tuned_config()
+    c.store(key, config, meta={
+        "stage": stage, "n_trials": res.n_trials,
+        "baseline_s": round(res.baseline_s, 6),
+        "best_s": round(res.best_s, 6),
+        "speedup": round(res.speedup, 4),
+        "baseline": res.baseline,
+    })
+    telemetry.event("tune.winner", stage=stage, key=key, config=config,
+                    n_trials=res.n_trials,
+                    baseline_s=round(res.baseline_s, 6),
+                    best_s=round(res.best_s, 6))
+    return knobs.apply_tuned(config)

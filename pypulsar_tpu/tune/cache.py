@@ -1,0 +1,194 @@
+"""The persisted, geometry-keyed tuning cache (round 17).
+
+One JSON file maps a **tuning key** — (stage, nchan, nsamp, dtype,
+zmax, engine, backend device kind, jax version, tune-schema version) —
+to the winning throughput-knob config the bounded searcher found there,
+plus provenance (trial count, baseline/best seconds, search date). The
+stage entry points consult it automatically (tune/__init__.py); a hit
+installs the config into the knob registry's tuned overlay and costs
+zero search trials (the ``tune.cache_hit`` telemetry gate the bench
+asserts).
+
+Durability rules, all tested (tests/test_tune.py):
+
+- **corrupt/torn JSON is ignored and rebuilt**, never crashed on — the
+  cache is an accelerator, losing it costs one re-search;
+- **any changed key component forces a re-search** — the key string
+  embeds geometry, engine, backend, jax version and ``SCHEMA_VERSION``,
+  so a jax upgrade or a schema change can never serve stale configs;
+- **writes are atomic** (``resilience.journal.atomic_write_text``: tmp
+  + ``os.replace``) and **merged under an fcntl lock** (read-merge-
+  write), so concurrent writers on one host neither tear the file nor
+  drop each other's entries;
+- ``nsamp`` is bucketed to the next power of two: two observations of
+  nearly equal length share an entry (the FFT geometry they compile is
+  the same bucket).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.tune import knobs
+
+__all__ = ["SCHEMA_VERSION", "TuneCache", "default_cache_path",
+           "make_key"]
+
+SCHEMA_VERSION = 1
+
+
+def default_cache_path() -> str:
+    """``PYPULSAR_TPU_TUNE_CACHE`` or ``~/.cache/pypulsar_tpu/tune.json``."""
+    p = knobs.env_str("PYPULSAR_TPU_TUNE_CACHE")
+    if p:
+        return p
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "pypulsar_tpu", "tune.json")
+
+
+def _pow2_bucket(n: Optional[int]) -> Optional[int]:
+    if n is None or n <= 0:
+        return n
+    return 1 << (int(n) - 1).bit_length()
+
+
+def _backend_kind() -> str:
+    """Device kind the tuned numbers were measured on — resolved through
+    the gang-lease registry (PL002) so a leased chip keys its own entry."""
+    try:
+        from pypulsar_tpu.parallel.mesh import lease_devices
+
+        d = lease_devices()[0]
+        return getattr(d, "device_kind", None) or d.platform
+    except Exception:  # noqa: BLE001 - backend probing must not fail
+        return "cpu"
+
+
+def _jax_version() -> str:
+    try:
+        import jax
+
+        return jax.__version__
+    except Exception:  # noqa: BLE001 - jax-less hosts still key cleanly
+        return "nojax"
+
+
+def make_key(stage: str, *, nchan: Optional[int] = None,
+             nsamp: Optional[int] = None, dtype: Optional[str] = None,
+             zmax: Optional[int] = None, engine: Optional[str] = None,
+             backend: Optional[str] = None) -> str:
+    """Canonical cache-key string. Every component that can change the
+    optimum (or the meaning of the stored config) is in the key; a
+    changed component is a different key, i.e. a forced re-search."""
+    parts = [
+        "s%d" % SCHEMA_VERSION,
+        "stage=%s" % stage,
+        "nchan=%s" % (nchan if nchan is not None else "-"),
+        "nsamp=%s" % (_pow2_bucket(nsamp) if nsamp is not None else "-"),
+        "dtype=%s" % (dtype or "-"),
+        "zmax=%s" % (zmax if zmax is not None else "-"),
+        "engine=%s" % (engine or "-"),
+        "backend=%s" % (backend or _backend_kind()),
+        "jax=%s" % _jax_version(),
+    ]
+    return "|".join(parts)
+
+
+class TuneCache:
+    """Load/lookup/store against one cache file (see module docstring
+    for the durability contract)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or default_cache_path()
+
+    # -- IO ------------------------------------------------------------
+
+    def _load(self) -> Dict[str, Any]:
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+        except FileNotFoundError:
+            return {"schema": SCHEMA_VERSION, "entries": {}}
+        except (OSError, ValueError):
+            # corrupt/torn cache: rebuild, never crash — and say so
+            telemetry.event("tune.cache_corrupt", path=self.path)
+            return {"schema": SCHEMA_VERSION, "entries": {}}
+        if (not isinstance(data, dict)
+                or data.get("schema") != SCHEMA_VERSION
+                or not isinstance(data.get("entries"), dict)):
+            telemetry.event("tune.cache_corrupt", path=self.path)
+            return {"schema": SCHEMA_VERSION, "entries": {}}
+        return data
+
+    def _write_locked(self, mutate) -> None:
+        """Read-merge-write under an advisory lock + atomic replace:
+        concurrent writers keep each other's entries and readers never
+        see a torn file."""
+        from pypulsar_tpu.resilience.journal import atomic_write_text
+
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        lockfn = self.path + ".lock"
+        lf = open(lockfn, "a+")
+        try:
+            try:
+                import fcntl
+
+                fcntl.flock(lf.fileno(), fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                pass  # no flock (non-posix): atomic replace still holds
+            data = self._load()
+            mutate(data["entries"])
+            atomic_write_text(self.path, json.dumps(data, indent=1,
+                                                    sort_keys=True))
+        finally:
+            lf.close()
+
+    # -- API -----------------------------------------------------------
+
+    def entries(self) -> Dict[str, Any]:
+        return self._load()["entries"]
+
+    def lookup(self, key: str) -> Optional[Dict[str, Any]]:
+        """The stored entry for ``key`` (``{"config": .., "meta": ..}``)
+        or None. Bumps the ``tune.cache_hit``/``tune.cache_miss``
+        telemetry contract either way."""
+        ent = self._load()["entries"].get(key)
+        if ent is not None and isinstance(ent.get("config"), dict):
+            telemetry.counter("tune.cache_hit")
+            return ent
+        telemetry.counter("tune.cache_miss")
+        return None
+
+    def store(self, key: str, config: Dict[str, Any],
+              meta: Optional[Dict[str, Any]] = None) -> None:
+        entry = {"config": dict(config),
+                 "meta": dict(meta or {}, written_unix=time.time())}
+
+        def mutate(entries):
+            entries[key] = entry
+
+        self._write_locked(mutate)
+
+    def clear(self, stage: Optional[str] = None) -> int:
+        """Drop all entries (or one stage's). Returns how many went."""
+        removed = [0]
+
+        def mutate(entries):
+            if stage is None:
+                removed[0] = len(entries)
+                entries.clear()
+                return
+            victims = [k for k in entries
+                       if ("|stage=%s|" % stage) in k]
+            removed[0] = len(victims)
+            for k in victims:
+                del entries[k]
+
+        self._write_locked(mutate)
+        return removed[0]

@@ -1,0 +1,139 @@
+"""Bounded on-line config search: deterministic, budgeted coordinate
+descent over the declared knob domains (round 17).
+
+The dedispersion auto-tuning literature (PAPERS.md 1601.05052,
+1601.01165) finds that (a) the optimal config varies strongly with
+(geometry, backend) and (b) a *small guided sample* of the config space
+recovers almost all of the exhaustive-search win. This searcher is that
+small guided sample:
+
+- **coordinate descent in declared order**: one knob at a time, domain
+  values probed nearest-first in each direction from the current value;
+- **early-cutoff on regression**: a candidate slower than
+  ``cutoff x`` the best-so-far abandons the rest of that direction
+  (monotone-valley assumption — the measured chunk-length curve in
+  BENCHNOTES r5 has exactly that shape);
+- **hard trial budget** (``PYPULSAR_TPU_TUNE_TRIALS``): the structural
+  guarantee the bench asserts — search cost is bounded no matter the
+  domain sizes;
+- **deterministic**: knob order is declaration order, the measure
+  callables build their synthetic data from a seed, and each config is
+  timed as the min over ``repeats`` runs (drops the XLA compile from
+  the comparison).
+
+Every timed candidate runs under :class:`knobs.trial_overrides` — the
+highest-precedence thread-local overlay — so the *real* stage dispatch
+being measured (tune/stages.py) resolves the candidate values through
+the same registry reads the production path uses. Knobs pinned by env
+are never searched (the operator wins); knobs whose results vary under
+the active engine are excluded (the science-invariance contract).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.tune import knobs
+
+__all__ = ["SearchResult", "coordinate_search"]
+
+
+@dataclass
+class SearchResult:
+    stage: str
+    baseline: Dict[str, Any]
+    baseline_s: float
+    best: Dict[str, Any]
+    best_s: float
+    n_trials: int
+    trials: List[Tuple[Dict[str, Any], float]] = field(default_factory=list)
+
+    @property
+    def speedup(self) -> float:
+        return self.baseline_s / self.best_s if self.best_s > 0 else 1.0
+
+    def tuned_config(self) -> Dict[str, Any]:
+        """Only the knobs the search actually moved off baseline — the
+        payload the cache stores (storing unchanged knobs would pin
+        today's defaults against tomorrow's better ones)."""
+        return {k: v for k, v in self.best.items()
+                if self.baseline.get(k) != v}
+
+
+def coordinate_search(stage: str,
+                      measure: Callable[[], float],
+                      *,
+                      engine: Optional[str] = None,
+                      budget: Optional[int] = None,
+                      repeats: int = 2,
+                      cutoff: float = 1.35,
+                      verbose: bool = False) -> SearchResult:
+    """Tune ``stage``'s searchable knobs against ``measure``.
+
+    ``measure`` runs ONE real stage dispatch at the actual run geometry
+    and returns nothing — it is timed here, under a ``tune_trial``
+    telemetry span, with the candidate config installed as a trial
+    overlay. Returns the :class:`SearchResult`; the caller decides
+    whether to persist it (tune/__init__.py stores winners in the
+    geometry-keyed cache).
+    """
+    if budget is None:
+        budget = max(1, knobs.env_int("PYPULSAR_TPU_TUNE_TRIALS"))
+    coords = list(knobs.searchable_knobs(stage, engine))
+    baseline = {k.env: knobs.env_value(k.env) for k in coords}
+    spent = [0]
+
+    def timed(cfg: Dict[str, Any]) -> float:
+        best = None
+        with knobs.trial_overrides(cfg):
+            for _ in range(max(1, repeats)):
+                with telemetry.span("tune_trial", stage=stage):
+                    t0 = time.perf_counter()
+                    measure()
+                    dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+        telemetry.counter("tune.trials")
+        spent[0] += 1
+        if verbose:
+            moved = {k: v for k, v in cfg.items() if baseline.get(k) != v}
+            print("# tune[%s] trial %d: %.4fs  %s"
+                  % (stage, spent[0], best, moved or "(baseline)"))
+        return best
+
+    current = dict(baseline)
+    baseline_s = best_s = timed(current)
+    trials: List[Tuple[Dict[str, Any], float]] = [(dict(current),
+                                                   baseline_s)]
+    improved = True
+    passes = 0
+    while improved and passes < 2 and spent[0] < budget:
+        improved = False
+        passes += 1
+        for k in coords:
+            if spent[0] >= budget:
+                break
+            dom = sorted(set(k.domain))
+            cur = current[k.env]
+            below = [v for v in dom if v < cur][::-1]  # nearest first
+            above = [v for v in dom if v > cur]
+            for direction in (above, below):
+                for v in direction:
+                    if spent[0] >= budget:
+                        break
+                    cand = dict(current, **{k.env: v})
+                    t = timed(cand)
+                    trials.append((dict(cand), t))
+                    if t < best_s:
+                        best_s = t
+                        current = cand
+                        improved = True
+                    elif t > cutoff * best_s:
+                        # early-cutoff: this direction is regressing
+                        # past noise — abandon its remaining values
+                        break
+    return SearchResult(stage=stage, baseline=baseline,
+                        baseline_s=baseline_s, best=dict(current),
+                        best_s=best_s, n_trials=spent[0], trials=trials)

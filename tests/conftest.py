@@ -21,3 +21,25 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_tuning(tmp_path_factory, monkeypatch):
+    """Round 17: the CLIs consult the persisted tuning cache by default.
+    Point every test at a throwaway cache file (never the developer's
+    ~/.cache winners — a tuned chunk length would silently change the
+    geometry under golden tests) and start from an empty tuned overlay,
+    unless the test pins the knob itself."""
+    from pypulsar_tpu.tune import knobs
+
+    # unconditional: a developer's exported PYPULSAR_TPU_TUNE_CACHE must
+    # not leak their real winners into golden tests (tests that need a
+    # specific cache path monkeypatch it themselves, which overrides)
+    monkeypatch.setenv(
+        "PYPULSAR_TPU_TUNE_CACHE",
+        str(tmp_path_factory.mktemp("tune") / "tune.json"))
+    knobs.clear_tuned()
+    yield
+    knobs.clear_tuned()

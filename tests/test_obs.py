@@ -312,6 +312,42 @@ def test_tlmsum_tree_dedispersion_rollup(tmp_path, capsys):
     assert "tree dedispersion" not in capsys.readouterr().out
 
 
+def test_tlmsum_autotuning_rollup(tmp_path, capsys):
+    """The round-17 tune.* telemetry contract gets its own tlmsum
+    roll-up: trials/hit/miss counters plus the winning config per stage
+    from the tune.winner (search) and tune.applied (cache-hit) event
+    attrs — and a trace without tune records renders no such section."""
+    path = str(tmp_path / "tune.jsonl")
+    with telemetry.session(path, tool="sweep"):
+        telemetry.counter("tune.trials", 7)
+        telemetry.counter("tune.cache_miss", 1)
+        telemetry.counter("tune.cache_hit", 2)
+        telemetry.event("tune.winner", stage="sweep",
+                        config={"PYPULSAR_TPU_SWEEP_CHUNK": 131072},
+                        n_trials=7, baseline_s=0.9, best_s=0.7)
+        telemetry.event("tune.applied", stage="accel",
+                        config={"PYPULSAR_TPU_ACCEL_BATCH": 8})
+    from pypulsar_tpu.obs.summarize import main as tlmsum_main
+
+    assert tlmsum_main([path]) == 0
+    out = capsys.readouterr().out
+    line = [ln for ln in out.splitlines() if "auto-tuning" in ln]
+    assert line, out
+    assert "trials=7" in line[0]
+    assert "cache hits=2" in line[0]
+    assert "cache misses=1" in line[0]
+    sweep = [ln for ln in out.splitlines() if "SWEEP_CHUNK=131072" in ln]
+    assert sweep and "7 trials" in sweep[0], out
+    accel = [ln for ln in out.splitlines() if "ACCEL_BATCH=8" in ln]
+    assert accel, out
+
+    plain = str(tmp_path / "plain.jsonl")
+    with telemetry.session(plain, tool="sweep"):
+        telemetry.counter("sweep.chunks", 1)
+    assert tlmsum_main([plain]) == 0
+    assert "auto-tuning" not in capsys.readouterr().out
+
+
 def test_tlmsum_truncated_trace(small_sweep_trace, capsys):
     """A killed run's trace (no end-of-run flush records) still
     summarizes from the incremental span/event records."""

@@ -347,6 +347,50 @@ def test_pl009_near_miss(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# PL011 raw knob read outside the registry
+
+def test_pl011_true_positive(tmp_path):
+    # a const-string read, the ENV_* constant-indirection idiom, and a
+    # subscript read are all raw-read shapes (round 17: tune/knobs.py
+    # is the single read path)
+    rep = lint(tmp_path, {"pypulsar_tpu/mod.py":
+                          "import os\n"
+                          "ENV_DEPTH = 'PYPULSAR_TPU_DEPTH'\n"
+                          "a = os.environ.get('PYPULSAR_TPU_CHUNK')\n"
+                          "b = os.environ.get(ENV_DEPTH, '4')\n"
+                          "c = os.environ['PYPULSAR_TPU_MODE']\n"},
+               select="PL011")
+    assert codes(rep) == ["PL011", "PL011", "PL011"]
+    assert {f.line for f in rep.findings} == {3, 4, 5}
+
+
+def test_pl011_near_miss(tmp_path):
+    # the registry module itself is exempt; registry accessors are the
+    # sanctioned shape; non-knob env vars are out of scope; env WRITES
+    # (bench arming subprocess knobs) are not reads; tests may poke env
+    # directly (precedence tests need to)
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/tune/knobs.py":
+            "import os\n"
+            "def env_raw(name):\n"
+            "    return os.environ.get('PYPULSAR_TPU_ANY')\n",
+        "pypulsar_tpu/mod.py":
+            "import os\n"
+            "from pypulsar_tpu.tune import knobs\n"
+            "a = knobs.env_int('PYPULSAR_TPU_CHUNK')\n"
+            "b = os.environ.get('JAX_PLATFORMS')\n"
+            "def arm():\n"
+            "    os.environ['PYPULSAR_TPU_FAULTS'] = 'oom:x'\n",
+        "tests/test_knobs.py":
+            "import os\n"
+            "def test_env_wins():\n"
+            "    os.environ['PYPULSAR_TPU_CHUNK'] = '5'\n"
+            "    assert os.environ.get('PYPULSAR_TPU_CHUNK') == '5'\n",
+    }, select="PL011")
+    assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions / select / ignore / baseline / output
 
 def test_suppression_silences_and_unused_is_flagged(tmp_path):
@@ -464,7 +508,7 @@ def test_report_json_schema(tmp_path):
 
 def test_rule_catalog_complete():
     got = {r.code for r in all_rules()}
-    assert got == {f"PL00{i}" for i in range(1, 10)}
+    assert got == {f"PL00{i}" for i in range(1, 10)} | {"PL011"}
     assert all(r.summary and r.name for r in all_rules())
 
 
