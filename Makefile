@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier test-faults test-fold test-survey test-corruption test-tune lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-multichip bench-specfuse bench-telemetry bench-tree bench-tune native clean
+.PHONY: test test-fourier test-faults test-fold test-survey test-corruption test-tune test-multihost lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-multichip bench-multihost-fleet bench-specfuse bench-telemetry bench-tree bench-tune native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -43,9 +43,19 @@ test-fourier:
 # survey orchestrator's kill/resume/quarantine and fleet-health
 # (watchdog, device-strike, admission) cases, and the seeded chaos
 # fleet
-test-faults: test-chaos test-corruption
+test-faults: test-chaos test-corruption test-multihost
 	$(CPU_ENV) $(PY) -m pytest tests/test_resilience.py -q
 	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -k "kill or resume or quarantine or retry or stall or deadline or evict or admission or chaos"
+
+# the multi-host fleet suite (round 18): fencing-token monotonicity +
+# stale-write rejection, double-adoption single-winner, netstall
+# split-brain cede, orphan adoption resuming byte-exactly, surplus
+# hosts as adopters, torn shared-manifest tails, and the M-process CLI
+# SIGKILL/adopt integration (spawn-probe gated) — plus the slow-marked
+# every-stage-boundary kill sweep
+test-multihost:
+	$(CPU_ENV) $(PY) -m pytest tests/test_multihost.py -q
+	$(CPU_ENV) $(PY) -m pytest tests/test_multihost.py -q -m slow -k sigkill
 
 # the seeded chaos harness (bounded time: --quick geometry, seeded
 # spray + one armed fault per family, resumed until complete, byte
@@ -136,6 +146,15 @@ bench-multichip:
 	$(CPU_ENV) $(PY) -m pytest tests/test_accel_pipeline.py -q -k "sharded or lease"
 	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -k "gang"
 	$(CPU_ENV) $(PY) bench.py --survey --devices 4 --out BENCH_r09_multichip.json
+
+# multi-host fleet (round 18): the coordination-plane suite, then the
+# 3-process harness — clean fleet A/B vs the 1-host serial chain, a
+# host SIGKILL'd mid-sweep with fenced adoption by survivors, byte
+# parity both legs, final resume re-runs zero stages ->
+# BENCH_r13_multihost.json + HOSTCHAOS_r01.json
+bench-multihost-fleet:
+	$(CPU_ENV) $(PY) -m pytest tests/test_multihost.py -q
+	$(CPU_ENV) $(PY) bench.py --multihost --quick --out BENCH_r13_multihost.json --hostchaos-out HOSTCHAOS_r01.json
 
 # spectral fusion (round 15): the fused-path parity suite (stitched
 # byte-identity at awkward geometries + mesh + kill/resume, decimate

@@ -121,6 +121,21 @@ def parse_args(argv=None):
                          "completes, and ASSERT byte-parity against a "
                          "clean run — the fleet-health acceptance "
                          "measurement (CHAOS_rXX.json)")
+    ap.add_argument("--multihost", action="store_true",
+                    help="run the round-18 multi-host fleet harness: a "
+                         "4-obs, 3-process CPU fleet coordinated through "
+                         "the shared-directory plane (fenced lease "
+                         "takeover), first CLEAN (A/B vs the 1-host "
+                         "serial chain), then with one host SIGKILL'd "
+                         "mid-sweep — survivors must ADOPT its "
+                         "observation, every artifact must be "
+                         "byte-identical to the serial run, and a final "
+                         "no-fault resume must re-run ZERO stages "
+                         "(BENCH_r13_multihost.json + HOSTCHAOS_r01.json)")
+    ap.add_argument("--hostchaos-out", default="HOSTCHAOS_r01.json",
+                    metavar="PATH",
+                    help="with --multihost: where the host-kill chaos "
+                         "record lands (default HOSTCHAOS_r01.json)")
     ap.add_argument("--chaos-seed", type=int, default=1,
                     help="with --chaos: the chaos seed (default 1)")
     ap.add_argument("--chaos-rate", type=float, default=None,
@@ -2274,6 +2289,294 @@ def run_chaos(args):
     }
 
 
+def run_multihost(args):
+    """Multi-host fleet harness (the round-18 fenced-lease-takeover
+    acceptance measurement): ONE survey over a 4-observation toy fleet,
+    run three ways —
+
+    - **serial**: the 1-host serial chain (the byte-parity reference);
+    - **clean 3-host**: three REAL host processes (``survey --host-id
+      hostN`` children, rank env grid) coordinating purely through the
+      shared-directory plane (``<outdir>/_fleet``): fsync'd heartbeat
+      leases, fencing-token'd claims, no coordinator service;
+    - **host-kill chaos**: the same 3-host fleet, but host0 is parked
+      mid-sweep by an armed in-stage hang and then SIGKILL'd (the real
+      signal — no finally blocks, no heartbeat retirement, the lease
+      just goes silent). Survivors must detect the death past
+      ``PYPULSAR_TPU_HOST_LEASE_S``, ADOPT the orphaned observation,
+      resume it from its manifest, and finish the fleet.
+
+    Asserted, not just reported: the kill leg's final artifact set is
+    byte-identical to the serial run, at least one adoption event fired,
+    the victim really died by signal, and a final no-fault single-host
+    ``--resume`` over the kill leg's outdir re-runs ZERO stages. The
+    wall-clock A/B is a CPU toy (hosts share one machine's cores) — the
+    committed claims are the adoption/fencing/parity structure."""
+    acquire_backend()
+    import glob as _glob
+    import signal
+    import tempfile
+
+    from pypulsar_tpu.survey.dag import SurveyConfig, build_dag
+    from pypulsar_tpu.survey.scheduler import FleetScheduler
+    from pypulsar_tpu.survey.state import Observation
+
+    n_obs, n_hosts = 4, 3
+    lease_s = 3.0
+    C, T, dtp = 32, (1 << 13 if (args.quick or args.cpu_fallback)
+                     else 1 << 14), 5e-4
+    rng_freqs = 1500.0 - 4.0 * np.arange(C)
+    cfg = SurveyConfig(
+        mask=True, mask_time=2.0, lodm=0.0, dmstep=10.0, numdms=8,
+        nsub=8, group_size=4, threshold=8.0,
+        accel_zmax=20.0, accel_numharm=2, accel_sigma=3.0, accel_batch=4,
+        sift_sigma=3.0, sift_min_hits=1, fold_nbins=32, fold_npart=8)
+    stages = build_dag(cfg)
+    # the SAME knobs as CLI flags — the children must run the identical
+    # chain or the byte-parity assert (and the final resume's
+    # fingerprint match) would be vacuous
+    flags = ["--mask-time", "2.0", "--lodm", "0.0", "--dmstep", "10.0",
+             "--numdms", "8", "-s", "8", "--group-size", "4",
+             "--threshold", "8.0", "--accel-zmax", "20.0",
+             "--accel-dz", "2.0", "--accel-numharm", "2",
+             "--accel-sigma", "3.0", "--accel-batch", "4",
+             "--sift-sigma", "3.0", "--sift-min-hits", "1",
+             "--fold-nbins", "32", "--fold-npart", "8"]
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+
+    def spawn_host(rank, fils, outdir, tlmdir, logdir, extra_env=None):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = (repo_root + os.pathsep
+                             + env.get("PYTHONPATH", "")).rstrip(
+                                 os.pathsep)
+        env["PYPULSAR_TPU_HOST_LEASE_S"] = str(lease_s)
+        env["PYPULSAR_TPU_NUM_PROCESSES"] = str(n_hosts)
+        env["PYPULSAR_TPU_PROCESS_ID"] = str(rank)
+        env.update(extra_env or {})
+        log = open(os.path.join(logdir, f"host{rank}.log"), "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pypulsar_tpu.cli", "survey",
+             *fils, "-o", outdir, *flags, "--host-id", f"host{rank}",
+             "--telemetry-dir", tlmdir],
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+        proc._log = log  # closed on wait below
+        return proc
+
+    def wait_hosts(procs, timeout=900):
+        codes = []
+        for proc in procs:
+            try:
+                codes.append(proc.wait(timeout=timeout))
+            finally:
+                proc._log.close()
+        return codes
+
+    def adoption_events(tlmdir):
+        out = []
+        for p in sorted(_glob.glob(os.path.join(tlmdir, "*.jsonl"))):
+            for line in open(p):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if (rec.get("type") == "event"
+                        and rec.get("name") == "survey.obs_adopted"
+                        and (rec.get("attrs") or {}).get("obs")):
+                    # the plane-emitted flavor only (host+obs+token);
+                    # the per-obs trace echoes a hostless twin that
+                    # would double-count the same adoption
+                    out.append(rec["attrs"])
+        return out
+
+    def parity(td, dir_a, dir_b):
+        ident = tot = 0
+        diverged = []
+        for pattern in (".cands", "_DM*_ACCEL_*.cand",
+                        "_DM*_ACCEL_*.txtcand", "_DM*.dat",
+                        ".accelcands", "_cand*.pfd"):
+            for fa in sorted(_glob.glob(os.path.join(td, dir_a,
+                                                     "*" + pattern))):
+                fb = os.path.join(td, dir_b, os.path.basename(fa))
+                tot += 1
+                if (os.path.exists(fb) and open(fa, "rb").read()
+                        == open(fb, "rb").read()):
+                    ident += 1
+                else:
+                    diverged.append(os.path.basename(fa))
+        return ident, tot, diverged
+
+    with tempfile.TemporaryDirectory() as td:
+        fils = [_synth_survey_fil(os.path.join(td, f"obs{i}.fil"), 31 + i,
+                                  C, T, dtp, rng_freqs, f"MH{i}",
+                                  period=0.1024 * (1.0 + 0.07 * i))
+                for i in range(n_obs)]
+
+        def fleet(dirname):
+            out = os.path.join(td, dirname)
+            os.makedirs(out, exist_ok=True)
+            return out, [Observation(f"obs{i}", fils[i],
+                                     os.path.join(out, f"obs{i}"))
+                         for i in range(n_obs)]
+
+        # leg 0 — serial 1-host reference (also the timing baseline)
+        sdir, sobs = fleet("serial")
+        t0 = time.perf_counter()
+        for obs in sobs:
+            for stage in stages:
+                stage.execute(obs, cfg)
+        serial_s = time.perf_counter() - t0
+        print(f"# multihost: serial 1-host reference {serial_s:.1f}s",
+              file=sys.stderr)
+
+        # leg 1 — clean 3-host fleet (subprocess hosts, cold jit caches:
+        # the wall includes per-host compile, stated in the record)
+        mdir, mobs = fleet("mh")
+        mtlm = os.path.join(td, "mh_tlm")
+        t0 = time.perf_counter()
+        procs = [spawn_host(r, fils, mdir, mtlm, td) for r in
+                 range(n_hosts)]
+        codes = wait_hosts(procs)
+        mh_s = time.perf_counter() - t0
+        assert codes == [0] * n_hosts, \
+            f"clean multihost leg exit codes {codes}"
+        ident, tot, diverged = parity(td, "serial", "mh")
+        assert ident == tot and tot > 0, (
+            f"clean 3-host artifacts diverged from serial: {ident}/{tot}"
+            f" ({diverged[:8]})")
+        print(f"# multihost: clean 3-host fleet {mh_s:.1f}s, {ident}/"
+              f"{tot} artifacts byte-identical to serial",
+              file=sys.stderr)
+
+        # leg 2 — HOST-KILL CHAOS: park host0 mid-sweep (armed in-stage
+        # hang, bound far beyond the leg), then SIGKILL it once the
+        # hang provably fired (its per-record-flushed fleet trace shows
+        # resilience.fault_injected). No finally blocks run: the lease
+        # just goes silent, which is exactly what survivors must detect.
+        kdir, kobs = fleet("kill")
+        ktlm = os.path.join(td, "kill_tlm")
+        t0 = time.perf_counter()
+        victim = spawn_host(0, fils, kdir, ktlm, td, extra_env={
+            "PYPULSAR_TPU_FAULTS": "hang:sweep.chunk_dispatch:1",
+            "PYPULSAR_TPU_HANG_S": "600"})
+        survivors = [spawn_host(r, fils, kdir, ktlm, td)
+                     for r in range(1, n_hosts)]
+        vtrace = os.path.join(ktlm, "fleet.host0.jsonl")
+        deadline = time.monotonic() + 300
+        parked = False
+        while time.monotonic() < deadline:
+            if victim.poll() is not None:
+                break  # died early — the log will say why
+            try:
+                parked = "resilience.fault_injected" in open(vtrace).read()
+            except OSError:
+                parked = False
+            if parked:
+                break
+            time.sleep(0.25)
+        assert parked, "victim never reached the armed mid-sweep hang"
+        os.kill(victim.pid, signal.SIGKILL)
+        vcode = victim.wait(timeout=60)
+        victim._log.close()
+        kcodes = wait_hosts(survivors)
+        kill_s = time.perf_counter() - t0
+        assert vcode == -signal.SIGKILL, \
+            f"victim exit {vcode}, expected -SIGKILL"
+        assert kcodes == [0] * (n_hosts - 1), \
+            f"survivor exit codes {kcodes}"
+        adoptions = adoption_events(ktlm)
+        assert adoptions, "no survey.obs_adopted event fired"
+        assert all(a.get("adopted_from") == "host0" for a in adoptions)
+        ident_k, tot_k, diverged_k = parity(td, "serial", "kill")
+        assert ident_k == tot_k and tot_k > 0, (
+            f"post-kill artifacts diverged from serial: "
+            f"{ident_k}/{tot_k} ({diverged_k[:8]})")
+
+        # the acceptance tail: a final no-fault single-host resume over
+        # the kill leg's outdir validates every manifest and runs NOTHING
+        final = FleetScheduler(kobs, cfg, resume=True).run()
+        assert final.ok and len(final.ran) == 0, (
+            f"final resume re-ran {len(final.ran)} stages: {final.ran}")
+        resume_skipped = len(final.skipped)
+
+    speedup = serial_s / mh_s
+    n_adopt = len(adoptions)
+    print(f"# multihost: host-kill leg {kill_s:.1f}s — victim SIGKILL'd "
+          f"mid-sweep, {n_adopt} adoption(s) by "
+          f"{sorted({a.get('host') for a in adoptions})}, "
+          f"{ident_k}/{tot_k} artifacts byte-identical to serial, final "
+          f"resume ran 0 / skipped {resume_skipped} stages",
+          file=sys.stderr)
+    hostchaos = {
+        "metric": "multihost_kill_recovery",
+        "value": round(ident_k / max(tot_k, 1), 3),
+        "unit": (f"fraction of artifacts byte-identical to the 1-host "
+                 f"serial run after a {n_obs}-obs x {n_hosts}-process "
+                 f"CPU fleet had host0 SIGKILL'd mid-sweep (parked by "
+                 f"an armed in-stage hang, killed by real SIGKILL, "
+                 f"lease silent past {lease_s}s) and survivors adopted "
+                 f"its observation via the fenced lease plane — "
+                 f"asserted 1.0, plus a final no-fault resume "
+                 f"validating 0 stages re-run"),
+        "vs_baseline": 1.0,
+        "multihost_n_obs": n_obs,
+        "multihost_n_hosts": n_hosts,
+        "multihost_lease_s": lease_s,
+        "multihost_victim": "host0",
+        "multihost_victim_exit": vcode,
+        "multihost_kill_point": "hang:sweep.chunk_dispatch:1 + SIGKILL",
+        "multihost_adoptions": n_adopt,
+        "multihost_adopters": sorted({str(a.get("host"))
+                                      for a in adoptions}),
+        "multihost_adopted_obs": sorted({str(a.get("obs", "?"))
+                                         for a in adoptions}),
+        "multihost_artifacts_identical": f"{ident_k}/{tot_k}",
+        "multihost_kill_leg_seconds": round(kill_s, 2),
+        "multihost_final_resume_ran": 0,
+        "multihost_final_resume_skipped": resume_skipped,
+        "multihost_nsamp": T,
+        "multihost_nchan": C,
+    }
+    if args.hostchaos_out:
+        with open(args.hostchaos_out, "w") as f:
+            json.dump(hostchaos, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"# multihost: host-kill chaos record -> "
+              f"{args.hostchaos_out}", file=sys.stderr)
+    return {
+        "metric": "multihost_fleet_parity",
+        "value": round((ident + ident_k) / max(tot + tot_k, 1), 3),
+        "unit": (f"fraction of artifacts byte-identical to the 1-host "
+                 f"serial chain across BOTH multi-host legs (clean "
+                 f"{n_hosts}-process fleet + host-kill/adoption leg; "
+                 f"{n_obs} toy obs x {len(stages)} stages, {C}-chan x "
+                 f"{T}-sample each) — asserted 1.0. Wall clocks are "
+                 f"recorded but NOT the claim on this CPU toy: host "
+                 f"processes are cold (each child pays its own jax "
+                 f"import + jit compile inside the timed leg) and all "
+                 f"hosts share one machine's cores; the committed "
+                 f"claims are plane coordination, fenced adoption "
+                 f"(detail in "
+                 f"{os.path.basename(args.hostchaos_out or 'HOSTCHAOS')}"
+                 f") and byte parity"),
+        "vs_baseline": 1.0,
+        "multihost_cold_fleet_speedup": round(speedup, 3),
+        "multihost_n_obs": n_obs,
+        "multihost_n_hosts": n_hosts,
+        "multihost_serial_seconds": round(serial_s, 2),
+        "multihost_fleet_seconds": round(mh_s, 2),
+        "multihost_artifacts_identical": f"{ident}/{tot}",
+        "multihost_kill_leg": {
+            k: hostchaos[k] for k in
+            ("multihost_adoptions", "multihost_adopters",
+             "multihost_victim_exit", "multihost_artifacts_identical",
+             "multihost_final_resume_ran", "multihost_kill_leg_seconds")},
+        "multihost_lease_s": lease_s,
+        "multihost_nsamp": T,
+        "multihost_nchan": C,
+    }
+
+
 def run_corruption(args):
     """Corruption-chaos harness (the round-13 data-integrity acceptance
     measurement): run a toy fleet CLEAN over pristine inputs, then run
@@ -2981,9 +3284,13 @@ def run_child(args, cpu: bool, timeout: float):
         argv += ["--tune-trials", str(args.tune_trials)]
     for flag in ("quick", "profile", "ab", "accel", "spectral", "fold",
                  "waterfall", "prepass", "survey", "chaos", "corruption",
-                 "dedisp_tree", "tune"):
+                 "dedisp_tree", "tune", "multihost"):
         if getattr(args, flag):
             argv.append("--" + flag.replace("_", "-"))
+    if args.multihost:
+        # the child writes the host-kill record itself; resolve the
+        # path NOW so the child's CWD cannot move it
+        argv += ["--hostchaos-out", os.path.abspath(args.hostchaos_out)]
     if args.corruption:
         argv += ["--corruption-seed", str(args.corruption_seed)]
     if args.chaos:
@@ -3022,6 +3329,7 @@ def main():
             and not (args.quick or args.ab or args.accel or args.fold
                      or args.waterfall or args.prepass or args.survey
                      or args.chaos or args.corruption or args.dedisp_tree or args.tune
+                     or args.multihost
                      or args.cpu_fallback or args.nsamp or args.nchan)
             and os.path.exists(DEFAULT_STREAM_FIL)):
         # the north-star workload exists on disk: measure THAT (streamed,
@@ -3058,6 +3366,8 @@ def main():
                 record = run_waterfall(args)
             elif args.survey:
                 record = run_survey(args)
+            elif args.multihost:
+                record = run_multihost(args)
             elif args.chaos:
                 record = run_chaos(args)
             elif args.corruption:

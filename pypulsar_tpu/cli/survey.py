@@ -23,6 +23,25 @@ in-process); the manifest is ``out/<stem>.survey.jsonl``. With
 ``--telemetry-dir`` each observation writes one trace plus one fleet
 trace, all summarizable together via
 ``tlmsum 'out/tlm/*.jsonl'`` (fleet roll-up mode).
+
+Multi-host (round 18)::
+
+    python -m pypulsar_tpu.cli survey beam*.fil -o out/ --hosts 3
+    # or, one process per machine against a shared out/:
+    PYPULSAR_TPU_HOST_ID=nodeA python -m pypulsar_tpu.cli survey \
+        beam*.fil -o out/ --host-id nodeA
+
+``--hosts M`` launches M host processes of THIS command (rank env vars
+``PYPULSAR_TPU_NUM_PROCESSES``/``PYPULSAR_TPU_PROCESS_ID`` set per
+child, the same grid ``parallel.distributed`` reads) against the shared
+``--outdir``; ``--host-id`` joins an existing fleet as one named host.
+Observations are claimed through fsync'd, fencing-token'd lease files
+under ``out/_fleet/`` — no coordinator service. A host that dies (or
+goes heartbeat-silent past ``PYPULSAR_TPU_HOST_LEASE_S``) has its
+in-flight observations adopted by the survivors, resuming from their
+manifests exactly like ``--resume``; its late writes are rejected by
+the fencing token. ``--status`` then adds a host-liveness block and a
+per-observation owner column.
 """
 
 from __future__ import annotations
@@ -77,6 +96,25 @@ def build_parser():
                    help="bounded per-stage retries (jittered exponential "
                         "backoff) before the observation is quarantined "
                         "(default 1)")
+    g = p.add_argument_group(
+        "multi-host fleet (shared-directory coordination plane)")
+    g.add_argument("--hosts", type=int, default=0, metavar="M",
+                   help="launch M host processes of this command against "
+                        "the shared --outdir (observations claimed via "
+                        "fenced lease files under <outdir>/_fleet; a "
+                        "dead host's in-flight observations are adopted "
+                        "by survivors). Each child gets "
+                        "PYPULSAR_TPU_PROCESS_ID/NUM_PROCESSES and a "
+                        "hostN id. 0 (default): single-process")
+    g.add_argument("--host-id", default=None, metavar="NAME",
+                   help="join the fleet under --outdir as ONE host named "
+                        "NAME (what --hosts children do; set it yourself "
+                        "to run one process per machine against a shared "
+                        "filesystem; also PYPULSAR_TPU_HOST_ID)")
+    g.add_argument("--host-lease", type=float, default=None, metavar="S",
+                   help="heartbeat-silence bound before a host is "
+                        "declared dead and its observations adoptable "
+                        "(also PYPULSAR_TPU_HOST_LEASE_S; default 10)")
     g = p.add_argument_group(
         "fleet health (deadlines, heartbeats, device strikes, admission)")
     g.add_argument("--stall-timeout", type=float, default=None,
@@ -170,6 +208,7 @@ def build_parser():
 
 
 def _status(outdir: str) -> int:
+    from pypulsar_tpu.survey.fleet import read_plane_status
     from pypulsar_tpu.survey.state import (
         MANIFEST_SUFFIX,
         format_status,
@@ -182,8 +221,49 @@ def _status(outdir: str) -> int:
         print(f"# no survey manifests under {outdir!r}", file=sys.stderr)
         return 1
     print(format_status(status_rows(paths),
-                        health=read_fleet_health(outdir)))
+                        health=read_fleet_health(outdir),
+                        plane=read_plane_status(outdir)))
     return 0
+
+
+def _launch_hosts(args, argv) -> int:
+    """The ``--hosts M`` launcher: M child processes of this same
+    command (``--hosts`` stripped, per-child ``--host-id``), each a
+    full fleet host claiming observations through the shared plane.
+    The rank env vars are the SAME grid ``parallel.distributed`` reads,
+    so a ``jax.distributed`` coordinator (real multi-machine TPU pods)
+    threads through unchanged — on collective-less CPU backends the
+    children simply never call initialize() and coordinate purely
+    through the plane files."""
+    import subprocess
+
+    child_argv = []
+    skip = 0
+    for a in (argv if argv is not None else sys.argv[1:]):
+        if skip:
+            skip -= 1
+            continue
+        if a == "--hosts":
+            skip = 1
+            continue
+        if a.startswith("--hosts="):
+            continue
+        child_argv.append(a)
+    procs = []
+    for rank in range(args.hosts):
+        env = dict(os.environ)
+        env["PYPULSAR_TPU_NUM_PROCESSES"] = str(args.hosts)
+        env["PYPULSAR_TPU_PROCESS_ID"] = str(rank)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "pypulsar_tpu.cli", "survey",
+             *child_argv, "--host-id", f"host{rank}"], env=env))
+    rc = 0
+    for rank, proc in enumerate(procs):
+        code = proc.wait()
+        print(f"# survey: host{rank} (pid {proc.pid}) exited {code}")
+        rc = max(rc, abs(code))
+    return rc
 
 
 def _observations(infiles, outdir):
@@ -210,6 +290,14 @@ def main(argv=None):
         return _status(args.outdir)
     if not args.infile:
         p.error("give at least one observation (or --status)")
+    if args.hosts and args.hosts < 1:
+        p.error(f"--hosts must be >= 1, got {args.hosts}")
+    if args.hosts and args.host_id:
+        p.error("--hosts launches its own named hosts; give one or the "
+                "other")
+    if args.hosts:
+        os.makedirs(args.outdir, exist_ok=True)
+        return _launch_hosts(args, argv)
     from pypulsar_tpu.obs import telemetry
     from pypulsar_tpu.resilience import faultinject
 
@@ -227,7 +315,14 @@ def main(argv=None):
     if args.telemetry_dir:
         os.makedirs(args.telemetry_dir, exist_ok=True)
         if fleet_trace is None:
-            fleet_trace = os.path.join(args.telemetry_dir, "fleet.jsonl")
+            from pypulsar_tpu.survey.fleet import ENV_HOST_ID
+            from pypulsar_tpu.tune import knobs
+
+            host = args.host_id or knobs.env_str(ENV_HOST_ID)
+            # per-host fleet traces: M hosts sharing one telemetry dir
+            # must not clobber each other's scheduler trace
+            name = f"fleet.{host}.jsonl" if host else "fleet.jsonl"
+            fleet_trace = os.path.join(args.telemetry_dir, name)
     with telemetry.session_from_flag(fleet_trace, tool="survey"):
         return _run(args)
 
@@ -266,6 +361,29 @@ def _run(args) -> int:
             print(f"survey: --gang {gang} exceeds --devices "
                   f"{args.devices}", file=sys.stderr)
             return 2
+    plane = None
+    host_id = args.host_id or None
+    if host_id is None:
+        from pypulsar_tpu.survey.fleet import ENV_HOST_ID
+        from pypulsar_tpu.tune import knobs
+
+        host_id = knobs.env_str(ENV_HOST_ID) or None
+    if host_id is not None:
+        # multi-host: join the shared plane, and give the jax
+        # distributed runtime its chance too (env-driven; a no-op
+        # without a coordinator address — the plane itself needs no
+        # collectives, so CPU fleets coordinate purely through files)
+        from pypulsar_tpu.parallel import distributed
+        from pypulsar_tpu.survey.fleet import FleetPlane
+
+        try:
+            distributed.initialize()
+        except Exception as e:  # noqa: BLE001 - collective-less backend
+            print(f"# survey[{host_id}]: jax.distributed unavailable "
+                  f"({type(e).__name__}); coordinating via the plane "
+                  f"files only")
+        plane = FleetPlane(args.outdir, host_id=host_id,
+                           lease_s=args.host_lease)
     sched = FleetScheduler(
         obs, cfg, max_host_workers=args.max_host_workers,
         devices=args.devices, retries=args.retries, resume=args.resume,
@@ -273,14 +391,20 @@ def _run(args) -> int:
         stall_s=args.stall_timeout, stage_deadline=args.stage_deadline,
         strike_limit=args.strike_limit, min_free_mb=args.min_free_mb,
         max_pending=args.max_pending, max_bad_frac=args.max_bad_frac,
-        verbose=True)
+        plane=plane, verbose=True)
     result = sched.run()
     n_stages = len(sched.stages)
-    print(f"# survey: {len(obs)} observations x {n_stages} stages in "
-          f"{result.wall:.2f}s — {len(result.ran)} stages run, "
+    tag = f"[{host_id}] " if host_id else ""
+    print(f"# survey: {tag}{len(obs)} observations x {n_stages} stages "
+          f"in {result.wall:.2f}s — {len(result.ran)} stages run, "
           f"{len(result.skipped)} skipped (validated), "
           f"{result.retried} retried, "
           f"{len(result.quarantined)} observations quarantined")
+    if plane is not None:
+        print(f"#   multi-host: {len(result.remote_done)} observations "
+              f"finished by other hosts, {len(result.adopted)} adopted "
+              f"here ({', '.join(result.adopted) or 'none'}), "
+              f"{len(result.ceded)} ceded to adopters")
     if result.timeouts:
         print(f"#   watchdog interrupts: {result.timeouts} "
               f"(deadline/stall; see survey.deadline_exceeded / "

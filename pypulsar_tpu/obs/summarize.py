@@ -74,16 +74,31 @@ class TraceSummary:
         # with a `dev` attribute (the gang-lease / mesh paths) — the
         # per-chip utilization view scaling records need
         self.device_busy: Dict[int, List] = {}
+        # host id -> [busy seconds, span count] from the scheduler's
+        # survey.stage.* spans stamped with a `host` attribute (the
+        # multi-host fleet, round 18) — per-HOST utilization, the level
+        # above per-device
+        self.host_busy: Dict[str, List] = {}
+        # host id -> {event tail: count} for the fleet-membership
+        # events (survey.obs_adopted / obs_ceded / host_strike /
+        # stale_write_rejected), keyed by the host they indict
+        self.host_events: Dict[str, Dict[str, int]] = {}
         # stage -> last tune.winner event attrs (config, trials,
         # baseline/best seconds) — the auto-tuning roll-up's payload
         self.tune_winners: Dict[str, dict] = {}
         self._span_stages: Dict[str, List] = {}
         self._t_max = 0.0
+        # per-observation traces (tool survey-obs) ECHO the scheduler's
+        # host-stamped stage spans and adoption events for per-obs
+        # forensics; host attribution must count only the fleet-trace
+        # originals or every number doubles when both are summarized
+        self._obs_trace = False
 
     def feed(self, rec: dict) -> None:
         t = rec.get("type")
         if t == "meta":
             self.meta = rec
+            self._obs_trace = rec.get("tool") == "survey-obs"
         elif t == "span":
             self.n_spans += 1
             if not rec.get("noagg"):
@@ -92,6 +107,16 @@ class TraceSummary:
                 # table would double-count the nested wall time
                 ent = self._span_stages.setdefault(rec.get("name", "?"),
                                                    [0.0, 0])
+                ent[0] += float(rec.get("dur", 0.0))
+                ent[1] += 1
+            host = (rec.get("attrs") or {}).get("host")
+            if host is not None and not self._obs_trace and str(
+                    rec.get("name", "")).startswith("survey.stage."):
+                # host attribution uses EXACTLY the scheduler's
+                # enclosing stage spans (one per stage execution): leaf
+                # kernel spans nest inside them, so counting any other
+                # host-stamped span would double-book
+                ent = self.host_busy.setdefault(str(host), [0.0, 0])
                 ent[0] += float(rec.get("dur", 0.0))
                 ent[1] += 1
             dev = (rec.get("attrs") or {}).get("dev")
@@ -116,6 +141,26 @@ class TraceSummary:
             self.n_events += 1
             name = rec.get("name", "?")
             self.events[name] = self.events.get(name, 0) + 1
+            if not self._obs_trace and name in (
+                    "survey.obs_adopted", "survey.obs_ceded",
+                    "survey.host_strike",
+                    "survey.stale_write_rejected",
+                    "survey.host_registered"):
+                attrs = rec.get("attrs") or {}
+                host = attrs.get("host")
+                if host is not None:
+                    ent = self.host_events.setdefault(str(host), {})
+                    tail = name.split(".", 1)[1]
+                    ent[tail] = ent.get(tail, 0) + 1
+                # an adoption also charges the host it was taken FROM —
+                # the roll-up answers "which node keeps dying" (gated
+                # on the host-stamped fleet-trace flavor like the rest:
+                # the per-obs echo carries adopted_from too)
+                src = attrs.get("adopted_from")
+                if name == "survey.obs_adopted" and src \
+                        and host is not None:
+                    ent = self.host_events.setdefault(str(src), {})
+                    ent["obs_lost"] = ent.get("obs_lost", 0) + 1
             if name in ("tune.winner", "tune.applied"):
                 # keep the winning config per stage (last wins — a
                 # re-search supersedes); `applied` records cache-served
@@ -176,6 +221,14 @@ def combine_summaries(summaries: List[TraceSummary]) -> TraceSummary:
             ent = out.device_busy.setdefault(d, [0.0, 0])
             ent[0] += secs
             ent[1] += count
+        for h, (secs, count) in s.host_busy.items():
+            ent = out.host_busy.setdefault(h, [0.0, 0])
+            ent[0] += secs
+            ent[1] += count
+        for h, evs in s.host_events.items():
+            ent = out.host_events.setdefault(h, {})
+            for k, n in evs.items():
+                ent[k] = ent.get(k, 0) + n
         for k, v in s.counters.items():
             out.counters[k] = out.counters.get(k, 0) + v
         for k, n in s.events.items():
@@ -280,10 +333,34 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
                 # limit evicted this lease from the pool mid-fleet
                 line += "  [QUARANTINED]"
             p(line)
+    # per-host roll-up (round 18): the multi-host fleet's utilization
+    # and membership churn — busy seconds per host from the scheduler's
+    # host-stamped stage spans, adoption/cede/strike counts per host
+    host_ids = sorted(set(s.host_busy) | set(s.host_events))
+    if host_ids:
+        p("#\n# per-host:")
+        for h in host_ids:
+            busy, nsp = s.host_busy.get(h, (0.0, 0))
+            pct = 100.0 * busy / max(wall, 1e-12)
+            line = (f"#   {h:<14s} busy {busy:9.3f}s  {pct:5.1f}%"
+                    f"  ({nsp} stage spans)")
+            evs = "  ".join(
+                f"{k}={n}"
+                for k, n in sorted(s.host_events.get(h, {}).items())
+                if k != "host_registered")
+            p(line + ("  " + evs if evs else ""))
     health_bits = []
     for key, label in (("survey.watchdog_interrupts", "watchdog interrupts"),
                        ("survey.admission_pauses", "admission pauses"),
-                       ("resilience.faults_injected", "injected faults")):
+                       ("resilience.faults_injected", "injected faults"),
+                       # multi-host membership churn from the COUNTERS
+                       # (one bump per adoption/cede at the plane):
+                       # the event tally would double-count the per-obs
+                       # trace's forensic echo
+                       ("survey.adoptions", "obs adoptions"),
+                       ("survey.obs_ceded", "obs cedes"),
+                       ("survey.stale_writes_rejected",
+                        "stale writes rejected")):
         v = s.counters.get(key)
         if v:
             health_bits.append(f"{label}={_fmt_count(v)}")
@@ -291,7 +368,8 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
                        ("survey.stage_stalled", "stalls"),
                        ("mesh.device_strike", "device strikes"),
                        ("mesh.device_quarantined", "devices quarantined"),
-                       ("survey.device_evicted", "lease evictions")):
+                       ("survey.device_evicted", "lease evictions"),
+                       ("survey.host_quarantined", "hosts claim-barred")):
         n = s.events.get(key)
         if n:
             health_bits.append(f"{label}={n}")

@@ -34,6 +34,8 @@ from pypulsar_tpu.tune import knobs
 __all__ = [
     "initialize",
     "is_distributed",
+    "local_rank",
+    "local_count",
     "process_index",
     "process_count",
     "shard_files",
@@ -101,16 +103,58 @@ def process_count() -> int:
     return jax.process_count()
 
 
+def local_rank() -> int:
+    """This process's rank WITHOUT touching jax: the launcher env
+    (``PYPULSAR_TPU_PROCESS_ID``) when a grid is declared, else the jax
+    grid if the distributed runtime is up, else 0. The survey fleet's
+    ``--hosts`` launcher and host-id derivation read this — they must
+    work on backends (CPU jaxlib) whose collectives cannot even
+    initialize."""
+    if int(knobs.env_int(ENV_NPROC)) > 1:
+        return int(knobs.env_int(ENV_PID))
+    if _initialized:
+        return process_index()
+    return 0
+
+
+def local_count() -> int:
+    """Declared process-grid size, env-first (see :func:`local_rank`)."""
+    n = int(knobs.env_int(ENV_NPROC))
+    if n > 1:
+        return n
+    if _initialized:
+        return process_count()
+    return 1
+
+
 def shard_files(files: Sequence[str],
                 index: Optional[int] = None,
                 count: Optional[int] = None) -> List[str]:
     """This host's slice of the observation file list (round-robin, so
     hosts stay balanced when file sizes are similar — the batch axis over
-    DCN)."""
+    DCN).
+
+    Surplus-host contract (round 18): with more processes than files the
+    high ranks get an EMPTY slice — deliberately, and validated here so
+    a mis-wired launcher fails loudly instead of silently double-
+    processing (``index >= count`` would alias another rank's files).
+    An idle shard is not an idle host: the survey fleet's claim loop
+    turns empty-slice hosts into adopters/host-pool workers (they pick
+    up orphaned observations the moment a loaded host dies), which is
+    the behavior the multi-host tests pin."""
     if index is None:
         index = process_index()
     if count is None:
         count = process_count()
+    count = int(count)
+    index = int(index)
+    if count < 1:
+        raise ValueError(f"shard_files count must be >= 1, got {count}")
+    if not 0 <= index < count:
+        raise ValueError(
+            f"shard_files rank {index} outside the {count}-process grid "
+            f"[0, {count}): a wrapped rank would alias another host's "
+            f"file share")
     return list(files[index::count])
 
 

@@ -24,7 +24,17 @@ in the pipeline:
   the bound keeps an UNwatched hang from wedging a test run forever);
 - ``device`` — raise :class:`InjectedDeviceFault`: a chip-indicting
   failure (``resilience.health.is_device_fault``) that feeds the
-  device strike/quarantine accounting.
+  device strike/quarantine accounting;
+- ``netstall`` — the coordination-plane sibling of ``hang`` (round 18):
+  the same deterministic, ``PYPULSAR_TPU_HANG_S``-bounded interruptible
+  stall, armed at the multi-host fleet's plane points
+  (``fleet.heartbeat`` / ``fleet.claim`` / ``fleet.fence`` /
+  ``fleet.token``) to simulate a slow or partitioned shared filesystem
+  without a real network. A netstall parked in the heartbeat renewer
+  past ``PYPULSAR_TPU_HOST_LEASE_S`` makes a host adoptable WHILE IT
+  STILL RUNS — the split-brain scenario the fencing tokens exist for —
+  and it composes with seeded chaos (chaos mode may draw it like any
+  other kind).
 
 Spec grammar (``PYPULSAR_TPU_FAULTS`` env var or the CLIs'
 ``--fault-inject``)::
@@ -88,7 +98,7 @@ ENV_FAULTS = "PYPULSAR_TPU_FAULTS"
 ENV_CHAOS = "PYPULSAR_TPU_CHAOS"
 ENV_HANG_S = "PYPULSAR_TPU_HANG_S"
 
-KINDS = ("oom", "io", "kill", "exit", "hang", "device")
+KINDS = ("oom", "io", "kill", "exit", "hang", "device", "netstall")
 
 # DATA fault kinds (round 13): not exceptions but *mutations* — an armed
 # data fault at a read-time point corrupts the block flowing through it
@@ -99,8 +109,11 @@ KINDS = ("oom", "io", "kill", "exit", "hang", "device")
 DATA_KINDS = ("nanburst", "dropblock", "dcjump", "bitflip", "truncate")
 
 # chaos never draws `exit`: os._exit would kill the very harness that
-# must resume the fleet and assert parity
-CHAOS_KINDS = ("oom", "io", "kill", "hang", "device")
+# must resume the fleet and assert parity. `netstall` IS drawable — at
+# a coordination-plane point it stalls the plane (the slow-coordinator
+# path), anywhere else it degenerates to a bounded hang the watchdog
+# already owns.
+CHAOS_KINDS = ("oom", "io", "kill", "hang", "device", "netstall")
 
 
 class InjectedFault:
@@ -294,9 +307,12 @@ def add_fault_flag(parser):
     parser.add_argument(
         "--fault-inject", default=None, metavar="SPEC",
         help="arm deterministic faults for resilience testing: "
-             "kind:point[:N],... with kinds oom|io|kill|exit|hang|device "
+             "kind:point[:N],... with kinds "
+             "oom|io|kill|exit|hang|device|netstall "
              "(e.g. oom:accel.batch_dispatch:2 injects a device OOM on "
-             "the 2nd batched accel dispatch) or the DATA kinds "
+             "the 2nd batched accel dispatch; "
+             "netstall:fleet.heartbeat:3 stalls the multi-host "
+             "coordination plane) or the DATA kinds "
              "nanburst|dropblock|dcjump|bitflip|truncate, which corrupt "
              "the block at a read-time point (e.g. nanburst:data.block:2) "
              "instead of raising; also via the "
@@ -312,8 +328,9 @@ def add_chaos_flag(parser):
         help="spray seeded probabilistic faults across every registered "
              "fault point: each (point, hit) rolls hash(seed, point, "
              "hit) against RATE and fires a hash-chosen kind (from "
-             "oom|io|kill|hang|device, or the +-separated KINDS "
-             "subset); deterministic per seed, fresh on every retry; "
+             "oom|io|kill|hang|device|netstall, or the +-separated "
+             "KINDS subset); deterministic per seed, fresh on every "
+             "retry; "
              f"also via the {ENV_CHAOS} env var")
     return parser
 
@@ -342,7 +359,11 @@ def _fire(kind: str, point: str, n: int, mode: str) -> None:
         raise InjectedKill(point)
     if kind == "device":
         raise InjectedDeviceFault(point)
-    if kind == "hang":
+    if kind in ("hang", "netstall"):
+        # netstall is semantically a COORDINATION stall (heartbeats /
+        # claims / fences stop making progress) but mechanically the
+        # same bounded interruptible sleep — what differs is where it
+        # is armed, not what it does
         _hang(point)
         return
     os._exit(137)  # "exit": SIGKILL-equivalent, no cleanup at all

@@ -57,6 +57,7 @@ __all__ = [
     "DeviceHealth",
     "HeartbeatEntry",
     "HeartbeatRegistry",
+    "HostHealth",
     "ResourceGuard",
     "StageDeadlineExceeded",
     "StageStalled",
@@ -379,6 +380,70 @@ class DeviceHealth:
             self._strikes.clear()
             self._quarantined.clear()
             self._last_error.clear()
+
+
+class HostHealth:
+    """Host-level strike accounting for the multi-host survey fleet
+    (round 18) — the :class:`DeviceHealth` idea one level up. Ids are
+    host-lease strings; strikes are charged when a host's death is
+    OBSERVED (an adoption: its heartbeat went silent with observations
+    in flight) or when a host CEDES its own observation to a higher
+    fencing token (it was stalled long enough to be presumed dead —
+    flappy, even if alive). Past ``PYPULSAR_TPU_HOST_STRIKES`` (default
+    3) the host is quarantined: the claim loop stops it taking NEW
+    observations, and the verdict renders next to device health in the
+    fleet-health JSON and ``survey --status``. Unlike a device, a
+    quarantined host is never 'evicted' — it simply drains its in-flight
+    work and idles; the fencing tokens already make its stale writes
+    harmless."""
+
+    ENV_HOST_STRIKES = "PYPULSAR_TPU_HOST_STRIKES"
+
+    def __init__(self, limit: Optional[int] = None):
+        if limit is None:
+            limit = int(env_float(self.ENV_HOST_STRIKES, 3))
+        self.limit = max(1, int(limit))
+        self._lock = threading.Lock()
+        self._strikes: Dict[str, int] = {}
+        self._quarantined: set = set()
+        self._last_error: Dict[str, str] = {}
+
+    def strike(self, host: str, kind: str = "adopted",
+               error: str = "") -> bool:
+        """One strike against ``host``; True when this strike NEWLY
+        quarantines it."""
+        host = str(host)
+        with self._lock:
+            n = self._strikes.get(host, 0) + 1
+            self._strikes[host] = n
+            if error:
+                self._last_error[host] = error[:200]
+            newly = n >= self.limit and host not in self._quarantined
+            if newly:
+                self._quarantined.add(host)
+        telemetry.event("survey.host_strike", host=host, kind=kind,
+                        strikes=n)
+        if newly:
+            telemetry.event("survey.host_quarantined", host=host,
+                            strikes=n, kind=kind)
+        return newly
+
+    def is_quarantined(self, host: str) -> bool:
+        with self._lock:
+            return str(host) in self._quarantined
+
+    def strikes(self, host: str) -> int:
+        with self._lock:
+            return self._strikes.get(str(host), 0)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-host view for the fleet-health JSON / ``--status``."""
+        with self._lock:
+            ids = set(self._strikes) | self._quarantined
+            return {h: {"strikes": self._strikes.get(h, 0),
+                        "quarantined": h in self._quarantined,
+                        "last_error": self._last_error.get(h, "")}
+                    for h in sorted(ids)}
 
 
 # -- resource admission ------------------------------------------------------

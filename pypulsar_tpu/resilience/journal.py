@@ -164,13 +164,28 @@ class RunJournal:
     restart: a journal whose header was written by a DIFFERENT tool is
     never restarted — the first write raises instead, so pointing one
     stage's CLI at another stage's manifest cannot silently erase it.
+
+    ``shared=True`` is the multi-host discipline (round 18): the journal
+    may be appended to by SEVERAL processes over its lifetime (one at a
+    time — the survey fleet's fencing tokens serialize ownership, and
+    every append is fenced first), so
+
+    - appends go through an ``"a"``-mode handle (``O_APPEND``: every
+      write lands at the REAL end of file, never at a stale offset a
+      previous owner remembered), each record framed by a leading
+      newline so a predecessor's torn tail glues onto a blank-skipped
+      fragment instead of corrupting the next record, and
+    - the loader skips malformed interior lines instead of declaring
+      the whole file foreign — a fenced-off writer's one torn line must
+      not erase every other host's recorded progress.
     """
 
     def __init__(self, path: str, fingerprint: str = "",
-                 tool: str = "run"):
+                 tool: str = "run", shared: bool = False):
         self.path = path
         self.fingerprint = fingerprint
         self.tool = tool
+        self.shared = bool(shared)
         self._fh = None
         self._records: List[dict] = []
         self._keep_bytes = 0  # byte offset after the last VALID line
@@ -214,9 +229,16 @@ class RunJournal:
                 rec = json.loads(stripped)
             except ValueError:
                 # only the LAST line may legitimately be torn; malformed
-                # interior lines mean the file is not ours — start over
+                # interior lines mean the file is not ours — start over.
+                # A SHARED journal instead skips them: a fenced-off
+                # previous owner's one torn line (each owner's appends
+                # are newline-framed) must not erase the progress every
+                # other host recorded after it.
                 if i == len(lines) - 1:
                     break
+                if self.shared and self._records:
+                    offset += nbytes
+                    continue
                 self._records = []
                 self._keep_bytes = 0
                 return
@@ -318,6 +340,12 @@ class RunJournal:
             self._append({"type": "journal", "version": JOURNAL_VERSION,
                           "tool": self.tool,
                           "fingerprint": self.fingerprint})
+        elif self.shared:
+            # multi-host append discipline: O_APPEND puts every write at
+            # the REAL end of file (a previous owner may have appended
+            # since we loaded); torn tails are NOT truncated — the
+            # newline framing in _append renders them skippable blanks
+            self._fh = open(self.path, "a")
         else:
             # matching run: append — after truncating any torn trailing
             # line so the next record starts on its own line
@@ -328,20 +356,29 @@ class RunJournal:
 
     def _append(self, rec: dict) -> None:
         fh = self._open()
-        fh.write(json.dumps(rec) + "\n")
+        line = json.dumps(rec) + "\n"
+        if self.shared:
+            # leading newline: if the predecessor died mid-append, its
+            # torn fragment ends here as a blank-skipped line instead of
+            # gluing onto this record
+            line = "\n" + line
+        fh.write(line)
         fh.flush()
         os.fsync(fh.fileno())  # a recorded unit must survive the next kill
         self._records.append(rec)
 
-    def done(self, unit: str, outputs: Iterable[str]) -> None:
+    def done(self, unit: str, outputs: Iterable[str], **extra) -> None:
         """Record ``unit`` complete with the current size + sha256 of each
         of its output artifacts (digested NOW, after the atomic writes —
-        the journal describes what is actually on disk)."""
+        the journal describes what is actually on disk). ``extra`` attrs
+        ride along on the record (the survey fleet stamps its fencing
+        ``token``); :meth:`completed` ignores them."""
         outs: List[Dict] = []
         for path in outputs:
             size, digest = file_digest(path)
             outs.append({"path": path, "bytes": size, "sha256": digest})
-        self._append({"type": "done", "unit": unit, "outputs": outs})
+        self._append({"type": "done", "unit": unit, "outputs": outs,
+                      **extra})
         if self._completed_cache is not None:
             self._completed_cache.add(unit)
         telemetry.counter("resilience.journal_units")

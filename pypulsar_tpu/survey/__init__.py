@@ -17,21 +17,30 @@ folding); this package composes them into one fleet-level runtime:
   (``resilience.journal`` underneath): kill -9 mid-fleet and
   ``survey --resume`` replans, skips validated stages, and re-runs only
   torn ones; persistent per-stage failure quarantines the observation
-  instead of aborting the fleet.
+  instead of aborting the fleet;
+- :mod:`.fleet` — the multi-host coordination plane (round 18):
+  fsync'd heartbeat-renewed host leases with monotonic fencing tokens
+  in a shared directory, atomic observation claims, orphan adoption
+  when a host goes silent, and stale-token write rejection so a dead
+  host's late writes are no-ops. ``survey --hosts M`` runs one survey
+  across M host processes on it.
 
 Surfaced as ``python -m pypulsar_tpu.cli survey`` (cli/survey.py).
 """
 
 from pypulsar_tpu.survey.dag import StageExit, SurveyConfig, build_dag
+from pypulsar_tpu.survey.fleet import FleetPlane, StaleLeaseError
 from pypulsar_tpu.survey.scheduler import FleetResult, FleetScheduler
 from pypulsar_tpu.survey.state import Observation, ObsManifest
 
 __all__ = [
+    "FleetPlane",
     "FleetResult",
     "FleetScheduler",
     "Observation",
     "ObsManifest",
     "StageExit",
+    "StaleLeaseError",
     "SurveyConfig",
     "build_dag",
 ]

@@ -70,6 +70,27 @@ Nth hit) and the per-stage ``survey.stage_start.<name>`` /
 ``survey.stage_done.<name>``. ``stage_done`` trips AFTER the artifacts
 are written but BEFORE the manifest records them — the torn-stage window
 a resume must redo.
+
+Multi-host fleet (round 18, ``survey.fleet``): pass a registered
+:class:`~pypulsar_tpu.survey.fleet.FleetPlane` and this scheduler
+becomes ONE HOST of an M-host fleet sharing the artifact directory.
+Observations are then not pre-assigned: a claim/adopt loop takes them
+one at a time through the plane's fenced lease files (at most
+``devices`` in flight per host, so a slow host never hoards the queue),
+opens the per-obs manifest lazily UNDER the held claim (token-stamped,
+fence-checked on every append), and resumes an adopted observation from
+its journal exactly as a single-host ``--resume`` would — validated
+stages skip, torn ones redo, bytes identical. A host whose heartbeat
+goes silent past ``PYPULSAR_TPU_HOST_LEASE_S`` has its in-flight
+observations adopted by survivors; if it was merely stalled (netstall,
+paused VM) and wakes, its next manifest append raises ``StaleLeaseError``
+and the observation is CEDED — not retried, not quarantined: the adopter
+owns it now (host-aware failure policy). Hosts charge
+:class:`~pypulsar_tpu.resilience.health.HostHealth` strikes on the
+deaths they observe (and on their own cedes); a host past the strike
+limit stops claiming new work and drains out. Each host's stage spans
+and fleet events are stamped ``host=<id>`` so ``tlmsum`` renders the
+per-host roll-up.
 """
 
 from __future__ import annotations
@@ -85,6 +106,7 @@ from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.resilience import faultinject
 from pypulsar_tpu.resilience import health as health_mod
 from pypulsar_tpu.resilience.retry import backoff_delay, is_oom_error
+from pypulsar_tpu.survey import fleet as fleet_mod
 from pypulsar_tpu.survey.dag import StageSpec, SurveyConfig, build_dag, stage_names
 from pypulsar_tpu.survey.state import (
     Observation,
@@ -114,7 +136,7 @@ GANG_COST_MIN_FRAC = health_mod.env_float(
 
 _UNSET = object()  # _n_jax_devices cache sentinel (None = no backend)
 
-_PENDING, _QUEUED, _RUNNING, _DONE, _QUARANTINED = range(5)
+_PENDING, _QUEUED, _RUNNING, _DONE, _QUARANTINED, _REMOTE = range(6)
 
 
 @dataclass
@@ -131,6 +153,12 @@ class FleetResult:
     timeouts: int = 0  # watchdog interrupts (deadline + stall)
     evicted_devices: List[int] = field(default_factory=list)
     wall: float = 0.0
+    # multi-host bookkeeping (empty without a plane): observations this
+    # host ADOPTED from a dead/left host, observations it CEDED to a
+    # higher fencing token, and observations other live hosts finished
+    adopted: List[str] = field(default_factory=list)
+    ceded: List[str] = field(default_factory=list)
+    remote_done: List[str] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -175,6 +203,7 @@ class FleetScheduler:
                  max_pending: Optional[float] = None,
                  max_bad_frac: Optional[float] = None,
                  jitter_rng=None,
+                 plane: Optional["fleet_mod.FleetPlane"] = None,
                  verbose: bool = False):
         self.cfg = cfg if cfg is not None else SurveyConfig()
         self.stages = list(stages) if stages is not None \
@@ -257,9 +286,27 @@ class FleetScheduler:
         self._claims: List[Tuple[object, List[int]]] = []
         self._stage_cost: Dict[str, List[float]] = {}  # name -> [s, n]
         self.result = FleetResult()
-        self._manifests: List[ObsManifest] = []
+        self._manifests: List[Optional[ObsManifest]] = []
         self._traces: List[Optional[ObsTrace]] = []
         self._t0 = 0.0
+
+        # multi-host plane (round 18): observations are CLAIMED, not
+        # pre-assigned — the claim/adopt loop owns admission, manifests
+        # open lazily under a held claim, and every manifest append is
+        # fenced by the claim's token
+        self.plane = plane
+        self.host_id = plane.host_id if plane is not None else None
+        self._owned: set = set()            # obs indices we hold claims on
+        self._obs_tokens: Dict[int, int] = {}
+        self._terminal_remote: set = set()  # obs another host finished
+        # at most `devices` claimed-but-unfinished obs per host: a host
+        # must not hoard the queue it cannot drain (the surplus-host /
+        # idle-adopter contract rides on unclaimed obs staying visible)
+        self._claim_ahead = max(1, self.devices)
+        self._host_health = (health_mod.HostHealth()
+                             if plane is not None else None)
+        self._claim_thread: Optional[threading.Thread] = None
+        self._plane_owned_here = False  # register()ed by this run()
 
     # -- manifests ----------------------------------------------------------
 
@@ -281,6 +328,14 @@ class FleetScheduler:
                 pass
 
     def _open_manifests(self) -> None:
+        if self.plane is not None:
+            # multi-host mode: manifests open LAZILY in _claim_obs,
+            # under the held claim — three hosts eagerly opening (and
+            # fresh-scrubbing) every manifest at startup would race each
+            # other over observations none of them own yet
+            self._manifests = [None] * len(self.obs)
+            self._traces = [None] * len(self.obs)
+            return
         snames = stage_names(self.stages)
         for obs in self.obs:
             if not self.resume and os.path.exists(obs.manifest):
@@ -312,35 +367,45 @@ class FleetScheduler:
         quarantine, because the fix is a re-transfer, not a retry.
         Salvageable inputs record their report in the manifest (the
         --status / tlmsum denominators) and DEGRADE: the readers carry
-        the valid prefix through the chain."""
+        the valid prefix through the chain. In multi-host mode each obs
+        is validated at CLAIM time instead (``_claim_obs``): only the
+        claim holder may write the verdict into the manifest."""
+        for i in range(len(self.obs)):
+            self._validate_ingest_one(i)
+
+    def _validate_ingest_one(self, i: int) -> bool:
+        """Ingest-validate one observation; returns False when it was
+        data-quarantined (the claim holder records the verdict)."""
         from pypulsar_tpu.io.errors import DataFormatError
         from pypulsar_tpu.resilience import dataguard
 
-        for i, obs in enumerate(self.obs):
-            try:
-                report = dataguard.validate_input(obs.infile)
-            except DataFormatError as e:
-                self._quarantine_data(i, f"{type(e).__name__}: {e}")
-                continue
-            except Exception as e:  # noqa: BLE001 - see below
-                # an unexpected validation failure (OSError on a flaky
-                # mount, a codec corner the wrappers missed) must not
-                # abort the WHOLE fleet at startup — admit the obs and
-                # let the stage machinery's retry->quarantine own it
-                print(f"# survey: {obs.name}: ingest validation failed "
-                      f"({type(e).__name__}: {e}); admitting unchecked")
-                continue
-            if report is None:
-                continue  # unrecognized/missing: the stage reports it
-            self._manifests[i].note_data_quality(report)
-            bad = float(report.get("bad_frac", 0.0) or 0.0)
-            if bad > self.max_bad_frac:
-                self._quarantine_data(
-                    i, f"data-quality bad_frac {bad:.3f} exceeds "
-                       f"--max-bad-frac {self.max_bad_frac:.3f}")
-            elif bad and self.verbose:
-                print(f"# survey: {obs.name}: degraded input admitted "
-                      f"(bad_frac {bad:.3f} <= {self.max_bad_frac:.3f})")
+        obs = self.obs[i]
+        try:
+            report = dataguard.validate_input(obs.infile)
+        except DataFormatError as e:
+            self._quarantine_data(i, f"{type(e).__name__}: {e}")
+            return False
+        except Exception as e:  # noqa: BLE001 - see below
+            # an unexpected validation failure (OSError on a flaky
+            # mount, a codec corner the wrappers missed) must not
+            # abort the WHOLE fleet at startup — admit the obs and
+            # let the stage machinery's retry->quarantine own it
+            print(f"# survey: {obs.name}: ingest validation failed "
+                  f"({type(e).__name__}: {e}); admitting unchecked")
+            return True
+        if report is None:
+            return True  # unrecognized/missing: the stage reports it
+        self._manifests[i].note_data_quality(report)
+        bad = float(report.get("bad_frac", 0.0) or 0.0)
+        if bad > self.max_bad_frac:
+            self._quarantine_data(
+                i, f"data-quality bad_frac {bad:.3f} exceeds "
+                   f"--max-bad-frac {self.max_bad_frac:.3f}")
+            return False
+        if bad and self.verbose:
+            print(f"# survey: {obs.name}: degraded input admitted "
+                  f"(bad_frac {bad:.3f} <= {self.max_bad_frac:.3f})")
+        return True
 
     def _quarantine_data(self, obs_i: int, error: str) -> None:
         obs = self.obs[obs_i]
@@ -362,6 +427,7 @@ class FleetScheduler:
             self.result.quarantined[obs.name] = {
                 "stage": "ingest", "error": error, "reason": "data"}
             self._cv.notify_all()
+        self._plane_mark_terminal(obs_i, "quarantined")
 
     # -- scheduling core ----------------------------------------------------
 
@@ -384,8 +450,325 @@ class FleetScheduler:
                 self._enqueue_locked(task)
 
     def _finished_locked(self) -> bool:
-        return all(t.state in (_DONE, _QUARANTINED)
+        return all(t.state in (_DONE, _QUARANTINED, _REMOTE)
                    for t in self._tasks.values())
+
+    # -- multi-host claim / adopt loop --------------------------------------
+
+    def _manifest_current(self, obs_i: int) -> bool:
+        """Does the observation's on-disk manifest carry THIS run's
+        fingerprint? A terminal plane claim is only trustworthy
+        together with a matching manifest — a claim left 'done' by a
+        PREVIOUS configuration's fleet must be re-opened and re-run,
+        exactly as a single-host rerun restarts a mismatched manifest
+        (finding: stale terminal claims must not short-circuit a
+        reconfigured rerun)."""
+        obs = self.obs[obs_i]
+        want = fleet_fingerprint(obs, self.cfg,
+                                 stage_names(self.stages))
+        import json
+
+        try:
+            with open(obs.manifest) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    return (rec.get("type") == "journal"
+                            and rec.get("fingerprint") == want)
+        except (OSError, ValueError):
+            pass
+        return False
+
+    def _interrupt_lost_stages_locked(self, obs_i: int) -> None:
+        """Our claim on ``obs_i`` is gone (a survivor adopted it while
+        we were presumed dead): async-interrupt any stage of it still
+        RUNNING with StaleLeaseError so its artifact writes stop within
+        one poll tick — waiting for the stage's next manifest append
+        could leave a zombie writer racing the adopter for minutes."""
+        for entry in self._hb.active():
+            task = entry.payload
+            if getattr(task, "obs_i", None) == obs_i:
+                health_mod.interrupt_thread(entry.thread_id,
+                                            fleet_mod.StaleLeaseError)
+
+    def _plane_mark_terminal(self, obs_i: int, state: str) -> None:
+        """Best-effort claim closeout (done/quarantined). Losing the
+        fence here means a survivor adopted the observation while its
+        last write was in flight — the adopter revalidates and closes
+        it out itself, so the local verdict simply stands down."""
+        if self.plane is None:
+            return
+        token = self._obs_tokens.get(obs_i)
+        if token is None:
+            return
+        try:
+            self.plane.mark_terminal(self.obs[obs_i].name, token, state)
+        except fleet_mod.StaleLeaseError:
+            self._cede_obs(obs_i, already_terminal=True)
+
+    def _claim_obs(self, i: int, token: int, adopted_from=None) -> None:
+        """Take ownership of one claimed observation: open its manifest
+        UNDER the held claim (token-stamped, fenced), scrub stale
+        artifacts only when the manifest is fresh, validate ingest, mark
+        journal-validated stages done (an adopted obs resumes exactly
+        like a single-host ``--resume``) and promote the rest."""
+        obs = self.obs[i]
+        snames = stage_names(self.stages)
+        m = ObsManifest(
+            obs.manifest, fleet_fingerprint(obs, self.cfg, snames),
+            token=token,
+            fence=lambda o=obs.name, t=token: self.plane.fence(o, t))
+        # re-verify the claim BEFORE the destructive scrub: a residual
+        # double-claim loser (both racers passed the settle re-read)
+        # must not delete the winner's freshly written artifacts — the
+        # fence raises here, before anything is touched
+        self.plane.fence(obs.name, token)
+        if m.fresh:
+            self._clean_stale_outputs(obs)
+        m.plan(obs, snames)
+        self._manifests[i] = m
+        if self.telemetry_dir and self._traces[i] is None:
+            # append: an adopted observation's trace keeps the dead
+            # host's recorded spans — exactly the forensics worth having
+            self._traces[i] = ObsTrace(
+                os.path.join(self.telemetry_dir, f"{obs.name}.jsonl"),
+                obs.name, append=True)
+        with self._cv:
+            self._owned.add(i)
+            self._obs_tokens[i] = token
+        if adopted_from:
+            self.result.adopted.append(obs.name)
+            trace = self._traces[i]
+            if trace is not None:
+                # no `host` attr here: the adopter's fleet trace already
+                # carries the host-keyed event (the plane emits it), and
+                # summarizing both traces together must not double-count
+                # the adoption in the per-host roll-up
+                trace.event("survey.obs_adopted",
+                            adopted_from=adopted_from, token=token)
+            if self.verbose:
+                print(f"# survey[{self.host_id}]: ADOPTED {obs.name} "
+                      f"from silent host {adopted_from!r} "
+                      f"(token {token}); resuming from its manifest")
+        if not self._validate_ingest_one(i):
+            return  # data-quarantined under our claim
+        done = m.done_stages()
+        with self._cv:
+            for s in self.stages:
+                task = self._tasks[(i, s.name)]
+                if s.name in done:
+                    task.state = _DONE
+                    self.result.skipped.append((obs.name, s.name))
+                    telemetry.counter("survey.stages_skipped")
+                else:
+                    task.state = _PENDING
+                    task.attempts = 0  # a fresh owner gets fresh retries
+            self._promote_locked(i)
+            if self._finished_locked():
+                self._stop = True
+            self._cv.notify_all()
+
+    def _claim_failed(self, i: int, token: int, e: Exception) -> None:
+        """A claim we won but cannot act on (foreign-tool manifest,
+        unreadable outdir): close it out as quarantined so the fleet
+        sees a verdict instead of a wedge held by a silent owner."""
+        obs = self.obs[i]
+        self._owned.discard(i)
+        self._obs_tokens.pop(i, None)
+        err = f"{type(e).__name__}: {e}"
+        print(f"# survey[{self.host_id}]: cannot open {obs.name}: "
+              f"{err}; quarantining the claim")
+        with self._cv:
+            # terminal HERE: later poll ticks must not re-read our own
+            # quarantined claim as another host's verdict and report it
+            # 'finished remotely'
+            self._terminal_remote.add(i)
+            for s in self.stages:
+                t = self._tasks[(i, s.name)]
+                if t.state != _DONE:
+                    t.state = _QUARANTINED
+            self.result.quarantined[obs.name] = {
+                "stage": "claim", "error": err}
+            self._cv.notify_all()
+        try:
+            self.plane.mark_terminal(obs.name, token, "quarantined")
+        except (fleet_mod.StaleLeaseError, OSError):
+            pass
+
+    def _cede_obs(self, i: int, already_terminal: bool = False) -> None:
+        """This host's claim on obs ``i`` was superseded (a survivor
+        adopted it while we were stalled/presumed dead): stand down
+        WITHOUT retry or quarantine — the adopter owns the observation
+        now, and the fencing token has already made our late writes
+        no-ops. Non-done tasks return to _PENDING so the claim loop can
+        re-adopt if the new owner dies in turn."""
+        obs = self.obs[i]
+        with self._cv:
+            if i not in self._owned:
+                return
+            self._owned.discard(i)
+            self._obs_tokens.pop(i, None)
+            for s in self.stages:
+                t = self._tasks[(i, s.name)]
+                if t.state not in (_DONE, _REMOTE):
+                    t.state = _PENDING
+            self._cv.notify_all()
+        m, self._manifests[i] = self._manifests[i], None
+        if m is not None:
+            m.close()
+        self.result.ceded.append(obs.name)
+        telemetry.counter("survey.obs_ceded")
+        telemetry.event("survey.obs_ceded", host=self.host_id,
+                        obs=obs.name)
+        if self._host_health is not None and not already_terminal:
+            # repeated losses mean THIS host keeps going silent under
+            # work (flaky node): past the strike limit it stops
+            # claiming and drains out
+            self._host_health.strike(self.host_id, kind="ceded",
+                                     error=f"lost {obs.name} to a "
+                                           f"higher fencing token")
+        if self.verbose:
+            print(f"# survey[{self.host_id}]: CEDED {obs.name} to its "
+                  f"adopter (stale fencing token); fleet continues")
+
+    def _plane_poll(self) -> None:
+        """One claim-loop tick: claim unowned observations (orphans
+        first — adoption is the liveness path), observe terminal states
+        other hosts recorded, and stop the fleet when every observation
+        is globally terminal."""
+        hosts = self.plane.hosts()
+        claims = self.plane.claims()
+        with self._lock:
+            owned_open = sum(
+                1 for i in self._owned
+                if any(self._tasks[(i, s.name)].state
+                       not in (_DONE, _QUARANTINED, _REMOTE)
+                       for s in self.stages))
+            owned_now = set(self._owned)
+        # zombie self-check FIRST: if any claim we think we hold now
+        # carries someone else's token, we were adopted away (netstall,
+        # long GC, partition) — interrupt the running stage NOW instead
+        # of letting it race the adopter's writes until its next
+        # manifest append
+        for i in owned_now:
+            tok = self._obs_tokens.get(i)
+            c = claims.get(self.obs[i].name)
+            if tok is not None and (c is None
+                                    or c.get("token") != tok):
+                with self._lock:
+                    self._interrupt_lost_stages_locked(i)
+        barred = (self._host_health is not None
+                  and self._host_health.is_quarantined(self.host_id))
+        for i, obs in enumerate(self.obs):
+            with self._lock:
+                if i in self._owned or i in self._terminal_remote:
+                    continue
+            c = claims.get(obs.name)
+            state = c.get("state", "running") if c else None
+            holder = str(c.get("host", "")) if c else None
+            if c is not None and state in ("done", "quarantined"):
+                reopen = not self._manifest_current(i)
+                if not reopen and self.resume and state == "done":
+                    # an EXPLICIT --resume in plane mode re-validates a
+                    # done claim's artifacts (size+sha256, the single-
+                    # host resume contract): a corrupted artifact
+                    # re-opens the claim instead of being trusted
+                    try:
+                        m = ObsManifest(self.obs[i].manifest,
+                                        fleet_fingerprint(
+                                            self.obs[i], self.cfg,
+                                            stage_names(self.stages)))
+                        done = m.done_stages()
+                        m.close()
+                        reopen = any(s.name not in done
+                                     for s in self.stages)
+                    except Exception:  # noqa: BLE001 - unreadable
+                        reopen = True  # manifest: redo, never trust
+                if reopen:
+                    # terminal under a DIFFERENT configuration (or the
+                    # manifest is gone / fails validation): the verdict
+                    # does not apply to THIS run — re-open the claim
+                    # and re-run, the plane-mode form of the restart-
+                    # on-fingerprint-mismatch contract
+                    if not barred and owned_open < self._claim_ahead:
+                        token = self.plane.claim(obs.name,
+                                                 allow_terminal=True)
+                        if token is not None:
+                            try:
+                                self._claim_obs(i, token)
+                            except fleet_mod.StaleLeaseError:
+                                continue
+                            except Exception as e:  # noqa: BLE001 - same
+                                # contract as the claim handler below
+                                self._claim_failed(i, token, e)
+                                continue
+                            owned_open += 1
+                    continue
+                # another host closed it out: record the remote verdict
+                # and mark the tasks terminal locally
+                with self._cv:
+                    self._terminal_remote.add(i)
+                    for s in self.stages:
+                        t = self._tasks[(i, s.name)]
+                        if t.state != _DONE:
+                            t.state = _REMOTE
+                    if state == "quarantined" \
+                            and obs.name not in self.result.quarantined:
+                        self.result.quarantined[obs.name] = {
+                            "stage": "?", "error":
+                                f"quarantined by host {holder!r}",
+                            "host": holder}
+                    self.result.remote_done.append(obs.name)
+                    self._cv.notify_all()
+                continue
+            if barred or owned_open >= self._claim_ahead:
+                continue
+            holder_live = (c is not None and holder != self.host_id
+                           and self.plane.is_live(hosts.get(holder)))
+            if holder_live:
+                continue  # a live host is on it
+            adopted_from = (holder if c is not None
+                            and holder != self.host_id else None)
+            token = self.plane.claim(obs.name)
+            if token is None:
+                continue  # lost the race (or it went terminal meanwhile)
+            if adopted_from and self._host_health is not None:
+                # charge the death we just observed: the account the
+                # fleet-health JSON and --status render per host
+                self._host_health.strike(
+                    adopted_from, kind="adopted",
+                    error=f"{obs.name} orphaned (heartbeat silent)")
+            try:
+                self._claim_obs(i, token, adopted_from=adopted_from)
+            except fleet_mod.StaleLeaseError:
+                continue  # out-adopted during setup: theirs now
+            except Exception as e:  # noqa: BLE001 - a claim we cannot
+                # act on must not be held forever: _claim_failed closes
+                # it out as quarantined (a verdict, not a wedge)
+                self._claim_failed(i, token, e)
+                continue
+            owned_open += 1
+        with self._cv:
+            if self._finished_locked():
+                self._stop = True
+                self._cv.notify_all()
+
+    def _plane_loop(self) -> None:
+        """The claim/adopt daemon: poll fast enough that adoption lands
+        within ~one heartbeat of the lease expiring, slow enough that M
+        idle hosts do not hammer the shared directory."""
+        poll = max(0.05, min(self.plane.heartbeat_s, 0.5))
+        while not self._stop:
+            try:
+                self._plane_poll()
+            except Exception as e:  # noqa: BLE001 - the claim loop must
+                # outlive transient plane IO errors (shared-FS hiccup):
+                # a dead claim loop would strand every unclaimed obs
+                telemetry.event("survey.claim_loop_error",
+                                error=type(e).__name__)
+            time.sleep(poll)
 
     # -- fleet health -------------------------------------------------------
 
@@ -517,13 +900,19 @@ class FleetScheduler:
         if self._health_dir is None:
             return
         snap = self._health.snapshot()
-        if not snap and not self.result.evicted_devices:
+        hosts = (self._host_health.snapshot()
+                 if self._host_health is not None else {})
+        if not snap and not self.result.evicted_devices and not hosts:
             return
-        write_fleet_health(self._health_dir, {
+        payload = {
             "pool": self.devices,
             "strike_limit": self._health.limit,
             "devices": {str(i): v for i, v in snap.items()},
-        })
+        }
+        if hosts:
+            payload["hosts"] = hosts
+            payload["host_strike_limit"] = self._host_health.limit
+        write_fleet_health(self._health_dir, payload)
 
     def _wait_admission(self) -> None:
         """Block until the resource gate admits new work (or the fleet
@@ -557,6 +946,8 @@ class FleetScheduler:
         obs = self.obs[task.obs_i]
         stage = task.stage
         span_attrs = {"obs": obs.name}
+        if self.host_id is not None:
+            span_attrs["host"] = self.host_id
         if dev_ids is not None:
             span_attrs["dev"] = dev_ids
         if gang > 1:
@@ -591,6 +982,8 @@ class FleetScheduler:
         trace = self._traces[task.obs_i]
         if trace is not None:
             tr_attrs = {"outputs": len(outputs)}
+            if self.host_id is not None:
+                tr_attrs["host"] = self.host_id
             if dev_ids is not None:
                 tr_attrs["dev"] = dev_ids
             if gang > 1:
@@ -612,22 +1005,43 @@ class FleetScheduler:
                 ent[1] += 1
             self.result.ran.append((obs.name, stage.name))
             self._promote_locked(task.obs_i)
+            obs_complete = all(
+                self._tasks[(task.obs_i, s.name)].state == _DONE
+                for s in self.stages)
             if self._finished_locked():
                 self._stop = True
             self._cv.notify_all()
+        if obs_complete:
+            # close the claim out so other hosts read this observation
+            # terminal instead of waiting on our heartbeat forever
+            self._plane_mark_terminal(task.obs_i, "done")
 
     def _requeue_retry(self, task: _Task) -> None:
         """Timer callback re-enqueuing a backing-off task — unless its
-        observation was quarantined (or the fleet stopped) while it
-        waited: a retry must not resurrect a cancelled stage."""
+        observation was quarantined, ceded to an adopter, or the fleet
+        stopped while it waited: a retry must not resurrect a stage
+        this host no longer owns."""
         with self._cv:
-            if not self._stop and task.state != _QUARANTINED:
-                self._enqueue_locked(task)
-                self._cv.notify_all()
+            if self._stop or task.state in (_QUARANTINED, _REMOTE):
+                return
+            if self.plane is not None and task.obs_i not in self._owned:
+                return
+            self._enqueue_locked(task)
+            self._cv.notify_all()
 
     def _handle_failure(self, task: _Task, err: Exception) -> None:
         obs = self.obs[task.obs_i]
         stage = task.stage
+        if self.plane is not None \
+                and isinstance(err, fleet_mod.StaleLeaseError):
+            # host-aware failure policy: a stale fencing token is not a
+            # stage failure — a survivor adopted the observation while
+            # this host was stalled/presumed dead. Cede it: no retry
+            # (the adopter is already running it), no quarantine (the
+            # observation is healthy), no device strike (the chip did
+            # nothing wrong).
+            self._cede_obs(task.obs_i)
+            return
         with self._lock:
             if task.state == _QUARANTINED:
                 # another stage of this observation quarantined it while
@@ -670,8 +1084,14 @@ class FleetScheduler:
             # the attempt + error excerpt land in the manifest so
             # --status (any process, any time) can show WHY a stage is
             # retrying, not just that it is slow
-            self._manifests[task.obs_i].note_retry(
-                stage.name, task.attempts, error)
+            try:
+                self._manifests[task.obs_i].note_retry(
+                    stage.name, task.attempts, error)
+            except fleet_mod.StaleLeaseError:
+                # adopted away between the failure and its verdict:
+                # the retry belongs to the new owner
+                self._cede_obs(task.obs_i)
+                return
             telemetry.event("survey.stage_retry", obs=obs.name,
                             stage=stage.name, attempt=task.attempts)
             if self.verbose:
@@ -689,7 +1109,12 @@ class FleetScheduler:
         # bounded retries exhausted: quarantine the OBSERVATION — the
         # fleet continues, the verdict is recorded, and a later resume
         # may try again (the operator explicitly asked)
-        self._manifests[task.obs_i].quarantine(stage.name, error)
+        try:
+            self._manifests[task.obs_i].quarantine(stage.name, error)
+        except fleet_mod.StaleLeaseError:
+            # the adopter owns the observation (and its verdicts) now
+            self._cede_obs(task.obs_i)
+            return
         telemetry.event("survey.quarantine", obs=obs.name,
                         stage=stage.name, error=type(err).__name__)
         trace = self._traces[task.obs_i]
@@ -707,6 +1132,7 @@ class FleetScheduler:
             if self._finished_locked():
                 self._stop = True
             self._cv.notify_all()
+        self._plane_mark_terminal(task.obs_i, "quarantined")
 
     # -- gang leases --------------------------------------------------------
 
@@ -929,8 +1355,11 @@ class FleetScheduler:
         with self._lock:
             if self._stop and self._fatal is not None:
                 return  # fleet is unwinding: drop queued work
-            if task.state == _QUARANTINED:
-                return  # cancelled while queued
+            if task.state in (_QUARANTINED, _REMOTE):
+                return  # cancelled / finished remotely while queued
+            if self.plane is not None \
+                    and task.obs_i not in self._owned:
+                return  # ceded while queued: the adopter runs it
             task.state = _RUNNING
         try:
             if device_lane:
@@ -955,7 +1384,12 @@ class FleetScheduler:
         kill, KeyboardInterrupt) after the in-flight stages settle."""
         self._t0 = time.perf_counter()
         self._open_manifests()
-        self._validate_ingest()
+        if self.plane is not None:
+            if self.plane.token is None:
+                self.plane.register()
+                self._plane_owned_here = True
+        else:
+            self._validate_ingest()
         if self._needs_watchdog():
             # heartbeats ride the telemetry the stages already record;
             # the hook is process-global, so it is installed only for
@@ -965,19 +1399,30 @@ class FleetScheduler:
                                                  self._on_stage_expired)
             self._watchdog.start()
         try:
-            with self._cv:
-                for i in range(len(self.obs)):
-                    done = (self._manifests[i].done_stages()
-                            if self.resume else set())
-                    for s in self.stages:
-                        if s.name in done:
-                            self._tasks[(i, s.name)].state = _DONE
-                            self.result.skipped.append(
-                                (self.obs[i].name, s.name))
-                            telemetry.counter("survey.stages_skipped")
-                    self._promote_locked(i)
-                if self._finished_locked():
-                    self._stop = True
+            if self.plane is not None:
+                # multi-host: nothing is pre-assigned — the claim loop
+                # admits observations as it wins their leases (and
+                # adopts orphans as hosts die); an initial tick before
+                # the workers start gives them something to chew on
+                self._plane_poll()
+                self._claim_thread = threading.Thread(
+                    target=self._plane_loop,
+                    name=f"survey-claims-{self.host_id}", daemon=True)
+                self._claim_thread.start()
+            else:
+                with self._cv:
+                    for i in range(len(self.obs)):
+                        done = (self._manifests[i].done_stages()
+                                if self.resume else set())
+                        for s in self.stages:
+                            if s.name in done:
+                                self._tasks[(i, s.name)].state = _DONE
+                                self.result.skipped.append(
+                                    (self.obs[i].name, s.name))
+                                telemetry.counter("survey.stages_skipped")
+                        self._promote_locked(i)
+                    if self._finished_locked():
+                        self._stop = True
             workers = (
                 [threading.Thread(target=self._worker,
                                   args=(self._device_q, True),
@@ -1009,13 +1454,24 @@ class FleetScheduler:
                 self._watchdog.stop()
                 self._watchdog = None
                 telemetry.remove_activity_hook(self._hb.beat_thread)
+            if self._claim_thread is not None:
+                self._claim_thread.join(timeout=5.0)
+                self._claim_thread = None
             self._write_health_json()
             self.result.wall = time.perf_counter() - self._t0
             for m in self._manifests:
-                m.close()
+                if m is not None:
+                    m.close()
             for t in self._traces:
                 if t is not None:
                     t.close()
+            if self.plane is not None and self._plane_owned_here:
+                # retire the host lease (LEFT, not DEAD). An InjectedKill
+                # unwinds through here too — its lease reads LEFT with
+                # claims still running, which is equally adoptable; only
+                # a true SIGKILL/os._exit skips this and leaves the
+                # lease to go silent (DEAD after the lease bound)
+                self.plane.close()
         if self._fatal is not None:
             raise self._fatal
         return self.result

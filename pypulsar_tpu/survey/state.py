@@ -127,21 +127,46 @@ def fleet_fingerprint(obs: Observation, cfg, stage_names: Sequence[str]) -> str:
 class ObsManifest:
     """One observation's stage journal (see module docstring). Unit ids
     are ``stage:<name>``; free-form notes record the plan (for --status)
-    and quarantine verdicts."""
+    and quarantine verdicts.
 
-    def __init__(self, path: str, fingerprint: str):
-        self._journal = RunJournal(path, fingerprint, tool="survey")
+    Multi-host fleets (round 18) open the manifest with a fencing
+    ``token`` and a ``fence`` callable: every append consults the fence
+    FIRST (it raises ``survey.fleet.StaleLeaseError`` when a survivor
+    adopted the observation — the dead host's late write becomes a
+    no-op), records carry the token, and the underlying journal runs in
+    its shared/append-only discipline so successive owners append to one
+    file without stepping on each other's offsets."""
+
+    def __init__(self, path: str, fingerprint: str,
+                 token: Optional[int] = None, fence=None):
+        # ALWAYS the shared/append-tolerant journal discipline, not just
+        # under a plane: a single-host `--resume` must be able to read a
+        # manifest a multi-host fleet wrote (interior torn line from a
+        # SIGKILL'd owner, later owners appended past it) — the reader
+        # cannot know who wrote the file
+        self._journal = RunJournal(path, fingerprint, tool="survey",
+                                   shared=True)
         self._lock = threading.Lock()
         self.path = path
+        self.token = token
+        self._fence = fence
         # captured BEFORE any write: a fresh manifest (new file, or a
         # restart after a parameter/input change) means the chain starts
         # over and stale artifacts must be scrubbed, not globbed up
         self.fresh = self._journal.is_fresh()
 
+    def _check_fence(self) -> None:
+        """The write gate: a stale fencing token must be rejected BEFORE
+        the append touches the file (outside the manifest lock — the
+        fence reads the claim file and may raise)."""
+        if self._fence is not None:
+            self._fence()
+
     def plan(self, obs: Observation, stage_names: Sequence[str]) -> None:
         """Record the planned stage list once per fresh manifest — the
         denominator the --status table renders without re-deriving the
         DAG (a resumed manifest already carries it)."""
+        self._check_fence()
         with self._lock:
             if not self._journal.notes(event="plan"):
                 self._journal.note(event="plan", obs=obs.name,
@@ -155,24 +180,30 @@ class ObsManifest:
         return {u.split(":", 1)[1] for u in units if u.startswith("stage:")}
 
     def mark_done(self, stage: str, outputs: Iterable[str]) -> None:
+        self._check_fence()
+        extra = {"token": self.token} if self.token is not None else {}
         with self._lock:
-            self._journal.done(f"stage:{stage}", outputs)
+            self._journal.done(f"stage:{stage}", outputs, **extra)
 
     def quarantine(self, stage: str, error: str,
                    reason: Optional[str] = None) -> None:
         """``reason="data"`` marks an INPUT verdict (ingest validation,
         --max-bad-frac) as distinct from a runtime quarantine — the
         operator's fix is a re-transfer, not a retry."""
+        self._check_fence()
         with self._lock:
             rec = {"event": "quarantine", "stage": stage, "error": error}
             if reason:
                 rec["reason"] = reason
+            if self.token is not None:
+                rec["token"] = self.token
             self._journal.note(**rec)
 
     def note_data_quality(self, report: Dict) -> None:
         """Record the ingest data-quality report once per manifest (the
         denominators --status and the tlmsum roll-up render: fraction
         masked/missing, salvaged span, fault kinds seen)."""
+        self._check_fence()
         with self._lock:
             if not self._journal.notes(event="data_quality"):
                 self._journal.note(event="data_quality", **report)
@@ -182,9 +213,13 @@ class ObsManifest:
         provoked it) so ``--status`` can show WHY a stage is retrying,
         not just that it is slow. Watchdog interrupts land here too —
         a deadline/stall verdict reads like any other stage error."""
+        self._check_fence()
         with self._lock:
-            self._journal.note(event="retry", stage=stage,
-                               attempt=int(attempt), error=error)
+            rec = {"event": "retry", "stage": stage,
+                   "attempt": int(attempt), "error": error}
+            if self.token is not None:
+                rec["token"] = self.token
+            self._journal.note(**rec)
 
     def close(self) -> None:
         self._journal.close()
@@ -272,11 +307,16 @@ def _excerpt(error: str, limit: int = ERROR_EXCERPT_LEN) -> str:
 
 
 def format_status(rows: Sequence[Dict],
-                  health: Optional[Dict] = None) -> str:
+                  health: Optional[Dict] = None,
+                  plane: Optional[Dict] = None) -> str:
     """Render the --status progress table (plus, with a fleet-health
-    mirror, the per-device strike/quarantine block under it)."""
+    mirror, the per-device strike/quarantine block, and, with a
+    multi-host plane snapshot from ``fleet.read_plane_status``, the
+    host-liveness block and a per-observation owner column)."""
+    claims = (plane or {}).get("claims", {})
+    host_col = bool(plane)
     lines = [f"# {'observation':<20s} {'progress':<10s} {'retries':<8s} "
-             f"state"]
+             + (f"{'host':<12s} " if host_col else "") + "state"]
     for r in rows:
         total = len(r["stages"]) or "?"
         done = r["done"]
@@ -315,8 +355,37 @@ def format_status(rows: Sequence[Dict],
                             f"samples")
             if bits:
                 state += " [data: " + ", ".join(bits) + "]"
+        owner = ""
+        if host_col:
+            c = claims.get(r["obs"])
+            owner = f"{c.get('host', '?')}" if c else "-"
+            if c and c.get("adopted_from"):
+                state += (f" [adopted from {c['adopted_from']} "
+                          f"(token {c.get('token', '?')})]")
         lines.append(f"# {r['obs']:<20s} {prog:<10s} {n_retries:<8d} "
-                     f"{state}")
+                     + (f"{owner:<12s} " if host_col else "") + state)
+    if plane and plane.get("hosts"):
+        hosts = plane["hosts"]
+        lines.append(f"# hosts (lease bound "
+                     f"{plane.get('lease_s', '?')}s):")
+        owned: Dict[str, List[str]] = {}
+        for obs_name, c in claims.items():
+            if c.get("state", "running") == "running":
+                owned.setdefault(str(c.get("host", "?")),
+                                 []).append(obs_name)
+        for hid in sorted(hosts):
+            h = hosts[hid]
+            if h.get("left"):
+                verdict = "LEFT"
+            elif h.get("live"):
+                verdict = "LIVE"
+            else:
+                verdict = "DEAD"
+            own = ",".join(sorted(owned.get(hid, []))) or "-"
+            lines.append(f"#   {hid:<18s} token {h.get('token', '?'):<6} "
+                         f"{verdict:<5s} beat "
+                         f"{h.get('beat_age_s', '?')}s ago  "
+                         f"owns: {own}")
     if health:
         devices = health.get("devices", {})
         if devices:
@@ -331,6 +400,18 @@ def format_status(rows: Sequence[Dict],
                 lines.append(f"#   device {dev_id}: "
                              f"{d.get('strikes', 0)} strike(s), "
                              f"{verdict}{tail}")
+        host_strikes = health.get("hosts", {})
+        if host_strikes:
+            lines.append(f"# host strikes (claim bar at "
+                         f"{health.get('host_strike_limit', '?')}):")
+            for hid in sorted(host_strikes):
+                h = host_strikes[hid]
+                verdict = ("BARRED from new claims"
+                           if h.get("quarantined") else "ok")
+                err = h.get("last_error", "")
+                tail = f" ({_excerpt(err)})" if err else ""
+                lines.append(f"#   {hid}: {h.get('strikes', 0)} "
+                             f"strike(s), {verdict}{tail}")
     return "\n".join(lines)
 
 

@@ -407,6 +407,26 @@ env_knob("PYPULSAR_TPU_NUM_PROCESSES", "int", 1, "multihost",
 env_knob("PYPULSAR_TPU_PROCESS_ID", "int", 0, "multihost",
          invariant=False,
          help="multi-host process rank")
+env_knob("PYPULSAR_TPU_HOST_LEASE_S", "float", 10.0, "multihost",
+         invariant=False,
+         help="survey-fleet host-lease bound: a host whose heartbeat "
+              "is silent this long is DEAD and its in-flight "
+              "observations become adoptable")
+env_knob("PYPULSAR_TPU_HOST_HEARTBEAT_S", "float", 0.0, "multihost",
+         invariant=False,
+         help="host-lease renewal cadence (0 = lease bound / 4)")
+env_knob("PYPULSAR_TPU_HOST_SETTLE_S", "float", 0.2, "multihost",
+         invariant=False,
+         help="claim settle window: write -> re-read delay resolving "
+              "the common double-adoption race before stage work starts")
+env_knob("PYPULSAR_TPU_HOST_ID", "str", None, "multihost",
+         invariant=False,
+         help="survey-fleet host identity override (the --hosts "
+              "launcher sets one per child)")
+env_knob("PYPULSAR_TPU_HOST_STRIKES", "int", 3, "multihost",
+         invariant=False,
+         help="adoption/cede strikes before a host stops claiming new "
+              "observations")
 
 # -- misc data --------------------------------------------------------------
 env_knob("PYPULSAR_TPU_HASLAM", "str", "", "data",

@@ -87,6 +87,40 @@ def test_shard_files_round_robin():
     assert sorted(sum(all_shards, [])) == sorted(files)
 
 
+def test_shard_files_surplus_hosts_empty_not_aliased():
+    """The round-18 idle-host contract: with more processes than files
+    the surplus ranks get clean EMPTY slices (they join the survey
+    claim pool as adopters — tests/test_multihost.py pins that side),
+    the partition still covers every file exactly once, and an
+    out-of-grid rank is a loud error rather than a silent alias of
+    another host's share."""
+    files = [f"f{i}" for i in range(3)]
+    shards = [distributed.shard_files(files, index=i, count=8)
+              for i in range(8)]
+    assert [s for s in shards[3:] if s] == []  # surplus ranks idle
+    assert sorted(sum(shards, [])) == sorted(files)  # no file dropped
+    assert all(len(s) <= 1 for s in shards)  # and none double-assigned
+    with pytest.raises(ValueError):
+        distributed.shard_files(files, index=8, count=8)
+    with pytest.raises(ValueError):
+        distributed.shard_files(files, index=-1, count=8)
+    with pytest.raises(ValueError):
+        distributed.shard_files(files, index=0, count=0)
+
+
+def test_local_rank_env_first(monkeypatch):
+    """local_rank/local_count read the launcher env grid without
+    touching jax — the path the survey --hosts children derive their
+    host ids from."""
+    monkeypatch.setenv(distributed.ENV_NPROC, "4")
+    monkeypatch.setenv(distributed.ENV_PID, "2")
+    assert distributed.local_count() == 4
+    assert distributed.local_rank() == 2
+    monkeypatch.setenv(distributed.ENV_NPROC, "1")
+    assert distributed.local_count() == 1
+    assert distributed.local_rank() == 0
+
+
 def test_initialize_noop_without_coordinator(monkeypatch):
     monkeypatch.delenv(distributed.ENV_COORD, raising=False)
     assert distributed.initialize() is False

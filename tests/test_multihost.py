@@ -1,0 +1,731 @@
+"""Multi-host survey fleet tests (round 18): the coordination plane's
+safety contracts (monotonic fencing tokens, stale-token write rejection,
+double-adoption resolving to one winner), the scheduler's claim/adopt
+loop (hosts split a fleet without duplicating work, orphans are adopted
+and resume byte-exactly, a netstalled host cedes to its adopter), and
+the M-process CLI integration (a host SIGKILL'd mid-stage loses its
+observation to a survivor and a final resume re-runs nothing).
+
+In-process tests drive several FleetScheduler instances — each with its
+own FleetPlane handle — over one shared directory with stub stage DAGs:
+the coordination machinery is identical to the M-process case (the
+plane is plain files), only the failure *injection* differs. The real
+SIGKILL/process-death paths run as subprocess integration tests behind
+a cached spawn-capability probe (the same pattern as
+test_distributed._require_cpu_collectives, which gates on jax
+COLLECTIVES — deliberately not reused here: the plane needs no
+collectives, and this container's jaxlib fails that probe while
+spawning plain subprocesses just fine)."""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.resilience.health import HostHealth
+from pypulsar_tpu.survey.dag import StageSpec, SurveyConfig
+from pypulsar_tpu.survey.fleet import (
+    FleetPlane,
+    StaleLeaseError,
+    read_plane_status,
+)
+from pypulsar_tpu.survey.scheduler import FleetScheduler
+from pypulsar_tpu.survey.state import (
+    ObsManifest,
+    Observation,
+    format_status,
+    status_rows,
+)
+
+_SPAWN_PROBE: list = []  # cached (ok, detail), once per session
+
+
+def _require_spawn():
+    """Capability gate for the subprocess integration tests: can this
+    container spawn a python child that imports the package? (Spawn-less
+    sandboxes skip cleanly instead of failing red.)"""
+    if not _SPAWN_PROBE:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (repo + os.pathsep
+                             + env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+        env["JAX_PLATFORMS"] = "cpu"
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import pypulsar_tpu; print('OK')"],
+                env=env, capture_output=True, text=True, timeout=120)
+            _SPAWN_PROBE.append(
+                (proc.returncode == 0 and "OK" in proc.stdout,
+                 proc.stderr.strip().splitlines()[-1][-200:]
+                 if proc.stderr.strip() else ""))
+        except (OSError, subprocess.TimeoutExpired) as e:
+            _SPAWN_PROBE.append((False, f"{type(e).__name__}: {e}"))
+    ok, detail = _SPAWN_PROBE[0]
+    if not ok:
+        pytest.skip("environment capability: cannot spawn python "
+                    f"subprocesses ({detail})")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+def _plane(td, host, lease_s=1.0, settle_s=0.02, heartbeat_s=None):
+    return FleetPlane(str(td), host_id=host, lease_s=lease_s,
+                      settle_s=settle_s, heartbeat_s=heartbeat_s)
+
+
+def _mk_stage(name, deps=(), slow_s=0.0, device=None):
+    def run(o, c, _n=name, _s=slow_s):
+        if _s:
+            time.sleep(_s)
+        with open(f"{o.outbase}.{_n}.out", "w") as f:
+            f.write(_n + o.name)
+        return 0
+
+    return StageSpec(name, "stub", device if device is not None
+                     else name.startswith("dev"), tuple(deps),
+                     lambda o, c: [],
+                     lambda o, c, n=name: [f"{o.outbase}.{n}.out"],
+                     run=run)
+
+
+def _mk_obs(td, n):
+    obs = []
+    for i in range(n):
+        raw = os.path.join(str(td), f"o{i}.raw")
+        with open(raw, "wb") as f:
+            f.write(b"x" * 64)
+        obs.append(Observation(f"o{i}", raw, os.path.join(str(td),
+                                                          f"o{i}")))
+    return obs
+
+
+# ---------------------------------------------------------------------------
+# plane primitives: tokens, fencing, adoption, double-adoption
+# ---------------------------------------------------------------------------
+
+
+def test_fencing_tokens_strictly_monotonic_across_hosts(tmp_path):
+    """Every allocation — from any host, interleaved however — yields a
+    strictly larger integer: the property the whole fencing design
+    rests on (an adopter ALWAYS outranks the host it adopted from)."""
+    pa, pb = _plane(tmp_path, "hA"), _plane(tmp_path, "hB")
+    got = []
+    lock = threading.Lock()
+
+    def grab(p, k):
+        for _ in range(k):
+            t = p.next_token()
+            with lock:
+                got.append(t)
+
+    ts = [threading.Thread(target=grab, args=(p, 10))
+          for p in (pa, pb, pa, pb)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(got) == 40
+    assert len(set(got)) == 40, "token collision across racing hosts"
+
+
+def test_stale_fencing_token_write_rejected(tmp_path):
+    """The acceptance bullet verbatim: after adoption, the dead host's
+    manifest append is a no-op — ObsManifest.mark_done raises
+    StaleLeaseError BEFORE touching the journal file."""
+    pa = _plane(tmp_path, "hA", settle_s=0.0)
+    pb = _plane(tmp_path, "hB", settle_s=0.0)
+    pa.register()
+    pb.register()
+    t_a = pa.claim("o0")
+    assert t_a is not None
+    # hA goes silent (stop renewing WITHOUT marking left: a death, not
+    # an exit), hB adopts past the lease bound
+    pa._stop.set()
+    pa._renew.join()
+    time.sleep(1.2)
+    t_b = pb.claim("o0")
+    assert t_b is not None and t_b > t_a
+    out = str(tmp_path / "art.out")
+    with open(out, "w") as f:
+        f.write("bytes")
+    m = ObsManifest(str(tmp_path / "o0.survey.jsonl"), "fp",
+                    token=t_a, fence=lambda: pa.fence("o0", t_a))
+    size_before = os.path.getsize(m.path) if os.path.exists(m.path) else 0
+    with pytest.raises(StaleLeaseError):
+        m.mark_done("s1", [out])
+    size_after = os.path.getsize(m.path) if os.path.exists(m.path) else 0
+    assert size_after == size_before, "stale write touched the manifest"
+    m.close()
+    # the adopter's fenced write goes through and carries ITS token
+    m2 = ObsManifest(str(tmp_path / "o0.survey.jsonl"), "fp",
+                     token=t_b, fence=lambda: pb.fence("o0", t_b))
+    m2.mark_done("s1", [out])
+    assert m2.done_stages() == {"s1"}
+    m2.close()
+    recs = [json.loads(ln) for ln in
+            open(str(tmp_path / "o0.survey.jsonl")) if ln.strip()]
+    assert [r.get("token") for r in recs if r.get("type") == "done"] \
+        == [t_b]
+    pb.close()
+
+
+def test_double_adoption_race_resolves_to_one_winner(tmp_path):
+    """Two survivors adopt the same orphan concurrently: os.replace
+    leaves exactly one claim, the settle re-read kicks the loser out,
+    and — for the residual race — at most one of the two tokens can
+    ever pass a fence afterwards."""
+    dead = _plane(tmp_path, "dead", settle_s=0.0)
+    dead.register()
+    assert dead.claim("o0") is not None
+    dead._stop.set()
+    dead._renew.join()
+    time.sleep(1.2)  # past the 1 s lease: o0 is an orphan
+
+    tokens = {}
+    barrier = threading.Barrier(2)
+
+    def adopt(host):
+        p = _plane(tmp_path, host, settle_s=0.1)
+        p.register()
+        barrier.wait()
+        tokens[host] = (p, p.claim("o0"))
+
+    ts = [threading.Thread(target=adopt, args=(h,)) for h in ("hA", "hB")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    survivors = []
+    for host, (p, tok) in tokens.items():
+        if tok is None:
+            continue
+        try:
+            p.fence("o0", tok)
+            survivors.append(host)
+        except StaleLeaseError:
+            pass
+    assert len(survivors) == 1, (
+        f"double adoption must resolve to ONE winner, got {survivors} "
+        f"(tokens {dict((h, t) for h, (_, t) in tokens.items())})")
+    for p, _ in tokens.values():
+        p.close()
+
+
+def test_left_host_running_claim_is_adoptable_immediately(tmp_path):
+    """A clean exit (lease marked LEFT) with an observation still
+    running is an orphan right away — no lease-timeout wait."""
+    pa = _plane(tmp_path, "hA", lease_s=60.0, settle_s=0.0)
+    pa.register()
+    assert pa.claim("o0") is not None
+    pa.close()  # LEFT, claim still "running"
+    pb = _plane(tmp_path, "hB", lease_s=60.0, settle_s=0.0)
+    pb.register()
+    assert pb.claim("o0") is not None
+    pb.close()
+
+
+def test_netstall_fault_kind_registered_and_bounded(tmp_path, monkeypatch):
+    """The new kind parses in both grammars, stalls (bounded by
+    PYPULSAR_TPU_HANG_S), and counts as fired."""
+    monkeypatch.setenv("PYPULSAR_TPU_HANG_S", "0.2")
+    assert "netstall" in faultinject.KINDS
+    assert "netstall" in faultinject.CHAOS_KINDS
+    faultinject.parse_chaos_spec("1:0.5:netstall+kill")
+    faultinject.configure("netstall:fleet.heartbeat:1")
+    t0 = time.monotonic()
+    faultinject.trip("fleet.heartbeat")  # stalls ~0.2 s, then returns
+    assert 0.15 <= time.monotonic() - t0 < 2.0
+    assert faultinject.fired_counts().get("netstall") == 1
+
+
+# ---------------------------------------------------------------------------
+# scheduler claim/adopt loop (in-process hosts, stub DAGs)
+# ---------------------------------------------------------------------------
+
+
+def _run_hosts(tmp_path, obs, stages, hosts, lease_s=1.0, stagger=0.0):
+    """Run one FleetScheduler per host id concurrently over the shared
+    dir; returns {host: FleetResult} (exceptions re-raised)."""
+    results = {}
+    errors = {}
+
+    def go(host):
+        plane = _plane(tmp_path, host, lease_s=lease_s)
+        try:
+            results[host] = FleetScheduler(
+                obs, SurveyConfig(), stages=stages, plane=plane).run()
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors[host] = e
+
+    ts = []
+    for host in hosts:
+        t = threading.Thread(target=go, args=(host,))
+        t.start()
+        ts.append(t)
+        if stagger:
+            time.sleep(stagger)
+    for t in ts:
+        t.join(timeout=60)
+    return results, errors
+
+
+def test_hosts_split_fleet_every_stage_exactly_once(tmp_path):
+    """Two hosts over four observations: every stage of every obs runs
+    exactly once fleet-wide, both hosts exit ok, and each host saw the
+    other's observations complete remotely."""
+    stages = [_mk_stage("dev1", slow_s=0.05), _mk_stage("host1", ("dev1",))]
+    obs = _mk_obs(tmp_path, 4)
+    results, errors = _run_hosts(tmp_path, obs, stages, ("hA", "hB"))
+    assert not errors, errors
+    assert all(r.ok for r in results.values())
+    ran = [x for r in results.values() for x in r.ran]
+    assert len(ran) == len(set(ran)) == 8, ran
+    for i in range(4):
+        for s in ("dev1", "host1"):
+            assert os.path.exists(str(tmp_path / f"o{i}.{s}.out"))
+    assert all(r.remote_done for r in results.values())
+
+
+def test_surplus_hosts_join_claim_pool_and_adopt(tmp_path):
+    """The shard_files idle-host fix at fleet level: THREE hosts over
+    TWO observations — the surplus host gets no initial work yet exits
+    cleanly as a pool member, and when a loaded host dies its orphan is
+    adopted (by whichever idle host wins the race) instead of dying
+    with it."""
+    stages = [_mk_stage("dev1", slow_s=0.3), _mk_stage("host1", ("dev1",))]
+    obs = _mk_obs(tmp_path, 2)
+    # host hA dies at its first stage-done boundary (InjectedKill
+    # unwinds its fleet like a signal); hB and the initially idle hC
+    # between them must finish everything
+    faultinject.configure("kill:survey.stage_done.dev1:1")
+    results, errors = _run_hosts(tmp_path, obs, stages,
+                                 ("hA", "hB", "hC"), stagger=0.05)
+    faultinject.reset()
+    assert set(errors) == {"hA"} \
+        and isinstance(errors["hA"], faultinject.InjectedKill)
+    assert results["hB"].ok and results["hC"].ok
+    ran = [x for h in ("hB", "hC") for x in results[h].ran]
+    assert len(ran) == len(set(ran)), f"duplicated stage runs: {ran}"
+    for i in range(2):
+        for s in ("dev1", "host1"):
+            assert os.path.exists(str(tmp_path / f"o{i}.{s}.out"))
+    adopted = results["hB"].adopted + results["hC"].adopted
+    assert adopted, "the dead host's observation was never adopted"
+    # a final validated single-host resume re-runs nothing
+    final = FleetScheduler(obs, SurveyConfig(), stages=stages,
+                           resume=True).run()
+    assert final.ran == [] and len(final.skipped) == 4
+
+
+def test_netstalled_host_cedes_to_adopter_single_winner(tmp_path,
+                                                        monkeypatch):
+    """The split-brain scenario end to end: hA's heartbeat renewer is
+    parked by a netstall while its (slow) stage still runs; hB adopts
+    past the lease bound; hA's next manifest append is rejected by the
+    fencing token and the observation is CEDED — one winner, no retry,
+    no quarantine, and the winner's artifacts validate."""
+    monkeypatch.setenv("PYPULSAR_TPU_HANG_S", "4")
+    stages = [_mk_stage("dev1", slow_s=2.5), _mk_stage("host1", ("dev1",))]
+    obs = _mk_obs(tmp_path, 1)
+    faultinject.configure("netstall:fleet.heartbeat:2")
+    results = {}
+
+    def go(host, plane):
+        results[host] = FleetScheduler(
+            obs, SurveyConfig(), stages=stages, plane=plane).run()
+
+    pa = _plane(tmp_path, "hA", lease_s=0.8, heartbeat_s=0.2)
+    ta = threading.Thread(target=go, args=("hA", pa))
+    ta.start()
+    time.sleep(1.6)  # hA mid-stage, heartbeat silent past the lease
+    pb = _plane(tmp_path, "hB", lease_s=0.8, heartbeat_s=0.2)
+    tb = threading.Thread(target=go, args=("hB", pb))
+    tb.start()
+    ta.join(timeout=60)
+    tb.join(timeout=60)
+    assert results["hA"].ok and results["hB"].ok
+    assert results["hA"].ceded == ["o0"]
+    assert results["hA"].ran == []  # its done never landed
+    assert results["hB"].adopted == ["o0"]
+    assert ("o0", "dev1") in results["hB"].ran
+    final = FleetScheduler(obs, SurveyConfig(), stages=stages,
+                           resume=True).run()
+    assert final.ran == [] and len(final.skipped) == 2
+
+
+def test_adopted_obs_resumes_from_manifest_not_from_scratch(tmp_path):
+    """Adoption IS resume: stages the dead host's manifest recorded
+    (and whose artifacts validate) are skipped by the adopter."""
+    stages = [_mk_stage("dev1"), _mk_stage("host1", ("dev1",))]
+    obs = _mk_obs(tmp_path, 1)
+    # hA completes dev1 then dies at host1's start boundary
+    faultinject.configure("kill:survey.stage_start.host1:1")
+    pa = _plane(tmp_path, "hA")
+    with pytest.raises(faultinject.InjectedKill):
+        FleetScheduler(obs, SurveyConfig(), stages=stages,
+                       plane=pa).run()
+    faultinject.reset()
+    pb = _plane(tmp_path, "hB")
+    r = FleetScheduler(obs, SurveyConfig(), stages=stages,
+                       plane=pb).run()
+    assert r.ok and r.adopted == ["o0"]
+    assert ("o0", "dev1") in r.skipped, "validated stage re-ran"
+    assert r.ran == [("o0", "host1")]
+
+
+def test_torn_manifest_tail_survives_adoption(tmp_path):
+    """A host SIGKILL'd mid-manifest-append leaves a torn trailing
+    line; the adopter's shared-mode journal must keep every whole
+    record (the newline framing glues the torn tail onto a blank) and
+    redo only the unrecorded stage."""
+    stages = [_mk_stage("dev1"), _mk_stage("host1", ("dev1",))]
+    obs = _mk_obs(tmp_path, 1)
+    pa = _plane(tmp_path, "hA", settle_s=0.0)
+    pa.register()
+    t_a = pa.claim("o0")
+    m = ObsManifest(obs[0].manifest, "fp-torn", token=t_a,
+                    fence=lambda: pa.fence("o0", t_a))
+    art = str(tmp_path / "o0.dev1.out")
+    with open(art, "w") as f:
+        f.write("dev1o0")
+    m.mark_done("dev1", [art])
+    m.close()
+    # the kill: a torn half-record at the tail, no trailing newline
+    with open(obs[0].manifest, "a") as f:
+        f.write('{"type": "done", "unit": "stage:host1", "outp')
+    pa._stop.set()
+    pa._renew.join()
+    time.sleep(1.2)
+    pb = _plane(tmp_path, "hB", settle_s=0.0)
+    pb.register()
+    t_b = pb.claim("o0")
+    m2 = ObsManifest(obs[0].manifest, "fp-torn", token=t_b,
+                     fence=lambda: pb.fence("o0", t_b))
+    assert m2.done_stages() == {"dev1"}, "whole record lost to the tear"
+    art2 = str(tmp_path / "o0.host1.out")
+    with open(art2, "w") as f:
+        f.write("host1o0")
+    m2.mark_done("host1", [art2])  # appends cleanly past the tear
+    assert m2.done_stages() == {"dev1", "host1"}
+    m2.close()
+    # a fresh read (the resume path) agrees
+    m3 = ObsManifest(obs[0].manifest, "fp-torn")
+    assert m3.done_stages() == {"dev1", "host1"}
+    m3.close()
+    pb.close()
+
+
+def test_reconfigured_plane_rerun_reopens_terminal_claims(tmp_path):
+    """A terminal claim left by a PREVIOUS configuration's fleet must
+    not short-circuit a reconfigured rerun: the claim is re-opened when
+    the manifest fingerprint no longer matches, and the observation is
+    re-run — the plane-mode form of the restart-on-fingerprint-mismatch
+    contract. A SAME-config rerun still runs nothing."""
+    stages = [_mk_stage("dev1"), _mk_stage("host1", ("dev1",))]
+    obs = _mk_obs(tmp_path, 1)
+    r1 = FleetScheduler(obs, SurveyConfig(numdms=8), stages=stages,
+                        plane=_plane(tmp_path, "hA")).run()
+    assert r1.ok and len(r1.ran) == 2
+    # same config: the done claim + matching manifest short-circuit
+    r2 = FleetScheduler(obs, SurveyConfig(numdms=8), stages=stages,
+                        plane=_plane(tmp_path, "hB")).run()
+    assert r2.ok and r2.ran == [] and r2.remote_done == ["o0"]
+    # changed config: terminal claim re-opened, everything re-runs
+    r3 = FleetScheduler(obs, SurveyConfig(numdms=16), stages=stages,
+                        plane=_plane(tmp_path, "hC")).run()
+    assert r3.ok and len(r3.ran) == 2 and r3.remote_done == []
+
+
+def test_plane_resume_revalidates_done_claims(tmp_path):
+    """An explicit --resume in plane mode re-validates a done claim's
+    artifacts: a corrupted artifact re-opens the claim and redoes
+    exactly the non-validating stage (the single-host resume
+    contract, kept across hosts)."""
+    stages = [_mk_stage("dev1"), _mk_stage("host1", ("dev1",))]
+    obs = _mk_obs(tmp_path, 1)
+    cfg = SurveyConfig()
+    assert FleetScheduler(obs, cfg, stages=stages,
+                          plane=_plane(tmp_path, "hA")).run().ok
+    with open(str(tmp_path / "o0.host1.out"), "w") as f:
+        f.write("corrupted past the recorded sha256")
+    # without --resume the done claim is trusted (cheap path)
+    r = FleetScheduler(obs, cfg, stages=stages,
+                       plane=_plane(tmp_path, "hB")).run()
+    assert r.ran == []
+    # with --resume the validation failure re-opens and redoes it
+    r = FleetScheduler(obs, cfg, stages=stages, resume=True,
+                       plane=_plane(tmp_path, "hC")).run()
+    assert r.ok and ("o0", "host1") in r.ran
+    assert ("o0", "dev1") in r.skipped  # the intact stage still skips
+
+
+def test_claim_write_cannot_regress_a_higher_token(tmp_path):
+    """The claim file's token may only go up: a slower claimant whose
+    allocated token is LOWER than what the file now holds loses at the
+    pre-write re-read instead of regressing the winner's claim."""
+    dead = _plane(tmp_path, "dead", settle_s=0.0)
+    dead.register()
+    assert dead.claim("o0") is not None
+    dead._stop.set()
+    dead._renew.join()
+    time.sleep(1.2)
+    pa = _plane(tmp_path, "hA", settle_s=0.0)
+    pa.register()
+    pb = _plane(tmp_path, "hB", settle_s=0.0)
+    pb.register()
+    t_low = pa.next_token()   # hA allocates FIRST (lower token)...
+    t_b = pb.claim("o0")      # ...but hB claims first with a higher one
+    assert t_b is not None and t_b > t_low
+    # simulate hA's delayed write exactly: it read the orphan before
+    # hB's claim landed (hosts() says the holder is gone) and its
+    # allocator already returned t_low — the pre-write re-read must
+    # refuse to regress the file below t_b
+    pa.hosts = lambda: {}
+    pa.next_token = lambda: t_low
+    assert pa.claim("o0") is None
+    assert pb.read_claim("o0").get("token") == t_b
+    pb.fence("o0", t_b)  # the winner's fence still passes
+    pa.close()
+    pb.close()
+
+
+def test_host_health_strikes_bar_claims(tmp_path):
+    """HostHealth: adoption/cede strikes accumulate per host id and bar
+    it from new claims past the limit."""
+    hh = HostHealth(limit=2)
+    assert not hh.strike("flappy", kind="adopted")
+    assert not hh.is_quarantined("flappy")
+    assert hh.strike("flappy", kind="ceded")  # newly quarantined
+    assert hh.is_quarantined("flappy")
+    snap = hh.snapshot()
+    assert snap["flappy"]["strikes"] == 2
+    assert snap["flappy"]["quarantined"] is True
+
+
+# ---------------------------------------------------------------------------
+# status + tlmsum views
+# ---------------------------------------------------------------------------
+
+
+def test_status_renders_host_liveness_and_owner_column(tmp_path):
+    """--status with a plane: per-obs owner column, adoption
+    annotation, and the LIVE/DEAD/LEFT host block."""
+    stages = [_mk_stage("dev1"), _mk_stage("host1", ("dev1",))]
+    obs = _mk_obs(tmp_path, 2)
+    pa = _plane(tmp_path, "hA")
+    assert FleetScheduler(obs, SurveyConfig(), stages=stages,
+                          plane=pa).run().ok
+    plane_view = read_plane_status(str(tmp_path))
+    assert plane_view is not None
+    assert plane_view["hosts"]["hA"]["left"] is True
+    text = format_status(status_rows([o.manifest for o in obs]),
+                         plane=plane_view)
+    assert "host" in text.splitlines()[0]
+    assert "hA" in text and "LEFT" in text
+    assert "complete" in text
+    # an adopted claim annotates its row
+    plane_view["claims"]["o0"]["adopted_from"] = "ghost"
+    text = format_status(status_rows([o.manifest for o in obs]),
+                         plane=plane_view)
+    assert "adopted from ghost" in text
+
+
+def test_tlmsum_per_host_rollup_renders(tmp_path, capsys):
+    """Host-stamped stage spans and adoption/cede events land in the
+    per-host section of the summary (and combine across traces)."""
+    from pypulsar_tpu.obs.summarize import (
+        combine_summaries,
+        render,
+        summarize,
+    )
+
+    recs_a = [
+        {"type": "meta", "tool": "survey"},
+        {"type": "span", "name": "survey.stage.sweep", "t": 0.0,
+         "dur": 2.0, "attrs": {"obs": "o0", "host": "hA"}},
+        {"type": "event", "name": "survey.obs_ceded", "t": 2.0,
+         "attrs": {"host": "hA", "obs": "o1"}},
+        {"type": "end", "wall": 3.0},
+    ]
+    recs_b = [
+        {"type": "meta", "tool": "survey"},
+        {"type": "span", "name": "survey.stage.fold", "t": 0.0,
+         "dur": 1.0, "attrs": {"obs": "o1", "host": "hB"}},
+        {"type": "event", "name": "survey.obs_adopted", "t": 1.0,
+         "attrs": {"host": "hB", "obs": "o1", "adopted_from": "hA"}},
+        {"type": "counters", "counters": {"survey.adoptions": 1}},
+        {"type": "end", "wall": 3.0},
+    ]
+    # the per-OBS trace echoes the same stage span and a hostless
+    # adoption event for forensics: summarizing it alongside the fleet
+    # traces must not double-count busy seconds, obs_lost, or the
+    # health-line adoption total
+    recs_obs_echo = [
+        {"type": "meta", "tool": "survey-obs", "obs": "o1"},
+        {"type": "span", "name": "survey.stage.fold", "t": 0.0,
+         "dur": 1.0, "attrs": {"host": "hB", "outputs": 1}},
+        {"type": "event", "name": "survey.obs_adopted", "t": 0.5,
+         "attrs": {"adopted_from": "hA", "token": 7}},
+        {"type": "end", "wall": 1.5},
+    ]
+    sa, sb = summarize(recs_a), summarize(recs_b)
+    so = summarize(recs_obs_echo)
+    assert sa.host_busy == {"hA": [2.0, 1]}
+    assert so.host_busy == {} and so.host_events == {}
+    combined = combine_summaries([sa, sb, so])
+    assert set(combined.host_busy) == {"hA", "hB"}
+    assert combined.host_busy["hB"] == [1.0, 1]  # echo not double-booked
+    assert combined.host_events["hB"]["obs_adopted"] == 1
+    assert combined.host_events["hA"]["obs_lost"] == 1
+    assert combined.host_events["hA"]["obs_ceded"] == 1
+    render(combined, sys.stdout)
+    out = capsys.readouterr().out
+    assert "# per-host:" in out
+    assert "hA" in out and "obs_ceded=1" in out and "obs_lost=1" in out
+    assert "obs adoptions=1" in out  # the fleet-health line (counter)
+
+
+# ---------------------------------------------------------------------------
+# M-process CLI integration (spawn-gated)
+# ---------------------------------------------------------------------------
+
+_CLI_FLAGS = ["--lodm", "0", "--dmstep", "10", "--numdms", "4",
+              "-s", "8", "--group-size", "2", "--threshold", "8",
+              "--mask-time", "1.0", "--accel-zmax", "20",
+              "--accel-numharm", "2", "--accel-sigma", "3",
+              "--accel-batch", "4", "--sift-sigma", "5",
+              "--sift-min-hits", "2", "--fold-nbins", "32",
+              "--fold-npart", "8"]
+
+
+def _cli_cfg():
+    return SurveyConfig(
+        mask=True, mask_time=1.0, lodm=0.0, dmstep=10.0, numdms=4,
+        nsub=8, group_size=2, threshold=8.0, accel_zmax=20.0,
+        accel_numharm=2, accel_sigma=3.0, accel_batch=4, sift_sigma=5.0,
+        sift_min_hits=2, fold_nbins=32, fold_npart=8)
+
+
+def _spawn_cli_host(rank, count, fils, outdir, tlmdir, lease_s,
+                    extra_env=None):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (repo + os.pathsep
+                         + env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYPULSAR_TPU_HOST_LEASE_S"] = str(lease_s)
+    env["PYPULSAR_TPU_NUM_PROCESSES"] = str(count)
+    env["PYPULSAR_TPU_PROCESS_ID"] = str(rank)
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "pypulsar_tpu.cli", "survey", *fils,
+         "-o", outdir, *_CLI_FLAGS, "--host-id", f"host{rank}",
+         "--telemetry-dir", tlmdir],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+
+@pytest.fixture(scope="module")
+def cli_fils(tmp_path_factory):
+    from tests.test_accel_pipeline import _pulsar_fil
+
+    root = tmp_path_factory.mktemp("mh_cli")
+    return [_pulsar_fil(root, name=f"mh{i}.fil", seed=9 + i, C=16,
+                        T=4096) for i in range(2)]
+
+
+def test_sigkill_host_mid_stage_adoption_cli(cli_fils, tmp_path):
+    """THE integration contract: a 2-process CLI fleet, host0 parked
+    mid-sweep by an armed hang and SIGKILL'd (lease goes silent — no
+    cleanup of any kind); host1 adopts the orphan, the fleet completes,
+    and a final in-process resume re-runs zero stages."""
+    _require_spawn()
+    outdir = str(tmp_path / "out")
+    tlmdir = str(tmp_path / "tlm")
+    lease_s = 2.0
+    victim = _spawn_cli_host(0, 2, cli_fils, outdir, tlmdir, lease_s,
+                             extra_env={
+                                 "PYPULSAR_TPU_FAULTS":
+                                     "hang:sweep.chunk_dispatch:1",
+                                 "PYPULSAR_TPU_HANG_S": "600"})
+    survivor = _spawn_cli_host(1, 2, cli_fils, outdir, tlmdir, lease_s)
+    vtrace = os.path.join(tlmdir, "fleet.host0.jsonl")
+    deadline = time.monotonic() + 240
+    parked = False
+    while time.monotonic() < deadline and victim.poll() is None:
+        try:
+            parked = "resilience.fault_injected" in open(vtrace).read()
+        except OSError:
+            parked = False
+        if parked:
+            break
+        time.sleep(0.25)
+    assert parked, "victim never reached the armed mid-sweep hang"
+    os.kill(victim.pid, signal.SIGKILL)
+    assert victim.wait(timeout=60) == -signal.SIGKILL
+    out, _ = survivor.communicate(timeout=600)
+    assert survivor.returncode == 0, out[-3000:]
+    assert "ADOPTED" in out
+    # every observation's chain completed (sifted list + SNR summary)
+    for i in range(2):
+        assert os.path.exists(os.path.join(outdir, f"mh{i}.accelcands"))
+        assert os.path.exists(os.path.join(outdir, f"mh{i}_snr.json"))
+    adoptions = []
+    for p in glob.glob(os.path.join(tlmdir, "*.jsonl")):
+        for line in open(p):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("type") == "event" \
+                    and rec.get("name") == "survey.obs_adopted":
+                adoptions.append(rec.get("attrs", {}))
+    assert any(a.get("adopted_from") == "host0" for a in adoptions)
+    # final no-fault resume (plain single-host) validates everything
+    obs = [Observation(f"mh{i}", cli_fils[i],
+                       os.path.join(outdir, f"mh{i}")) for i in range(2)]
+    final = FleetScheduler(obs, _cli_cfg(), resume=True).run()
+    assert final.ok and final.ran == [], final.ran
+    # --status over the shared dir shows the DEAD host and the owners
+    from pypulsar_tpu.cli import survey as cli_survey
+
+    assert cli_survey.main(["--status", "-o", outdir]) == 0
+
+
+@pytest.mark.slow
+def test_sigkill_every_stage_boundary_cli(cli_fils, tmp_path):
+    """SIGKILL-equivalent (exit:137, no cleanup) at EVERY stage-done
+    boundary of a 2-process fleet: the survivor adopts and completes
+    each time, and the resumed artifacts validate (final resume runs
+    nothing). Slow-marked: five full subprocess fleets."""
+    _require_spawn()
+    for ki, stage in enumerate(("mask", "sweep", "sift", "fold", "snr")):
+        outdir = str(tmp_path / f"out{ki}")
+        tlmdir = str(tmp_path / f"tlm{ki}")
+        victim = _spawn_cli_host(
+            0, 2, cli_fils, outdir, tlmdir, 2.0,
+            extra_env={"PYPULSAR_TPU_FAULTS":
+                       f"exit:survey.stage_done.{stage}:1"})
+        survivor = _spawn_cli_host(1, 2, cli_fils, outdir, tlmdir, 2.0)
+        vcode = victim.wait(timeout=600)
+        victim.stdout.close()
+        out, _ = survivor.communicate(timeout=600)
+        assert vcode == 137, f"{stage}: victim exit {vcode}"
+        assert survivor.returncode == 0, f"{stage}: {out[-3000:]}"
+        obs = [Observation(f"mh{i}", cli_fils[i],
+                           os.path.join(outdir, f"mh{i}"))
+               for i in range(2)]
+        final = FleetScheduler(obs, _cli_cfg(), resume=True).run()
+        assert final.ok and final.ran == [], (stage, final.ran)
