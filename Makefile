@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier test-faults test-fold test-survey test-corruption test-tune test-multihost lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-multichip bench-multihost-fleet bench-specfuse bench-telemetry bench-tree bench-tune native clean
+.PHONY: test test-fourier test-faults test-fold test-survey test-corruption test-tune test-multihost test-race lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-multichip bench-multihost-fleet bench-specfuse bench-telemetry bench-tree bench-tune native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -18,10 +18,12 @@ test: lint
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
 
 # the static-analysis gate (docs/ARCHITECTURE.md "Static analysis"):
-# psrlint's project-invariant rules PL001-PL011 (each locks in a bug
+# psrlint's project-invariant rules PL001-PL016 (each locks in a bug
 # class an earlier PR fixed by hand — PL011: raw PYPULSAR_TPU_* env
-# reads outside the tune/knobs.py registry; baseline empty by policy),
-# then the
+# reads outside the tune/knobs.py registry; PL012-PL016: the psrrace
+# concurrency rules — lock-order cycles, blocking-under-lock, bare
+# acquires, unguarded condition waits, orphanable threads; baseline
+# empty by policy), then the
 # third-party ruff pass (pyproject [tool.ruff], crash-bug classes
 # only) when the container ships ruff — the image this repo grows in
 # does not, so the ruff leg degrades to a loud skip, never a pass
@@ -43,9 +45,22 @@ test-fourier:
 # survey orchestrator's kill/resume/quarantine and fleet-health
 # (watchdog, device-strike, admission) cases, and the seeded chaos
 # fleet
-test-faults: test-chaos test-corruption test-multihost
+test-faults: test-chaos test-corruption test-multihost test-race
 	$(CPU_ENV) $(PY) -m pytest tests/test_resilience.py -q
 	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -k "kill or resume or quarantine or retry or stall or deadline or evict or admission or chaos"
+
+# the concurrency-correctness suite (round 19, psrrace): lockdep unit
+# tests + the watchdog defer-interrupt-while-locked regression under
+# PYPULSAR_TPU_LOCKDEP=strict, the survey/multihost suites re-run
+# strict (any acquisition-order cycle raises), then the quick seeded
+# interleaving harness (claim/adopt + watchdog interrupt + prefetch
+# concurrently, seeded lock-boundary pauses, byte-parity + zero
+# violations asserted; committed record RACE_r01.json) — the
+# slow-marked long-seed twin is tests/test_lockdep.py -m slow
+test-race:
+	PYPULSAR_TPU_LOCKDEP=strict $(CPU_ENV) $(PY) -m pytest tests/test_lockdep.py -q
+	PYPULSAR_TPU_LOCKDEP=strict $(CPU_ENV) $(PY) -m pytest tests/test_multihost.py tests/test_survey.py -q -k "stall or deadline or watchdog or adopt or cede or prefetch"
+	$(CPU_ENV) $(PY) bench.py --race --quick
 
 # the multi-host fleet suite (round 18): fencing-token monotonicity +
 # stale-write rejection, double-adoption single-winner, netstall

@@ -136,6 +136,19 @@ def parse_args(argv=None):
                     metavar="PATH",
                     help="with --multihost: where the host-kill chaos "
                          "record lands (default HOSTCHAOS_r01.json)")
+    ap.add_argument("--race", action="store_true",
+                    help="seeded interleaving stress harness (psrrace): "
+                         "a toy fleet on 2 in-process hosts + a leaving "
+                         "ghost, claim/adopt + watchdog hang-interrupt "
+                         "+ prefetch concurrently, setswitchinterval "
+                         "cranked and seeded pauses injected at every "
+                         "tracked lock boundary under "
+                         "PYPULSAR_TPU_LOCKDEP=strict; asserts "
+                         "byte-identical artifacts and zero lockdep "
+                         "order violations per seed (RACE_rXX.json)")
+    ap.add_argument("--race-seeds", type=int, default=2,
+                    help="with --race: how many interleaving seeds to "
+                         "run (default 2)")
     ap.add_argument("--chaos-seed", type=int, default=1,
                     help="with --chaos: the chaos seed (default 1)")
     ap.add_argument("--chaos-rate", type=float, default=None,
@@ -2289,6 +2302,241 @@ def run_chaos(args):
     }
 
 
+def run_race(args):
+    """Seeded interleaving stress harness (psrrace's dynamic acceptance
+    measurement, round 19): run a toy fleet CLEAN (single host, no
+    perturbation), then re-run the SAME fleet once per seed with every
+    concurrency surface the runtime has, deliberately perturbed:
+
+    - TWO in-process hosts coordinating through a shared FleetPlane
+      (claim/adopt loops, heartbeat renewers, fenced manifests), plus a
+      ghost host that claims an observation and leaves — so adoption is
+      exercised every leg, not just when a race happens to produce one;
+    - an armed in-stage ``hang`` outlasting ``--stall`` so the watchdog
+      async-interrupt path fires (under the round-19 deferral rule: an
+      interrupt is withheld while the target holds a tracked lock);
+    - prefetch producers inside the real sweep stages;
+    - ``sys.setswitchinterval`` cranked down per seed AND seeded
+      faultinject-driven pauses at every tracked lock boundary
+      (``resilience.locks.configure_race``), widening race windows by
+      orders of magnitude;
+    - ``PYPULSAR_TPU_LOCKDEP=strict``: ANY acquisition-order cycle
+      raises instead of warning.
+
+    Asserted per seed: the fleet completes with zero quarantines, at
+    least one adoption and at least one watchdog interrupt happened,
+    ZERO lockdep order violations were recorded, and every artifact is
+    byte-identical to the clean run's. The committed record is
+    RACE_r01.json."""
+    acquire_backend()
+    import glob as _glob
+    import tempfile
+    import threading
+
+    from pypulsar_tpu.resilience import faultinject, locks
+    from pypulsar_tpu.survey.dag import SurveyConfig, build_dag
+    from pypulsar_tpu.survey.fleet import FleetPlane
+    from pypulsar_tpu.survey.scheduler import FleetScheduler
+    from pypulsar_tpu.survey.state import Observation
+
+    n_obs, n_hosts = 3, 2
+    stall_s = 6.0
+    seeds = list(range(1, max(1, args.race_seeds) + 1))
+    C, T, dtp = 32, 1 << 13, 5e-4  # structure, not walls: always small
+    rng_freqs = 1500.0 - 4.0 * np.arange(C)
+    cfg = SurveyConfig(
+        mask=True, mask_time=2.0, lodm=0.0, dmstep=10.0, numdms=8,
+        nsub=8, group_size=4, threshold=8.0,
+        accel_zmax=20.0, accel_numharm=2, accel_sigma=3.0, accel_batch=4,
+        sift_sigma=3.0, sift_min_hits=1, fold_nbins=32, fold_npart=8)
+    stages = build_dag(cfg)
+
+    env_save = {k: os.environ.get(k) for k in
+                ("PYPULSAR_TPU_HANG_S", "PYPULSAR_TPU_PREFETCH_TIMEOUT",
+                 "PYPULSAR_TPU_LOCKDEP")}
+    os.environ["PYPULSAR_TPU_HANG_S"] = str(stall_s + 4.0)
+    os.environ["PYPULSAR_TPU_PREFETCH_TIMEOUT"] = "20"
+    os.environ["PYPULSAR_TPU_LOCKDEP"] = "strict"
+    old_si = sys.getswitchinterval()
+    per_seed = []
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            fils = [_synth_survey_fil(
+                os.path.join(td, f"obs{i}.fil"), 31 + i, C, T, dtp,
+                rng_freqs, f"RACE{i}", dm=40.0,
+                period=0.1024 * (1.0 + 0.07 * i), amp=10.0)
+                for i in range(n_obs)]
+
+            def fleet(dirname):
+                out = os.path.join(td, dirname)
+                os.makedirs(out, exist_ok=True)
+                return [Observation(f"obs{i}", fils[i],
+                                    os.path.join(out, f"obs{i}"))
+                        for i in range(n_obs)]
+
+            def parity(dirname):
+                ident = tot = 0
+                diverged = []
+                for pattern in ("*_ACCEL_*.cand", "*_ACCEL_*.txtcand",
+                                "*_cand*.pfd", "*.dat"):
+                    for fa in sorted(_glob.glob(
+                            os.path.join(td, "clean", pattern))):
+                        fb = os.path.join(td, dirname,
+                                          os.path.basename(fa))
+                        tot += 1
+                        if (os.path.exists(fb)
+                                and open(fa, "rb").read()
+                                == open(fb, "rb").read()):
+                            ident += 1
+                        else:
+                            diverged.append(os.path.basename(fa))
+                return ident, tot, diverged
+
+            # clean reference leg (also warms every stage's jit
+            # programs so the race legs' stall bound never fires on a
+            # cold compile)
+            faultinject.reset()
+            locks.reset()
+            clean = FleetScheduler(fleet("clean"), cfg,
+                                   max_host_workers=2, devices=1).run()
+            assert clean.ok and len(clean.ran) == n_obs * len(stages)
+
+            for seed in seeds:
+                tag = f"race{seed}"
+                obs = fleet(tag)
+                out = os.path.join(td, tag)
+                faultinject.reset()
+                locks.reset()
+                locks.configure_race(seed, pause_us=150.0)
+                sys.setswitchinterval(
+                    (2e-6, 5e-5, 5e-6, 2e-4)[seed % 4])
+                # one armed in-stage hang per leg: the watchdog
+                # interrupt path must fire under perturbation, not just
+                # when the seed happens to produce a stall
+                faultinject.configure("hang:sweep.chunk_dispatch:3")
+                # a ghost host claims an observation and LEAVES (lease
+                # retired with the claim still running): adoption is
+                # exercised deterministically every leg
+                ghost = FleetPlane(out, host_id="ghost", lease_s=0.5,
+                                   settle_s=0.0)
+                ghost.register()
+                ghost.claim(obs[0].name)
+                ghost.close()
+                results, errors = {}, {}
+
+                def go(host_id, _obs=obs, _out=out):
+                    plane = FleetPlane(_out, host_id=host_id,
+                                       lease_s=1.0, settle_s=0.02,
+                                       heartbeat_s=0.2)
+                    try:
+                        results[host_id] = FleetScheduler(
+                            _obs, cfg, max_host_workers=2, devices=1,
+                            retries=2, stall_s=stall_s,
+                            plane=plane).run()
+                    except BaseException as e:  # noqa: BLE001 - re-raised
+                        errors[host_id] = e
+                t0 = time.perf_counter()
+                hosts = [threading.Thread(target=go, args=(f"host{h}",))
+                         for h in range(n_hosts)]
+                for t in hosts:
+                    t.start()
+                    time.sleep(0.05)
+                for t in hosts:
+                    t.join(timeout=600)
+                wall = time.perf_counter() - t0
+                sys.setswitchinterval(old_si)
+                locks.configure_race(None)
+                assert not errors, (
+                    f"seed {seed}: host raised: "
+                    f"{ {h: repr(e) for h, e in errors.items()} }")
+                assert all(not t.is_alive() for t in hosts), (
+                    f"seed {seed}: a host thread wedged past 600s")
+                quarantined = {n: q for r in results.values()
+                               for n, q in r.quarantined.items()}
+                assert not quarantined, (
+                    f"seed {seed}: quarantines under race stress: "
+                    f"{quarantined}")
+                adopted = sorted({n for r in results.values()
+                                  for n in r.adopted})
+                timeouts = sum(r.timeouts for r in results.values())
+                assert adopted, (
+                    f"seed {seed}: the ghost's claim was never adopted")
+                assert timeouts >= 1, (
+                    f"seed {seed}: the armed hang never produced a "
+                    f"watchdog interrupt — the async-interrupt-under-"
+                    f"perturbation path went uncovered")
+                viol = locks.violations()
+                assert not viol, (
+                    f"seed {seed}: lockdep order violations: {viol}")
+                ident, tot, diverged = parity(tag)
+                assert ident == tot and tot > 0, (
+                    f"seed {seed}: artifacts diverged from clean: "
+                    f"{ident}/{tot} ({diverged[:8]})")
+                # a final no-perturbation resume validates every
+                # manifest and re-runs nothing
+                final = FleetScheduler(fleet(tag), cfg,
+                                       max_host_workers=2, devices=1,
+                                       resume=True).run()
+                assert final.ok and len(final.ran) == 0, (
+                    f"seed {seed}: post-race resume re-ran "
+                    f"{len(final.ran)} stages")
+                snap = locks.snapshot()
+                per_seed.append({
+                    "seed": seed,
+                    "switch_interval_s": (2e-6, 5e-5, 5e-6, 2e-4)[seed % 4],
+                    "lock_pauses_injected": locks.race_pauses(),
+                    "adopted": adopted,
+                    "watchdog_interrupts": timeouts,
+                    "order_violations": 0,
+                    "artifacts_identical": f"{ident}/{tot}",
+                    "wall_s": round(wall, 2),
+                    "locks_tracked": len(snap),
+                    "contentions": sum(v["contentions"]
+                                       for v in snap.values()),
+                })
+                print(f"# race: seed {seed}: "
+                      f"{per_seed[-1]['lock_pauses_injected']} lock "
+                      f"pauses, {timeouts} watchdog interrupts, "
+                      f"adopted {adopted}, {ident}/{tot} artifacts "
+                      f"identical, 0 violations ({wall:.1f}s)",
+                      file=sys.stderr)
+    finally:
+        sys.setswitchinterval(old_si)
+        faultinject.reset()
+        locks.configure_race(None)
+        for k, v in env_save.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    timeouts_total = sum(p["watchdog_interrupts"] for p in per_seed)
+    return {
+        "metric": "race_interleaving_parity",
+        "value": 1.0,
+        "unit": (f"fraction of artifacts byte-identical to a clean run "
+                 f"across {len(seeds)} seeded interleaving legs of a "
+                 f"{n_obs}-obs x {len(stages)}-stage fleet on "
+                 f"{n_hosts} in-process hosts + 1 leaving ghost "
+                 f"(claim/adopt + watchdog hang-interrupt + prefetch "
+                 f"concurrently, setswitchinterval cranked, seeded "
+                 f"lock-boundary pauses, PYPULSAR_TPU_LOCKDEP=strict) "
+                 f"— asserted 1.0 with ZERO lockdep order violations "
+                 f"and a zero-stage final resume per seed"),
+        "vs_baseline": 1.0,
+        "race_seeds": seeds,
+        "race_n_obs": n_obs,
+        "race_n_hosts": n_hosts,
+        "race_n_stages": len(stages),
+        "race_stall_timeout_s": stall_s,
+        "race_pause_us": 150.0,
+        "race_watchdog_interrupts_total": timeouts_total,
+        "race_per_seed": per_seed,
+        "race_nsamp": T,
+        "race_nchan": C,
+    }
+
+
 def run_multihost(args):
     """Multi-host fleet harness (the round-18 fenced-lease-takeover
     acceptance measurement): ONE survey over a 4-observation toy fleet,
@@ -3284,9 +3532,11 @@ def run_child(args, cpu: bool, timeout: float):
         argv += ["--tune-trials", str(args.tune_trials)]
     for flag in ("quick", "profile", "ab", "accel", "spectral", "fold",
                  "waterfall", "prepass", "survey", "chaos", "corruption",
-                 "dedisp_tree", "tune", "multihost"):
+                 "dedisp_tree", "tune", "multihost", "race"):
         if getattr(args, flag):
             argv.append("--" + flag.replace("_", "-"))
+    if args.race:
+        argv += ["--race-seeds", str(args.race_seeds)]
     if args.multihost:
         # the child writes the host-kill record itself; resolve the
         # path NOW so the child's CWD cannot move it
@@ -3329,7 +3579,7 @@ def main():
             and not (args.quick or args.ab or args.accel or args.fold
                      or args.waterfall or args.prepass or args.survey
                      or args.chaos or args.corruption or args.dedisp_tree or args.tune
-                     or args.multihost
+                     or args.multihost or args.race
                      or args.cpu_fallback or args.nsamp or args.nchan)
             and os.path.exists(DEFAULT_STREAM_FIL)):
         # the north-star workload exists on disk: measure THAT (streamed,
@@ -3368,6 +3618,8 @@ def main():
                 record = run_survey(args)
             elif args.multihost:
                 record = run_multihost(args)
+            elif args.race:
+                record = run_race(args)
             elif args.chaos:
                 record = run_chaos(args)
             elif args.corruption:

@@ -738,12 +738,512 @@ class RawKnobReadRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# psrrace static rules (PL012-PL016, round 19): the concurrency bug
+# classes the threaded fleet runtime (PRs 5-13) paid for by hand — lock
+# ordering, blocking under a lock, leak-prone acquires, unguarded
+# condition waits, orphanable threads. The runtime half lives in
+# resilience/locks.py (lockdep); these rules lock the SOURCE shapes in.
+
+_LOCKISH_RE = re.compile(r"(?:^|_)(?:lock|locks|mutex|cv|cond)$", re.I)
+_CONDISH_RE = re.compile(r"(?:^|_)(?:cv|cond|condition)$", re.I)
+
+
+def _enclosing_fn(node, parents):
+    cur = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return cur
+        entry = parents.get(cur)
+        cur = entry[0] if entry else None
+    return None
+
+
+def _enclosing_class_name(node, parents) -> Optional[str]:
+    cur = node
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur.name
+        entry = parents.get(cur)
+        cur = entry[0] if entry else None
+    return None
+
+
+def _lockish_name(expr) -> Optional[str]:
+    """The final name segment of a lock-looking expression (``self._cv``
+    -> ``_cv``), or None when the expression does not look like a lock.
+    Name-convention based BY DESIGN: this repo's locks are uniformly
+    ``*_lock`` / ``*_cv`` (and the tracked wrappers keep that idiom), so
+    a miss means a naming drift worth fixing anyway."""
+    if isinstance(expr, ast.Name):
+        return expr.id if _LOCKISH_RE.search(expr.id) else None
+    if isinstance(expr, ast.Attribute):
+        return expr.attr if _LOCKISH_RE.search(expr.attr) else None
+    return None
+
+
+def _lock_key(ctx: FileContext, node, expr) -> Optional[str]:
+    """Graph node identity for a lock expression: ``<Class>.<attr>`` for
+    ``self._lock``-style attributes (the class IS the lock's home, so
+    the same class merges across files), the receiver chain verbatim for
+    other attributes (``sched._lock`` from any file is one node —
+    variable naming is the convention-based join key, same philosophy
+    as the lockish-name heuristic itself), and ``<module-stem>.<name>``
+    for module-global lock names (two modules' private globals must NOT
+    merge on a shared spelling)."""
+    tail = _lockish_name(expr)
+    if tail is None:
+        return None
+    if isinstance(expr, ast.Attribute):
+        chain = _attr_chain(expr)
+        root = chain.split(".", 1)[0]
+        if root in ("self", "cls"):
+            cls = _enclosing_class_name(node, ctx.parents)
+            if cls:
+                return f"{cls}.{tail}"
+        return chain
+    stem = ctx.relpath.rsplit("/", 1)[-1].removesuffix(".py")
+    return f"{stem}.{tail}"
+
+
+def _concurrency_scope(ctx: FileContext) -> bool:
+    return not _is_test(ctx) and (
+        _in_package(ctx) or ctx.relpath.startswith("tools/")
+        or ctx.relpath == "bench.py")
+
+
+# ---------------------------------------------------------------------------
+# PL012 — cross-file lock-order inversion
+
+
+class LockOrderInversionRule(ProjectRule):
+    """Build the lock acquisition-order graph from lexically nested
+    ``with <lock>`` scopes over the WHOLE project (edges merge across
+    files via class-qualified lock keys) and flag every cycle — the
+    static twin of ``resilience.locks``' runtime lockdep, catching the
+    AB/BA deadlocks PR 7 and PR 13 each had to fix in review before any
+    thread runs. Also flags a lexically nested re-``with`` of the same
+    non-reentrant lock (instant self-deadlock). Lexical analysis only:
+    a cross-function nesting is runtime lockdep's job."""
+
+    code = "PL012"
+    name = "lock-order-inversion"
+    summary = "nested with-lock scopes form an ordering cycle"
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        sites: Dict[Tuple[str, str], Tuple[FileContext, ast.AST]] = {}
+        self_deadlocks: List[Tuple[FileContext, ast.AST, str]] = []
+        for ctx in project.contexts:
+            if not _concurrency_scope(ctx) or ctx.tree is None:
+                continue
+            parents = ctx.parents
+            for node in ctx.walk():
+                if not isinstance(node, ast.With):
+                    continue
+                inner = self._with_keys(ctx, node)
+                if not inner:
+                    continue
+                outer = self._outer_keys(ctx, node, parents)
+                # multiple lockish items in ONE with are ordered too
+                for i in range(len(inner)):
+                    for j in range(i + 1, len(inner)):
+                        graph.setdefault(inner[i], set()).add(inner[j])
+                        sites.setdefault((inner[i], inner[j]),
+                                         (ctx, node))
+                for ok in outer:
+                    for ik in inner:
+                        if ok == ik:
+                            if "rlock" not in ik.lower():
+                                self_deadlocks.append((ctx, node, ik))
+                            continue
+                        graph.setdefault(ok, set()).add(ik)
+                        sites.setdefault((ok, ik), (ctx, node))
+
+        for ctx, node, key in self_deadlocks:
+            yield self.finding(
+                ctx, node,
+                f"nested 'with' re-acquisition of the non-reentrant "
+                f"lock {key!r}: a plain Lock self-deadlocks here — use "
+                f"an RLock or restructure (runtime twin: "
+                f"resilience.locks lockdep)")
+
+        reported: Set[frozenset] = set()
+        for a, b in sorted(sites):
+            back = self._path(graph, b, a)
+            if back is None:
+                continue
+            cycle = [a] + back  # a -> b -> ... -> a
+            key = frozenset(cycle)
+            if key in reported:
+                continue
+            reported.add(key)
+            ctx, node = sites[(a, b)]
+            others = ", ".join(
+                f"{c2.relpath}:{n2.lineno}"
+                for (x, y), (c2, n2) in sorted(sites.items())
+                if x in key and y in key and (x, y) != (a, b))
+            yield self.finding(
+                ctx, node,
+                f"lock-order inversion: acquisition cycle "
+                f"{' -> '.join(cycle)} (other edge sites: "
+                f"{others or 'same statement'}); pick ONE order and "
+                f"document it in the ARCHITECTURE lock hierarchy")
+
+    def _with_keys(self, ctx: FileContext, node: ast.With) -> List[str]:
+        out = []
+        for item in node.items:
+            key = _lock_key(ctx, node, item.context_expr)
+            if key is not None:
+                out.append(key)
+        return out
+
+    def _outer_keys(self, ctx, node, parents) -> List[str]:
+        out: List[str] = []
+        cur = node
+        while True:
+            entry = parents.get(cur)
+            if entry is None:
+                break
+            parent, field = entry
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                break  # a closure body runs later, outside the with
+            if isinstance(parent, ast.With) and field == "body":
+                out.extend(self._with_keys(ctx, parent))
+            cur = parent
+        return out
+
+    @staticmethod
+    def _path(graph: Dict[str, Set[str]], src: str,
+              dst: str) -> Optional[List[str]]:
+        if src == dst:
+            return [src]
+        seen = {src}
+        frontier = [[src]]
+        while frontier:
+            nxt = []
+            for path in frontier:
+                for peer in sorted(graph.get(path[-1], ())):
+                    if peer == dst:
+                        return path + [dst]
+                    if peer not in seen:
+                        seen.add(peer)
+                        nxt.append(path + [peer])
+            frontier = nxt
+        return None
+
+
+# ---------------------------------------------------------------------------
+# PL013 — blocking call while holding a lock
+
+
+class BlockingWhileLockedRule(Rule):
+    """A sleep / file-open / subprocess / jax dispatch / ``.result()`` /
+    thread-join inside a ``with <lock>`` body serializes every peer of
+    that lock behind wall-clock time the lock was never meant to cover —
+    the shape behind PR 7's first watchdog deadline bugs (and the reason
+    the scheduler's retry backoff runs on a timer thread, not under the
+    lease). Move the blocking work outside the critical section; a
+    deliberate exception carries a suppression with its reason."""
+
+    code = "PL013"
+    name = "blocking-while-locked"
+    summary = "blocking call (sleep/IO/subprocess/jax/.result) under a lock"
+
+    _BLOCKING_DOTTED = {
+        "time.sleep", "os.replace", "os.rename", "os.fsync",
+        "os.remove", "os.unlink", "shutil.rmtree", "shutil.copy",
+        "shutil.copyfile", "shutil.disk_usage",
+    }
+    _BLOCKING_ATTRS = {"result", "block_until_ready", "device_put"}
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return _concurrency_scope(ctx)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        parents = ctx.parents
+        seen: Set[Tuple[int, int]] = set()  # nested lock withs: report once
+        for node in ctx.walk():
+            if not isinstance(node, ast.With):
+                continue
+            if not any(_lockish_name(item.context_expr)
+                       for item in node.items):
+                continue
+            fn = _enclosing_fn(node, parents)
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    key = (sub.lineno, sub.col_offset)
+                    if key in seen:
+                        continue
+                    if _enclosing_fn(sub, parents) is not fn:
+                        continue  # closure body: runs later, unlocked
+                    why = self._blocking(sub)
+                    if why:
+                        seen.add(key)
+                        yield self.finding(
+                            ctx, sub,
+                            f"{why} inside a 'with <lock>' block: every "
+                            f"peer of this lock now waits on wall-clock "
+                            f"work the lock was not meant to cover — "
+                            f"move it outside the critical section "
+                            f"(scheduler precedent: retry backoff runs "
+                            f"on a timer, never under the lease)")
+
+    def _blocking(self, call: ast.Call) -> Optional[str]:
+        cn = _call_name(call)
+        if isinstance(call.func, ast.Name) and call.func.id == "open":
+            return "file IO (open)"
+        if cn == "sleep" or cn in self._BLOCKING_DOTTED:
+            return f"blocking call {cn}()"
+        if cn.startswith("subprocess."):
+            return f"subprocess call {cn}()"
+        root = cn.split(".", 1)[0]
+        if root in ("jax", "jnp"):
+            return f"jax dispatch {cn}()"
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr in ("result", "block_until_ready") and not call.args:
+                return f".{attr}() (blocks on async work)"
+            if attr == "join" and self._threadish_join(call):
+                return ".join() (blocks on another thread)"
+        return None
+
+    @staticmethod
+    def _threadish_join(call: ast.Call) -> bool:
+        """``t.join()`` / ``t.join(5)`` / ``t.join(timeout=...)`` —
+        but never ``sep.join(parts)`` (one non-numeric positional)."""
+        if any(kw.arg == "timeout" for kw in call.keywords):
+            return True
+        if not call.args and not call.keywords:
+            return True
+        if len(call.args) == 1 and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, (int, float)):
+            return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# PL014 — bare .acquire() without try/finally release
+
+
+class BareAcquireRule(Rule):
+    """``lock.acquire()`` with no ``try/finally: lock.release()`` leaks
+    the lock on ANY exception between acquire and release — including
+    the watchdog's async interrupts, which land at an arbitrary bytecode
+    boundary. Use ``with lock:`` (preferred — the tracked wrappers make
+    it lockdep-visible too), or acquire immediately before a
+    ``try/finally`` that releases."""
+
+    code = "PL014"
+    name = "bare-acquire"
+    summary = ".acquire() without a try/finally release"
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return _concurrency_scope(ctx)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        parents = ctx.parents
+        for node in ctx.walk():
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"):
+                continue
+            if _lockish_name(node.func.value) is None:
+                continue
+            chain = _attr_chain(node.func.value)
+            if self._guarded(node, chain, parents):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"bare {chain}.acquire() with no try/finally release: "
+                f"any exception (including a watchdog async interrupt) "
+                f"between acquire and release strands the lock — use "
+                f"'with {chain}:' or acquire directly before a "
+                f"try/finally that releases")
+
+    def _guarded(self, node, chain: str, parents) -> bool:
+        # (a) inside a Try whose finalbody releases the same lock
+        cur = node
+        while True:
+            entry = parents.get(cur)
+            if entry is None:
+                break
+            parent, field = entry
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                break
+            if isinstance(parent, ast.Try) and field == "body" \
+                    and self._releases(parent.finalbody, chain):
+                return True
+            cur = parent
+        # (b) the acquire's statement is immediately followed by such a
+        # Try (the classic acquire-then-guard idiom)
+        stmt = node
+        while stmt is not None and not isinstance(stmt, ast.stmt):
+            entry = parents.get(stmt)
+            stmt = entry[0] if entry else None
+        if stmt is None:
+            return False
+        entry = parents.get(stmt)
+        if entry is None:
+            return False
+        parent, field = entry
+        body = getattr(parent, field, None)
+        if not isinstance(body, list) or stmt not in body:
+            return False
+        idx = body.index(stmt)
+        if idx + 1 < len(body):
+            nxt = body[idx + 1]
+            if isinstance(nxt, ast.Try) \
+                    and self._releases(nxt.finalbody, chain):
+                return True
+        return False
+
+    @staticmethod
+    def _releases(stmts, chain: str) -> bool:
+        for stmt in stmts:
+            for sub in ast.walk(stmt):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "release"
+                        and _attr_chain(sub.func.value) == chain):
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# PL015 — Condition.wait outside a predicate while loop
+
+
+class ConditionWaitPredicateRule(Rule):
+    """``cv.wait()`` not inside a ``while`` loop: condition variables
+    have spurious wakeups and lost-wakeup races by contract — a bare
+    ``if``/straight-line wait resumes with the predicate still false
+    (the lost-completion shape PR 13 fixed in review). Re-test the
+    predicate in a loop (``while not pred: cv.wait()``), or use
+    ``cv.wait_for(pred)``."""
+
+    code = "PL015"
+    name = "condition-wait-no-predicate-loop"
+    summary = "Condition.wait outside a predicate while loop"
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return _concurrency_scope(ctx)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        parents = ctx.parents
+        for node in ctx.walk():
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "wait"):
+                continue
+            recv = node.func.value
+            tail = None
+            if isinstance(recv, ast.Name):
+                tail = recv.id
+            elif isinstance(recv, ast.Attribute):
+                tail = recv.attr
+            if tail is None or not _CONDISH_RE.search(tail):
+                continue
+            if self._in_while(node, parents):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"{_attr_chain(recv)}.wait() outside a predicate while "
+                f"loop: spurious wakeups and notify races resume with "
+                f"the predicate still false — 'while not <pred>: "
+                f"{tail}.wait()' or wait_for(<pred>)")
+
+    @staticmethod
+    def _in_while(node, parents) -> bool:
+        cur = node
+        while True:
+            entry = parents.get(cur)
+            if entry is None:
+                return False
+            parent, _ = entry
+            if isinstance(parent, ast.While):
+                return True
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                return False
+            cur = parent
+
+
+# ---------------------------------------------------------------------------
+# PL016 — threads without daemon-or-join discipline
+
+
+class ThreadDisciplineRule(Rule):
+    """A ``threading.Thread``/``Timer`` that is neither ``daemon=True``
+    nor joined in its creating function outlives the fleet that spawned
+    it: a non-daemon orphan blocks interpreter exit (the survey CLI
+    hangs after the run 'finished'), and an unjoined worker races
+    teardown for shared state. Every thread in this runtime declares its
+    lifetime: daemon (watchdog, heartbeat renewers, prefetch producers,
+    retry timers) or joined (lane workers, claim loop)."""
+
+    code = "PL016"
+    name = "thread-without-daemon-or-join"
+    summary = "threading.Thread/Timer with neither daemon=True nor a join"
+
+    _CTORS = {"threading.Thread", "Thread", "threading.Timer", "Timer"}
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return _concurrency_scope(ctx)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        parents = ctx.parents
+        for node in ctx.walk():
+            if not (isinstance(node, ast.Call)
+                    and _call_name(node) in self._CTORS):
+                continue
+            if any(kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is True for kw in node.keywords):
+                continue
+            fn = _enclosing_fn(node, parents)
+            scope = fn if fn is not None else None
+            if scope is not None and self._disciplined(scope):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"{_call_name(node)}(...) with neither daemon=True nor "
+                f"a join in the creating function: a non-daemon orphan "
+                f"blocks interpreter exit and races teardown — declare "
+                f"the thread's lifetime (daemon=True, t.daemon = True, "
+                f"or join it)")
+
+    @staticmethod
+    def _disciplined(fn) -> bool:
+        for sub in ast.walk(fn):
+            # <var>.daemon = True
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and tgt.attr == "daemon"
+                            and isinstance(sub.value, ast.Constant)
+                            and sub.value.value is True):
+                        return True
+            # a thread-shaped .join() anywhere in the function
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "join"
+                    and BlockingWhileLockedRule._threadish_join(sub)):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES: Tuple[type, ...] = (
     TruedivIndexRule, BareJaxDevicesRule, NonAtomicWriteRule,
     KnobRegistryDriftRule, DeadFaultPointRule, RawHeaderReadRule,
     MutableDefaultRule, SpanLeakRule, SwallowedFaultRule,
-    RawKnobReadRule,
+    RawKnobReadRule, LockOrderInversionRule, BlockingWhileLockedRule,
+    BareAcquireRule, ConditionWaitPredicateRule, ThreadDisciplineRule,
 )
 
 

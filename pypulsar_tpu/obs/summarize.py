@@ -349,6 +349,36 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
                 for k, n in sorted(s.host_events.get(h, {}).items())
                 if k != "host_registered")
             p(line + ("  " + evs if evs else ""))
+    # lock-health roll-up (round 19): the lockdep wrappers' hold-time
+    # gauges, contention counters and order-violation events — the view
+    # that says WHICH lock a slow fleet is serializing on, and whether
+    # the acquisition discipline held (violations must read 0; a
+    # deferred-interrupt count is the watchdog declining to strand a
+    # held lock, normal under load)
+    lock_names = sorted(
+        {k[len("lock."):-len(".hold_ms")] for k in s.gauges
+         if k.startswith("lock.") and k.endswith(".hold_ms")}
+        | {k[len("lock."):-len(".contended")] for k in s.counters
+           if k.startswith("lock.") and k.endswith(".contended")})
+    n_viol = (s.counters.get("lockdep.order_violations", 0)
+              or s.events.get("lockdep.order_violation", 0))
+    n_defer = (s.counters.get("lockdep.interrupts_deferred", 0)
+               or s.events.get("survey.interrupt_deferred", 0))
+    if lock_names or n_viol or n_defer:
+        head = f"order violations={_fmt_count(n_viol)}"
+        if n_defer:
+            head += f"  interrupts deferred={_fmt_count(n_defer)}"
+        p("#\n# lock health: " + head)
+        for name in lock_names:
+            hold = s.gauges.get(f"lock.{name}.hold_ms", {})
+            wait = s.gauges.get(f"lock.{name}.wait_ms", {})
+            contended = s.counters.get(f"lock.{name}.contended", 0)
+            line = (f"#   {name:<18s} hold max "
+                    f"{hold.get('max', 0):8.3f} ms")
+            if contended:
+                line += (f"  contended {_fmt_count(contended)}"
+                         f" (wait max {wait.get('max', 0):.3f} ms)")
+            p(line)
     health_bits = []
     for key, label in (("survey.watchdog_interrupts", "watchdog interrupts"),
                        ("survey.admission_pauses", "admission pauses"),

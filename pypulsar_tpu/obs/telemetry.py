@@ -122,13 +122,26 @@ class _Span:
         self.attrs.update(attrs)
 
 
+def _session_lock():
+    """The collector's mutex, lockdep-tracked when the resilience layer
+    is importable (``quiet``: this lock sits UNDER every telemetry call,
+    so emitting telemetry about it would recurse) and a plain stdlib
+    lock during half-initialized bootstrap imports — observability must
+    never be the thing that creates an import cycle."""
+    try:
+        from pypulsar_tpu.resilience.locks import TrackedLock
+    except ImportError:  # pragma: no cover - bootstrap half-import
+        return threading.Lock()
+    return TrackedLock("obs.telemetry", quiet=True)
+
+
 class Telemetry:
     """One run's collector. Create via :func:`session`, not directly."""
 
     def __init__(self, path: Optional[str] = None,
                  meta: Optional[Dict[str, Any]] = None):
         self._t0 = time.perf_counter()
-        self._lock = threading.Lock()
+        self._lock = _session_lock()
         self._tls = threading.local()
         # name -> [total_seconds, count] — the aggregate profiling.py kept
         self.stages: Dict[str, List] = {}

@@ -48,6 +48,7 @@ import time
 from typing import Callable, Iterable, Optional, Tuple
 
 from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.resilience.locks import TrackedEvent
 from pypulsar_tpu.resilience.retry import RETRY_BACKOFF_MAX_S  # noqa: F401
 from pypulsar_tpu.tune import knobs
 
@@ -109,7 +110,7 @@ def prefetch(items: Iterable, depth: int = 2, name: str = "prefetch",
     deadline = _resolve_timeout(timeout)
     q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
     _done = object()
-    stop = threading.Event()
+    stop = TrackedEvent("prefetch.stop")
 
     def worker():
         try:

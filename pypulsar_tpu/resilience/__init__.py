@@ -18,7 +18,13 @@ survey pass. The submodules divide the problem:
 - :mod:`~pypulsar_tpu.resilience.health` — the fleet health layer:
   stage heartbeats + deadlines with a watchdog that interrupts wedged
   workers, per-device strike/quarantine accounting, and the
-  disk/backpressure admission gate the survey scheduler consults.
+  disk/backpressure admission gate the survey scheduler consults;
+- :mod:`~pypulsar_tpu.resilience.locks` — lockdep-instrumented
+  Lock/RLock/Condition/Event wrappers (round 19): per-thread held-sets
+  (the watchdog's defer-interrupt-while-locked guard), a global
+  acquisition-order graph with cycle detection
+  (``PYPULSAR_TPU_LOCKDEP`` warn/strict), hold/contention telemetry,
+  and the seeded lock-boundary pauses ``bench.py --race`` drives.
 
 The failure model itself (what is retried, what is journaled, what is
 fatal) is documented in docs/ARCHITECTURE.md "Failure model & recovery".
@@ -51,6 +57,13 @@ from pypulsar_tpu.resilience.journal import (  # noqa: F401
     atomic_write_text,
     candfile_complete,
     file_digest,
+)
+from pypulsar_tpu.resilience.locks import (  # noqa: F401
+    LockOrderError,
+    TrackedCondition,
+    TrackedEvent,
+    TrackedLock,
+    TrackedRLock,
 )
 from pypulsar_tpu.resilience.retry import (  # noqa: F401
     halving_dispatch,

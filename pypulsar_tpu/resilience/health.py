@@ -46,6 +46,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.resilience import locks
 # the historical typo-tolerant helper is now a re-export of the knob
 # registry's read path (round 17): registered knobs resolve env > tuned
 # cache > declared default; unregistered names keep the old
@@ -54,6 +55,7 @@ from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.tune.knobs import env_float  # noqa: F401
 
 __all__ = [
+    "DEFERRED",
     "DeviceHealth",
     "HeartbeatEntry",
     "HeartbeatRegistry",
@@ -99,14 +101,37 @@ class StageStalled(StageTimeout):
     """The stage stopped heartbeating for longer than the stall bound."""
 
 
-def interrupt_thread(thread_id: int, exc_type: type) -> bool:
+# interrupt_thread's third verdict (round 19): the target currently
+# holds a lockdep-tracked lock, so delivery is withheld — the caller
+# retries next tick. Truthy ON PURPOSE: legacy ``assert
+# interrupt_thread(...)`` call sites read deferral as "the thread is
+# being handled", never as "the thread is gone".
+DEFERRED = "deferred"
+
+
+def interrupt_thread(thread_id: int, exc_type: type, *,
+                     force: bool = False):
     """Raise ``exc_type`` asynchronously in the thread ``thread_id``
     (CPython's ``PyThreadState_SetAsyncExc``). The exception lands at
     the thread's next bytecode boundary — which is why the injected
     ``hang`` fault sleeps in small increments instead of one long
     ``sleep``. Returns False when the thread is gone (raced with
     completion); a result > 1 means the interpreter refused and the
-    request is withdrawn."""
+    request is withdrawn.
+
+    Async-interrupt safety (round 19): when the target thread holds any
+    lockdep-tracked lock (``resilience.locks.thread_holds_lock``), the
+    exception is NOT delivered and :data:`DEFERRED` is returned instead
+    — an exception landing inside a held-lock window can strand the
+    lock (the ``with`` protocol never runs ``__exit__`` for an acquire
+    it never returned from) or tear a locked invariant mid-update.
+    Callers poll (the watchdog re-arms the entry and retries next tick;
+    the claim loop's zombie check re-fires every poll), so delivery
+    lands at the first unlocked boundary. ``force=True`` bypasses the
+    guard — last-resort teardown only."""
+    if not force and locks.thread_holds_lock(thread_id):
+        telemetry.counter("lockdep.interrupts_deferred")
+        return DEFERRED
     res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
         ctypes.c_ulong(thread_id), ctypes.py_object(exc_type))
     if res > 1:  # pragma: no cover - interpreter refused: undo
@@ -152,7 +177,7 @@ class HeartbeatRegistry:
     retry -> quarantine policy), never artifacts."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.TrackedLock("health.heartbeats")
         self._entries: Dict[int, HeartbeatEntry] = {}  # id(entry) keyed
         self._by_thread: Dict[int, HeartbeatEntry] = {}
 
@@ -189,6 +214,16 @@ class HeartbeatRegistry:
         stage that completed since :meth:`expired` is never shot at."""
         with self._lock:
             return id(entry) in self._entries
+
+    def rearm(self, entry: HeartbeatEntry) -> None:
+        """Put a fired entry back on the watchdog's radar — the
+        deferred-interrupt retry path: :meth:`expired` marks an entry
+        fired exactly once, so a verdict whose delivery was withheld
+        (the target held a tracked lock) must be re-armed to be
+        re-returned on the next poll tick."""
+        with self._lock:
+            if id(entry) in self._entries:
+                entry.fired = False
 
     def expired(self, now: Optional[float] = None) \
             -> List[Tuple[HeartbeatEntry, str]]:
@@ -227,7 +262,7 @@ class Watchdog:
         self.registry = registry
         self.interval = interval
         self._on_expire = on_expire
-        self._stop = threading.Event()
+        self._stop = locks.TrackedEvent("health.watchdog_stop")
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
@@ -322,7 +357,7 @@ class DeviceHealth:
             limit = int(env_float(ENV_DEVICE_STRIKES,
                                   DEFAULT_DEVICE_STRIKES))
         self.limit = max(1, int(limit))
-        self._lock = threading.Lock()
+        self._lock = locks.TrackedLock("health.devices")
         self._strikes: Dict[int, int] = {}
         self._quarantined: set = set()
         self._last_error: Dict[int, str] = {}
@@ -403,7 +438,7 @@ class HostHealth:
         if limit is None:
             limit = int(env_float(self.ENV_HOST_STRIKES, 3))
         self.limit = max(1, int(limit))
-        self._lock = threading.Lock()
+        self._lock = locks.TrackedLock("health.hosts")
         self._strikes: Dict[str, int] = {}
         self._quarantined: set = set()
         self._last_error: Dict[str, str] = {}

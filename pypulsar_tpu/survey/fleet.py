@@ -73,6 +73,7 @@ from typing import Dict, List, Optional
 
 from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.resilience.locks import TrackedEvent
 from pypulsar_tpu.tune import knobs
 
 __all__ = [
@@ -176,7 +177,7 @@ class FleetPlane:
             os.makedirs(d, exist_ok=True)
         self.token: Optional[int] = None  # the HOST lease's token
         self._renew: Optional[threading.Thread] = None
-        self._stop = threading.Event()
+        self._stop = TrackedEvent("fleet.renew_stop")
 
     # -- fencing tokens ------------------------------------------------------
 

@@ -105,6 +105,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.resilience import faultinject
 from pypulsar_tpu.resilience import health as health_mod
+from pypulsar_tpu.resilience import locks as locks_mod
 from pypulsar_tpu.resilience.retry import backoff_delay, is_oom_error
 from pypulsar_tpu.survey import fleet as fleet_mod
 from pypulsar_tpu.survey.dag import StageSpec, SurveyConfig, build_dag, stage_names
@@ -270,8 +271,13 @@ class FleetScheduler:
         self.max_bad_frac = float(max_bad_frac)
         self._admission_blocked = False  # one event per pause episode
 
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        # ONE mutex behind two guards (the bare lock for state peeks,
+        # the condition for wait/notify) — lockdep-tracked under a
+        # single name, so the order graph sees them as the one lock
+        # they are (docs/ARCHITECTURE.md "Concurrency model")
+        self._lock = locks_mod.TrackedLock("survey.sched")
+        self._cv = locks_mod.TrackedCondition("survey.sched",
+                                              lock=self._lock)
         self._device_q: "queue.PriorityQueue" = queue.PriorityQueue()
         self._host_q: "queue.PriorityQueue" = queue.PriorityQueue()
         self._seq = 0
@@ -486,7 +492,10 @@ class FleetScheduler:
         we were presumed dead): async-interrupt any stage of it still
         RUNNING with StaleLeaseError so its artifact writes stop within
         one poll tick — waiting for the stage's next manifest append
-        could leave a zombie writer racing the adopter for minutes."""
+        could leave a zombie writer racing the adopter for minutes.
+        A DEFERRED delivery (the stage holds a tracked lock right now)
+        is fine: the claim loop calls this every poll tick, so the
+        interrupt retries until it lands at an unlocked boundary."""
         for entry in self._hb.active():
             task = entry.payload
             if getattr(task, "obs_i", None) == obs_i:
@@ -834,8 +843,23 @@ class FleetScheduler:
         # fleet. (The remaining finish-vs-raise race is closed by the
         # worker loop's StageTimeout catch and the done_recorded
         # guard in _handle_failure.)
-        if not self._hb.is_active(entry) \
-                or not health_mod.interrupt_thread(entry.thread_id, exc):
+        if not self._hb.is_active(entry):
+            telemetry.event("survey.late_interrupt", obs=obs.name,
+                            stage=task.stage.name)
+            return
+        res = health_mod.interrupt_thread(entry.thread_id, exc)
+        if res is health_mod.DEFERRED:
+            # the stage currently holds a lockdep-tracked lock: an
+            # async exception landing there could strand the lock or
+            # tear a locked invariant. The verdict STANDS — re-arm the
+            # entry so the next watchdog tick retries; delivery lands
+            # at the first unlocked boundary (round 19 contract;
+            # regression: tests/test_lockdep.py)
+            self._hb.rearm(entry)
+            telemetry.event("survey.interrupt_deferred", obs=obs.name,
+                            stage=task.stage.name, reason=reason)
+            return
+        if not res:
             telemetry.event("survey.late_interrupt", obs=obs.name,
                             stage=task.stage.name)
             return

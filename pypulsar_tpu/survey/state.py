@@ -24,12 +24,12 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import threading
 import time
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence
 
 from pypulsar_tpu.resilience.journal import RunJournal, atomic_write_text
+from pypulsar_tpu.resilience.locks import TrackedLock
 
 __all__ = [
     "ObsManifest",
@@ -146,7 +146,7 @@ class ObsManifest:
         # cannot know who wrote the file
         self._journal = RunJournal(path, fingerprint, tool="survey",
                                    shared=True)
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("survey.manifest")
         self.path = path
         self.token = token
         self._fence = fence
@@ -424,7 +424,7 @@ class ObsTrace:
     process-global session — which the fleet trace owns."""
 
     def __init__(self, path: str, obs: str, append: bool = False):
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("survey.obstrace")
         self._t0 = time.perf_counter()
         self._fh: Optional[object] = None
         # a resumed fleet APPENDS: the killed run's recorded stage spans

@@ -104,6 +104,10 @@ _REGISTRY: Dict[str, Knob] = {}
 # the stage entry points; each knob binds to exactly ONE stage, so two
 # concurrent stages of different kinds never collide on a key)
 _tuned: Dict[str, Any] = {}
+# a plain stdlib lock at bootstrap (this module may not import the
+# package); resilience.locks swaps in its lockdep-tracked wrapper the
+# first time the resilience layer loads (_adopt_bootstrap_locks) — a
+# leaf in the canonical hierarchy, never held across another acquire
 _tuned_lock = threading.Lock()
 
 # thread-local trial overlay stack (the searcher's timed candidates)
@@ -372,6 +376,25 @@ env_knob("PYPULSAR_TPU_MAX_BAD_FRAC", "float", 0.5, "data",
 env_knob("PYPULSAR_TPU_DATAGUARD", "str", "1", "data",
          invariant=False,
          help="0 disables the on-device non-finite stream scrub")
+
+# -- concurrency / lockdep --------------------------------------------------
+env_knob("PYPULSAR_TPU_LOCKDEP", "str", "warn", "concurrency",
+         invariant=False,
+         help="lock-discipline runtime mode: warn (default; a detected "
+              "acquisition-order cycle emits a lockdep.order_violation "
+              "telemetry event), strict (raise LockOrderError, the "
+              "offending lock is never held), off (disable held-set/"
+              "order tracking entirely)")
+env_knob("PYPULSAR_TPU_RACE_SEED", "int", 0, "concurrency",
+         invariant=False,
+         help="seed for the interleaving stress harness's deterministic "
+              "lock-boundary pauses (bench.py --race)")
+env_knob("PYPULSAR_TPU_RACE_PAUSE_US", "float", 0.0, "concurrency",
+         invariant=False,
+         help="arm seeded pauses of up to this many microseconds at "
+              "every tracked lock acquire/release (0 = off); widens "
+              "race windows for the --race harness and its subprocess "
+              "children")
 
 # -- fault injection --------------------------------------------------------
 env_knob("PYPULSAR_TPU_FAULTS", "str", None, "faults",

@@ -26,6 +26,22 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
+def _lockdep_strict(monkeypatch):
+    """Round 19: the whole suite (and every subprocess it spawns —
+    children inherit the env) runs under PYPULSAR_TPU_LOCKDEP=strict,
+    so ANY lock-acquisition-order cycle the survey/multihost/prefetch
+    paths produce raises LockOrderError instead of warning. An explicit
+    operator setting wins (so `PYPULSAR_TPU_LOCKDEP=off make test`
+    still works); lockdep-mode tests monkeypatch their own value."""
+    from pypulsar_tpu.resilience import locks
+
+    if "PYPULSAR_TPU_LOCKDEP" not in os.environ:
+        monkeypatch.setenv("PYPULSAR_TPU_LOCKDEP", "strict")
+    locks.reset()  # per-test: re-resolve mode, isolate the order graph
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _hermetic_tuning(tmp_path_factory, monkeypatch):
     """Round 17: the CLIs consult the persisted tuning cache by default.
     Point every test at a throwaway cache file (never the developer's

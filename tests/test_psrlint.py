@@ -391,6 +391,259 @@ def test_pl011_near_miss(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# PL012 lock-order inversion (psrrace static, round 19)
+
+def test_pl012_cross_file_cycle(tmp_path):
+    # the AB/BA deadlock split across two files: the acquisition graph
+    # is project-wide (class-qualified keys merge), so each half looks
+    # innocent alone and the CYCLE is the finding
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/a.py":
+            "def one(sched, health):\n"
+            "    with sched._lock:\n"
+            "        with health._lock:\n"
+            "            pass\n",
+        "pypulsar_tpu/b.py":
+            "def two(sched, health):\n"
+            "    with health._lock:\n"
+            "        with sched._lock:\n"
+            "            pass\n",
+    }, select="PL012")
+    # non-self receivers key by their chain verbatim, so conventionally
+    # named receivers merge across files and the CYCLE is the finding
+    assert codes(rep) == ["PL012"]
+    assert "cycle" in rep.findings[0].message
+
+
+def test_pl012_self_deadlock_and_consistent_order(tmp_path):
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/mod.py":
+            "import threading\n"
+            "a_lock = threading.Lock()\n"
+            "b_lock = threading.Lock()\n"
+            "def nested_same():\n"
+            "    with a_lock:\n"
+            "        with a_lock:\n"
+            "            pass\n",
+    }, select="PL012")
+    assert codes(rep) == ["PL012"]
+    assert "non-reentrant" in rep.findings[0].message
+
+
+def test_pl012_near_miss(tmp_path):
+    # a consistent order everywhere, a reentrant rlock re-with, and
+    # non-lock context managers are all silent
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/mod.py":
+            "import threading\n"
+            "a_lock = threading.Lock()\n"
+            "b_lock = threading.Lock()\n"
+            "an_rlock = threading.RLock()\n"
+            "def one():\n"
+            "    with a_lock:\n"
+            "        with b_lock:\n"
+            "            pass\n"
+            "def two():\n"
+            "    with a_lock:\n"
+            "        with b_lock:\n"
+            "            pass\n"
+            "def re():\n"
+            "    with an_rlock:\n"
+            "        with an_rlock:\n"
+            "            pass\n"
+            "def files(path):\n"
+            "    with open(path) as f:\n"
+            "        with open(path + '2') as g:\n"
+            "            return f, g\n",
+    }, select="PL012")
+    assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# PL013 blocking call while holding a lock
+
+def test_pl013_true_positive(tmp_path):
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/mod.py":
+            "import time, threading, subprocess\n"
+            "a_lock = threading.Lock()\n"
+            "def slow(t, fut):\n"
+            "    with a_lock:\n"
+            "        time.sleep(1)\n"
+            "        open('x.txt').read()\n"
+            "        subprocess.run(['true'])\n"
+            "        fut.result()\n"
+            "        t.join(timeout=5)\n",
+    }, select="PL013")
+    assert len(codes(rep)) == 5
+    assert all(c == "PL013" for c in codes(rep))
+
+
+def test_pl013_near_miss(tmp_path):
+    # blocking work OUTSIDE the critical section, a cv.wait (releases
+    # the lock by contract), str.join, and a closure defined (not run)
+    # under the lock are all silent
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/mod.py":
+            "import time, threading\n"
+            "a_lock = threading.Lock()\n"
+            "a_cv = threading.Condition(a_lock)\n"
+            "def ok(parts):\n"
+            "    with a_lock:\n"
+            "        n = len(parts)\n"
+            "        name = ','.join(parts)\n"
+            "    time.sleep(0.1)\n"
+            "    with a_cv:\n"
+            "        while n:\n"
+            "            a_cv.wait(0.1)\n"
+            "            n -= 1\n"
+            "    with a_lock:\n"
+            "        def later():\n"
+            "            time.sleep(1)\n"
+            "        return later, name\n",
+    }, select="PL013")
+    assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# PL014 bare acquire
+
+def test_pl014_true_positive(tmp_path):
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/mod.py":
+            "import threading\n"
+            "a_lock = threading.Lock()\n"
+            "def leak():\n"
+            "    a_lock.acquire()\n"
+            "    work = 1\n"
+            "    a_lock.release()\n"
+            "    return work\n",
+    }, select="PL014")
+    assert codes(rep) == ["PL014"]
+
+
+def test_pl014_near_miss(tmp_path):
+    # acquire-then-try/finally (both shapes: next-sibling and inside
+    # the try), the with statement, and non-lock .acquire() names
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/mod.py":
+            "import threading\n"
+            "a_lock = threading.Lock()\n"
+            "def sibling():\n"
+            "    a_lock.acquire()\n"
+            "    try:\n"
+            "        return 1\n"
+            "    finally:\n"
+            "        a_lock.release()\n"
+            "def inside():\n"
+            "    try:\n"
+            "        a_lock.acquire()\n"
+            "        return 1\n"
+            "    finally:\n"
+            "        a_lock.release()\n"
+            "def managed():\n"
+            "    with a_lock:\n"
+            "        return 1\n"
+            "def other(backend):\n"
+            "    backend.acquire()\n",
+    }, select="PL014")
+    assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# PL015 condition wait outside a predicate loop
+
+def test_pl015_true_positive(tmp_path):
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/mod.py":
+            "import threading\n"
+            "cv = threading.Condition()\n"
+            "def bad(ready):\n"
+            "    with cv:\n"
+            "        if not ready():\n"
+            "            cv.wait()\n",
+    }, select="PL015")
+    assert codes(rep) == ["PL015"]
+
+
+def test_pl015_near_miss(tmp_path):
+    # while-loop waits (incl. while True) and wait_for are the
+    # sanctioned shapes; Event/processes named un-cv-ishly are out of
+    # scope (an Event.wait has no predicate contract to violate)
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/mod.py":
+            "import threading\n"
+            "cv = threading.Condition()\n"
+            "stop = threading.Event()\n"
+            "def good(ready):\n"
+            "    with cv:\n"
+            "        while not ready():\n"
+            "            cv.wait(0.1)\n"
+            "def forever():\n"
+            "    with cv:\n"
+            "        while True:\n"
+            "            cv.wait(0.1)\n"
+            "def pred(ready):\n"
+            "    with cv:\n"
+            "        cv.wait_for(ready)\n"
+            "def ev(proc):\n"
+            "    stop.wait(1.0)\n"
+            "    proc.wait()\n",
+    }, select="PL015")
+    assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# PL016 thread daemon-or-join discipline
+
+def test_pl016_true_positive(tmp_path):
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/mod.py":
+            "import threading\n"
+            "def orphan(fn):\n"
+            "    t = threading.Thread(target=fn)\n"
+            "    t.start()\n"
+            "    return t\n",
+    }, select="PL016")
+    assert codes(rep) == ["PL016"]
+
+
+def test_pl016_near_miss(tmp_path):
+    # daemon kwarg, .daemon assignment (Timer idiom), and a join in the
+    # creating function are all declared lifetimes; sep.join(parts)
+    # must not count as a thread join
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/mod.py":
+            "import threading\n"
+            "def daemonized(fn):\n"
+            "    t = threading.Thread(target=fn, daemon=True)\n"
+            "    t.start()\n"
+            "def timered(fn):\n"
+            "    t = threading.Timer(0.5, fn)\n"
+            "    t.daemon = True\n"
+            "    t.start()\n"
+            "def joined(fn, parts):\n"
+            "    name = ','.join(parts)\n"
+            "    t = threading.Thread(target=fn, name=name)\n"
+            "    t.start()\n"
+            "    t.join(timeout=5)\n",
+    }, select="PL016")
+    assert codes(rep) == []
+
+
+def test_pl016_str_join_does_not_count(tmp_path):
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/mod.py":
+            "import threading\n"
+            "def sneaky(fn, parts):\n"
+            "    t = threading.Thread(target=fn)\n"
+            "    t.start()\n"
+            "    return ','.join(parts)\n",
+    }, select="PL016")
+    assert codes(rep) == ["PL016"]
+
+
+# ---------------------------------------------------------------------------
 # suppressions / select / ignore / baseline / output
 
 def test_suppression_silences_and_unused_is_flagged(tmp_path):
@@ -508,7 +761,8 @@ def test_report_json_schema(tmp_path):
 
 def test_rule_catalog_complete():
     got = {r.code for r in all_rules()}
-    assert got == {f"PL00{i}" for i in range(1, 10)} | {"PL011"}
+    assert got == ({f"PL00{i}" for i in range(1, 10)}
+                   | {f"PL01{i}" for i in range(1, 7)})
     assert all(r.summary and r.name for r in all_rules())
 
 
