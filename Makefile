@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier test-faults test-fold test-survey test-corruption test-tune test-multihost test-race lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-survey bench-multichip bench-multihost-fleet bench-specfuse bench-telemetry bench-tree bench-tune native clean
+.PHONY: test test-fourier test-faults test-fold test-obs test-survey test-corruption test-tune test-multihost test-race lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-obs bench-survey bench-multichip bench-multihost-fleet bench-specfuse bench-telemetry bench-tree bench-tune native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -14,15 +14,17 @@ smoke:
 probe:
 	$(PY) tools/tpu_component_probe.py
 
-test: lint
+test: lint test-obs
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
 
 # the static-analysis gate (docs/ARCHITECTURE.md "Static analysis"):
-# psrlint's project-invariant rules PL001-PL016 (each locks in a bug
+# psrlint's project-invariant rules PL001-PL017 (each locks in a bug
 # class an earlier PR fixed by hand — PL011: raw PYPULSAR_TPU_* env
 # reads outside the tune/knobs.py registry; PL012-PL016: the psrrace
 # concurrency rules — lock-order cycles, blocking-under-lock, bare
-# acquires, unguarded condition waits, orphanable threads; baseline
+# acquires, unguarded condition waits, orphanable threads; PL017:
+# telemetry names consumed by tlmsum/bench/tests must match an emitter,
+# and emitted events must have a consumer; baseline
 # empty by policy), then the
 # third-party ruff pass (pyproject [tool.ruff], crash-bug classes
 # only) when the container ships ruff — the image this repo grows in
@@ -45,9 +47,17 @@ test-fourier:
 # survey orchestrator's kill/resume/quarantine and fleet-health
 # (watchdog, device-strike, admission) cases, and the seeded chaos
 # fleet
-test-faults: test-chaos test-corruption test-multihost test-race
+test-faults: test-chaos test-corruption test-multihost test-race test-obs
 	$(CPU_ENV) $(PY) -m pytest tests/test_resilience.py -q
 	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -k "kill or resume or quarantine or retry or stall or deadline or evict or admission or chaos"
+
+# the observability-plane suite (round 21): causal trace ids surviving
+# kill+resume and cross-host adoption (one stitched trace, tlmtrace
+# --check clean), log2 latency histograms + SLO burn accounting through
+# tlmsum, postmortem capsules at every failure edge, heartbeat
+# trace-attribution, and the live /status.json + /metrics endpoint
+test-obs:
+	$(CPU_ENV) $(PY) -m pytest tests/test_obs.py tests/test_obs_plane.py -q
 
 # the concurrency-correctness suite (round 19, psrrace): lockdep unit
 # tests + the watchdog defer-interrupt-while-locked regression under
@@ -148,6 +158,13 @@ test-fold:
 bench-fold:
 	$(PY) bench.py --fold
 
+# the observability-plane overhead A/B (round 21): instrumentation-off
+# vs flight-recorder-only vs full telemetry on the toy sweep->accel
+# fleet — candidates byte-checked identical, full overhead asserted
+# <= 5% in-process -> OBS_r01.json (the committed record)
+bench-obs: test-obs
+	$(CPU_ENV) $(PY) bench.py --obs-overhead --quick --out OBS_r01.json
+
 # the survey orchestrator A/B: serial per-observation chain vs the
 # fleet scheduler (host/device overlap) on 4 toy observations
 bench-survey:
@@ -163,10 +180,13 @@ bench-multichip:
 	$(CPU_ENV) $(PY) bench.py --survey --devices 4 --out BENCH_r09_multichip.json
 
 # multi-host fleet (round 18): the coordination-plane suite, then the
-# 3-process harness — clean fleet A/B vs the 1-host serial chain, a
+# 3-process harness — clean fleet A/B vs the 1-host serial chain
+# (with the round-21 live --status-port endpoint scraped mid-fleet), a
 # host SIGKILL'd mid-sweep with fenced adoption by survivors, byte
-# parity both legs, final resume re-runs zero stages ->
-# BENCH_r13_multihost.json + HOSTCHAOS_r01.json
+# parity both legs, final resume re-runs zero stages, and the kill
+# leg's traces tlmtrace-stitched with the adoption asserted visible as
+# a lane handover -> BENCH_r13_multihost.json + HOSTCHAOS_r01.json +
+# OBS_trace_r01.json
 bench-multihost-fleet:
 	$(CPU_ENV) $(PY) -m pytest tests/test_multihost.py -q
 	$(CPU_ENV) $(PY) bench.py --multihost --quick --out BENCH_r13_multihost.json --hostchaos-out HOSTCHAOS_r01.json

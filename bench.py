@@ -113,6 +113,12 @@ def parse_args(argv=None):
                          "1-device orchestrated run. Needs that many "
                          "JAX devices (CPU recipe: XLA_FLAGS="
                          "--xla_force_host_platform_device_count=8)")
+    ap.add_argument("--obs-overhead", action="store_true",
+                    help="A/B the round-21 observability plane on a toy "
+                         "sweep->accel fleet: instrumentation-off vs "
+                         "flight-recorder-only vs full telemetry, "
+                         "candidates byte-checked identical and the "
+                         "full overhead asserted <= 5% (OBS_rXX.json)")
     ap.add_argument("--chaos", action="store_true",
                     help="run a toy fleet under seeded probabilistic "
                          "fault chaos (kills + OOMs + IO errors + hangs "
@@ -136,6 +142,13 @@ def parse_args(argv=None):
                     metavar="PATH",
                     help="with --multihost: where the host-kill chaos "
                          "record lands (default HOSTCHAOS_r01.json)")
+    ap.add_argument("--trace-out", default="OBS_trace_r01.json",
+                    metavar="PATH",
+                    help="with --multihost: where the tlmtrace-stitched "
+                         "Perfetto/Chrome-trace JSON of the host-kill "
+                         "leg lands — the adoption is visible as a lane "
+                         "handover on one trace_id (default "
+                         "OBS_trace_r01.json; empty string disables)")
     ap.add_argument("--race", action="store_true",
                     help="seeded interleaving stress harness (psrrace): "
                          "a toy fleet on 2 in-process hosts + a leaving "
@@ -2302,6 +2315,153 @@ def run_chaos(args):
     }
 
 
+def run_obs_overhead(args):
+    """Observability-plane overhead A/B (round 21's zero-overhead
+    contract, measured): the SAME toy sweep->accel chain over a small
+    fleet, run three ways —
+
+    - **off**: flight recorder disabled (``PYPULSAR_TPU_OBS_FLIGHTREC=0``
+      semantics via ``flightrec.configure(0)``), no telemetry session —
+      the true zero-instrumentation floor;
+    - **flightrec**: the always-on default — the in-memory ring records
+      every span/counter, nothing hits disk;
+    - **full**: flight recorder + a live ``--telemetry`` JSONL session +
+      per-observation obs traces (``telemetry_dir``) — everything the
+      observability plane can write.
+
+    Each leg is min-of-``reps`` over a freshly-dirs'd fleet after a full
+    warmup chain, candidates are byte-checked identical across legs
+    (observability must never touch science), and the full-vs-off
+    overhead is asserted <= 5% in-process — the bound ROADMAP's
+    "passenger, never the payload" rule means."""
+    acquire_backend()
+    import glob as _glob
+    import tempfile
+
+    from pypulsar_tpu.obs import flightrec, telemetry
+    from pypulsar_tpu.survey.dag import SurveyConfig, build_dag
+    from pypulsar_tpu.survey.scheduler import FleetScheduler
+    from pypulsar_tpu.survey.state import Observation
+
+    n_obs = 2
+    # min-of-N is the noise floor: the toy chain is ~2 s, so scheduler
+    # jitter is a few percent per rep — enough reps that the minima
+    # compare floors, not jitter
+    reps = 3 if (args.quick or args.cpu_fallback) else 5
+    C, T, dtp = 32, 1 << 14, 5e-4
+    rng_freqs = 1500.0 - 4.0 * np.arange(C)
+    cfg = SurveyConfig(
+        lodm=0.0, dmstep=10.0, numdms=8, nsub=8, group_size=4,
+        threshold=8.0, accel_zmax=20.0, accel_numharm=2,
+        accel_sigma=3.0, accel_batch=4)
+    stages = build_dag(cfg)
+    overhead_bound = 0.05
+
+    with tempfile.TemporaryDirectory() as td:
+        fils = [_synth_survey_fil(os.path.join(td, f"obs{i}.fil"),
+                                  11 + i, C, T, dtp, rng_freqs,
+                                  f"BENCH{i}", dm=40.0,
+                                  period=0.1024 * (1.0 + 0.07 * i),
+                                  amp=10.0)
+                for i in range(n_obs)]
+
+        def fleet(dirname):
+            out = os.path.join(td, dirname)
+            os.makedirs(out, exist_ok=True)
+            return [Observation(f"obs{i}", fils[i],
+                                os.path.join(out, f"obs{i}"))
+                    for i in range(n_obs)]
+
+        # warmup: one full chain compiles every stage's jit programs
+        for stage in stages:
+            stage.execute(fleet("warm")[0], cfg)
+
+        def leg(name, rep, telemetry_dir=None):
+            obs = fleet(f"{name}{rep}")
+            t0 = time.perf_counter()
+            result = FleetScheduler(obs, cfg, max_host_workers=2,
+                                    devices=1,
+                                    telemetry_dir=telemetry_dir).run()
+            dt = time.perf_counter() - t0
+            assert result.ok and len(result.ran) == n_obs * len(stages)
+            return dt
+
+        legs = {}
+        try:
+            # interleave reps so drift (thermal, page cache) hits all
+            # three legs evenly instead of the last one measured
+            for rep in range(reps):
+                flightrec.configure(0)
+                legs.setdefault("off", []).append(leg("off", rep))
+                flightrec.configure(None)
+                legs.setdefault("flightrec", []).append(leg("ring", rep))
+                tlm_dir = os.path.join(td, f"tlm{rep}")
+                with telemetry.session(os.path.join(td, f"full{rep}.jsonl"),
+                                       tool="bench-obs"):
+                    legs.setdefault("full", []).append(
+                        leg("full", rep, telemetry_dir=tlm_dir))
+        finally:
+            flightrec.configure(None)
+
+        # byte parity: candidates identical across all three legs
+        def _parity(dir_a, dir_b):
+            ident = tot = 0
+            for pattern in ("*_ACCEL_*.cand", "*_ACCEL_*.txtcand"):
+                for fa in sorted(_glob.glob(os.path.join(td, dir_a,
+                                                         pattern))):
+                    fb = os.path.join(td, dir_b, os.path.basename(fa))
+                    tot += 1
+                    if (os.path.exists(fb) and open(fa, "rb").read()
+                            == open(fb, "rb").read()):
+                        ident += 1
+            return ident, tot
+
+        ident_r, tot_r = _parity("off0", "ring0")
+        ident_f, tot_f = _parity("off0", "full0")
+        assert ident_r == tot_r and tot_r > 0, \
+            f"flightrec leg diverged: {ident_r}/{tot_r}"
+        assert ident_f == tot_f and tot_f > 0, \
+            f"full-telemetry leg diverged: {ident_f}/{tot_f}"
+
+    off_s = min(legs["off"])
+    ring_s = min(legs["flightrec"])
+    full_s = min(legs["full"])
+    ring_frac = ring_s / off_s - 1.0
+    full_frac = full_s / off_s - 1.0
+    print(f"# obs overhead A/B: off {off_s:.3f}s, flightrec "
+          f"{ring_s:.3f}s ({100 * ring_frac:+.1f}%), full telemetry "
+          f"{full_s:.3f}s ({100 * full_frac:+.1f}%) — min of {reps} "
+          f"reps, {n_obs} obs x {len(stages)} stages, "
+          f"{ident_f}/{tot_f} candidates byte-identical",
+          file=sys.stderr)
+    assert full_frac <= overhead_bound, (
+        f"observability plane costs {100 * full_frac:.1f}% "
+        f"(> {100 * overhead_bound:.0f}%): the passenger is steering")
+    return {
+        "metric": "obs_overhead_frac",
+        "value": round(full_frac, 4),
+        "unit": (f"fractional wall-clock overhead of the FULL "
+                 f"observability plane (flight recorder + telemetry "
+                 f"session + obs traces) vs instrumentation-off on the "
+                 f"toy sweep->accel fleet ({n_obs} obs x {len(stages)} "
+                 f"stages, {C}-chan x {T}-sample, min of {reps} reps, "
+                 f"warm jit; bound asserted <= {overhead_bound})"),
+        "vs_baseline": 0.0,
+        "obs_off_seconds": round(off_s, 4),
+        "obs_flightrec_seconds": round(ring_s, 4),
+        "obs_full_seconds": round(full_s, 4),
+        "obs_flightrec_overhead_frac": round(ring_frac, 4),
+        "obs_full_overhead_frac": round(full_frac, 4),
+        "obs_overhead_bound": overhead_bound,
+        "obs_reps": reps,
+        "obs_n_obs": n_obs,
+        "obs_n_stages": len(stages),
+        "obs_candidates_identical": f"{ident_f}/{tot_f}",
+        "obs_nsamp": T,
+        "obs_nchan": C,
+    }
+
+
 def run_race(args):
     """Seeded interleaving stress harness (psrrace's dynamic acceptance
     measurement, round 19): run a toy fleet CLEAN (single host, no
@@ -2559,11 +2719,21 @@ def run_multihost(args):
     the victim really died by signal, and a final no-fault single-host
     ``--resume`` over the kill leg's outdir re-runs ZERO stages. The
     wall-clock A/B is a CPU toy (hosts share one machine's cores) — the
-    committed claims are the adoption/fencing/parity structure."""
+    committed claims are the adoption/fencing/parity structure.
+
+    Round-21 observability riders: the clean leg's host0 runs with
+    ``--status-port 0`` and this process scrapes the LIVE
+    ``/status.json`` + Prometheus ``/metrics`` mid-fleet; the kill
+    leg's traces are fed through ``tlmtrace --check`` (no dangling
+    parent_ids even across a SIGKILL'd host) and stitched into the
+    committed Perfetto JSON (``--trace-out``), with the adoption
+    asserted visible as a lane handover on one trace_id."""
     acquire_backend()
     import glob as _glob
+    import re
     import signal
     import tempfile
+    import urllib.request
 
     from pypulsar_tpu.survey.dag import SurveyConfig, build_dag
     from pypulsar_tpu.survey.scheduler import FleetScheduler
@@ -2592,7 +2762,8 @@ def run_multihost(args):
              "--fold-nbins", "32", "--fold-npart", "8"]
     repo_root = os.path.dirname(os.path.abspath(__file__))
 
-    def spawn_host(rank, fils, outdir, tlmdir, logdir, extra_env=None):
+    def spawn_host(rank, fils, outdir, tlmdir, logdir, extra_env=None,
+                   extra_flags=None):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = (repo_root + os.pathsep
@@ -2606,9 +2777,10 @@ def run_multihost(args):
         proc = subprocess.Popen(
             [sys.executable, "-m", "pypulsar_tpu.cli", "survey",
              *fils, "-o", outdir, *flags, "--host-id", f"host{rank}",
-             "--telemetry-dir", tlmdir],
+             "--telemetry-dir", tlmdir, *(extra_flags or [])],
             env=env, stdout=log, stderr=subprocess.STDOUT)
         proc._log = log  # closed on wait below
+        proc._logpath = log.name
         return proc
 
     def wait_hosts(procs, timeout=900):
@@ -2678,12 +2850,60 @@ def run_multihost(args):
               file=sys.stderr)
 
         # leg 1 — clean 3-host fleet (subprocess hosts, cold jit caches:
-        # the wall includes per-host compile, stated in the record)
+        # the wall includes per-host compile, stated in the record).
+        # host0 carries the round-21 endpoint smoke: --status-port 0
+        # binds a free port, and while the fleet is LIVE we scrape both
+        # /status.json and the Prometheus /metrics from this process.
         mdir, mobs = fleet("mh")
         mtlm = os.path.join(td, "mh_tlm")
         t0 = time.perf_counter()
-        procs = [spawn_host(r, fils, mdir, mtlm, td) for r in
-                 range(n_hosts)]
+        procs = [spawn_host(r, fils, mdir, mtlm, td,
+                            extra_flags=(["--status-port", "0"]
+                                         if r == 0 else None))
+                 for r in range(n_hosts)]
+        status_url = None
+        url_re = re.compile(r"live status at (http://[^/\s]+)/status\.json")
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline and status_url is None:
+            if procs[0].poll() is not None:
+                break  # host0 already exited — the asserts below will say
+            try:
+                m = url_re.search(open(procs[0]._logpath).read())
+            except OSError:
+                m = None
+            if m:
+                status_url = m.group(1)
+            else:
+                time.sleep(0.2)
+        assert status_url, "host0 never announced its --status-port URL"
+        # the server lives for host0's whole scheduler run, so these
+        # fetches hit a LIVE endpoint — but observation rows only
+        # appear once the first manifests land, a moment after the
+        # claims, so poll the snapshot until they do
+        snap = None
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            try:
+                snap = json.loads(urllib.request.urlopen(
+                    status_url + "/status.json", timeout=15).read())
+            except OSError:
+                snap = None
+            if snap and snap.get("rows"):
+                break
+            if procs[0].poll() is not None:
+                break  # host0 done: the server is gone with it
+            time.sleep(0.5)
+        assert snap and snap.get("rows"), \
+            f"live /status.json never grew observation rows: {snap}"
+        assert all(r.get("state") for r in snap["rows"])
+        metrics = urllib.request.urlopen(
+            status_url + "/metrics", timeout=15).read().decode()
+        assert "pypulsar_obs_state" in metrics, \
+            f"live /metrics missing obs_state gauges:\n{metrics[:400]}"
+        print(f"# multihost: live endpoint smoke OK — {status_url} "
+              f"served {len(snap['rows'])} status rows + "
+              f"{sum(1 for ln in metrics.splitlines() if ln and not ln.startswith('#'))} "
+              f"Prometheus samples mid-fleet", file=sys.stderr)
         codes = wait_hosts(procs)
         mh_s = time.perf_counter() - t0
         assert codes == [0] * n_hosts, \
@@ -2740,6 +2960,52 @@ def run_multihost(args):
             f"post-kill artifacts diverged from serial: "
             f"{ident_k}/{tot_k} ({diverged_k[:8]})")
 
+        # the round-21 trace smoke: tlmtrace over EVERYTHING the kill
+        # leg wrote (per-host fleet traces, per-obs traces, postmortem
+        # capsules). --check must come back clean — the victim's torn
+        # tail (children of the stage span it never got to flush) is
+        # tolerated because the adoption receipt proves the murder,
+        # but any OTHER dangling parent_id fails — and the stitched
+        # Perfetto JSON must show the adoption as a LANE HANDOVER:
+        # spans of one trace_id on both the victim's and an adopter's
+        # host lane. That stitched file is the committed OBS_trace
+        # artifact (--trace-out).
+        from pypulsar_tpu.cli import tlmtrace as _tlmtrace
+        trace_files = sorted(_glob.glob(os.path.join(ktlm, "*.jsonl")))
+        trace_files += sorted(_glob.glob(
+            os.path.join(kdir, "_fleet", "postmortem", "*.json")))
+        assert _tlmtrace.main(["--check", *trace_files]) == 0, \
+            "tlmtrace --check found dangling parent_ids after host kill"
+        trace_dst = (os.path.abspath(args.trace_out) if args.trace_out
+                     else os.path.join(td, "kill.trace.json"))
+        assert _tlmtrace.main([*trace_files, "-o", trace_dst]) == 0
+        with open(trace_dst) as f:
+            doc = json.load(f)
+        lanes_by_trace = {}
+        for ev in doc["traceEvents"]:
+            a = ev.get("args") or {}
+            if a.get("trace_id") and a.get("host"):
+                lanes_by_trace.setdefault(
+                    a["trace_id"], set()).add(a["host"])
+        trace_by_obs = {o: t for t, o
+                        in doc["otherData"]["traces"].items()}
+        adopters = {str(a.get("host")) for a in adoptions}
+        handover = {}
+        for obs_name in sorted({str(a.get("obs")) for a in adoptions}):
+            tid = trace_by_obs.get(obs_name)
+            assert tid, f"adopted obs {obs_name} has no stitched trace"
+            handover[obs_name] = sorted(lanes_by_trace.get(tid, ()))
+        assert any("host0" in lanes and set(lanes) & adopters
+                   for lanes in handover.values()), (
+            f"no adopted trace spans both the victim's and an "
+            f"adopter's lane: {handover} (adopters {adopters})")
+        n_trace_ev = len(doc["traceEvents"])
+        n_trace_hosts = len(doc["otherData"]["hosts"])
+        print(f"# multihost: tlmtrace --check clean over "
+              f"{len(trace_files)} file(s); stitched {n_trace_ev} "
+              f"events / {n_trace_hosts} host lanes -> {trace_dst} — "
+              f"adoption lane handover {handover}", file=sys.stderr)
+
         # the acceptance tail: a final no-fault single-host resume over
         # the kill leg's outdir validates every manifest and runs NOTHING
         final = FleetScheduler(kobs, cfg, resume=True).run()
@@ -2782,6 +3048,13 @@ def run_multihost(args):
         "multihost_kill_leg_seconds": round(kill_s, 2),
         "multihost_final_resume_ran": 0,
         "multihost_final_resume_skipped": resume_skipped,
+        "multihost_trace_out": (os.path.basename(args.trace_out)
+                                if args.trace_out else None),
+        "multihost_trace_events": n_trace_ev,
+        "multihost_trace_host_lanes": n_trace_hosts,
+        "multihost_trace_handover": {k: list(v)
+                                     for k, v in handover.items()},
+        "multihost_status_endpoint_rows": len(snap["rows"]),
         "multihost_nsamp": T,
         "multihost_nchan": C,
     }
@@ -3532,15 +3805,18 @@ def run_child(args, cpu: bool, timeout: float):
         argv += ["--tune-trials", str(args.tune_trials)]
     for flag in ("quick", "profile", "ab", "accel", "spectral", "fold",
                  "waterfall", "prepass", "survey", "chaos", "corruption",
-                 "dedisp_tree", "tune", "multihost", "race"):
+                 "dedisp_tree", "tune", "multihost", "race",
+                 "obs_overhead"):
         if getattr(args, flag):
             argv.append("--" + flag.replace("_", "-"))
     if args.race:
         argv += ["--race-seeds", str(args.race_seeds)]
     if args.multihost:
         # the child writes the host-kill record itself; resolve the
-        # path NOW so the child's CWD cannot move it
+        # paths NOW so the child's CWD cannot move them
         argv += ["--hostchaos-out", os.path.abspath(args.hostchaos_out)]
+        argv += ["--trace-out", os.path.abspath(args.trace_out)
+                 if args.trace_out else ""]
     if args.corruption:
         argv += ["--corruption-seed", str(args.corruption_seed)]
     if args.chaos:
@@ -3579,7 +3855,7 @@ def main():
             and not (args.quick or args.ab or args.accel or args.fold
                      or args.waterfall or args.prepass or args.survey
                      or args.chaos or args.corruption or args.dedisp_tree or args.tune
-                     or args.multihost or args.race
+                     or args.multihost or args.race or args.obs_overhead
                      or args.cpu_fallback or args.nsamp or args.nchan)
             and os.path.exists(DEFAULT_STREAM_FIL)):
         # the north-star workload exists on disk: measure THAT (streamed,
@@ -3614,6 +3890,8 @@ def main():
                 record = run_fold(args)
             elif args.waterfall:
                 record = run_waterfall(args)
+            elif args.obs_overhead:
+                record = run_obs_overhead(args)
             elif args.survey:
                 record = run_survey(args)
             elif args.multihost:

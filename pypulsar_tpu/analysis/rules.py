@@ -1237,6 +1237,140 @@ class ThreadDisciplineRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# PL017 — telemetry name drift between emitters and consumers
+
+
+class TelemetryNameDriftRule(ProjectRule):
+    """Telemetry names are a cross-file contract with no compiler: the
+    tree emits ``telemetry.event("survey.slo_burn", ...)`` and tlmsum /
+    bench / the tests consume the same dotted literal.  Rename one side
+    and the other silently reads zeros — the observability flavor of
+    PL004's knob drift (round 21).  Two directions, scoped to the
+    dotted ``survey.`` / ``tree.`` / ``tune.`` families:
+
+    - a consumer literal (``pypulsar_tpu/obs/summarize.py``,
+      ``bench.py``, ``tests/``) nothing in the production tree emits is
+      drift — the consumer reads a channel that never carries data;
+    - a production ``event()`` literal no consumer references is drift
+      the other way — a verdict nobody renders or asserts.  (Counters,
+      gauges and spans render generically in tlmsum, so only the
+      event channel — the verdict channel — needs a named consumer.)
+
+    Emission counts via a literal first argument to ``counter`` /
+    ``event`` / ``gauge`` / ``span`` / ``record_span``, an f-string
+    family prefix (dynamic stage names), or a production string
+    assignment that flows into an emit call (the watchdog's
+    ``name = "survey.deadline_exceeded"`` shape).  Fault-point
+    literals (PL005's domain) are excluded in both directions."""
+
+    code = "PL017"
+    name = "telemetry-name-drift"
+    summary = "telemetry name referenced on one side of the emit/consume contract only"
+
+    _FAMILIES = ("survey.", "tree.", "tune.")
+    _EMIT_FNS = ("counter", "event", "gauge", "span", "record_span")
+    _FAULT_FNS = ("trip", "trip_data", "hits", "configure",
+                  "parse_chaos_spec")
+    _NAME_RE = re.compile(
+        r"^(?:survey|tree|tune)\.[A-Za-z0-9_.]*[A-Za-z0-9_]$")
+    # dotted names that are files, not telemetry channels
+    _EXT = (".json", ".jsonl", ".npz", ".npy", ".out", ".txt", ".fil",
+            ".dat", ".csv", ".md")
+
+    @classmethod
+    def _is_name(cls, s: str) -> bool:
+        return bool(cls._NAME_RE.match(s)) \
+            and not s.endswith(cls._EXT)
+
+    @staticmethod
+    def _is_consumer(ctx: FileContext) -> bool:
+        if ctx.relpath.rsplit("/", 1)[-1] == "test_psrlint.py":
+            # the linter's own tests assert on fixture names that are
+            # drift BY DESIGN — they are specimens, not consumers
+            return False
+        return (_is_test(ctx) or ctx.relpath == "bench.py"
+                or ctx.relpath == "pypulsar_tpu/obs/summarize.py")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        emitted: Set[str] = set()
+        emit_prefixes: Set[str] = set()
+        event_sites: List[Tuple[FileContext, ast.AST, str]] = []
+        fault_exact: Set[str] = set()
+        fault_prefixes: Set[str] = set()
+        consumed: Dict[str, List[Tuple[FileContext, ast.AST]]] = {}
+
+        for ctx in project.contexts:
+            is_prod = _in_package(ctx) and not _is_test(ctx)
+            for node in ctx.walk():
+                if isinstance(node, ast.Call):
+                    fn = _call_name(node).split(".")[-1]
+                    if fn in self._EMIT_FNS and node.args and is_prod:
+                        arg = node.args[0]
+                        s = _const_str(arg)
+                        if s is not None and self._is_name(s):
+                            emitted.add(s)
+                            if fn == "event":
+                                event_sites.append((ctx, node, s))
+                        elif isinstance(arg, ast.JoinedStr) and arg.values:
+                            fs = _const_str(arg.values[0])
+                            if fs and fs.startswith(self._FAMILIES):
+                                emit_prefixes.add(fs)
+                    elif fn in self._FAULT_FNS and node.args:
+                        arg = node.args[0]
+                        s = _const_str(arg)
+                        if s is not None:
+                            fault_exact.add(s)
+                        elif isinstance(arg, ast.JoinedStr) and arg.values:
+                            fs = _const_str(arg.values[0])
+                            if fs:
+                                fault_prefixes.add(fs)
+                elif isinstance(node, ast.Assign) and is_prod:
+                    # the variable-flow shape: name = "survey.x" feeding
+                    # a later emit call in the same production file
+                    s = _const_str(node.value)
+                    if s is not None and self._is_name(s):
+                        emitted.add(s)
+                if self._is_consumer(ctx):
+                    s = _const_str(node)
+                    if s is not None and self._is_name(s):
+                        consumed.setdefault(s, []).append((ctx, node))
+
+        def _is_fault_point(s: str) -> bool:
+            return (s in fault_exact
+                    or any(s.startswith(p) for p in fault_prefixes if p))
+
+        # direction 1: consumer literal nothing emits
+        seen: Set[Tuple[str, str]] = set()
+        for s, sites in sorted(consumed.items()):
+            if s in emitted or _is_fault_point(s):
+                continue
+            if any(s.startswith(p) for p in emit_prefixes):
+                continue
+            for ctx, node in sites:
+                key = (ctx.relpath, s)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield self.finding(
+                    ctx, node,
+                    f"telemetry name '{s}' is consumed here but nothing "
+                    f"in the tree emits it — the consumer reads a "
+                    f"channel that never carries data (rename drift?)")
+
+        # direction 2: production event nobody consumes
+        seen2: Set[str] = set()
+        for ctx, node, s in event_sites:
+            if s in consumed or _is_fault_point(s) or s in seen2:
+                continue
+            seen2.add(s)
+            yield self.finding(
+                ctx, node,
+                f"telemetry event '{s}' is emitted here but no consumer "
+                f"(tlmsum, bench.py, tests/) references it — a verdict "
+                f"nobody renders or asserts (rename drift?)")
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES: Tuple[type, ...] = (
     TruedivIndexRule, BareJaxDevicesRule, NonAtomicWriteRule,
@@ -1244,6 +1378,7 @@ ALL_RULES: Tuple[type, ...] = (
     MutableDefaultRule, SpanLeakRule, SwallowedFaultRule,
     RawKnobReadRule, LockOrderInversionRule, BlockingWhileLockedRule,
     BareAcquireRule, ConditionWaitPredicateRule, ThreadDisciplineRule,
+    TelemetryNameDriftRule,
 )
 
 

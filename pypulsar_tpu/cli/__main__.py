@@ -13,7 +13,8 @@ TOOLS = [
     "autozap", "plot_accelcands", "combinefil", "stitchdat",
     "mockspecfil2subbands", "demodulate", "pfd_snr", "pfdinfo",
     "gridding", "fitkepler", "shapiro", "pbdot", "massfunc",
-    "pyppdot", "pyplotres", "coordconv", "tlmsum", "psrlint", "tune",
+    "pyppdot", "pyplotres", "coordconv", "tlmsum", "tlmtrace", "psrlint",
+    "tune",
 ]
 
 

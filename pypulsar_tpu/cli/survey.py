@@ -70,6 +70,18 @@ def build_parser():
     p.add_argument("--status", action="store_true",
                    help="print the fleet progress table read from the "
                         "manifests in --outdir and exit")
+    p.add_argument("--follow", action="store_true",
+                   help="with --status: refresh the progress table "
+                        "every PYPULSAR_TPU_OBS_FOLLOW_S seconds "
+                        "(default 2) until interrupted; with "
+                        "--status-port N it polls the live endpoint at "
+                        "127.0.0.1:N instead of re-reading the files")
+    p.add_argument("--status-port", type=int, default=None, metavar="N",
+                   help="serve the live --status snapshot as JSON at "
+                        "http://127.0.0.1:N/status.json plus Prometheus "
+                        "metrics at /metrics for the duration of the "
+                        "run (0 picks a free port; also "
+                        "PYPULSAR_TPU_OBS_STATUS_PORT; default off)")
     p.add_argument("--resume", action="store_true",
                    help="replan from the per-observation manifests: "
                         "stages whose recorded artifacts validate "
@@ -207,23 +219,66 @@ def build_parser():
     return p
 
 
-def _status(outdir: str) -> int:
+def _status_text(outdir: str, port=None):
+    """One rendered progress table (or None when no manifests exist):
+    read from a live ``--status-port`` endpoint when ``port`` is given,
+    else straight from the manifest/plane files."""
+    from pypulsar_tpu.survey.state import format_status
+
+    if port:
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status.json", timeout=5) as r:
+            snap = json.load(r)
+        if not snap.get("rows"):
+            return None
+        return format_status(snap["rows"], health=snap.get("health"),
+                             plane=snap.get("plane"),
+                             capsules=snap.get("capsules"))
+    from pypulsar_tpu.obs.statusd import capsules_by_obs
     from pypulsar_tpu.survey.fleet import read_plane_status
     from pypulsar_tpu.survey.state import (
         MANIFEST_SUFFIX,
-        format_status,
         read_fleet_health,
         status_rows,
     )
 
     paths = sorted(glob.glob(os.path.join(outdir, "*" + MANIFEST_SUFFIX)))
     if not paths:
+        return None
+    return format_status(status_rows(paths),
+                         health=read_fleet_health(outdir),
+                         plane=read_plane_status(outdir),
+                         capsules=capsules_by_obs(outdir))
+
+
+def _status(outdir: str, follow: bool = False, port=None) -> int:
+    text = _status_text(outdir, port=port)
+    if text is None:
         print(f"# no survey manifests under {outdir!r}", file=sys.stderr)
         return 1
-    print(format_status(status_rows(paths),
-                        health=read_fleet_health(outdir),
-                        plane=read_plane_status(outdir)))
-    return 0
+    print(text)
+    if not follow:
+        return 0
+    import time as _time
+
+    from pypulsar_tpu.tune import knobs
+
+    interval = max(0.2, float(knobs.env_float(
+        "PYPULSAR_TPU_OBS_FOLLOW_S")))
+    try:
+        while True:
+            _time.sleep(interval)
+            text = _status_text(outdir, port=port)
+            # ANSI clear + home: a refreshing view, not a scrolling log
+            sys.stdout.write("\033[2J\033[H")
+            print(text if text is not None
+                  else f"# no survey manifests under {outdir!r}")
+            sys.stdout.flush()
+    except KeyboardInterrupt:
+        return 0
 
 
 def _launch_hosts(args, argv) -> int:
@@ -287,7 +342,8 @@ def main(argv=None):
     p = build_parser()
     args = p.parse_args(argv)
     if args.status:
-        return _status(args.outdir)
+        return _status(args.outdir, follow=args.follow,
+                       port=args.status_port)
     if not args.infile:
         p.error("give at least one observation (or --status)")
     if args.hosts and args.hosts < 1:
@@ -311,19 +367,24 @@ def main(argv=None):
             print(f"survey: {e}", file=sys.stderr)
             return 2
     os.makedirs(args.outdir, exist_ok=True)
+    from pypulsar_tpu.survey.fleet import ENV_HOST_ID
+    from pypulsar_tpu.tune import knobs
+
+    host = args.host_id or knobs.env_str(ENV_HOST_ID) or None
     fleet_trace = args.telemetry
     if args.telemetry_dir:
         os.makedirs(args.telemetry_dir, exist_ok=True)
         if fleet_trace is None:
-            from pypulsar_tpu.survey.fleet import ENV_HOST_ID
-            from pypulsar_tpu.tune import knobs
-
-            host = args.host_id or knobs.env_str(ENV_HOST_ID)
             # per-host fleet traces: M hosts sharing one telemetry dir
             # must not clobber each other's scheduler trace
             name = f"fleet.{host}.jsonl" if host else "fleet.jsonl"
             fleet_trace = os.path.join(args.telemetry_dir, name)
-    with telemetry.session_from_flag(fleet_trace, tool="survey"):
+    meta = {"tool": "survey"}
+    if host:
+        # the stitched timeline's lane key: tlmtrace maps each trace
+        # file to a process lane by its meta host
+        meta["host"] = host
+    with telemetry.session_from_flag(fleet_trace, **meta):
         return _run(args)
 
 
@@ -392,7 +453,30 @@ def _run(args) -> int:
         strike_limit=args.strike_limit, min_free_mb=args.min_free_mb,
         max_pending=args.max_pending, max_bad_frac=args.max_bad_frac,
         plane=plane, verbose=True)
-    result = sched.run()
+    server = None
+    status_port = args.status_port
+    if status_port is None:
+        from pypulsar_tpu.tune import knobs
+
+        port = int(knobs.env_int("PYPULSAR_TPU_OBS_STATUS_PORT"))
+        status_port = port if port > 0 else None
+    if status_port is not None:
+        from pypulsar_tpu.obs.statusd import StatusServer
+
+        try:
+            server = StatusServer(args.outdir, status_port).start()
+            print(f"# survey: live status at {server.url}/status.json "
+                  f"(+ Prometheus {server.url}/metrics)")
+        except OSError as e:
+            # observability is a passenger: a taken port must not stop
+            # the fleet
+            print(f"# survey: --status-port {status_port} disabled "
+                  f"({e})", file=sys.stderr)
+    try:
+        result = sched.run()
+    finally:
+        if server is not None:
+            server.close()
     n_stages = len(sched.stages)
     tag = f"[{host_id}] " if host_id else ""
     print(f"# survey: {tag}{len(obs)} observations x {n_stages} stages "

@@ -32,18 +32,39 @@ from typing import Dict, Iterable, List, Optional, TextIO
 
 
 def load_records(path: str) -> Iterable[dict]:
-    """Yield parsed records, skipping unparseable (truncated) lines."""
+    """Yield parsed records, skipping unparseable (truncated) lines.
+
+    Accepts JSONL traces AND flight-recorder postmortem capsules (one
+    JSON object with a ``records`` list, round 21): a capsule's ring
+    contents round-trip through the same summary, so the forensic view
+    of a quarantined observation reads like any other trace."""
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if isinstance(rec, dict):
-                yield rec
+        text = f.read()
+    if text.lstrip().startswith("{"):
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError:
+            doc = None
+        if isinstance(doc, dict) and doc.get("type") == "postmortem":
+            yield {"type": "meta", "tool": "postmortem",
+                   "reason": doc.get("reason"), "host": doc.get("host"),
+                   "obs": doc.get("obs"), "t_unix": doc.get("t_unix")}
+            for rec in doc.get("records", []):
+                # the ring may hold a live session's meta record; it
+                # must not masquerade as the capsule's own header
+                if isinstance(rec, dict) and rec.get("type") != "meta":
+                    yield rec
+            return
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict):
+            yield rec
 
 
 def _fmt_bytes(n: float) -> str:
@@ -55,6 +76,43 @@ def _fmt_bytes(n: float) -> str:
 
 def _fmt_count(n: float) -> str:
     return f"{n:.0f}" if float(n) == int(n) else f"{n:g}"
+
+
+def _fmt_us(us: float) -> str:
+    """Render a microsecond latency at a human scale."""
+    if us >= 1e6:
+        return f"{us / 1e6:.2f}s"
+    if us >= 1e3:
+        return f"{us / 1e3:.1f}ms"
+    return f"{us:.0f}µs"
+
+
+def hist_merge(into: List[int], other: Iterable[int]) -> List[int]:
+    """Element-wise sum of two log2 histograms; serialized histograms
+    are trimmed (trailing zero buckets dropped), so pad to the longer."""
+    other = list(other)
+    if len(other) > len(into):
+        into.extend([0] * (len(other) - len(into)))
+    for i, n in enumerate(other):
+        into[i] += int(n)
+    return into
+
+
+def hist_percentile(buckets: List[int], q: float) -> float:
+    """Upper bucket edge at quantile ``q`` (0..1). Bucket ``i`` counts
+    values in ``[2**(i-1), 2**i)`` (bucket 0: < 1), so the estimate is
+    conservative — never below the true percentile — and the error is
+    bounded by one octave, which is what a fixed-cost collector buys."""
+    total = sum(buckets)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    for i, n in enumerate(buckets):
+        cum += n
+        if cum >= target:
+            return float(1 << i) if i else 1.0
+    return float(1 << (len(buckets) - 1))
 
 
 class TraceSummary:
@@ -86,6 +144,18 @@ class TraceSummary:
         # stage -> last tune.winner event attrs (config, trials,
         # baseline/best seconds) — the auto-tuning roll-up's payload
         self.tune_winners: Dict[str, dict] = {}
+        # log2 latency histograms (round 21): span name -> µs buckets,
+        # gauge name -> value buckets, from the periodic counters
+        # records (cumulative snapshots — last one wins within a trace,
+        # traces sum in the fleet roll-up)
+        self.hists: Dict[str, List[int]] = {}
+        self.ghists: Dict[str, List[int]] = {}
+        # SLO accounting (round 21): stage -> {budget_s, n, burns,
+        # worst_frac} from the scheduler's stage spans, which stamp the
+        # effective deadline as a `budget_s` attr; a "burn" is a stage
+        # execution that consumed >80% of its budget without tripping
+        # the watchdog
+        self.slo: Dict[str, dict] = {}
         self._span_stages: Dict[str, List] = {}
         self._t_max = 0.0
         # per-observation traces (tool survey-obs) ECHO the scheduler's
@@ -119,6 +189,23 @@ class TraceSummary:
                 ent = self.host_busy.setdefault(str(host), [0.0, 0])
                 ent[0] += float(rec.get("dur", 0.0))
                 ent[1] += 1
+            budget = (rec.get("attrs") or {}).get("budget_s")
+            if budget and not self._obs_trace and str(
+                    rec.get("name", "")).startswith("survey.stage."):
+                # SLO accounting gates on the fleet-trace originals for
+                # the same reason host attribution does: the per-obs
+                # echo would double every burn
+                stage = rec["name"][len("survey.stage."):]
+                frac = float(rec.get("dur", 0.0)) / max(float(budget),
+                                                        1e-12)
+                ent = self.slo.setdefault(
+                    stage, {"budget_s": float(budget), "n": 0,
+                            "burns": 0, "worst_frac": 0.0})
+                ent["budget_s"] = float(budget)
+                ent["n"] += 1
+                if frac > 0.8:
+                    ent["burns"] += 1
+                ent["worst_frac"] = max(ent["worst_frac"], frac)
             dev = (rec.get("attrs") or {}).get("dev")
             if dev is not None and not rec.get("noagg") \
                     and not str(rec.get("name", "")).startswith(
@@ -175,6 +262,12 @@ class TraceSummary:
             self.counters.update(rec.get("counters", {}))
             self.gauges.update(rec.get("gauges", {}))
             self.events.update(rec.get("events", {}))
+            # histograms are cumulative snapshots like the counters
+            # around them: replace, don't sum, within one trace
+            for name, buckets in (rec.get("hists") or {}).items():
+                self.hists[name] = [int(n) for n in buckets]
+            for name, buckets in (rec.get("ghists") or {}).items():
+                self.ghists[name] = [int(n) for n in buckets]
         elif t == "stages":
             self.stages = rec.get("stages", {})
         elif t == "device":
@@ -237,6 +330,18 @@ def combine_summaries(summaries: List[TraceSummary]) -> TraceSummary:
             ent = out.gauges.setdefault(k, dict(g))
             ent["last"] = g.get("last", 0)
             ent["max"] = max(ent.get("max", 0), g.get("max", 0))
+        for name, buckets in s.hists.items():
+            hist_merge(out.hists.setdefault(name, []), buckets)
+        for name, buckets in s.ghists.items():
+            hist_merge(out.ghists.setdefault(name, []), buckets)
+        for stage, ent in s.slo.items():
+            o = out.slo.setdefault(
+                stage, {"budget_s": ent["budget_s"], "n": 0, "burns": 0,
+                        "worst_frac": 0.0})
+            o["budget_s"] = ent["budget_s"]
+            o["n"] += ent["n"]
+            o["burns"] += ent["burns"]
+            o["worst_frac"] = max(o["worst_frac"], ent["worst_frac"])
         out.tune_winners.update(s.tune_winners)
         if s.last_device is not None:
             out.last_device = s.last_device
@@ -269,9 +374,14 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
     p = lambda *a: print(*a, file=file)  # noqa: E731
     if s.meta is not None:
         tool = s.meta.get("tool", "?")
-        p(f"# telemetry trace: tool={tool}"
-          + (f"  argv={' '.join(s.meta.get('argv', []))}"
-             if s.meta.get("argv") else ""))
+        extra = ""
+        if tool == "postmortem":
+            extra = (f"  reason={s.meta.get('reason')}"
+                     f"  host={s.meta.get('host')}"
+                     f"  obs={s.meta.get('obs')}")
+        elif s.meta.get("argv"):
+            extra = f"  argv={' '.join(s.meta.get('argv', []))}"
+        p(f"# telemetry trace: tool={tool}{extra}")
     wall = s.wall or 0.0
     p(f"# wall {wall:.3f}s, {s.n_spans} spans, {s.n_events} events")
 
@@ -283,6 +393,45 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
             p(f"#   {name:<28s} {secs:10.3f}s  {pct:5.1f}%  "
               f"({count} calls)")
 
+    if s.hists:
+        # per-stage latency distribution (round 21): log2 µs buckets
+        # from the collector, percentiles read as upper bucket edges
+        # (conservative to one octave)
+        p("#\n# latency percentiles (p50 / p95 / p99, log2 buckets):")
+        order = sorted(s.hists.items(),
+                       key=lambda kv: -hist_percentile(kv[1], 0.95))
+        for name, buckets in order[:top]:
+            n = sum(buckets)
+            p50 = _fmt_us(hist_percentile(buckets, 0.50))
+            p95 = _fmt_us(hist_percentile(buckets, 0.95))
+            p99 = _fmt_us(hist_percentile(buckets, 0.99))
+            p(f"#   {name:<28s} {p50:>9s} / {p95:>9s} / {p99:>9s}  "
+              f"({n} samples)")
+    if s.ghists:
+        p("#\n# gauge watermarks (p50 / p95 / p99, log2 buckets):")
+        for name, buckets in sorted(s.ghists.items()):
+            n = sum(buckets)
+            vals = [_fmt_count(hist_percentile(buckets, q))
+                    for q in (0.50, 0.95, 0.99)]
+            p(f"#   {name:<28s} {vals[0]:>9s} / {vals[1]:>9s} / "
+              f"{vals[2]:>9s}  ({n} samples)")
+    n_burn_events = s.events.get("survey.slo_burn", 0)
+    if s.slo or n_burn_events:
+        # SLO burn accounting (round 21): how close each stage ran to
+        # the deadline that would have tripped the watchdog
+        head = (f"  slo_burn events={n_burn_events}"
+                if n_burn_events else "")
+        p("#\n# SLO burn (stage runtime vs watchdog budget):" + head)
+        for stage, ent in sorted(s.slo.items(),
+                                 key=lambda kv: -kv[1]["worst_frac"]):
+            flag = ""
+            if ent["worst_frac"] > 1.0:
+                flag = "  [EXCEEDED]"
+            elif ent["burns"]:
+                flag = "  [BURNING]"
+            p(f"#   {stage:<10s} budget {ent['budget_s']:8.2f}s  "
+              f"{ent['n']:>4d} runs  burns>80%: {ent['burns']:<4d} "
+              f"worst {100.0 * ent['worst_frac']:5.1f}%{flag}")
     byte_counters = {k: v for k, v in s.counters.items()
                      if k.endswith(".bytes")}
     other_counters = {k: v for k, v in s.counters.items()
@@ -399,7 +548,10 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
                        ("mesh.device_strike", "device strikes"),
                        ("mesh.device_quarantined", "devices quarantined"),
                        ("survey.device_evicted", "lease evictions"),
-                       ("survey.host_quarantined", "hosts claim-barred")):
+                       ("survey.host_quarantined", "hosts claim-barred"),
+                       ("survey.claim_lost", "claims lost"),
+                       ("survey.claim_loop_error", "claim-loop errors"),
+                       ("survey.late_interrupt", "late interrupts")):
         n = s.events.get(key)
         if n:
             health_bits.append(f"{label}={n}")

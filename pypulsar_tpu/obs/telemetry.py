@@ -36,25 +36,33 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from pypulsar_tpu.obs import flightrec
+
 __all__ = [
     "Telemetry",
     "add_activity_hook",
+    "adopt_context",
     "counter",
     "current",
+    "current_context",
     "device_snapshot",
     "event",
     "gauge",
+    "hist_bucket",
     "is_active",
+    "new_span_id",
     "record_span",
     "remove_activity_hook",
     "session",
     "session_from_flag",
     "span",
+    "trace_context",
 ]
 
 _session: Optional["Telemetry"] = None  # None = inactive (the one branch)
@@ -69,9 +77,13 @@ _activity_hooks: List[Any] = []
 
 
 def add_activity_hook(fn) -> None:
-    """Register a zero-arg callable fired on every telemetry entry
-    point (spans, counters, gauges, events), active session or not.
-    Hooks must be cheap and never raise (exceptions are swallowed)."""
+    """Register a callable fired on every telemetry entry point (spans,
+    counters, gauges, events), active session or not. Hooks receive one
+    positional argument: the recording thread's current ``trace_id``
+    (None outside any :func:`trace_context`) — the round-21 fix for the
+    per-thread heartbeat-attribution caveat: a beat carries its causal
+    identity, not just its thread identity. Hooks must be cheap and
+    never raise (exceptions are swallowed)."""
     if fn not in _activity_hooks:
         _activity_hooks.append(fn)
 
@@ -84,13 +96,118 @@ def remove_activity_hook(fn) -> None:
 
 
 def _notify_activity() -> None:
+    ctx = current_context()
+    tid = ctx.trace_id if ctx is not None else None
     for fn in tuple(_activity_hooks):
         try:
-            fn()
+            fn(tid)
         except Exception:  # noqa: BLE001 - liveness must never break work
             pass
 
 SCHEMA_VERSION = 1
+
+# ---------------------------------------------------------------------------
+# causal trace context (round 21)
+#
+# A trace is one observation's causal story: the scheduler mints a
+# trace_id when an observation is first claimed (persisted in its
+# manifest so kill+resume and cross-host adoption continue the SAME
+# trace), then wraps every stage execution in trace_context(). Spans
+# recorded inside mint a span_id and parent onto the enclosing span
+# (same thread) or the context's parent span. The context lives in
+# module-level TLS — it works with NO session active, because the
+# flight recorder and the watchdog's beat attribution need it even when
+# --telemetry is off.
+
+_trace_tls = threading.local()
+
+
+def new_span_id() -> str:
+    """A fresh 64-bit hex id (span_id / trace_id flavor)."""
+    return os.urandom(8).hex()
+
+
+class _TraceCtx:
+    __slots__ = ("trace_id", "span_id", "obs", "stage")
+
+    def __init__(self, trace_id, span_id, obs, stage):
+        self.trace_id = trace_id
+        self.span_id = span_id  # what a context-root span parents onto
+        self.obs = obs
+        self.stage = stage
+
+
+def current_context() -> Optional[_TraceCtx]:
+    """The innermost active trace context on THIS thread, or None."""
+    st = getattr(_trace_tls, "ctx", None)
+    return st[-1] if st else None
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str] = None,
+                  parent_id: Optional[str] = None,
+                  obs: Optional[str] = None,
+                  stage: Optional[str] = None):
+    """Establish the causal identity for the block: spans recorded
+    inside carry ``trace_id``/``span_id``/``parent_id`` fields, the
+    flight recorder stamps its ring entries, and activity-hook beats
+    attribute to the trace (not the thread). Nestable; inner contexts
+    inherit unspecified fields from the outer one."""
+    st = getattr(_trace_tls, "ctx", None)
+    if st is None:
+        st = _trace_tls.ctx = []
+    outer = st[-1] if st else None
+    if outer is not None:
+        trace_id = trace_id or outer.trace_id
+        parent_id = parent_id or outer.span_id
+        obs = obs or outer.obs
+        stage = stage or outer.stage
+    ctx = _TraceCtx(trace_id, parent_id, obs, stage)
+    st.append(ctx)
+    try:
+        yield ctx
+    finally:
+        st.pop()
+
+
+def adopt_context(ctx: Optional[_TraceCtx]):
+    """Re-enter a context captured (via :func:`current_context`) on
+    ANOTHER thread — how helper threads (prefetch producers, pool
+    workers) keep recording under the stage that spawned them, so their
+    beats refresh the right heartbeat entry and their spans land on the
+    right trace. ``None`` yields a no-op block."""
+    if ctx is None:
+        return contextlib.nullcontext()
+    return trace_context(trace_id=ctx.trace_id, parent_id=ctx.span_id,
+                         obs=ctx.obs, stage=ctx.stage)
+
+
+# ---------------------------------------------------------------------------
+# latency histograms (round 21): fixed log2 buckets, zero config.
+#
+# Bucket i counts span durations in [2^(i-1), 2^i) microseconds
+# (bucket 0: < 1 us), so 40 buckets span sub-microsecond to ~8 days —
+# fixed edges make histograms from M hosts mergeable by element-wise
+# sum with no rebinning (tlmsum's combine path). Gauge histograms use
+# the same rule on the raw value (pending-depth watermarks).
+
+HIST_BUCKETS = 40
+
+
+def hist_bucket(value: float) -> int:
+    """Log2 bucket index for a non-negative value (see HIST_BUCKETS)."""
+    if value < 1.0:
+        return 0
+    return min(HIST_BUCKETS - 1, int(value).bit_length())
+
+
+def _trim_hist(buckets: List[int]) -> List[int]:
+    """Drop trailing empty buckets for the wire/JSONL form (fixed edges
+    mean a short list is unambiguous; consumers re-pad)."""
+    n = len(buckets)
+    while n > 1 and buckets[n - 1] == 0:
+        n -= 1
+    return buckets[:n]
 
 # seconds between incremental counter flushes to the sink (piggybacked on
 # event records): a killed/OOM'd run must leave its byte/chunk totals on
@@ -112,11 +229,12 @@ class _Span:
     """Live handle yielded by :func:`span` — lets the block attach
     attributes discovered mid-flight (``sp.set(rows=n)``)."""
 
-    __slots__ = ("name", "attrs")
+    __slots__ = ("name", "attrs", "sid")
 
     def __init__(self, name: str, attrs: Dict[str, Any]):
         self.name = name
         self.attrs = attrs
+        self.sid: Optional[str] = None  # span_id when a trace is active
 
     def set(self, **attrs) -> None:
         self.attrs.update(attrs)
@@ -148,6 +266,10 @@ class Telemetry:
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, Dict[str, float]] = {}  # name -> last/max
         self.event_counts: Dict[str, int] = {}
+        # fixed log2-bucket histograms: span durations (microseconds)
+        # and gauge levels (raw value) — see hist_bucket()
+        self.hists: Dict[str, List[int]] = {}
+        self.ghists: Dict[str, List[int]] = {}
         self.path = path
         self._last_counter_flush = 0.0
         self._sink_warned = False
@@ -160,12 +282,12 @@ class Telemetry:
                 self._fh = open(path, "w")
             except OSError as e:
                 self._warn_sink(e)
-        if self._fh is not None:
+        if self._fh is not None or flightrec.enabled():
             rec = {"type": "meta", "version": SCHEMA_VERSION,
                    "t_unix": time.time(), "argv": list(sys.argv)}
             if meta:
                 rec.update(meta)
-            self._write(rec)
+            self._emit(rec)
 
     # -- record plumbing ---------------------------------------------------
 
@@ -181,6 +303,12 @@ class Telemetry:
             print(f"# telemetry: sink {self.path!r} unwritable "
                   f"({type(e).__name__}: {e}); dropping further trace "
                   f"records (run continues)", file=sys.stderr)
+
+    def _emit(self, rec: Dict[str, Any]) -> None:
+        """One record out: the flight recorder's always-on ring first
+        (bounded, in-memory), then the JSONL sink when there is one."""
+        flightrec.record(rec)
+        self._write(rec)
 
     def _write(self, rec: Dict[str, Any]) -> None:
         if self._fh is None:
@@ -211,13 +339,19 @@ class Telemetry:
 
     def _finish_span(self, name: str, t_start: float, dur: float,
                      parent: Optional[str], depth: int,
-                     attrs: Dict[str, Any], aggregate: bool = True) -> None:
-        if aggregate:
-            with self._lock:
+                     attrs: Dict[str, Any], aggregate: bool = True,
+                     ids: Optional[tuple] = None) -> None:
+        b = hist_bucket(dur * 1e6)
+        with self._lock:
+            if aggregate:
                 ent = self.stages.setdefault(name, [0.0, 0])
                 ent[0] += dur
                 ent[1] += 1
-        if self._fh is not None:
+            h = self.hists.get(name)
+            if h is None:
+                h = self.hists[name] = [0] * HIST_BUCKETS
+            h[b] += 1
+        if self._fh is not None or flightrec.enabled():
             rec = {"type": "span", "name": name,
                    "t": round(t_start, 6), "dur": round(dur, 6)}
             if depth:
@@ -226,9 +360,16 @@ class Telemetry:
                 rec["parent"] = parent
             if not aggregate:
                 rec["noagg"] = True
+            if ids is not None:
+                trace_id, span_id, parent_id = ids
+                if trace_id:
+                    rec["trace_id"] = trace_id
+                rec["span_id"] = span_id
+                if parent_id:
+                    rec["parent_id"] = parent_id
             if attrs:
                 rec["attrs"] = attrs
-            self._write(rec)
+            self._emit(rec)
 
     # -- read-side accessors -----------------------------------------------
 
@@ -257,9 +398,23 @@ class Telemetry:
             rec = {"type": "counters", "counters": dict(self.counters),
                    "gauges": {k: dict(v) for k, v in self.gauges.items()},
                    "events": dict(self.event_counts)}
+            if self.hists:
+                rec["hists"] = {k: _trim_hist(v)
+                                for k, v in self.hists.items()}
+            if self.ghists:
+                rec["ghists"] = {k: _trim_hist(v)
+                                 for k, v in self.ghists.items()}
         if partial:
             rec["partial"] = True
         return rec
+
+    def hist_snapshot(self) -> Dict[str, Dict[str, List[int]]]:
+        """Live copy of the log2 histograms (span durations in us
+        buckets, gauge levels in value buckets) — the statusd /metrics
+        read path."""
+        with self._lock:
+            return {"spans": {k: list(v) for k, v in self.hists.items()},
+                    "gauges": {k: list(v) for k, v in self.ghists.items()}}
 
     def _maybe_flush_counters(self) -> None:
         """Throttled incremental counters record (see
@@ -270,7 +425,7 @@ class Telemetry:
         if now - self._last_counter_flush < COUNTER_FLUSH_INTERVAL:
             return
         self._last_counter_flush = now
-        self._write(self._counters_record(partial=True))
+        self._emit(self._counters_record(partial=True))
 
     def gauge_values(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
@@ -374,6 +529,8 @@ def span(name: str, *, aggregate: bool = True, **attrs):
     if _activity_hooks:
         _notify_activity()
     if _session is None:
+        if flightrec.enabled():
+            return _ring_span(name, attrs, aggregate)
         return _NULL_SPAN
     return _live_span(name, attrs, aggregate)
 
@@ -388,6 +545,13 @@ def _live_span(name: str, attrs, aggregate: bool = True):
     parent = stack[-1].name if stack else None
     depth = len(stack)
     handle = _Span(name, attrs)
+    ctx = current_context()
+    ids = None
+    if ctx is not None:
+        handle.sid = new_span_id()
+        parent_id = (stack[-1].sid if stack and stack[-1].sid
+                     else ctx.span_id)
+        ids = (ctx.trace_id, handle.sid, parent_id)
     stack.append(handle)
     t_start = s._now()
     t0 = time.perf_counter()
@@ -397,7 +561,37 @@ def _live_span(name: str, attrs, aggregate: bool = True):
         dur = time.perf_counter() - t0
         stack.pop()
         s._finish_span(name, t_start, dur, parent, depth, handle.attrs,
-                       aggregate)
+                       aggregate, ids=ids)
+
+
+@contextlib.contextmanager
+def _ring_span(name: str, attrs, aggregate: bool = True):
+    """Session-OFF span: no sink, no aggregates — just one bounded
+    ring entry in the flight recorder, so a postmortem capsule exists
+    even for fleets run without ``--telemetry``."""
+    handle = _Span(name, attrs)
+    ctx = current_context()
+    if ctx is not None:
+        handle.sid = new_span_id()
+    t_start = flightrec.now()
+    t0 = time.perf_counter()
+    try:
+        yield handle
+    finally:
+        dur = time.perf_counter() - t0
+        rec = {"type": "span", "name": name,
+               "t": round(t_start, 6), "dur": round(dur, 6)}
+        if not aggregate:
+            rec["noagg"] = True
+        if ctx is not None:
+            if ctx.trace_id:
+                rec["trace_id"] = ctx.trace_id
+            rec["span_id"] = handle.sid
+            if ctx.span_id:
+                rec["parent_id"] = ctx.span_id
+        if handle.attrs:
+            rec["attrs"] = handle.attrs
+        flightrec.record(rec)
 
 
 def record_span(name: str, seconds: float) -> None:
@@ -421,12 +615,15 @@ def counter(name: str, inc: float = 1) -> None:
 
 
 def gauge(name: str, value: float) -> None:
-    """Record an instantaneous level; the session keeps last and max."""
+    """Record an instantaneous level; the session keeps last and max
+    plus a log2 histogram of every recorded level (the pending-depth
+    watermark distributions tlmsum's percentile section reads)."""
     if _activity_hooks:
         _notify_activity()
     s = _session
     if s is None:
         return
+    b = hist_bucket(value)
     with s._lock:
         g = s.gauges.get(name)
         if g is None:
@@ -435,23 +632,41 @@ def gauge(name: str, value: float) -> None:
             g["last"] = value
             if value > g["max"]:
                 g["max"] = value
+        h = s.ghists.get(name)
+        if h is None:
+            h = s.ghists[name] = [0] * HIST_BUCKETS
+        h[b] += 1
 
 
 def event(name: str, **attrs) -> None:
     """One-shot record (e.g. a serial-fallback, a per-chunk milestone):
-    counted in the session and appended to the sink with attributes."""
+    counted in the session and appended to the sink with attributes.
+    With no session, the record still lands in the flight recorder's
+    ring (when enabled) so postmortem capsules carry the faults and
+    evictions that led up to the dump."""
     if _activity_hooks:
         _notify_activity()
     s = _session
+    ctx = current_context()
     if s is None:
+        if flightrec.enabled():
+            rec = {"type": "event", "name": name,
+                   "t": round(flightrec.now(), 6)}
+            if ctx is not None and ctx.trace_id:
+                rec["trace_id"] = ctx.trace_id
+            if attrs:
+                rec["attrs"] = attrs
+            flightrec.record(rec)
         return
     with s._lock:
         s.event_counts[name] = s.event_counts.get(name, 0) + 1
-    if s._fh is not None:
+    if s._fh is not None or flightrec.enabled():
         rec = {"type": "event", "name": name, "t": round(s._now(), 6)}
+        if ctx is not None and ctx.trace_id:
+            rec["trace_id"] = ctx.trace_id
         if attrs:
             rec["attrs"] = attrs
-        s._write(rec)
+        s._emit(rec)
         # events fire at chunk/batch cadence — the right hook for the
         # incremental counter flush that keeps killed runs summarizable
         s._maybe_flush_counters()
@@ -505,7 +720,7 @@ def device_snapshot(tag: str = "snapshot"):
     for ent in devices:
         if "bytes_in_use" in ent:
             gauge(f"device{ent['id']}.bytes_in_use", ent["bytes_in_use"])
-    if s._fh is not None:
-        s._write({"type": "device", "tag": tag, "t": round(s._now(), 6),
-                  "devices": devices})
+    if s._fh is not None or flightrec.enabled():
+        s._emit({"type": "device", "tag": tag, "t": round(s._now(), 6),
+                 "devices": devices})
     return devices

@@ -111,21 +111,28 @@ def prefetch(items: Iterable, depth: int = 2, name: str = "prefetch",
     q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
     _done = object()
     stop = TrackedEvent("prefetch.stop")
+    # the consumer's causal identity, captured HERE (construction runs
+    # on the stage's thread): the worker re-enters it so its telemetry
+    # lands on the stage's trace and its beats refresh the stage's
+    # heartbeat entry — not the producer thread's nonexistent one
+    # (round 21; the PR 7 attribution caveat this closes)
+    trace_ctx = telemetry.current_context()
 
     def worker():
-        try:
-            for item in items:
-                if stop.is_set():  # consumer gone: don't produce the rest
-                    return
-                out = _produce(xf, item, name, retries, retry_backoff,
-                               retry_on)
-                if telemetry.is_active():  # gauges are thread-safe
-                    telemetry.gauge(gauge_name, q.qsize() + 1)
-                q.put(out)
-        except BaseException as e:  # noqa: BLE001 - re-raised in consumer
-            q.put(e)
-            return
-        q.put(_done)
+        with telemetry.adopt_context(trace_ctx):
+            try:
+                for item in items:
+                    if stop.is_set():  # consumer gone: stop producing
+                        return
+                    out = _produce(xf, item, name, retries,
+                                   retry_backoff, retry_on)
+                    if telemetry.is_active():  # gauges are thread-safe
+                        telemetry.gauge(gauge_name, q.qsize() + 1)
+                    q.put(out)
+            except BaseException as e:  # noqa: BLE001 - re-raised in consumer
+                q.put(e)
+                return
+            q.put(_done)
 
     t = threading.Thread(target=worker,
                          name=thread_name or f"pypulsar-{name}",

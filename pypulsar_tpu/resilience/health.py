@@ -143,14 +143,20 @@ def interrupt_thread(thread_id: int, exc_type: type, *,
 
 class HeartbeatEntry:
     """One running stage's liveness record (created by
-    :meth:`HeartbeatRegistry.start`)."""
+    :meth:`HeartbeatRegistry.start`). ``obs``/``stage``/``trace_id``
+    carry the causal identity of the work being watched (round 21):
+    the watchdog's verdicts, the postmortem capsules, and the stitched
+    trace all attribute through them."""
 
     __slots__ = ("label", "thread_id", "started", "deadline_s",
-                 "stall_s", "last_beat", "fired", "payload")
+                 "stall_s", "last_beat", "fired", "payload",
+                 "obs", "stage", "trace_id")
 
     def __init__(self, label: str, thread_id: int,
                  deadline_s: Optional[float], stall_s: Optional[float],
-                 payload=None):
+                 payload=None, obs: Optional[str] = None,
+                 stage: Optional[str] = None,
+                 trace_id: Optional[str] = None):
         now = time.monotonic()
         self.label = label
         self.thread_id = thread_id
@@ -160,39 +166,68 @@ class HeartbeatEntry:
         self.last_beat = now
         self.fired = False  # the watchdog interrupts an entry ONCE
         self.payload = payload
+        self.obs = obs
+        self.stage = stage
+        self.trace_id = trace_id
 
 
 class HeartbeatRegistry:
-    """Thread-safe registry of running stages. ``beat_thread`` is the
-    hot path (called from the telemetry activity hook on every span
-    entry / counter bump): one dict get + one float store, no lock —
+    """Thread-safe registry of running stages. ``beat`` is the hot path
+    (called from the telemetry activity hook on every span entry /
+    counter bump): one or two dict gets + one float store, no lock —
     heartbeats may be arbitrarily slightly stale, the watchdog's poll
     interval dwarfs any race window.
 
-    Liveness is attributed PER THREAD: telemetry recorded by a stage's
-    helper threads (prefetch producers) beats those threads, not the
-    stage's entry, and jit compilation records nothing at all — so a
-    stall bound must exceed the stage's longest legitimately silent
-    window. A false stall costs one retry (ordinary Exception into the
-    retry -> quarantine policy), never artifacts."""
+    Liveness is attributed PER TRACE first, per thread second
+    (round 21): telemetry carries the active trace context's
+    ``trace_id`` into the hook, so work recorded by a stage's helper
+    threads (prefetch producers running under the adopted context)
+    beats the STAGE's entry, not the helper's thread. Only contextless
+    telemetry falls back to thread attribution — and jit compilation
+    still records nothing at all, so a stall bound must exceed the
+    stage's longest legitimately silent window. A false stall costs one
+    retry (ordinary Exception into the retry -> quarantine policy),
+    never artifacts."""
 
     def __init__(self):
         self._lock = locks.TrackedLock("health.heartbeats")
         self._entries: Dict[int, HeartbeatEntry] = {}  # id(entry) keyed
         self._by_thread: Dict[int, HeartbeatEntry] = {}
+        self._by_trace: Dict[str, HeartbeatEntry] = {}
 
     def start(self, label: str, *, thread_id: Optional[int] = None,
               deadline_s: Optional[float] = None,
               stall_s: Optional[float] = None,
-              payload=None) -> HeartbeatEntry:
+              payload=None, obs: Optional[str] = None,
+              stage: Optional[str] = None,
+              trace_id: Optional[str] = None) -> HeartbeatEntry:
         tid = thread_id if thread_id is not None else threading.get_ident()
-        entry = HeartbeatEntry(label, tid, deadline_s, stall_s, payload)
+        entry = HeartbeatEntry(label, tid, deadline_s, stall_s, payload,
+                               obs=obs, stage=stage, trace_id=trace_id)
         with self._lock:
             self._entries[id(entry)] = entry
             self._by_thread[tid] = entry
+            if trace_id is not None:
+                self._by_trace[trace_id] = entry
         return entry
 
+    def beat(self, trace_id: Optional[str] = None) -> None:
+        """The telemetry activity hook (one positional arg: the active
+        trace context's id, or None). Trace attribution wins — a helper
+        thread working under an adopted context beats the stage that
+        owns the trace; contextless telemetry beats whatever entry this
+        thread started."""
+        entry = None
+        if trace_id is not None:
+            entry = self._by_trace.get(trace_id)
+        if entry is None:
+            entry = self._by_thread.get(threading.get_ident())
+        if entry is not None:
+            entry.last_beat = time.monotonic()
+
     def beat_thread(self, thread_id: Optional[int] = None) -> None:
+        """Thread-attributed beat (the pre-round-21 hook shape); kept
+        for direct callers that watch a specific worker thread."""
         tid = thread_id if thread_id is not None else threading.get_ident()
         entry = self._by_thread.get(tid)
         if entry is not None:
@@ -203,6 +238,9 @@ class HeartbeatRegistry:
             self._entries.pop(id(entry), None)
             if self._by_thread.get(entry.thread_id) is entry:
                 del self._by_thread[entry.thread_id]
+            if entry.trace_id is not None \
+                    and self._by_trace.get(entry.trace_id) is entry:
+                del self._by_trace[entry.trace_id]
 
     def active(self) -> List[HeartbeatEntry]:
         with self._lock:

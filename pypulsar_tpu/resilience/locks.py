@@ -646,6 +646,14 @@ def _adopt_bootstrap_locks() -> None:
         # import: the registry keeps its plain stdlib lock, losing only
         # lockdep coverage of one leaf, never correctness
         pass
+    try:
+        from pypulsar_tpu.obs import flightrec as _flightrec
+
+        if not isinstance(_flightrec._lock, TrackedLock):
+            _flightrec._lock = TrackedLock("obs.flightrec", quiet=True)
+    except Exception:  # noqa: BLE001 - same contract: the flight
+        # recorder keeps its plain bootstrap lock, a quiet leaf
+        pass
 
 
 _adopt_bootstrap_locks()

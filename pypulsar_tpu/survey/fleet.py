@@ -469,15 +469,22 @@ class FleetPlane:
                 f"rejected, observation ceded to the adopter")
 
     def mark_terminal(self, obs: str, token: int,
-                      state: str = "done") -> None:
+                      state: str = "done",
+                      trace_id: Optional[str] = None) -> None:
         """Record ``obs`` terminal (``done`` / ``quarantined``) under a
         still-held claim — fenced, so only the real owner can close an
-        observation out."""
+        observation out. ``trace_id`` (round 21) links the terminal
+        claim record to the observation's causal trace, so ``--status``
+        and the stitched timeline agree on WHICH story ended here."""
         self.fence(obs, token)
         cur = self.read_claim(obs) or {}
         cur.update({"obs": obs, "host": self.host_id, "token": token,
                     "state": state, "t": time.time()})
+        if trace_id is not None:
+            cur["trace_id"] = trace_id
         _atomic_write_json(self._claim_path(obs), cur, self.host_id)
+        telemetry.event("survey.claim_terminal", host=self.host_id,
+                        obs=obs, state=state, trace_id=trace_id)
 
 
 def read_plane_status(outdir: str) -> Optional[dict]:

@@ -102,13 +102,14 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.obs import flightrec, telemetry, tracing
 from pypulsar_tpu.resilience import faultinject
 from pypulsar_tpu.resilience import health as health_mod
 from pypulsar_tpu.resilience import locks as locks_mod
 from pypulsar_tpu.resilience.retry import backoff_delay, is_oom_error
 from pypulsar_tpu.survey import fleet as fleet_mod
 from pypulsar_tpu.survey.dag import StageSpec, SurveyConfig, build_dag, stage_names
+from pypulsar_tpu.tune import knobs as knobs_mod
 from pypulsar_tpu.survey.state import (
     Observation,
     ObsManifest,
@@ -294,6 +295,17 @@ class FleetScheduler:
         self.result = FleetResult()
         self._manifests: List[Optional[ObsManifest]] = []
         self._traces: List[Optional[ObsTrace]] = []
+        # per-obs causal trace ids (round 21): minted once in each
+        # manifest, so kill+resume and adoption continue the SAME trace
+        self._trace_ids: List[Optional[str]] = []
+        # obs index -> dead host it was adopted from; consumed by the
+        # FIRST stage span after adoption (the lane-handover link the
+        # stitched trace renders)
+        self._adopted_from: Dict[int, str] = {}
+        # a stage that consumed more than this fraction of its watchdog
+        # budget without tripping it emits survey.slo_burn — the
+        # early-warning margin tlmsum's SLO section accounts
+        self._slo_frac = knobs_mod.env_float("PYPULSAR_TPU_OBS_SLO_FRAC")
         self._t0 = 0.0
 
         # multi-host plane (round 18): observations are CLAIMED, not
@@ -341,6 +353,7 @@ class FleetScheduler:
             # other over observations none of them own yet
             self._manifests = [None] * len(self.obs)
             self._traces = [None] * len(self.obs)
+            self._trace_ids = [None] * len(self.obs)
             return
         snames = stage_names(self.stages)
         for obs in self.obs:
@@ -356,12 +369,23 @@ class FleetScheduler:
                 self._clean_stale_outputs(obs)
             m.plan(obs, snames)
             self._manifests.append(m)
+            tid = self._mint_trace(m)
+            self._trace_ids.append(tid)
             trace = None
             if self.telemetry_dir:
                 trace = ObsTrace(
                     os.path.join(self.telemetry_dir, f"{obs.name}.jsonl"),
-                    obs.name, append=self.resume)
+                    obs.name, append=self.resume, trace_id=tid)
             self._traces.append(trace)
+
+    def _mint_trace(self, m: ObsManifest) -> Optional[str]:
+        """The observation's causal trace_id (minted once, persisted in
+        the manifest — see ObsManifest.ensure_trace). Observability is a
+        passenger: a failure here runs the observation untraced."""
+        try:
+            return m.ensure_trace(tracing.new_trace_id)
+        except (fleet_mod.StaleLeaseError, OSError):
+            return None
 
     # -- ingest data validation ---------------------------------------------
 
@@ -425,6 +449,8 @@ class FleetScheduler:
                         reason="data")
         print(f"# survey: DATA-QUARANTINED {obs.name} at ingest: {error} "
               f"(fleet continues)")
+        self._postmortem("data_quarantine", obs_i,
+                         extra={"error": error})
         with self._cv:
             for s in self.stages:
                 t = self._tasks[(obs_i, s.name)]
@@ -513,7 +539,9 @@ class FleetScheduler:
         if token is None:
             return
         try:
-            self.plane.mark_terminal(self.obs[obs_i].name, token, state)
+            self.plane.mark_terminal(
+                self.obs[obs_i].name, token, state,
+                trace_id=self._trace_ids[obs_i])
         except fleet_mod.StaleLeaseError:
             self._cede_obs(obs_i, already_terminal=True)
 
@@ -538,17 +566,25 @@ class FleetScheduler:
             self._clean_stale_outputs(obs)
         m.plan(obs, snames)
         self._manifests[i] = m
+        # SAME trace_id the previous owner minted (the manifest is the
+        # shared source of truth): the adopter's spans continue the
+        # observation's causal story, they don't start a new one
+        self._trace_ids[i] = self._mint_trace(m)
         if self.telemetry_dir and self._traces[i] is None:
             # append: an adopted observation's trace keeps the dead
             # host's recorded spans — exactly the forensics worth having
             self._traces[i] = ObsTrace(
                 os.path.join(self.telemetry_dir, f"{obs.name}.jsonl"),
-                obs.name, append=True)
+                obs.name, append=True, trace_id=self._trace_ids[i])
         with self._cv:
             self._owned.add(i)
             self._obs_tokens[i] = token
         if adopted_from:
             self.result.adopted.append(obs.name)
+            # the lane-handover link: the first stage span this host
+            # runs for the adopted obs carries adopted_from, so the
+            # stitched trace shows WHERE the trace hopped hosts
+            self._adopted_from[i] = adopted_from
             trace = self._traces[i]
             if trace is not None:
                 # no `host` attr here: the adopter's fleet trace already
@@ -605,6 +641,7 @@ class FleetScheduler:
             self.plane.mark_terminal(obs.name, token, "quarantined")
         except (fleet_mod.StaleLeaseError, OSError):
             pass
+        self._postmortem("claim_quarantined", i, extra={"error": err})
 
     def _cede_obs(self, i: int, already_terminal: bool = False) -> None:
         """This host's claim on obs ``i`` was superseded (a survivor
@@ -641,6 +678,7 @@ class FleetScheduler:
         if self.verbose:
             print(f"# survey[{self.host_id}]: CEDED {obs.name} to its "
                   f"adopter (stale fencing token); fleet continues")
+        self._postmortem("obs_ceded", i)
 
     def _plane_poll(self) -> None:
         """One claim-loop tick: claim unowned observations (orphans
@@ -875,6 +913,9 @@ class FleetScheduler:
         if self.verbose:
             print(f"# survey: WATCHDOG {obs.name}: {task.stage.name} "
                   f"{reason} after {after:.1f}s; interrupting worker")
+        self._postmortem(f"watchdog_{reason}", task.obs_i,
+                         extra={"stage": task.stage.name,
+                                "after_s": round(after, 3)})
 
     def _strike_leases(self, task: "_Task", err: Exception) -> None:
         """Charge the failed execution's leased chips when the error
@@ -915,7 +956,29 @@ class FleetScheduler:
                   f"({type(err).__name__}); pool shrinks to "
                   f"{len(self._healthy_ids())} chips, gangs retry "
                   f"shrunk")
+            self._postmortem("device_evicted", task.obs_i,
+                             extra={"devices": evicted,
+                                    "stage": task.stage.name})
         self._write_health_json()
+
+    def _postmortem(self, reason: str, obs_i: Optional[int] = None,
+                    extra: Optional[dict] = None) -> None:
+        """Freeze the flight recorder into a capsule at a failure edge
+        (quarantine, watchdog verdict, eviction, cede, crash): the last
+        N telemetry records land under ``<outdir>/_fleet/postmortem/``
+        so every QUARANTINED ``--status`` row has its explanation on
+        disk even when ``--telemetry`` was off. Best-effort by
+        construction (flightrec.dump never raises)."""
+        if self._health_dir is None:
+            return
+        path = flightrec.dump(
+            os.path.join(fleet_mod.plane_dir(self._health_dir),
+                         "postmortem"),
+            reason, host=self.host_id,
+            obs=self.obs[obs_i].name if obs_i is not None else None,
+            extra=extra)
+        if path is not None and self.verbose:
+            print(f"# survey: postmortem capsule {path}")
 
     def _write_health_json(self) -> None:
         """Mirror the per-device verdicts next to the manifests so
@@ -969,6 +1032,9 @@ class FleetScheduler:
                  dev_ids: Optional[List[int]] = None) -> None:
         obs = self.obs[task.obs_i]
         stage = task.stage
+        tid = (self._trace_ids[task.obs_i]
+               if task.obs_i < len(self._trace_ids) else None)
+        budget = self._deadline_for(stage, obs)
         span_attrs = {"obs": obs.name}
         if self.host_id is not None:
             span_attrs["host"] = self.host_id
@@ -976,6 +1042,13 @@ class FleetScheduler:
             span_attrs["dev"] = dev_ids
         if gang > 1:
             span_attrs["gang"] = gang
+        if budget is not None:
+            # the SLO denominator, carried ON the span so tlmsum can
+            # account burn from the trace alone
+            span_attrs["budget_s"] = round(float(budget), 3)
+        adopted_src = self._adopted_from.pop(task.obs_i, None)
+        if adopted_src is not None:
+            span_attrs["adopted_from"] = adopted_src
         t_rel = time.perf_counter() - self._t0
         t0 = time.perf_counter()
         # liveness entry: the watchdog interrupts this thread on
@@ -986,15 +1059,26 @@ class FleetScheduler:
         # in a window the watchdog cannot see (it holds the lease).
         task.done_recorded = False
         hb = self._hb.start(f"{obs.name}:{stage.name}",
-                            deadline_s=self._deadline_for(stage, obs),
-                            stall_s=self.stall_s, payload=task)
+                            deadline_s=budget,
+                            stall_s=self.stall_s, payload=task,
+                            obs=obs.name, stage=stage.name,
+                            trace_id=tid)
+        sp_sid = None
         try:
             faultinject.trip("survey.stage_start")
             faultinject.trip(f"survey.stage_start.{stage.name}")
-            telemetry.counter("survey.stages_run")
-            with telemetry.span(f"survey.stage.{stage.name}",
-                                **span_attrs):
-                stage.execute(obs, self.cfg, gang=gang)
+            # the stage span is its trace's ROOT (parent_id unset): every
+            # span the stage's kernels record nests under it, and helper
+            # threads adopt the context so their beats land on this
+            # heartbeat entry (the round-21 attribution fix)
+            with telemetry.trace_context(trace_id=tid, obs=obs.name,
+                                         stage=stage.name):
+                telemetry.counter("survey.stages_run")
+                with telemetry.span(f"survey.stage.{stage.name}",
+                                    **span_attrs) as sp:
+                    stage.execute(obs, self.cfg, gang=gang)
+                if sp is not None:
+                    sp_sid = getattr(sp, "sid", None)
             dur = time.perf_counter() - t0
             faultinject.trip("survey.stage_done")
             faultinject.trip(f"survey.stage_done.{stage.name}")
@@ -1003,6 +1087,17 @@ class FleetScheduler:
             task.done_recorded = True
         finally:
             self._hb.finish(hb)
+        slo_burn = (budget is not None and budget > 0
+                    and dur > self._slo_frac * float(budget))
+        if slo_burn:
+            # consumed most of the watchdog budget WITHOUT tripping it:
+            # the early warning that a deadline is about to start
+            # costing retries
+            telemetry.counter("survey.slo_burns")
+            telemetry.event("survey.slo_burn", obs=obs.name,
+                            stage=stage.name,
+                            budget_s=round(float(budget), 3),
+                            frac=round(dur / float(budget), 3))
         trace = self._traces[task.obs_i]
         if trace is not None:
             tr_attrs = {"outputs": len(outputs)}
@@ -1012,8 +1107,15 @@ class FleetScheduler:
                 tr_attrs["dev"] = dev_ids
             if gang > 1:
                 tr_attrs["gang"] = gang
+            if budget is not None:
+                tr_attrs["budget_s"] = round(float(budget), 3)
+            if adopted_src is not None:
+                tr_attrs["adopted_from"] = adopted_src
             trace.span(f"survey.stage.{stage.name}", t_rel, dur,
-                       **tr_attrs)
+                       span_id=sp_sid, **tr_attrs)
+            if slo_burn:
+                trace.event("survey.slo_burn", stage=stage.name,
+                            frac=round(dur / float(budget), 3))
         if self.verbose:
             print(f"# survey: {obs.name}: {stage.name} done "
                   f"({dur:.2f}s, {len(outputs)} artifacts"
@@ -1146,6 +1248,8 @@ class FleetScheduler:
             trace.event("survey.quarantine", stage=stage.name)
         print(f"# survey: QUARANTINED {obs.name} at {stage.name}: {error} "
               f"(fleet continues)")
+        self._postmortem("quarantine", task.obs_i,
+                         extra={"stage": stage.name, "error": error})
         with self._cv:
             for s in self.stages:
                 t = self._tasks[(task.obs_i, s.name)]
@@ -1418,7 +1522,7 @@ class FleetScheduler:
             # heartbeats ride the telemetry the stages already record;
             # the hook is process-global, so it is installed only for
             # the run and removed in the finally below
-            telemetry.add_activity_hook(self._hb.beat_thread)
+            telemetry.add_activity_hook(self._hb.beat)
             self._watchdog = health_mod.Watchdog(self._hb,
                                                  self._on_stage_expired)
             self._watchdog.start()
@@ -1477,7 +1581,7 @@ class FleetScheduler:
             if self._watchdog is not None:
                 self._watchdog.stop()
                 self._watchdog = None
-                telemetry.remove_activity_hook(self._hb.beat_thread)
+                telemetry.remove_activity_hook(self._hb.beat)
             if self._claim_thread is not None:
                 self._claim_thread.join(timeout=5.0)
                 self._claim_thread = None
@@ -1497,5 +1601,11 @@ class FleetScheduler:
                 # lease to go silent (DEAD after the lease bound)
                 self.plane.close()
         if self._fatal is not None:
+            # the capsule for the run that ended in a bang: the last N
+            # telemetry records before the unhandled crash/interrupt
+            self._postmortem(
+                "crash",
+                extra={"error": f"{type(self._fatal).__name__}: "
+                                f"{self._fatal}"})
             raise self._fatal
         return self.result

@@ -208,6 +208,22 @@ class ObsManifest:
             if not self._journal.notes(event="data_quality"):
                 self._journal.note(event="data_quality", **report)
 
+    def ensure_trace(self, trace_id_factory) -> str:
+        """The observation's causal trace_id (round 21): minted once
+        per manifest on first claim, re-read by every later owner —
+        kill+resume and cross-host adoption both continue the SAME
+        trace, which is what lets tlmtrace stitch one causal story
+        across M hosts' files."""
+        self._check_fence()
+        with self._lock:
+            for note in self._journal.notes(event="trace"):
+                tid = note.get("trace_id")
+                if tid:
+                    return str(tid)
+            tid = str(trace_id_factory())
+            self._journal.note(event="trace", trace_id=tid)
+            return tid
+
     def note_retry(self, stage: str, attempt: int, error: str) -> None:
         """Record one retry verdict (attempt number + the error that
         provoked it) so ``--status`` can show WHY a stage is retrying,
@@ -262,6 +278,7 @@ def status_rows(manifest_paths: Sequence[str]) -> List[Dict]:
         done: List[str] = []
         quarantine = None
         data_quality = None
+        trace_id = None
         retries: Dict[str, Dict] = {}
         for rec in recs:
             if rec.get("type") == "note" and rec.get("event") == "plan":
@@ -295,9 +312,12 @@ def status_rows(manifest_paths: Sequence[str]) -> List[Dict]:
                 retries[rec.get("stage", "?")] = {
                     "attempts": int(rec.get("attempt", 0) or 0),
                     "error": str(rec.get("error", ""))}
+            elif rec.get("type") == "note" and rec.get("event") == "trace":
+                trace_id = rec.get("trace_id")
         rows.append({"obs": obs, "manifest": path, "stages": stages,
                      "done": done, "quarantine": quarantine,
-                     "data_quality": data_quality, "retries": retries})
+                     "data_quality": data_quality, "retries": retries,
+                     "trace_id": trace_id})
     return rows
 
 
@@ -308,12 +328,16 @@ def _excerpt(error: str, limit: int = ERROR_EXCERPT_LEN) -> str:
 
 def format_status(rows: Sequence[Dict],
                   health: Optional[Dict] = None,
-                  plane: Optional[Dict] = None) -> str:
+                  plane: Optional[Dict] = None,
+                  capsules: Optional[Dict[str, List[str]]] = None) -> str:
     """Render the --status progress table (plus, with a fleet-health
     mirror, the per-device strike/quarantine block, and, with a
     multi-host plane snapshot from ``fleet.read_plane_status``, the
-    host-liveness block and a per-observation owner column)."""
+    host-liveness block and a per-observation owner column).
+    ``capsules`` maps observation name -> postmortem capsule paths
+    (obs/flightrec) so a QUARANTINED row points at its explanation."""
     claims = (plane or {}).get("claims", {})
+    capsules = capsules or {}
     host_col = bool(plane)
     lines = [f"# {'observation':<20s} {'progress':<10s} {'retries':<8s} "
              + (f"{'host':<12s} " if host_col else "") + "state"]
@@ -329,6 +353,9 @@ def format_status(rows: Sequence[Dict],
                    else "QUARANTINED")
             state = (f"{tag} at {q['stage']} "
                      f"({_excerpt(q['error'])})")
+            caps = capsules.get(r["obs"], [])
+            if caps:
+                state += f" [capsule: {os.path.basename(caps[-1])}]"
         elif r["stages"] and len(done) == len(r["stages"]):
             state = "complete"
         else:
@@ -423,10 +450,16 @@ class ObsTrace:
     directly (not via obs.telemetry) because that module is one
     process-global session — which the fleet trace owns."""
 
-    def __init__(self, path: str, obs: str, append: bool = False):
+    def __init__(self, path: str, obs: str, append: bool = False,
+                 trace_id: Optional[str] = None):
         self._lock = TrackedLock("survey.obstrace")
         self._t0 = time.perf_counter()
         self._fh: Optional[object] = None
+        # the observation's causal trace (round 21): stamped on every
+        # span/event so tlmtrace can stitch this file into the fleet
+        # timeline; survives append-mode reopens (each owner re-reads
+        # the id from the manifest)
+        self.trace_id = trace_id
         # a resumed fleet APPENDS: the killed run's recorded stage spans
         # are exactly the forensics worth keeping (tlmsum aggregates
         # spans across the whole file; later end/meta records win)
@@ -437,7 +470,11 @@ class ObsTrace:
         except OSError:
             return  # observability is a passenger, never the payload
         if fresh:
-            self._write({"type": "meta", "tool": "survey-obs", "obs": obs})
+            meta = {"type": "meta", "tool": "survey-obs", "obs": obs,
+                    "t_unix": time.time()}
+            if trace_id:
+                meta["trace_id"] = trace_id
+            self._write(meta)
 
     def _write(self, rec: dict) -> None:
         with self._lock:
@@ -453,9 +490,19 @@ class ObsTrace:
                     pass
                 self._fh = None
 
-    def span(self, name: str, t_start: float, dur: float, **attrs) -> None:
+    def span(self, name: str, t_start: float, dur: float,
+             span_id: Optional[str] = None,
+             parent_id: Optional[str] = None, **attrs) -> None:
         rec = {"type": "span", "name": name, "t": round(t_start, 6),
                "dur": round(dur, 6)}
+        if self.trace_id:
+            rec["trace_id"] = self.trace_id
+        if span_id:
+            # echo spans share the fleet-trace span's id (they ARE the
+            # same execution); tlmtrace dedups by (trace_id, span_id)
+            rec["span_id"] = span_id
+        if parent_id:
+            rec["parent_id"] = parent_id
         if attrs:
             rec["attrs"] = attrs
         self._write(rec)
@@ -463,6 +510,8 @@ class ObsTrace:
     def event(self, name: str, **attrs) -> None:
         rec = {"type": "event", "name": name,
                "t": round(time.perf_counter() - self._t0, 6)}
+        if self.trace_id:
+            rec["trace_id"] = self.trace_id
         if attrs:
             rec["attrs"] = attrs
         self._write(rec)

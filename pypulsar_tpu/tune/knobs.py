@@ -451,6 +451,25 @@ env_knob("PYPULSAR_TPU_HOST_STRIKES", "int", 3, "multihost",
          help="adoption/cede strikes before a host stops claiming new "
               "observations")
 
+# -- observability (round 21) ----------------------------------------------
+env_knob("PYPULSAR_TPU_OBS_FLIGHTREC", "int", 256, "obs",
+         invariant=False,
+         help="crash flight recorder ring size (telemetry records kept "
+              "in memory per process, dumped to _fleet/postmortem/ on "
+              "quarantine/watchdog/eviction/crash); 0 disables")
+env_knob("PYPULSAR_TPU_OBS_STATUS_PORT", "int", 0, "obs",
+         invariant=False,
+         help="default port for the survey live status/metrics "
+              "endpoint (0 = off unless --status-port is given)")
+env_knob("PYPULSAR_TPU_OBS_FOLLOW_S", "float", 2.0, "obs",
+         invariant=False,
+         help="refresh cadence of `survey --status --follow` (seconds)")
+env_knob("PYPULSAR_TPU_OBS_SLO_FRAC", "float", 0.8, "obs",
+         invariant=False,
+         help="fraction of a stage's deadline budget consumed (without "
+              "tripping the watchdog) that emits a survey.slo_burn "
+              "event")
+
 # -- misc data --------------------------------------------------------------
 env_knob("PYPULSAR_TPU_HASLAM", "str", "", "data",
          invariant=False,

@@ -21,16 +21,47 @@ def _read_jsonl(path):
 
 
 def test_inactive_is_noop():
+    from pypulsar_tpu.obs import flightrec
+
     assert not telemetry.is_active()
     assert telemetry.current() is None
-    with telemetry.span("x", a=1) as sp:
-        assert sp is None  # inactive: nothing collected
-    telemetry.counter("c", 5)
-    telemetry.gauge("g", 2.0)
-    telemetry.event("e", detail="ignored")
-    telemetry.record_span("x", 1.0)
+    flightrec.configure(0)  # recorder off: the truly-zero-overhead path
+    try:
+        with telemetry.span("x", a=1) as sp:
+            assert sp is None  # inactive: nothing collected
+        telemetry.counter("c", 5)
+        telemetry.gauge("g", 2.0)
+        telemetry.event("e", detail="ignored")
+        telemetry.record_span("x", 1.0)
+    finally:
+        flightrec.configure(None)  # back to the env-resolved default
     assert telemetry.device_snapshot() is None
     assert not telemetry.is_active()  # nothing leaked a session
+
+
+def test_inactive_span_feeds_flight_recorder():
+    """With no session but the (default-on) flight recorder enabled,
+    span() yields a live handle and the record lands in the ring —
+    round 21's always-on crash context."""
+    from pypulsar_tpu.obs import flightrec
+
+    assert not telemetry.is_active()
+    flightrec.configure(8)
+    try:
+        flightrec.clear()
+        with telemetry.span("ring.x", a=1) as sp:
+            assert sp is not None  # ring handle, attrs attachable
+            sp.set(rows=3)
+        recs = flightrec.snapshot()
+        spans = [r for r in recs if r.get("type") == "span"
+                 and r.get("name") == "ring.x"]
+        assert len(spans) == 1
+        assert spans[0]["attrs"] == {"a": 1, "rows": 3}
+        assert "tw" in spans[0]  # wall-stamped for cross-host alignment
+    finally:
+        flightrec.clear()
+        flightrec.configure(None)
+    assert not telemetry.is_active()
 
 
 def test_span_nesting_attrs_and_jsonl(tmp_path):

@@ -1,0 +1,527 @@
+"""Round-21 observability plane: causal trace propagation (span ids,
+kill+resume continuity, cross-host adoption on ONE trace), the log2
+latency histograms + SLO burn accounting through tlmsum, the crash
+flight recorder's postmortem capsules, the tlmtrace stitcher/--check
+CLI, heartbeat trace-attribution, and the live status/metrics
+endpoint."""
+
+import glob
+import io
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from pypulsar_tpu.obs import flightrec, statusd, summarize, telemetry, tracing
+from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.resilience.health import HeartbeatRegistry
+from pypulsar_tpu.survey.dag import StageSpec, SurveyConfig
+from pypulsar_tpu.survey.fleet import FleetPlane
+from pypulsar_tpu.survey.scheduler import FleetScheduler
+from pypulsar_tpu.survey.state import Observation
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+def _read_jsonl(path):
+    return [json.loads(line) for line in open(path) if line.strip()]
+
+
+def _stub_outputs(name):
+    def outputs(obs, cfg):
+        return [f"{obs.outbase}.{name}.out"]
+    return outputs
+
+
+def _mk_stage(name, deps=(), body=None, device=None, **kw):
+    def run(o, c, _n=name):
+        if body is not None:
+            rc = body(o, c)
+            if rc:
+                return rc
+        with open(f"{o.outbase}.{_n}.out", "w") as f:
+            f.write(_n + o.name)
+        return 0
+
+    return StageSpec(name, "stub", device if device is not None
+                     else name.startswith("dev"), tuple(deps),
+                     lambda o, c: [], _stub_outputs(name), run=run, **kw)
+
+
+def _mk_obs(td, n):
+    obs = []
+    for i in range(n):
+        raw = os.path.join(str(td), f"o{i}.raw")
+        with open(raw, "wb") as f:
+            f.write(b"x" * 64)
+        obs.append(Observation(f"o{i}", raw,
+                               os.path.join(str(td), f"o{i}")))
+    return obs
+
+
+# ---------------------------------------------------------------------------
+# causal trace context: ids on spans
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_stamps_span_ids(tmp_path):
+    """Spans inside a trace_context carry trace_id/span_id and parent
+    onto the enclosing span; spans outside carry no ids at all (old
+    traces stay byte-stable)."""
+    path = str(tmp_path / "t.jsonl")
+    with telemetry.session(path, tool="test"):
+        with telemetry.span("bare"):
+            pass
+        with telemetry.trace_context(trace_id="t" * 16, obs="o0",
+                                     stage="dev1"):
+            with telemetry.span("root") as sp:
+                with telemetry.span("child"):
+                    pass
+            assert sp.sid
+    recs = _read_jsonl(path)
+    spans = {r["name"]: r for r in recs if r["type"] == "span"}
+    assert "trace_id" not in spans["bare"]
+    assert "span_id" not in spans["bare"]
+    root, child = spans["root"], spans["child"]
+    assert root["trace_id"] == child["trace_id"] == "t" * 16
+    # the context root has no parent (it IS the trace root)
+    assert "parent_id" not in root
+    assert child["parent_id"] == root["span_id"]
+
+
+def test_prefetch_worker_adopts_stage_trace(tmp_path):
+    """The ship-ahead worker thread re-enters the consumer's trace
+    context (the PR 7 attribution caveat, closed): telemetry it records
+    lands on the stage's trace_id."""
+    from pypulsar_tpu.parallel.prefetch import prefetch
+
+    seen = []
+
+    def xf(x):
+        ctx = telemetry.current_context()
+        seen.append(ctx.trace_id if ctx else None)
+        return x * 2
+
+    with telemetry.session(str(tmp_path / "t.jsonl")):
+        with telemetry.trace_context(trace_id="feed" * 4, obs="o0",
+                                     stage="dev1"):
+            out = list(prefetch(range(4), transform=xf, name="tst"))
+    assert out == [0, 2, 4, 6]
+    assert seen == ["feed" * 4] * 4  # worker thread, stage's trace
+
+
+# ---------------------------------------------------------------------------
+# histograms: bucket math, percentiles, tlmsum rendering
+# ---------------------------------------------------------------------------
+
+
+def test_hist_bucket_and_percentile_math():
+    assert telemetry.hist_bucket(0) == 0
+    assert telemetry.hist_bucket(1) == 1
+    assert telemetry.hist_bucket(2) == 2        # [2, 4) -> bucket 2
+    assert telemetry.hist_bucket(1023) == 10
+    assert telemetry.hist_bucket(1 << 60) == telemetry.HIST_BUCKETS - 1
+    buckets = [0] * telemetry.HIST_BUCKETS
+    for v in (3, 3, 3, 1000):  # three in [2,4), one in [512,1024)
+        buckets[telemetry.hist_bucket(v)] += 1
+    assert summarize.hist_percentile(buckets, 0.5) == 4.0   # upper edge
+    assert summarize.hist_percentile(buckets, 0.99) == 1024.0
+    assert summarize.hist_percentile([0] * 4, 0.5) == 0.0  # empty hist
+    merged = summarize.hist_merge([1, 2], [0, 1, 5])
+    assert merged == [1, 3, 5]
+
+
+def test_span_hists_roundtrip_tlmsum(tmp_path):
+    """Span durations land in log2 µs histograms, serialize with the
+    counters record, and tlmsum renders p50/p95/p99 for them."""
+    path = str(tmp_path / "t.jsonl")
+    with telemetry.session(path) as tlm:
+        for ms in (1, 1, 1, 30):
+            telemetry.record_span("stage.x", ms / 1000.0)
+        telemetry.gauge("pipe.pending_depth", 3)
+        snap = tlm.hist_snapshot()
+    assert sum(snap["spans"]["stage.x"]) == 4
+    assert sum(snap["gauges"]["pipe.pending_depth"]) == 1
+    buf = io.StringIO()
+    summarize.render(
+        summarize.summarize(summarize.load_records(path)), buf)
+    out = buf.getvalue()
+    assert "latency percentiles" in out
+    assert "stage.x" in out
+    assert "gauge watermarks" in out
+
+
+# ---------------------------------------------------------------------------
+# SLO burn accounting
+# ---------------------------------------------------------------------------
+
+
+def test_slo_burn_event_and_tlmsum_section(tmp_path):
+    """A stage that consumes >80% of its watchdog budget WITHOUT
+    tripping it emits survey.slo_burn, and tlmsum's SLO section
+    accounts the burn against the stage's budget."""
+    def slow(o, c):
+        time.sleep(0.45)
+        return 0
+
+    stages = [_mk_stage("dev1", body=slow, deadline_s=0.5)]
+    obs = _mk_obs(tmp_path, 1)
+    tpath = str(tmp_path / "t.jsonl")
+    with telemetry.session(tpath) as tlm:
+        result = FleetScheduler(obs, SurveyConfig(), stages=stages,
+                                stall_s=30.0).run()
+        assert tlm.event_counts.get("survey.slo_burn") == 1
+        assert tlm.counters.get("survey.slo_burns") == 1
+    assert result.ok and result.timeouts == 0  # watchdog never fired
+    buf = io.StringIO()
+    summarize.render(
+        summarize.summarize(summarize.load_records(tpath)), buf)
+    out = buf.getvalue()
+    assert "SLO burn" in out
+    assert "dev1" in out and "burns>80%: 1" in out
+    # the span carried the budget so the trace alone can account it
+    recs = _read_jsonl(tpath)
+    span = next(r for r in recs if r["type"] == "span"
+                and r["name"] == "survey.stage.dev1")
+    assert span["attrs"]["budget_s"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: capsules at failure edges
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_dumps_postmortem_capsule(tmp_path):
+    """A quarantined observation leaves a capsule under
+    _fleet/postmortem/ (recorder on even with --telemetry off), the
+    capsule round-trips through tlmsum, and --status maps it to the
+    QUARANTINED row."""
+    def boom(o, c):
+        if o.name == "o0":
+            raise RuntimeError("injected stage failure")
+        return 0
+
+    stages = [_mk_stage("dev1", body=boom)]
+    obs = _mk_obs(tmp_path, 2)
+    flightrec.configure(64)
+    # a live session's meta record in the ring must not masquerade as
+    # the capsule's own header when tlmsum reads it back
+    flightrec.record({"type": "meta", "tool": "?", "argv": ["stale"]})
+    try:
+        result = FleetScheduler(obs, SurveyConfig(), stages=stages,
+                                retries=0).run()
+    finally:
+        flightrec.configure(None)
+    assert not result.ok and set(result.quarantined) == {"o0"}
+    caps = flightrec.capsule_paths(statusd.postmortem_dir(str(tmp_path)))
+    assert caps, "no postmortem capsule written"
+    cap = json.load(open(caps[0]))
+    assert cap["type"] == "postmortem" and cap["reason"] == "quarantine"
+    assert cap["obs"] == "o0"
+    assert cap["extra"]["stage"] == "dev1"
+    assert any(r.get("type") for r in cap["records"])
+    # tlmsum accepts the capsule directly
+    buf = io.StringIO()
+    summarize.render(
+        summarize.summarize(summarize.load_records(caps[0])), buf)
+    assert "postmortem" in buf.getvalue()
+    # --status knows which row it explains
+    by_obs = statusd.capsules_by_obs(str(tmp_path))
+    assert "o0" in by_obs and by_obs["o0"]
+
+
+def test_flightrec_dump_never_raises(tmp_path):
+    flightrec.configure(0)
+    try:
+        assert flightrec.dump(str(tmp_path), "x") is None  # disabled
+    finally:
+        flightrec.configure(None)
+    # unwritable dir: returns None instead of raising
+    assert flightrec.dump("/dev/null/nope", "x") is None
+
+
+# ---------------------------------------------------------------------------
+# trace continuity: kill+resume, cross-host adoption
+# ---------------------------------------------------------------------------
+
+
+def test_kill_resume_is_one_trace(tmp_path):
+    """The trace_id persists in the manifest: spans from the run that
+    died and the resume stitch into ONE trace with no dangling
+    parents."""
+    stages = [_mk_stage("dev1"), _mk_stage("host1", ("dev1",))]
+    obs = _mk_obs(tmp_path, 1)
+    tdir = str(tmp_path / "tlm")
+    faultinject.configure("kill:survey.stage_start.host1:1")
+    with pytest.raises(faultinject.InjectedKill):
+        FleetScheduler(obs, SurveyConfig(), stages=stages,
+                       telemetry_dir=tdir).run()
+    faultinject.reset()
+    r = FleetScheduler(obs, SurveyConfig(), stages=stages,
+                       telemetry_dir=tdir, resume=True).run()
+    assert r.ok and r.ran == [("o0", "host1")]
+    recs = _read_jsonl(os.path.join(tdir, "o0.jsonl"))
+    spans = [x for x in recs if x["type"] == "span"]
+    tids = {x.get("trace_id") for x in spans}
+    assert len(tids) == 1 and None not in tids, tids
+    # both stage spans are on the trace, and the stitcher agrees
+    names = {x["name"] for x in spans}
+    assert {"survey.stage.dev1", "survey.stage.host1"} <= names
+    assert tracing.check([os.path.join(tdir, "o0.jsonl")]) == []
+    doc = tracing.stitch([os.path.join(tdir, "o0.jsonl")])
+    assert len(doc["otherData"]["traces"]) == 1
+
+
+def test_adoption_continues_the_trace_across_hosts(tmp_path):
+    """Cross-host adoption: the adopter reuses the trace_id the dead
+    host minted (it lives in the manifest), stamps adopted_from on its
+    first span, and the stitched timeline shows the lane handover on
+    one trace."""
+    stages = [_mk_stage("dev1"), _mk_stage("host1", ("dev1",))]
+    obs = _mk_obs(tmp_path, 1)
+    tdir = str(tmp_path / "tlm")
+    faultinject.configure("kill:survey.stage_start.host1:1")
+    pa = FleetPlane(str(tmp_path), host_id="hA", lease_s=1.0,
+                    settle_s=0.02)
+    with pytest.raises(faultinject.InjectedKill):
+        FleetScheduler(obs, SurveyConfig(), stages=stages, plane=pa,
+                       telemetry_dir=tdir).run()
+    faultinject.reset()
+    pb = FleetPlane(str(tmp_path), host_id="hB", lease_s=1.0,
+                    settle_s=0.02)
+    with telemetry.session() as tlm:
+        r = FleetScheduler(obs, SurveyConfig(), stages=stages, plane=pb,
+                           telemetry_dir=tdir).run()
+        # the claim's terminal state is an event on the trace too
+        assert tlm.event_counts.get("survey.claim_terminal") == 1
+    assert r.ok and r.adopted == ["o0"]
+    recs = _read_jsonl(os.path.join(tdir, "o0.jsonl"))
+    spans = [x for x in recs if x["type"] == "span"]
+    tids = {x.get("trace_id") for x in spans}
+    assert len(tids) == 1 and None not in tids, tids
+    hosts = {x["attrs"].get("host") for x in spans}
+    assert hosts == {"hA", "hB"}  # the handover happened on one trace
+    hb_span = next(x for x in spans if x["attrs"].get("host") == "hB")
+    assert hb_span["attrs"]["adopted_from"] == "hA"
+    # manifest trace note survives and matches
+    notes = [x for x in _read_jsonl(obs[0].manifest)
+             if x.get("type") == "note" and x.get("event") == "trace"]
+    assert len(notes) == 1  # adoption reused it, never re-minted
+    assert notes[0]["trace_id"] == tids.pop()
+    assert tracing.check([os.path.join(tdir, "o0.jsonl")]) == []
+    doc = tracing.stitch([os.path.join(tdir, "o0.jsonl")])
+    lanes = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {"hA", "hB"} <= lanes
+
+
+# ---------------------------------------------------------------------------
+# tlmtrace stitcher + --check
+# ---------------------------------------------------------------------------
+
+
+def test_tlmtrace_stitch_and_check_cli(tmp_path, capsys):
+    from pypulsar_tpu.cli import tlmtrace
+
+    good = str(tmp_path / "good.jsonl")
+    with open(good, "w") as f:
+        f.write(json.dumps({"type": "meta", "tool": "survey",
+                            "host": "hA", "t_unix": 100.0}) + "\n")
+        f.write(json.dumps({"type": "span", "name": "a", "t": 1.0,
+                            "dur": 0.5, "trace_id": "T1",
+                            "span_id": "s1", "attrs": {}}) + "\n")
+        f.write(json.dumps({"type": "span", "name": "b", "t": 1.1,
+                            "dur": 0.2, "trace_id": "T1", "span_id": "s2",
+                            "parent_id": "s1", "attrs": {}}) + "\n")
+    out = str(tmp_path / "trace.json")
+    assert tlmtrace.main([good, "-o", out]) == 0
+    doc = json.load(open(out))
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 2
+    assert all(e["args"]["trace_id"] == "T1" for e in xs)
+    assert tlmtrace.main([good, "--check"]) == 0
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as f:
+        f.write(json.dumps({"type": "span", "name": "orphan", "t": 1.0,
+                            "dur": 0.1, "trace_id": "T2",
+                            "span_id": "s9", "parent_id": "GONE",
+                            "attrs": {}}) + "\n")
+    assert tlmtrace.main([bad, "--check"]) == 1
+    assert "GONE" in capsys.readouterr().err
+    assert tlmtrace.main([str(tmp_path / "missing.jsonl")]) == 1
+
+
+def test_check_tolerates_torn_tail_of_adopted_trace(tmp_path, capsys):
+    """A SIGKILL'd host never flushes its in-flight stage span, so its
+    completed children dangle — tolerated ONLY when the trace carries
+    an adoption receipt (an ``adopted_from`` attr somewhere in the
+    stitch set); the same shape without the receipt stays fatal."""
+    from pypulsar_tpu.cli import tlmtrace
+
+    victim = str(tmp_path / "fleet.h0.jsonl")
+    with open(victim, "w") as f:
+        f.write(json.dumps({"type": "meta", "tool": "survey",
+                            "host": "h0", "t_unix": 100.0}) + "\n")
+        # a prefetch child whose parent (the hung stage span) was
+        # never written — h0 died by SIGKILL mid-stage
+        f.write(json.dumps({"type": "span", "name": "block_source",
+                            "t": 1.0, "dur": 0.1, "trace_id": "T1",
+                            "span_id": "c1", "parent_id": "LOST",
+                            "attrs": {"obs": "o0"}}) + "\n")
+    adopter = str(tmp_path / "fleet.h1.jsonl")
+    with open(adopter, "w") as f:
+        f.write(json.dumps({"type": "meta", "tool": "survey",
+                            "host": "h1", "t_unix": 100.0}) + "\n")
+        f.write(json.dumps({"type": "span", "name": "survey.stage.dev1",
+                            "t": 9.0, "dur": 1.0, "trace_id": "T1",
+                            "span_id": "s2",
+                            "attrs": {"obs": "o0",
+                                      "adopted_from": "h0"}}) + "\n")
+    # without the adoption receipt the dangle is a hard failure
+    assert len(tracing.check([victim])) == 1
+    # with it: no failures, the torn span reported as tolerated
+    torn = []
+    assert tracing.check([victim, adopter], tolerated=torn) == []
+    assert len(torn) == 1 and "LOST" in torn[0]
+    assert tlmtrace.main(["--check", victim, adopter]) == 0
+    out = capsys.readouterr()
+    assert "tolerated" in out.err and "LOST" in out.err
+    # an adoption EVENT (plane flavor: obs attr, no trace context)
+    # resolves onto the trace via the obs name too
+    ev_adopter = str(tmp_path / "fleet.h2.jsonl")
+    with open(ev_adopter, "w") as f:
+        f.write(json.dumps({"type": "meta", "tool": "survey",
+                            "host": "h2", "t_unix": 100.0}) + "\n")
+        f.write(json.dumps({"type": "event",
+                            "name": "survey.obs_adopted", "t": 9.0,
+                            "attrs": {"obs": "o0", "host": "h2",
+                                      "adopted_from": "h0"}}) + "\n")
+    assert tracing.check([victim, ev_adopter]) == []
+
+
+def test_stitch_dedups_echoed_spans(tmp_path):
+    """The obs-trace echo of a fleet span (same trace_id+span_id) is
+    folded into one event, keeping the host-attributed record."""
+    fleet = str(tmp_path / "fleet.hA.jsonl")
+    with open(fleet, "w") as f:
+        f.write(json.dumps({"type": "meta", "tool": "survey",
+                            "host": "hA", "t_unix": 100.0}) + "\n")
+        f.write(json.dumps({"type": "span", "name": "survey.stage.d",
+                            "t": 1.0, "dur": 0.5, "trace_id": "T1",
+                            "span_id": "s1",
+                            "attrs": {"host": "hA"}}) + "\n")
+    echo = str(tmp_path / "o0.jsonl")
+    with open(echo, "w") as f:
+        f.write(json.dumps({"type": "meta", "tool": "survey-obs",
+                            "obs": "o0", "t_unix": 100.0}) + "\n")
+        f.write(json.dumps({"type": "span", "name": "survey.stage.d",
+                            "t": 1.0, "dur": 0.5, "trace_id": "T1",
+                            "span_id": "s1", "attrs": {}}) + "\n")
+    doc = tracing.stitch([echo, fleet])
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 1
+    assert xs[0]["args"].get("host") == "hA"
+
+
+# ---------------------------------------------------------------------------
+# heartbeat trace attribution (the PR 7 caveat, closed)
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_beats_attribute_per_trace_then_thread():
+    reg = HeartbeatRegistry()
+    entry = reg.start("o0:dev1", stall_s=60.0, obs="o0", stage="dev1",
+                      trace_id="T1")
+    entry.last_beat = 0.0
+    # a helper thread beating with the trace id refreshes the entry
+    t = threading.Thread(target=lambda: reg.beat("T1"))
+    t.start()
+    t.join()
+    assert entry.last_beat > 0.0
+    # beat(None) from the OWNING thread falls back to thread identity
+    entry.last_beat = 0.0
+    reg.beat(None)
+    assert entry.last_beat > 0.0
+    # ...but from a foreign thread with no trace id it is a no-op
+    entry.last_beat = 0.0
+    t2 = threading.Thread(target=lambda: reg.beat(None))
+    t2.start()
+    t2.join()
+    assert entry.last_beat == 0.0
+    reg.finish(entry)
+    assert entry.obs == "o0" and entry.stage == "dev1"
+    assert entry.trace_id == "T1"
+
+
+def test_activity_hook_receives_trace_id(tmp_path):
+    got = []
+    telemetry.add_activity_hook(got.append)
+    try:
+        with telemetry.session(str(tmp_path / "t.jsonl")):
+            telemetry.counter("c")  # outside any trace -> None
+            with telemetry.trace_context(trace_id="T9"):
+                telemetry.counter("c")
+    finally:
+        telemetry.remove_activity_hook(got.append)
+    assert None in got and "T9" in got
+
+
+# ---------------------------------------------------------------------------
+# live status/metrics endpoint
+# ---------------------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_status_server_serves_json_and_prometheus(tmp_path):
+    """StatusServer on an ephemeral port serves the --status snapshot
+    as JSON and the live collector as Prometheus text."""
+    stages = [_mk_stage("dev1")]
+    obs = _mk_obs(tmp_path, 1)
+    assert FleetScheduler(obs, SurveyConfig(), stages=stages).run().ok
+    with telemetry.session() :
+        telemetry.counter("survey.stages_run", 3)
+        telemetry.record_span("survey.stage.dev1", 0.01)
+        with statusd.StatusServer(str(tmp_path), 0) as srv:
+            assert srv.port > 0
+            code, body = _get(srv.url + "/status.json")
+            assert code == 200
+            snap = json.loads(body)
+            assert snap["rows"] and snap["rows"][0]["obs"] == "o0"
+            assert snap["rows"][0]["state"] == "done"
+            code, text = _get(srv.url + "/metrics")
+            assert code == 200
+            assert 'pypulsar_counter{name="survey.stages_run"} 3' in text
+            assert "pypulsar_span_seconds_bucket" in text
+            assert 'le="+Inf"' in text
+            assert 'pypulsar_obs_state{state="done"} 1' in text
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.url + "/nope")
+            assert ei.value.code == 404
+
+
+def test_survey_status_follow_and_port_flags(tmp_path):
+    """CLI wiring: `survey --status` renders the endpoint's snapshot
+    when --status-port names a live server."""
+    from pypulsar_tpu.cli import survey as cli_survey
+
+    stages = [_mk_stage("dev1")]
+    obs = _mk_obs(tmp_path, 1)
+    assert FleetScheduler(obs, SurveyConfig(), stages=stages).run().ok
+    with statusd.StatusServer(str(tmp_path), 0) as srv:
+        text = cli_survey._status_text(str(tmp_path), port=srv.port)
+    assert text and "o0" in text and "complete" in text
+    # and without a port it reads the manifests directly
+    text2 = cli_survey._status_text(str(tmp_path))
+    assert text2 and "o0" in text2

@@ -644,6 +644,67 @@ def test_pl016_str_join_does_not_count(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# PL017 telemetry name drift
+
+def test_pl017_consumer_name_nothing_emits(tmp_path):
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/prod.py":
+            "from pypulsar_tpu.obs import telemetry\n"
+            "def f():\n"
+            "    telemetry.event('survey.slo_burn', frac=0.9)\n",
+        "tests/test_x.py":
+            "def test_x(tlm):\n"
+            "    assert tlm.event_counts.get('survey.slo_burn')\n"
+            "    assert tlm.event_counts.get('survey.slo_burm')\n",
+    }, select="PL017")
+    assert codes(rep) == ["PL017"]
+    assert "survey.slo_burm" in rep.findings[0].message
+    assert rep.findings[0].path == "tests/test_x.py"
+
+
+def test_pl017_event_nobody_consumes(tmp_path):
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/prod.py":
+            "from pypulsar_tpu.obs import telemetry\n"
+            "def f():\n"
+            "    telemetry.event('survey.orphan_verdict', n=1)\n",
+        "tests/test_x.py": "def test_x():\n    pass\n",
+    }, select="PL017")
+    assert codes(rep) == ["PL017"]
+    assert "survey.orphan_verdict" in rep.findings[0].message
+    assert rep.findings[0].path == "pypulsar_tpu/prod.py"
+
+
+def test_pl017_near_misses(tmp_path):
+    # matched emit/consume pairs, f-string prefixes, the assigned-name
+    # emit shape, fault points, file names, and out-of-family names are
+    # all clean in both directions
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/prod.py":
+            "from pypulsar_tpu.obs import telemetry\n"
+            "from pypulsar_tpu.resilience import faultinject\n"
+            "def f(stage, reason):\n"
+            "    telemetry.event('survey.quarantine', stage=stage)\n"
+            "    telemetry.counter('survey.stages_run')\n"
+            "    with telemetry.span(f'survey.stage.{stage}'):\n"
+            "        faultinject.trip(f'survey.stage_start.{stage}')\n"
+            "    name = 'survey.deadline_exceeded'\n"
+            "    telemetry.event(name, after=1.0)\n"
+            "    telemetry.event('mesh.device_strike', dev=0)\n",
+        "tests/test_x.py":
+            "from pypulsar_tpu.resilience import faultinject\n"
+            "def test_x(tlm, tmp_path):\n"
+            "    assert tlm.event_counts.get('survey.quarantine')\n"
+            "    assert tlm.event_counts.get('survey.deadline_exceeded')\n"
+            "    assert tlm.stages.get('survey.stage.sweep')\n"
+            "    faultinject.configure('kill:survey.stage_start.sweep:1')\n"
+            "    assert faultinject.hits('survey.stage_start.sweep')\n"
+            "    assert (tmp_path / 'tune.json').exists()\n",
+    }, select="PL017")
+    assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions / select / ignore / baseline / output
 
 def test_suppression_silences_and_unused_is_flagged(tmp_path):
@@ -762,7 +823,7 @@ def test_report_json_schema(tmp_path):
 def test_rule_catalog_complete():
     got = {r.code for r in all_rules()}
     assert got == ({f"PL00{i}" for i in range(1, 10)}
-                   | {f"PL01{i}" for i in range(1, 7)})
+                   | {f"PL01{i}" for i in range(1, 8)})
     assert all(r.summary and r.name for r in all_rules())
 
 
