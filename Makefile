@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier test-faults test-fold test-obs test-survey test-corruption test-tune test-multihost test-race lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-obs bench-survey bench-multichip bench-multihost-fleet bench-specfuse bench-telemetry bench-tree bench-tune native clean
+.PHONY: test test-fourier test-faults test-fold test-obs test-survey test-corruption test-tune test-multihost test-race lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-obs bench-survey bench-multichip bench-multihost-fleet bench-specfuse bench-telemetry bench-tree bench-tune bench-compile native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -18,13 +18,15 @@ test: lint test-obs
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
 
 # the static-analysis gate (docs/ARCHITECTURE.md "Static analysis"):
-# psrlint's project-invariant rules PL001-PL017 (each locks in a bug
+# psrlint's project-invariant rules PL001-PL018 (each locks in a bug
 # class an earlier PR fixed by hand — PL011: raw PYPULSAR_TPU_* env
 # reads outside the tune/knobs.py registry; PL012-PL016: the psrrace
 # concurrency rules — lock-order cycles, blocking-under-lock, bare
 # acquires, unguarded condition waits, orphanable threads; PL017:
 # telemetry names consumed by tlmsum/bench/tests must match an emitter,
-# and emitted events must have a consumer; baseline
+# and emitted events must have a consumer; PL018: raw jax.jit outside
+# the round-22 compilation plane (compile/ + the ops leaf allowlist);
+# baseline
 # empty by policy), then the
 # third-party ruff pass (pyproject [tool.ruff], crash-bug classes
 # only) when the container ships ruff — the image this repo grows in
@@ -215,6 +217,15 @@ bench-tree:
 # byte-identical across tuned configs) -> BENCH_r12_tune.json
 bench-tune: test-tune
 	$(CPU_ENV) $(PY) bench.py --tune --out BENCH_r12_tune.json
+
+# the round-22 compilation-plane A/B: cold-vs-warm compile counters at
+# 3 toy geometries (warm legs must compile NOTHING), bucket-ladder
+# collapse, cross-process persistent-cache hits, byte-identical
+# artifacts throughout, and the fleet warm-pool precompile span
+# overlapping another observation's device span
+bench-compile:
+	$(CPU_ENV) $(PY) -m pytest tests/test_compile.py -q
+	$(CPU_ENV) $(PY) bench.py --compile --out BENCH_r17_compile.json
 
 native:
 	$(PY) -c "from pypulsar_tpu import native; assert native.available(); print('native codec OK')"

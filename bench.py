@@ -63,6 +63,13 @@ def parse_args(argv=None):
     ap.add_argument("--tune-trials", type=int, default=None,
                     help="trial budget per stage search (default: the "
                          "PYPULSAR_TPU_TUNE_TRIALS knob)")
+    ap.add_argument("--compile", action="store_true",
+                    help="compilation-plane A/B (round 22): cold vs "
+                         "warm compile counters over 3 toy geometries, "
+                         "bucket-ladder collapse, cross-process "
+                         "persistent-cache hits, and the fleet "
+                         "warm-pool precompile overlap "
+                         "(BENCH_r17_compile.json)")
     ap.add_argument("--dedisp-tree", action="store_true",
                     help="run the round-16 three-engine dedispersion A/B "
                          "(gather vs fourier vs tree) at a production "
@@ -3698,10 +3705,12 @@ def run_tune(args):
                           f"({meta['speedup']:.2f}x, {trials} trials, "
                           f"reuse=hit)")
                 record["geometries"].append(geo)
+            # compile.* rides along (round 22): tuned-config changes
+            # key fresh executables, so the search cost includes them
             record["telemetry_counters"] = {
                 k: round(v, 1) for k, v in
                 sorted(tlm.counter_totals().items())
-                if k.startswith("tune.")}
+                if k.startswith(("tune.", "compile."))}
         # ---- science-invariance leg (gather engine: the CPU default
         # whose chunk domain is byte-invariant; fourier's tuned configs
         # never carry the chunk, enforced by variant_engines) ----
@@ -3767,6 +3776,341 @@ def run_tune(args):
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def run_compile(args):
+    """Compilation-plane A/B (round 22, BENCH_r17_compile.json).
+
+    Four legs; every claim is STRUCTURAL (compile-counter deltas, byte
+    parity, span overlap) — walls are CPU-toy numbers unless a real
+    chip is attached, labeled per the PR 10 convention:
+
+    1. **cold vs warm** — the in-process CLI sweep at 3 toy
+       geometries, each run twice into separate outdirs. The cold pass
+       compiles; the warm pass at the SAME geometry must show
+       ``compile.cache_miss == 0`` (the never-compile-twice gate) and
+       byte-identical candidate tables.
+    2. **bucket collapse** — two fold candidate-batch sizes (10 and
+       12) land on ONE ``{2^k} U {3*2^k}`` ladder rung, so the second
+       warm compiles nothing (the mixed-geometry headline, on the axis
+       bucketing actually owns — the DM-range statics of a sweep are
+       time-axis geometry, which is never padded). A bucketing-off
+       rerun (``PYPULSAR_TPU_COMPILE_BUCKETS=0``) of geometry 2 must
+       be byte-identical — padding is execution policy, never science.
+    3. **persistent cross-process** — a child interpreter pointed at
+       the same ``PYPULSAR_TPU_COMPILE_CACHE`` reruns geometry 1: its
+       (process-cold) compiles must probe as ``compile.persistent_hit``
+       and its artifacts must match the parent's bytes.
+    4. **warm-pool overlap** — a 3-observation fleet with per-obs
+       channel counts (a mixed-geometry fleet) and the scheduler warm
+       pool on: some observation's ``survey.precompile`` span must
+       overlap ANOTHER observation's device-stage span in the fleet
+       trace — precompile rides spare host cycles, off the critical
+       path.
+    """
+    acquire_backend()
+    import glob as _glob
+    import shutil
+    import tempfile
+
+    from pypulsar_tpu.cli import sweep as cli_sweep
+    from pypulsar_tpu.obs import telemetry
+    from pypulsar_tpu.parallel.mesh import lease_devices
+    from pypulsar_tpu.parallel.sweep import resolve_engine
+
+    workdir = tempfile.mkdtemp(prefix="bench_compile_")
+    saved_env = {k: os.environ.get(k)
+                 for k in ("PYPULSAR_TPU_COMPILE_CACHE",
+                           "PYPULSAR_TPU_COMPILE_BUCKETS")}
+    # a FRESH persistent cache: the cold legs must actually compile
+    # (set before the first plane dispatch — the cache dir latches
+    # once per process)
+    cache_dir = os.path.join(workdir, "xla")
+    os.environ["PYPULSAR_TPU_COMPILE_CACHE"] = cache_dir
+    os.environ.pop("PYPULSAR_TPU_COMPILE_BUCKETS", None)
+
+    engine = resolve_engine(args.engine)
+    dev = lease_devices()[0]
+    on_tpu = getattr(dev, "platform", "cpu") == "tpu"
+    C, T, dtp = 32, (1 << 13 if (args.quick or args.cpu_fallback)
+                     else 1 << 14), 5e-4
+    freqs = (1500.0 - 4.0 * np.arange(C)).astype(np.float64)
+    record = {
+        "metric": "compile_plane_ab", "unit": "see legs",
+        "engine": engine,
+        "backend": str(dev.device_kind if hasattr(dev, "device_kind")
+                       else dev.platform),
+        "wall_label": ("real-chip walls" if on_tpu else
+                       "CPU-toy walls (structural gates are the claim: "
+                       "zero warm-leg compiles + bucket collapse + "
+                       "persistent cross-process hits + byte parity + "
+                       "precompile span overlap)"),
+        "geometries": [],
+    }
+    _DELTA_KEYS = ("compile.cache_miss", "compile.cache_hit",
+                   "compile.persistent_hit", "compile.aot_fallback",
+                   "compile.bucket_pad_rows", "compile.ms")
+
+    def sweep_argv(base, numdms):
+        return [fil, "-o", base, "--lodm", "0", "--dmstep", "10",
+                "--numdms", str(numdms), "-s", "8", "--group-size", "4",
+                "--threshold", "8", "--engine", engine]
+
+    def read_arts(outdir):
+        arts = {}
+        for fn in sorted(_glob.glob(os.path.join(outdir, "x*"))):
+            with open(fn, "rb") as f:
+                arts[os.path.basename(fn)] = f.read()
+        return arts
+
+    try:
+        fil = _synth_survey_fil(os.path.join(workdir, "psr.fil"), 7, C,
+                                T, dtp, freqs, "PSR_COMPILE")
+        geometries = [{"name": "g1", "numdms": 8},
+                      {"name": "g2", "numdms": 10},
+                      {"name": "g3", "numdms": 12}]
+        cold_wall = warm_wall = 0.0
+        g1_arts = g2_arts = None
+        with telemetry.session() as tlm:
+            for geo in geometries:
+                legs = {}
+                arts = {}
+                for leg in ("cold", "warm"):
+                    outdir = os.path.join(workdir,
+                                          f"{geo['name']}_{leg}")
+                    os.makedirs(outdir)
+                    base = os.path.join(outdir, "x")
+                    c0 = dict(tlm.counter_totals())
+                    t0 = time.perf_counter()
+                    rc = cli_sweep.main(sweep_argv(base, geo["numdms"]))
+                    wall = time.perf_counter() - t0
+                    c1 = dict(tlm.counter_totals())
+                    assert rc == 0, f"{geo['name']} {leg} leg rc={rc}"
+                    legs[leg] = {"wall_s": round(wall, 3)}
+                    legs[leg].update(
+                        {k: round(c1.get(k, 0) - c0.get(k, 0), 1)
+                         for k in _DELTA_KEYS})
+                    arts[leg] = read_arts(outdir)
+                # the warm-leg contract: a previously-seen geometry
+                # never compiles on the critical path
+                assert legs["warm"]["compile.cache_miss"] == 0, \
+                    f"{geo['name']}: warm leg compiled " \
+                    f"({legs['warm']['compile.cache_miss']} misses)"
+                assert legs["warm"]["compile.cache_hit"] >= 1, \
+                    f"{geo['name']}: warm leg never hit the registry"
+                assert arts["cold"] and arts["cold"] == arts["warm"], \
+                    f"{geo['name']}: cold/warm artifacts diverged"
+                if geo["name"] == "g1":
+                    g1_arts = arts["cold"]
+                if geo["name"] == "g2":
+                    g2_arts = arts["cold"]
+                cold_wall += legs["cold"]["wall_s"]
+                warm_wall += legs["warm"]["wall_s"]
+                print(f"# compile[{geo['name']}] numdms="
+                      f"{geo['numdms']}: cold "
+                      f"{legs['cold']['compile.cache_miss']:.0f} "
+                      f"compiles ({legs['cold']['compile.ms']:.0f} ms), "
+                      f"warm 0 compiles / "
+                      f"{legs['warm']['compile.cache_hit']:.0f} hits, "
+                      f"{len(arts['cold'])} artifacts byte-identical",
+                      file=sys.stderr)
+                record["geometries"].append(
+                    dict(geo, legs=legs,
+                         artifacts_identical=len(arts["cold"])))
+        # ---- bucket-collapse leg: two candidate-batch sizes, one
+        # ladder rung, zero second compiles (through the production
+        # warm-pool entry point) ----
+        import pypulsar_tpu.fold.engine  # noqa: F401 - registers warmer
+        from pypulsar_tpu.compile import bucket_rows, warm_stage
+
+        fold_geo = dict(n_samples=T, downsamp=1, fold_nbins=32,
+                        fold_npart=8)
+        assert bucket_rows(10) == bucket_rows(12) == 12
+        with telemetry.session() as tlm:
+            n1 = warm_stage("fold", fold_batch=10, **fold_geo)
+            c_mid = dict(tlm.counter_totals())
+            n2 = warm_stage("fold", fold_batch=12, **fold_geo)
+            c_end = dict(tlm.counter_totals())
+        assert n1 >= 1, "first fold warm compiled nothing"
+        assert n2 == 0 and (c_end.get("compile.cache_miss", 0)
+                            == c_mid.get("compile.cache_miss", 0)), (
+            "bucket ladder failed to collapse fold batches 10 and 12 "
+            "onto one executable")
+        record["bucket_collapse"] = {
+            "axis": "fold candidate batch", "batch_sizes": [10, 12],
+            "ladder_rows": 12, "first_warm_compiles": int(n1),
+            "second_warm_compiles": 0}
+        print("# compile[collapse]: fold batches 10 and 12 -> one "
+              "12-row executable (second warm compiled nothing)",
+              file=sys.stderr)
+
+        # bucketing is runtime policy, not science: geometry 2 with the
+        # ladder off is byte-identical (its unpadded shapes may compile)
+        os.environ["PYPULSAR_TPU_COMPILE_BUCKETS"] = "0"
+        try:
+            outdir = os.path.join(workdir, "g2_nobuckets")
+            os.makedirs(outdir)
+            rc = cli_sweep.main(sweep_argv(os.path.join(outdir, "x"), 10))
+            assert rc == 0, f"no-buckets leg rc={rc}"
+            nb_arts = read_arts(outdir)
+        finally:
+            os.environ.pop("PYPULSAR_TPU_COMPILE_BUCKETS", None)
+        assert nb_arts == g2_arts, \
+            "bucketing changed artifact bytes (science regression)"
+        record["bucket_invariance"] = {
+            "geometry": "g2", "artifacts_compared": len(nb_arts),
+            "byte_identical": True}
+
+        # ---- persistent cross-process leg ----
+        child_dir = os.path.join(workdir, "child")
+        os.makedirs(child_dir)
+        child_argv = sweep_argv(os.path.join(child_dir, "x"), 8)
+        child_src = (
+            "import json, sys\n"
+            "from pypulsar_tpu.obs import telemetry\n"
+            "from pypulsar_tpu.cli import sweep as cli_sweep\n"
+            "with telemetry.session() as tlm:\n"
+            "    rc = cli_sweep.main(%r)\n"
+            "    print('COMPILE_TOTALS '"
+            " + json.dumps(tlm.counter_totals()))\n"
+            "sys.exit(rc)\n" % (child_argv,))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (
+            os.path.dirname(os.path.abspath(__file__)) + os.pathsep
+            + env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+        proc = subprocess.run([sys.executable, "-c", child_src], env=env,
+                              capture_output=True, text=True,
+                              timeout=1800)
+        assert proc.returncode == 0, \
+            f"persistent-cache child rc={proc.returncode}: " \
+            f"{proc.stderr[-2000:]}"
+        totals = json.loads(
+            [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("COMPILE_TOTALS ")][-1]
+            [len("COMPILE_TOTALS "):])
+        assert totals.get("compile.persistent_hit", 0) >= 1, (
+            f"child process saw no persistent-cache hits "
+            f"({ {k: v for k, v in totals.items() if k.startswith('compile.')} })")
+        assert read_arts(child_dir) == g1_arts, \
+            "cross-process artifacts diverged"
+        record["persistent_cross_process"] = {
+            "cache_dir_shared": True,
+            "child_persistent_hits":
+                int(totals.get("compile.persistent_hit", 0)),
+            "child_compiles": int(totals.get("compile.cache_miss", 0)),
+            "artifacts_identical": len(g1_arts),
+        }
+        print(f"# compile[persistent]: child process "
+              f"{int(totals.get('compile.persistent_hit', 0))} "
+              f"persistent hit(s) over "
+              f"{int(totals.get('compile.cache_miss', 0))} compiles, "
+              f"{len(g1_arts)} artifacts byte-identical",
+              file=sys.stderr)
+
+        # ---- warm-pool overlap leg (a mixed-geometry fleet) ----
+        from pypulsar_tpu.survey.dag import SurveyConfig, build_dag
+        from pypulsar_tpu.survey.scheduler import FleetScheduler
+        from pypulsar_tpu.survey.state import Observation
+
+        n_obs = 3
+        cfg = SurveyConfig(
+            mask=False, lodm=0.0, dmstep=10.0, numdms=8, nsub=8,
+            group_size=4, threshold=8.0, accel_zmax=20.0,
+            accel_numharm=2, accel_sigma=3.0, accel_batch=4,
+            sift_sigma=3.0, sift_min_hits=1, fold_nbins=32,
+            fold_npart=8)
+        stages = build_dag(cfg)
+        # per-obs channel counts: each observation's geometry keys its
+        # own executables, so every precompile does real work
+        fleet_out = os.path.join(workdir, "fleet")
+        os.makedirs(fleet_out)
+        obs = []
+        for i, Ci in enumerate((24, 32, 48)):
+            fi = _synth_survey_fil(
+                os.path.join(workdir, f"obs{i}.fil"), 11 + i, Ci, T,
+                dtp, 1500.0 - 4.0 * np.arange(Ci), f"CMP{i}",
+                period=0.1024 * (1.0 + 0.07 * i))
+            obs.append(Observation(f"obs{i}", fi,
+                                   os.path.join(fleet_out, f"obs{i}")))
+        tlm_dir = os.path.join(workdir, "tlm")
+        with telemetry.session() as tlm:
+            result = FleetScheduler(obs, cfg, max_host_workers=2,
+                                    devices=1,
+                                    telemetry_dir=tlm_dir).run()
+            fleet_totals = dict(tlm.counter_totals())
+        assert result.ok and len(result.ran) == n_obs * len(stages), \
+            f"fleet failed: ran {len(result.ran)}, " \
+            f"failed {result.failed}"
+        assert fleet_totals.get("survey.precompiled", 0) >= 1, \
+            "warm pool precompiled nothing"
+        # device-LANE stages by declaration ("dev" span attrs only
+        # appear at devices>1, where stages pin explicitly)
+        dev_names = {f"survey.stage.{s.name}" for s in stages
+                     if s.device_bound}
+        pre_spans, dev_spans = [], []
+        for p in sorted(_glob.glob(os.path.join(tlm_dir, "*.jsonl"))):
+            o = os.path.basename(p)[:-len(".jsonl")]
+            with open(p) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("type") != "span":
+                        continue
+                    t0, t1 = rec.get("t", 0), \
+                        rec.get("t", 0) + rec.get("dur", 0)
+                    if rec.get("name") == "survey.precompile":
+                        pre_spans.append((o, t0, t1))
+                    elif rec.get("name") in dev_names:
+                        dev_spans.append((o, t0, t1, rec["name"]))
+        overlaps = [
+            {"precompile_obs": po, "device_obs": do, "device_span": dn,
+             "overlap_s": round(min(p1, d1) - max(p0, d0), 3)}
+            for (po, p0, p1) in pre_spans
+            for (do, d0, d1, dn) in dev_spans
+            if po != do and p0 < d1 and d0 < p1]
+        assert overlaps, (
+            f"no survey.precompile span overlapped another "
+            f"observation's device span (precompile spans: "
+            f"{pre_spans}; device spans: {dev_spans[:6]})")
+        best = max(overlaps, key=lambda d: d["overlap_s"])
+        record["warm_pool"] = {
+            "n_obs": n_obs,
+            "nchan_per_obs": [24, 32, 48],
+            "precompiled_executables":
+                int(fleet_totals.get("survey.precompiled", 0)),
+            "precompile_spans": len(pre_spans),
+            "off_critical_path_overlaps": len(overlaps),
+            "example_overlap": best,
+        }
+        print(f"# compile[warm-pool]: {len(pre_spans)} precompile "
+              f"span(s), {len(overlaps)} overlap(s) with another "
+              f"observation's device span (best {best['overlap_s']}s: "
+              f"{best['precompile_obs']} warmed during "
+              f"{best['device_obs']}'s {best['device_span']})",
+              file=sys.stderr)
+
+        record["value"] = round(cold_wall / max(warm_wall, 1e-9), 3)
+        record["vs_baseline"] = record["value"]
+        record["unit"] = (
+            "cold-vs-warm wall ratio across 3 toy geometries (the "
+            "structural gates are the claim: warm legs compile "
+            "nothing, the bucket ladder collapses nearby DM counts "
+            "onto one executable, a second process hits the shared "
+            "persistent cache byte-identically, and fleet precompile "
+            "overlaps another observation's device work)")
+        if args.cpu_fallback:
+            record["unit"] += \
+                " [CPU FALLBACK: accelerator backend unavailable]"
+        return record
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def run_child(args, cpu: bool, timeout: float):
     """Run the measurement in a child interpreter; return its JSON record.
 
@@ -3805,7 +4149,7 @@ def run_child(args, cpu: bool, timeout: float):
         argv += ["--tune-trials", str(args.tune_trials)]
     for flag in ("quick", "profile", "ab", "accel", "spectral", "fold",
                  "waterfall", "prepass", "survey", "chaos", "corruption",
-                 "dedisp_tree", "tune", "multihost", "race",
+                 "dedisp_tree", "tune", "compile", "multihost", "race",
                  "obs_overhead"):
         if getattr(args, flag):
             argv.append("--" + flag.replace("_", "-"))
@@ -3855,7 +4199,8 @@ def main():
             and not (args.quick or args.ab or args.accel or args.fold
                      or args.waterfall or args.prepass or args.survey
                      or args.chaos or args.corruption or args.dedisp_tree or args.tune
-                     or args.multihost or args.race or args.obs_overhead
+                     or args.compile or args.multihost or args.race
+                     or args.obs_overhead
                      or args.cpu_fallback or args.nsamp or args.nchan)
             and os.path.exists(DEFAULT_STREAM_FIL)):
         # the north-star workload exists on disk: measure THAT (streamed,
@@ -3878,6 +4223,8 @@ def main():
                                          tool="bench") as tlm:
             if args.tune:
                 record = run_tune(args)
+            elif args.compile:
+                record = run_compile(args)
             elif args.ab:
                 record = run_ab(args)
             elif args.dedisp_tree:

@@ -1372,13 +1372,60 @@ class TelemetryNameDriftRule(ProjectRule):
 
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# PL018 — raw jax.jit outside the compilation plane
+
+
+class RawJitRule(Rule):
+    """Raw ``jax.jit`` bypasses the compilation plane (round 22): a
+    directly-jitted kernel gets no AOT executable registry entry, no
+    compile telemetry (``compile.cache_miss`` stays blind to it) and no
+    warm-pool precompile — exactly the critical-path trace+compile
+    stall the plane exists to remove.  Every jit in the tree goes
+    through :func:`pypulsar_tpu.compile.plane_jit` except the plane
+    itself and the ``ops/`` leaf-kernel modules registered in
+    :data:`pypulsar_tpu.compile.registry.OPS_LEAF_ALLOWLIST` (their
+    call sites are reached through plane-wrapped stage runners one
+    layer up, so re-wrapping them would double-count the same
+    compiles).
+
+    Any ``jax.jit`` attribute reference counts — ``@jax.jit``
+    decorators (bare or parameterized), direct ``jax.jit(fn)`` calls,
+    and indirections like ``functools.partial(jax.jit, ...)``.  Other
+    modules' ``.jit`` attributes (``self.jit``, ``nn.jit``) and the
+    word in strings/comments stay silent.  Tests are exempt."""
+
+    code = "PL018"
+    name = "raw-jax-jit"
+    summary = "raw jax.jit outside the compilation plane; use compile.plane_jit"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not _in_package(ctx) or _is_test(ctx):
+            return
+        if ctx.relpath.startswith("pypulsar_tpu/compile/"):
+            return
+        from pypulsar_tpu.compile.registry import OPS_LEAF_ALLOWLIST
+
+        if ctx.relpath in OPS_LEAF_ALLOWLIST:
+            return
+        for node in ctx.walk():
+            if (isinstance(node, ast.Attribute) and node.attr == "jit"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "jax"):
+                yield self.finding(
+                    ctx, node,
+                    "raw jax.jit bypasses the compilation plane (no AOT "
+                    "registry entry, no compile telemetry, no warm-pool "
+                    "precompile); use pypulsar_tpu.compile.plane_jit")
+
+
 ALL_RULES: Tuple[type, ...] = (
     TruedivIndexRule, BareJaxDevicesRule, NonAtomicWriteRule,
     KnobRegistryDriftRule, DeadFaultPointRule, RawHeaderReadRule,
     MutableDefaultRule, SpanLeakRule, SwallowedFaultRule,
     RawKnobReadRule, LockOrderInversionRule, BlockingWhileLockedRule,
     BareAcquireRule, ConditionWaitPredicateRule, ThreadDisciplineRule,
-    TelemetryNameDriftRule,
+    TelemetryNameDriftRule, RawJitRule,
 )
 
 

@@ -1,0 +1,39 @@
+"""pypulsar_tpu.compile — the compilation plane (round 22).
+
+Two halves:
+
+- :mod:`.registry` (stdlib-only, imported eagerly): the bucket-size
+  ladder and the ``ops/`` leaf-kernel allowlist psrlint PL018 reads.
+- :mod:`.plane` (jax-facing, re-exported lazily): the persistent XLA
+  cache wiring, the :func:`plane_jit` wrapper with its AOT executable
+  registry, and the warm-pool precompile hooks.
+
+The lazy split keeps ``import pypulsar_tpu.compile.registry`` (the
+linter's path) from dragging in jax.
+"""
+
+from __future__ import annotations
+
+from pypulsar_tpu.compile.registry import (  # noqa: F401
+    OPS_LEAF_ALLOWLIST, bucket_floor, bucket_rows, bucket_size,
+    buckets_enabled,
+)
+
+_PLANE_NAMES = (
+    "plane_jit", "PlaneJit", "configure_persistent_cache",
+    "persistent_cache_dir", "note_bucket_pad", "register_warmer",
+    "warmable_stages", "warm_stage",
+)
+
+__all__ = list(_PLANE_NAMES) + [
+    "OPS_LEAF_ALLOWLIST", "bucket_floor", "bucket_rows", "bucket_size",
+    "buckets_enabled",
+]
+
+
+def __getattr__(name: str):
+    if name in _PLANE_NAMES:
+        from pypulsar_tpu.compile import plane
+
+        return getattr(plane, name)
+    raise AttributeError(name)

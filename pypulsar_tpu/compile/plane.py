@@ -1,0 +1,448 @@
+"""The compilation plane: persistent XLA cache + AOT executable
+registry + warm-pool precompile hooks (round 22).
+
+Every ``jax.jit`` outside ``ops/`` leaf kernels dispatches through
+:func:`plane_jit` (psrlint PL018 enforces it). The wrapper layers
+three caches:
+
+1. **Persistent XLA cache** (``PYPULSAR_TPU_COMPILE_CACHE``, default
+   ``~/.cache/pypulsar_tpu/xla``): ``jax_compilation_cache_dir`` wired
+   fleet-wide, so a geometry compiled by ANY process on ANY host is a
+   disk hit everywhere else. Configured lazily, once per process, the
+   first time the plane compiles anything.
+2. **In-process AOT executable registry**: per-wrapper executables
+   from ``jit(f).lower(...).compile()`` keyed by (stage, static
+   argument values, dynamic leaf shapes/dtypes, default device, jax
+   version, device kind, resolved tuned-config digest). A repeat
+   geometry skips tracing entirely — ``compile.cache_hit`` — and a
+   tuned config change (round 17) keys a *different* entry, so tuning
+   trials are never charged another trial's first-trace compile.
+3. **Warm-pool precompile**: pipeline stages register warmers
+   (:func:`register_warmer`); the fleet scheduler's host pool calls
+   :func:`warm_stage` for the next ready observation's geometry while
+   devices are busy, so a cold fleet's first device dispatch finds a
+   warm executable (``wrapper.warm(...)`` lowers from
+   ``jax.ShapeDtypeStruct`` — no data needed).
+
+Anything the AOT path cannot key faithfully — tracer inputs (a
+plane-wrapped fn called under an outer trace), variadic signatures,
+multi-device arrays from a mesh context — falls back to the held
+plain ``jax.jit`` and counts ``compile.aot_fallback``; factory sites
+that close over meshes/shardings opt out wholesale with ``aot=False``
+(the plane still owns their telemetry). A bad cache dir or a failed
+AOT dispatch degrades the same way: the plane must never abort work
+that plain jit would have completed.
+
+Cross-host accounting: the XLA disk cache is opaque, so on every
+in-process miss the plane probes a sidecar marker
+(``<cache>/plane/<digest>.json``, written atomically after each
+compile, digest excludes process-local identity) and counts
+``compile.persistent_hit`` when another process/host compiled that
+key first — the counter the multi-host test asserts on.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import inspect
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from pypulsar_tpu.compile.registry import (  # noqa: F401  (re-export)
+    OPS_LEAF_ALLOWLIST, bucket_rows, bucket_size, buckets_enabled,
+)
+from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.tune import knobs
+
+__all__ = [
+    "plane_jit",
+    "PlaneJit",
+    "configure_persistent_cache",
+    "persistent_cache_dir",
+    "note_bucket_pad",
+    "register_warmer",
+    "warmable_stages",
+    "warm_stage",
+]
+
+
+class _Unkeyable(Exception):
+    """Inputs the AOT registry cannot key faithfully -> plain jit."""
+
+
+# ---------------------------------------------------------------------------
+# persistent XLA cache
+
+_cache_lock = threading.Lock()
+_cache_state: Dict[str, Any] = {"configured": False, "dir": None}
+
+
+def configure_persistent_cache() -> Optional[str]:
+    """Wire ``jax_compilation_cache_dir`` to the fleet-shared directory
+    (``PYPULSAR_TPU_COMPILE_CACHE``; ``0``/``off`` disables). Resolved
+    once per process — idempotent, thread-safe, returns the active
+    directory or None. Never raises: an uncreatable directory simply
+    disables persistence (plain in-memory jit still works)."""
+    with _cache_lock:
+        if _cache_state["configured"]:
+            return _cache_state["dir"]
+        _cache_state["configured"] = True
+    # The jax.config updates below go through JAX's own global config
+    # machinery and must not run under our lock; the once-per-process
+    # latch above already guarantees a single configuring thread (a
+    # concurrent caller may briefly observe dir=None, which only skips
+    # the accounting sidecar for that one dispatch).
+    raw = knobs.env_str("PYPULSAR_TPU_COMPILE_CACHE")
+    if not raw or str(raw).strip().lower() in ("0", "off", "none"):
+        return None
+    path = os.path.abspath(os.path.expanduser(str(raw)))
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache everything: the CPU-toy geometries tests exercise
+        # compile in microseconds, and tiny executables are exactly
+        # the ones a mixed-geometry fleet recompiles the most
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update(
+            "jax_persistent_cache_enable_xla_caches", "all")
+    except Exception:
+        return None
+    _cache_state["dir"] = path
+    return path
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The active persistent cache directory (configuring lazily)."""
+    return configure_persistent_cache()
+
+
+def _marker_path(digest: str) -> Optional[str]:
+    root = _cache_state["dir"] if _cache_state["configured"] \
+        else configure_persistent_cache()
+    if not root:
+        return None
+    return os.path.join(root, "plane", f"{digest}.json")
+
+
+def _probe_marker(digest: str, meta: Dict[str, Any]) -> bool:
+    """True when another process already compiled this key (the
+    cross-host ``compile.persistent_hit`` probe); records our own
+    marker intent in ``meta`` for :func:`_write_marker`."""
+    path = _marker_path(digest)
+    if path is None:
+        return False
+    meta["marker_path"] = path
+    return os.path.exists(path)
+
+
+def _write_marker(meta: Dict[str, Any], payload: Dict[str, Any]) -> None:
+    path = meta.get("marker_path")
+    if not path:
+        return
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # accounting sidecar only — never worth failing a dispatch
+
+
+# ---------------------------------------------------------------------------
+# keying helpers
+
+def _aot_enabled() -> bool:
+    raw = knobs.env_str("PYPULSAR_TPU_COMPILE_AOT")
+    return str(raw) not in ("0", "off", "none")
+
+
+def _device_key() -> str:
+    """The thread's placement context: ``jax.default_device`` is
+    thread-local (the scheduler sets it per gang lease), and an AOT
+    executable is pinned to the device it lowered under — so placement
+    MUST key the registry or a lease on chip 3 would silently run on
+    chip 0."""
+    dd = jax.config.jax_default_device
+    return "auto" if dd is None else str(dd)
+
+
+def _default_device_str() -> str:
+    """Where jit lands a host input: the thread's jax.default_device,
+    else the backend's first device (cached — process-stable)."""
+    dd = jax.config.jax_default_device
+    if dd is not None:
+        return str(dd)
+    d0 = _kind_cache.get("dev0")
+    if d0 is None:
+        try:
+            d0 = str(jax.devices()[0])  # psrlint: ignore[PL002] -- registry-key metadata (jit's implicit placement target), not a compute placement
+        except Exception:
+            d0 = ""
+        _kind_cache["dev0"] = d0
+    return d0
+
+
+def _leaf_key(x: Any) -> Tuple:
+    if isinstance(x, jax.core.Tracer):
+        raise _Unkeyable("tracer input")
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        if isinstance(x, jax.Array):
+            try:
+                devs = x.devices()
+            except Exception:
+                raise _Unkeyable("unreadable placement")
+            if len(devs) != 1:
+                raise _Unkeyable("multi-device input")
+            d = str(next(iter(devs)))
+            # an array already sitting where jit would commit a host
+            # input keys like a host input — so a ShapeDtypeStruct
+            # warm covers both call forms
+            return ("a", tuple(shape), str(dtype),
+                    "host" if d == _default_device_str() else d)
+        return ("a", tuple(shape), str(dtype), "host")
+    if isinstance(x, (bool, int, float, complex)) or x is None:
+        # python scalars trace to weak-typed arrays: the TYPE picks
+        # the dtype, the value never affects the executable
+        return ("s", type(x).__name__)
+    raise _Unkeyable(f"unkeyable leaf {type(x).__name__}")
+
+
+def _config_digest(stage: str) -> str:
+    """Digest of the stage's fully-resolved knob config (trial > env >
+    tuned > default) — the round-17 fix: a tuned config change keys a
+    different executable."""
+    if not stage:
+        return ""
+    cfg = knobs.current_config(stage)
+    blob = repr(sorted(cfg.items())).encode()
+    return hashlib.sha1(blob).hexdigest()[:12]
+
+
+_WRAPPER_IDS = itertools.count()
+
+
+# ---------------------------------------------------------------------------
+# the wrapper
+
+class PlaneJit:
+    """Drop-in for ``jax.jit`` that dispatches through the plane's AOT
+    executable registry (see module docstring for the cache layers and
+    the fallback ladder)."""
+
+    def __init__(self, fn: Callable, *, static_argnames=(),
+                 stage: str = "", name: Optional[str] = None,
+                 aot: bool = True):
+        if isinstance(static_argnames, str):
+            static_argnames = (static_argnames,)
+        self._fn = fn
+        self._static = tuple(static_argnames)
+        self._stage = stage
+        self.__name__ = name or getattr(fn, "__name__", "fn")
+        self._jit = (jax.jit(fn, static_argnames=self._static)
+                     if self._static else jax.jit(fn))
+        self._uid = next(_WRAPPER_IDS)
+        self._compiled: Dict[Tuple, Any] = {}
+        self._lock = threading.Lock()
+        self._aot = bool(aot)
+        try:
+            sig = inspect.signature(fn)
+        except (TypeError, ValueError):
+            sig = None
+        if sig is None or any(
+                p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD)
+                for p in sig.parameters.values()):
+            self._aot = False  # can't map statics -> positions
+        self._sig = sig
+
+    # -- keying ------------------------------------------------------------
+
+    def _split(self, args, kwargs):
+        """Bind the call, split static vs dynamic arguments, and build
+        the registry key. Returns (key, persist_digest, dynamics)."""
+        ba = self._sig.bind(*args, **kwargs)
+        ba.apply_defaults()
+        statics, dyn_keys, dynamics = [], [], []
+        for pname, value in ba.arguments.items():
+            if pname in self._static:
+                statics.append((pname, repr(value)))
+            else:
+                leaves, treedef = jax.tree_util.tree_flatten(value)
+                dyn_keys.append(
+                    (pname, str(treedef),
+                     tuple(_leaf_key(leaf) for leaf in leaves)))
+                dynamics.append(value)
+        shape_key = (tuple(statics), tuple(dyn_keys))
+        cfg = _config_digest(self._stage)
+        key = (shape_key, _device_key(), cfg)
+        blob = repr((self.__name__, self._stage, jax.__version__,
+                     _device_kind(), shape_key, cfg)).encode()
+        return key, hashlib.sha1(blob).hexdigest(), dynamics
+
+    # -- dispatch ----------------------------------------------------------
+
+    def __call__(self, *args, **kwargs):
+        if not self._aot or not _aot_enabled():
+            return self._jit(*args, **kwargs)
+        try:
+            key, digest, dynamics = self._split(args, kwargs)
+        except (_Unkeyable, TypeError):
+            telemetry.counter("compile.aot_fallback")
+            return self._jit(*args, **kwargs)
+        with self._lock:
+            compiled = self._compiled.get(key)
+        if compiled is None:
+            compiled = self._compile(key, digest, args, kwargs)
+            if compiled is None:  # lowering refused -> plain jit
+                return self._jit(*args, **kwargs)
+        else:
+            telemetry.counter("compile.cache_hit")
+        try:
+            return compiled(*dynamics)
+        except Exception:
+            # shape drift inside a pytree, donation mismatch, a
+            # backend refusing the AOT path — plain jit still works
+            telemetry.counter("compile.aot_fallback")
+            return self._jit(*args, **kwargs)
+
+    def _compile(self, key, digest, args, kwargs):
+        configure_persistent_cache()
+        meta: Dict[str, Any] = {}
+        cross_host = _probe_marker(digest, meta)
+        label = self._stage or self.__name__
+        t0 = time.perf_counter()
+        try:
+            compiled = self._jit.lower(*args, **kwargs).compile()
+        except Exception:
+            telemetry.counter("compile.aot_fallback")
+            with self._lock:
+                self._aot = False  # this fn will never lower; stop trying
+            return None
+        dt = time.perf_counter() - t0
+        telemetry.counter("compile.cache_miss")
+        telemetry.counter("compile.ms", dt * 1e3)
+        if cross_host:
+            telemetry.counter("compile.persistent_hit")
+        # first-dispatch span: steady-state hits stay span-free, so
+        # tlmsum's compilation roll-up shows first-vs-steady directly
+        telemetry.record_span(f"compile.first.{label}", dt)
+        _write_marker(meta, {
+            "fn": self.__name__, "stage": self._stage,
+            "jax": jax.__version__, "device_kind": _device_kind(),
+        })
+        with self._lock:
+            self._compiled.setdefault(key, compiled)
+        return compiled
+
+    # -- precompile --------------------------------------------------------
+
+    def warm(self, *args, **kwargs) -> bool:
+        """AOT-compile for the given (possibly abstract —
+        ``jax.ShapeDtypeStruct``) arguments without dispatching; the
+        warm-pool entry point. True when this call compiled (or found
+        cross-host), False on a registry hit or fallback."""
+        if not self._aot or not _aot_enabled():
+            return False
+        try:
+            key, digest, _ = self._split(args, kwargs)
+        except (_Unkeyable, TypeError):
+            return False
+        with self._lock:
+            if key in self._compiled:
+                return False
+        return self._compile(key, digest, args, kwargs) is not None
+
+    # -- introspection (tests / bench) ------------------------------------
+
+    def cache_size(self) -> int:
+        with self._lock:
+            return len(self._compiled)
+
+
+_kind_cache: Dict[str, str] = {}
+
+
+def _device_kind() -> str:
+    """Backend device kind, resolved lazily (touching jax.devices() at
+    import would initialize the backend before CLIs pick a platform)."""
+    k = _kind_cache.get("kind")
+    if k is None:
+        try:
+            k = jax.devices()[0].device_kind  # psrlint: ignore[PL002] -- cache-key metadata (hardware KIND, not a compute placement); no lease involved
+        except Exception:
+            k = "unknown"
+        _kind_cache["kind"] = k
+    return k
+
+
+def plane_jit(fn: Optional[Callable] = None, *, static_argnames=(),
+              stage: str = "", name: Optional[str] = None,
+              aot: bool = True):
+    """``jax.jit`` through the compilation plane. Usable as a direct
+    wrapper (``plane_jit(f, stage="fold")``) or a decorator factory
+    (``@plane_jit(static_argnames=("nbins",), stage="fold")``).
+    ``aot=False`` keeps plain-jit dispatch (for factories that close
+    over meshes/shardings) while still routing through the plane."""
+    if fn is None:
+        return lambda f: PlaneJit(f, static_argnames=static_argnames,
+                                  stage=stage, name=name, aot=aot)
+    return PlaneJit(fn, static_argnames=static_argnames, stage=stage,
+                    name=name, aot=aot)
+
+
+def note_bucket_pad(n_real: int, n_padded: int) -> None:
+    """Account one bucketing decision: the pad fraction gauge and the
+    padded-row counter the bench reads."""
+    if n_padded <= 0:
+        return
+    telemetry.gauge("compile.bucket_pad_frac",
+                    (n_padded - n_real) / float(n_padded))
+    if n_padded > n_real:
+        telemetry.counter("compile.bucket_pad_rows", n_padded - n_real)
+
+
+# ---------------------------------------------------------------------------
+# warm-pool registry
+
+_warmers: Dict[str, Callable[..., int]] = {}
+_warmers_lock = threading.Lock()
+
+
+def register_warmer(stage: str, fn: Callable[..., int]) -> None:
+    """Register ``stage``'s precompile planner: ``fn(**geometry)``
+    lowers that stage's wrappers for one observation geometry and
+    returns how many executables it compiled. Last registration wins
+    (re-import safe)."""
+    with _warmers_lock:
+        _warmers[stage] = fn
+
+
+def warmable_stages() -> Tuple[str, ...]:
+    with _warmers_lock:
+        return tuple(sorted(_warmers))
+
+
+def warm_stage(stage: str, **geometry) -> int:
+    """Run ``stage``'s registered warmer for ``geometry``; 0 when no
+    warmer is registered or the warmer declined. Never raises — the
+    warm pool is an optimization, not a correctness path."""
+    with _warmers_lock:
+        fn = _warmers.get(stage)
+    if fn is None:
+        return 0
+    try:
+        return int(fn(**geometry) or 0)
+    except Exception:
+        telemetry.counter("compile.warm_error")
+        return 0

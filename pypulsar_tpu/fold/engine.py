@@ -22,13 +22,13 @@ strategy 1).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pypulsar_tpu.compile import bucket_rows, plane_jit, register_warmer
 from pypulsar_tpu.core.psrmath import SECPERDAY
 from pypulsar_tpu.obs import telemetry
 
@@ -50,7 +50,8 @@ def _fold_bins_impl(data, bin_idx, nbins: int):
     return prof, counts
 
 
-_fold_bins_jit = partial(jax.jit, static_argnames=("nbins",))(_fold_bins_impl)
+_fold_bins_jit = plane_jit(_fold_bins_impl, static_argnames=("nbins",),
+                           stage="fold")
 
 
 def fold_bins(data, bin_idx, nbins: int):
@@ -136,8 +137,8 @@ def _fold_parts_impl(data, bin_idx, nbins: int, npart: int):
     return profs, counts
 
 
-_fold_parts_jit = partial(jax.jit, static_argnames=("nbins", "npart"))(
-    _fold_parts_impl)
+_fold_parts_jit = plane_jit(_fold_parts_impl,
+                            static_argnames=("nbins", "npart"), stage="fold")
 
 
 def fold_parts(data, bin_idx, nbins: int, npart: int):
@@ -163,7 +164,7 @@ def fold_parts(data, bin_idx, nbins: int, npart: int):
         return _fold_parts_jit(data, bin_idx, nbins, npart)
 
 
-@partial(jax.jit, static_argnames=("nbins", "npart"))
+@plane_jit(static_argnames=("nbins", "npart"), stage="fold")
 def _fold_stats_jit(data, bin_idx, nbins: int, npart: int, dp_offsets):
     """One-dispatch fold + ON-DEVICE profile statistics (VERDICT r3
     item 4): everything pfd_snr-style analysis needs leaves the device as
@@ -384,8 +385,9 @@ def _fold_parts_batch_impl(series, bin_idx, nbins: int, npart: int):
     return profs.transpose(1, 0, 2), counts.transpose(1, 0, 2)
 
 
-_fold_parts_batch_jit = partial(jax.jit, static_argnames=("nbins", "npart"))(
-    _fold_parts_batch_impl)
+_fold_parts_batch_jit = plane_jit(_fold_parts_batch_impl,
+                                  static_argnames=("nbins", "npart"),
+                                  stage="fold")
 
 
 def fold_parts_batch(series, bin_idx, nbins: int, npart: int):
@@ -425,7 +427,7 @@ def fold_parts_batch_numpy(series, bin_idx, nbins: int, npart: int):
     return profs, counts
 
 
-@jax.jit
+@plane_jit(stage="fold")
 def _refine_chi2_jit(part_profs, offsets):
     """chi2[K, J] of every candidate x drift-trial combination: trial j
     rotates candidate k's partition i by ``offsets[j, i]`` cycles
@@ -627,3 +629,26 @@ def fold_spectra(
     .pfd-style product)."""
     return _fold_any(data, dt, nbins, data.shape[1], period, polycos,
                      mjdstart, normalize)
+
+
+# ---------------------------------------------------------------------------
+# warm-pool precompile (round 22)
+
+def _warm_fold(*, n_samples=None, downsamp=1, fold_nbins=64,
+               fold_npart=32, fold_batch=32, **_ignored) -> int:
+    """Warm-pool planner for the fold stage: AOT-lower the batched
+    partition fold at the geometry the fold pipeline will dispatch —
+    the downsampled series length and the candidate batch padded to the
+    compile plane's bucket ladder (exactly what foldpipe's dispatch
+    pads to). Abstract arrays only; nothing is read or dispatched."""
+    T = int(n_samples or 0) // max(1, int(downsamp))
+    if T <= 0:
+        return 0
+    K = bucket_rows(max(1, int(fold_batch)))
+    series = jax.ShapeDtypeStruct((T,), np.float32)
+    bins = jax.ShapeDtypeStruct((K, T), np.int32)
+    return int(_fold_parts_batch_jit.warm(series, bins, int(fold_nbins),
+                                          int(fold_npart)))
+
+
+register_warmer("fold", _warm_fold)

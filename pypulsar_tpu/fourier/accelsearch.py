@@ -44,13 +44,14 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
-from functools import partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from scipy.special import gammaincc, gammainccinv, gammaln, log_ndtr, ndtri
+
+from pypulsar_tpu.compile import plane_jit
 
 from pypulsar_tpu.fourier.zresponse import template_bank_zw
 from pypulsar_tpu.obs import telemetry
@@ -256,7 +257,7 @@ class AccelCandidate:
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("front", "pad"))
+@plane_jit(static_argnames=("front", "pad"), stage="accel")
 def _build_spec_pad(re, im, front, pad):
     """Padded search spectrum as [2, Np] float planes: conjugate
     reflection in front (bin -k of a real input's FFT is conj(bin k)) so
@@ -270,7 +271,7 @@ def _build_spec_pad(re, im, front, pad):
     return jnp.stack([sp.real, sp.imag])
 
 
-@partial(jax.jit, static_argnames=("front", "pad"))
+@plane_jit(static_argnames=("front", "pad"), stage="accel")
 def _build_spec_pad_batch(re, im, front, pad):
     """Batched :func:`_build_spec_pad`: [B, N] planes -> [B, 2, Np]."""
     f = join_planes(re, im)  # [B, N]
@@ -336,7 +337,7 @@ def _make_stage_runner(segw: int, Z: int, Wn: int, topk: int,
         _, res = jax.lax.scan(body, 0, seg_ids)
         return res
 
-    return jax.jit(run)
+    return plane_jit(run, stage="accel", name="accel_stage")
 
 
 @functools.lru_cache(maxsize=64)
@@ -397,7 +398,7 @@ def _make_stage_runner_batch(segw: int, Z: int, Wn: int, topk: int,
         return res  # each [n_seg, B, Wn, ...]
 
     if not mesh_devs:
-        return jax.jit(run)
+        return plane_jit(run, stage="accel", name="accel_stage_batch")
 
     from jax.sharding import Mesh
     from jax.sharding import PartitionSpec as P
@@ -416,7 +417,10 @@ def _make_stage_runner_batch(segw: int, Z: int, Wn: int, topk: int,
         return shd(spec_pad2, tfs, idxs,
                    jnp.int32(top_lo), jnp.int32(top_hi), thresh, seg_ids)
 
-    return jax.jit(run_sharded)
+    # sharded factory: the mesh closure makes AOT keying unsound, so the
+    # plane holds plain-jit dispatch (aot=False) and keeps the telemetry
+    return plane_jit(run_sharded, stage="accel", name="accel_stage_sharded",
+                     aot=False)
 
 
 def _detect_impl(accum, thresh, k: int):

@@ -25,13 +25,13 @@ Design notes (TPU-first re-design of reference formats/prestofft.py):
 from __future__ import annotations
 
 import functools
-from functools import partial
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pypulsar_tpu.compile import plane_jit
 from pypulsar_tpu.ops.transfer import (join_planes, split_complex,
                                         to_host_complex)
 
@@ -53,7 +53,7 @@ def _interpolate_body(fft, r, m):
     return jnp.sum(coefs * expterm * sincterm, axis=1)
 
 
-@partial(jax.jit, static_argnames=("m",))
+@plane_jit(static_argnames=("m",), stage="accel")
 def _fourier_interpolate_jit(re, im, r, m=32):
     out = _interpolate_body(join_planes(re, im), r, m)
     return out.real, out.imag
@@ -72,7 +72,7 @@ def fourier_interpolate(fft, r, m=32) -> np.ndarray:
     return to_host_complex(our, oui)
 
 
-@partial(jax.jit, static_argnames=("nharm",))
+@plane_jit(static_argnames=("nharm",), stage="accel")
 def harmonic_sum(powers, nharm=8):
     """Decimated harmonic sum: out[i] = sum_{h=1..nharm} powers[i*h]
     (reference prestofft.py:98-113). Output length N//nharm."""
@@ -84,7 +84,7 @@ def harmonic_sum(powers, nharm=8):
     return out
 
 
-@partial(jax.jit, static_argnames=("nharm", "m"))
+@plane_jit(static_argnames=("nharm", "m"), stage="accel")
 def _incoherent_harmonic_sum_jit(re, im, powers, nharm=8, m=2):
     fft = join_planes(re, im)
     nn = fft.shape[0]
@@ -95,7 +95,7 @@ def _incoherent_harmonic_sum_jit(re, im, powers, nharm=8, m=2):
     return out
 
 
-@partial(jax.jit, static_argnames=("nharm", "m"))
+@plane_jit(static_argnames=("nharm", "m"), stage="accel")
 def _coherent_harmonic_sum_jit(re, im, nharm=8, m=2):
     fft = join_planes(re, im)
     nn = fft.shape[0]
@@ -226,11 +226,11 @@ def _deredden_body(re, im, powers, starts, lens, elem_block, elem_off,
     return out.real, out.imag
 
 
-_deredden_apply = partial(jax.jit, static_argnames=("maxlen",))(
-    _deredden_body)
+_deredden_apply = plane_jit(_deredden_body, static_argnames=("maxlen",),
+                            stage="accel")
 
 
-@partial(jax.jit, static_argnames=("maxlen",))
+@plane_jit(static_argnames=("maxlen",), stage="accel")
 def _prep_spectra_kernel(series, starts, lens, elem_block, elem_off, maxlen):
     # subtract the per-series mean before the f32 rfft: deredden overwrites
     # bin 0 anyway, so this changes nothing in exact arithmetic, but a
@@ -249,7 +249,7 @@ def _prep_spectra_kernel(series, starts, lens, elem_block, elem_off, maxlen):
     )(re, im, powers, starts, lens, elem_block, elem_off, maxlen)
 
 
-@partial(jax.jit, static_argnames=("maxlen",))
+@plane_jit(static_argnames=("maxlen",), stage="accel")
 def _prep_transformed_kernel(re, im, starts, lens, elem_block, elem_off,
                              maxlen):
     """Deredden-only half of :func:`_prep_spectra_kernel` for input that
@@ -364,7 +364,7 @@ def deredden(fft, powers=None, initialbuflen=6, maxbuflen=200,
     return to_host_complex(our, oui)
 
 
-@partial(jax.jit, static_argnames=("maxlen",))
+@plane_jit(static_argnames=("maxlen",), stage="accel")
 def _errors_apply(powers, starts, lens, elem_block, elem_off, maxlen):
     rms = _masked_block_stat(powers, starts, lens, maxlen, "std")
     B = starts.shape[0]
@@ -396,7 +396,7 @@ def estimate_power_errors(powers, initialbuflen=6, maxbuflen=200,
     )
 
 
-@partial(jax.jit, static_argnames=("samp_per_block",))
+@plane_jit(static_argnames=("samp_per_block",), stage="accel")
 def spectrogram(timeseries, samp_per_block):
     """Block power spectra: reshape to (numspec, samp_per_block), batched
     rfft, |.|^2 (reference bin/spectrogram.py:17-37). Returns

@@ -44,7 +44,13 @@ from pypulsar_tpu.resilience.locks import TrackedLock
 __all__ = ["StatusServer", "capsules_by_obs", "fleet_snapshot",
            "postmortem_dir", "prometheus_text"]
 
-_CACHE_TTL_S = 0.25
+def _cache_ttl_s() -> float:
+    """Snapshot cache TTL for the scrape loop — a registered knob
+    (round 22) so always-on fleets can tune scrape cost vs freshness
+    instead of living with a hard-coded 0.25 s."""
+    from pypulsar_tpu.tune import knobs
+
+    return max(0.0, knobs.env_float("PYPULSAR_TPU_OBS_STATUSD_TTL_S"))
 
 
 def postmortem_dir(outdir: str) -> str:
@@ -242,7 +248,7 @@ class _Server(ThreadingHTTPServer):
         now = time.monotonic()
         with self._lock:
             if self._cached is not None \
-                    and now - self._cached_t < _CACHE_TTL_S:
+                    and now - self._cached_t < _cache_ttl_s():
                 return self._cached
         snap = fleet_snapshot(self.outdir)
         with self._lock:

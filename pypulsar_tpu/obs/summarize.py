@@ -617,6 +617,36 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
                          f"{w['best_s']:.4f}s, "
                          f"{w.get('n_trials', 0)} trials]")
             p(f"#   {stage:<10s} {cfg_s}{extra}")
+    # compilation roll-up (round 22): what the compile plane's AOT
+    # registry and persistent XLA cache kept off the critical path —
+    # in-process executable hits vs first compiles, cross-host
+    # persistent-cache hits, warm-pool precompiles, and how much of
+    # each bucketed dispatch was ladder padding
+    cp_bits = []
+    for key, label in (("compile.cache_hit", "registry hits"),
+                       ("compile.cache_miss", "compiles"),
+                       ("compile.persistent_hit", "persistent-cache hits"),
+                       ("survey.precompiled", "warm-pool precompiles"),
+                       ("compile.aot_fallback", "aot fallbacks")):
+        v = s.counters.get(key)
+        if v:
+            cp_bits.append(f"{label}={_fmt_count(v)}")
+    ms = s.counters.get("compile.ms")
+    if ms:
+        cp_bits.append(f"compile wall={ms / 1e3:.2f}s")
+    pad = s.gauges.get("compile.bucket_pad_frac", {}).get("max")
+    if pad:
+        cp_bits.append(f"bucket pad frac (max)={pad:.3f}")
+    if cp_bits:
+        p("#\n# compilation: " + "  ".join(cp_bits))
+        firsts = sorted((name, sc) for name, sc in s.stages.items()
+                        if name.startswith("compile.first."))
+        for name, sc in firsts:
+            # first-dispatch cost per stage: the stall the registry and
+            # the warm pool exist to hide
+            p(f"#   {name.replace('compile.first.', ''):<10s} "
+              f"first-compile {sc[0]:.2f}s over {int(sc[1])} "
+              f"program(s)")
     # data-quality roll-up: what the dataguard scrub and the finite
     # gates did to this run's bytes (round 13)
     data_bits = []

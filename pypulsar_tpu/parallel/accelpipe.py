@@ -44,6 +44,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from pypulsar_tpu.compile import bucket_floor, bucket_rows, note_bucket_pad
 from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.resilience import faultinject, health
 from pypulsar_tpu.tune import knobs
@@ -386,6 +387,10 @@ def sweep_accel_stream(
     # a batch holds only its gathered rows — no per-batch prep cap
     unit = (min(batch, max(1, ndm * ((hbm // inflight) // (24 * T))))
             if device_prep and not spectral else batch)
+    # the batch cap lands on the compile plane's bucket ladder (floor:
+    # it bounds HBM) so full dispatch batches reuse one executable
+    # across nearby geometries; tails pad UP to the ladder in prep()
+    unit = bucket_floor(unit)
     if ndm > 1:
         # dispatch batches stay whole device multiples; short tails pad
         # by replicating the last row (dropped after the search)
@@ -452,16 +457,20 @@ def sweep_accel_stream(
                                      dtype=np.int32)
                     with telemetry.span("accel_prep_fused", **prep_attrs):
                         rre, rim = re_pl[loc], im_pl[loc]
-                        if ndm > 1 and rre.shape[0] % ndm:
-                            pad = ndm - rre.shape[0] % ndm
+                        pad = (bucket_rows(rre.shape[0], multiple=ndm)
+                               - rre.shape[0])
+                        if pad:
+                            note_bucket_pad(rre.shape[0],
+                                            rre.shape[0] + pad)
                             rre = jnp.concatenate(
                                 [rre, jnp.repeat(rre[-1:], pad, axis=0)])
                             rim = jnp.concatenate(
                                 [rim, jnp.repeat(rim[-1:], pad, axis=0)])
                         return idxs, (rre, rim), None
                 rows = np.ascontiguousarray(series[[i - d0 for i in idxs]])
-                if ndm > 1 and rows.shape[0] % ndm:
-                    pad = ndm - rows.shape[0] % ndm
+                pad = bucket_rows(rows.shape[0], multiple=ndm) - rows.shape[0]
+                if pad:
+                    note_bucket_pad(rows.shape[0], rows.shape[0] + pad)
                     rows = np.concatenate(
                         [rows, np.repeat(rows[-1:], pad, axis=0)])
                 with telemetry.span("accel_prep_device" if device_prep

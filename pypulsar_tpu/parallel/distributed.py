@@ -315,21 +315,22 @@ def _time_shard_local_accum(reader, dms, rank, count, nsub, group_size,
     probe = _ReaderSource(reader)  # full-file view for geometry
     T = probe.nsamples // factor   # downsampled samples (the sweep grid)
     dms = np.asarray(dms, dtype=np.float64)
-    pad_groups_to = None
-    if mesh is not None:
-        # group padding so groups divide the mesh axis (same rule as
-        # staged._run_step; group_size<=0 resolves inside make_sweep_plan,
-        # so resolve it first for the ceiling arithmetic)
-        from pypulsar_tpu.parallel.sweep import choose_group_size
+    # group padding so groups divide the mesh axis and land on the
+    # compile plane's bucket ladder (same rule as staged._run_step;
+    # group_size<=0 resolves inside make_sweep_plan, so resolve it
+    # first for the ceiling arithmetic)
+    from pypulsar_tpu.parallel.sweep import (
+        choose_group_size,
+        padded_group_count,
+    )
 
-        gs = group_size
-        if gs <= 0:
-            gs = choose_group_size(dms, probe.frequencies,
-                                   probe.tsamp * factor, nsub)
-        ndm = mesh.shape["dm"]
-        G = -(-len(dms) // gs)
-        pad_groups_to = -(-G // ndm) * ndm
-        group_size = gs
+    gs = group_size
+    if gs <= 0:
+        gs = choose_group_size(dms, probe.frequencies,
+                               probe.tsamp * factor, nsub)
+    ndm = 1 if mesh is None else mesh.shape["dm"]
+    pad_groups_to = padded_group_count(-(-len(dms) // gs), ndm)
+    group_size = gs
     plan = make_sweep_plan(dms, probe.frequencies, probe.tsamp * factor,
                            nsub=nsub, group_size=group_size,
                            widths=tuple(widths),

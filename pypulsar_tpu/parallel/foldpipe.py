@@ -46,6 +46,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from pypulsar_tpu.compile import bucket_floor, bucket_rows, note_bucket_pad
 from pypulsar_tpu.core import psrmath
 from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.resilience import faultinject, health
@@ -515,7 +516,9 @@ def fold_pipeline(
         except OSError:
             T_est = None  # provider will surface the real read error
     if T_est:
-        cap = max(1, binidx_budget // (4 * T_est))
+        # the RAM-derived cap floors onto the bucket ladder so full
+        # candidate groups dispatch at one canonical executable shape
+        cap = max(1, bucket_floor(binidx_budget // (4 * T_est)))
         if cap < batch:
             if verbose:
                 print(f"# candidate batch {batch} -> {cap}: bin-index "
@@ -593,17 +596,31 @@ def fold_pipeline(
                 try:
                     def run(lo, hi):
                         faultinject.trip("fold.batch_dispatch")
+                        bi = bin_idx[lo:hi]
+                        n = hi - lo
+                        padded = bucket_rows(n)
+                        if padded > n:
+                            # candidate batches land on the compile
+                            # plane's bucket ladder by replicating the
+                            # last candidate's bin indices; the padded
+                            # folds are sliced off below, so archive
+                            # bytes never change
+                            note_bucket_pad(n, padded)
+                            bi = np.concatenate(
+                                [bi, np.repeat(bi[-1:], padded - n,
+                                               axis=0)])
                         # counts stay on device: stats[...,0] is part_len by
                         # construction (the serial fold_partitions contract),
                         # so pulling the [K, npart, nbins] int cube would be
                         # pure transfer waste
                         profs_dev, _ = fold_parts_batch(
-                            series, bin_idx[lo:hi], nbins, npart)
+                            series, bi, nbins, npart)
                         outs = ((profs_dev, refine_chi2(profs_dev, offsets))
                                 if refine else (profs_dev,))
                         from pypulsar_tpu.ops.transfer import pull_host
 
-                        return tuple(np.asarray(x) for x in pull_host(*outs))
+                        return tuple(np.asarray(x)[:n]
+                                     for x in pull_host(*outs))
 
                     parts = halving_dispatch(run, K, what="fold.batch")
                     profs = np.concatenate([p[2][0] for p in parts])

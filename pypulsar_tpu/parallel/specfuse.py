@@ -63,6 +63,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pypulsar_tpu.compile import plane_jit
 from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.resilience import faultinject
 from pypulsar_tpu.tune import knobs
@@ -99,7 +100,10 @@ def _make_sharded_spectra_chunk(mesh, nsub, n_fft, dec_stride, dec_len,
     fn = shard_map_compat(impl, mesh=mesh,
                           in_specs=(P(), P("dm"), P("dm")),
                           out_specs=(P("dm"), P("dm")))
-    return jax.jit(fn)
+    # mesh-closing factory: AOT keying is unsound across meshes, so the
+    # plane keeps plain-jit dispatch (aot=False) and owns the telemetry
+    return plane_jit(fn, stage="specfuse", name="specfuse_sharded_chunk",
+                     aot=False)
 
 
 def fused_spectra_slice(
@@ -170,14 +174,15 @@ def fused_spectra_slice(
     ndm = 1 if mesh is None else int(mesh.shape["dm"])
     dev_ids = ([int(getattr(d, "id", -1)) for d in mesh.devices.flat]
                if mesh is not None else None)
-    if mesh is not None:
-        padded_groups = -(-plan.n_groups // ndm) * ndm
-        if padded_groups != plan.n_groups:
-            # padded groups replicate the last real trial (group math is
-            # independent; rows [:n_real] below are untouched)
-            plan = make_sweep_plan(dms, probe.frequencies, dt_eff,
-                                   nsub=nsub, group_size=plan.group_size,
-                                   widths=(1,), pad_groups_to=padded_groups)
+    from pypulsar_tpu.parallel.sweep import padded_group_count
+
+    padded_groups = padded_group_count(plan.n_groups, ndm)
+    if padded_groups != plan.n_groups:
+        # padded groups replicate the last real trial (group math is
+        # independent; rows [:n_real] below are untouched)
+        plan = make_sweep_plan(dms, probe.frequencies, dt_eff,
+                               nsub=nsub, group_size=plan.group_size,
+                               widths=(1,), pad_groups_to=padded_groups)
     if schedule is None:
         schedule = deredden_schedule(T // 2 + 1)
 

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pypulsar_tpu.compile import plane_jit
 from pypulsar_tpu.obs import telemetry
 from pypulsar_tpu.ops import kernels
 from pypulsar_tpu.tune import knobs
@@ -152,7 +153,7 @@ class _SpectraSource:
             pos += payload
 
 
-@functools.partial(jax.jit, static_argnames=("flip", "nbits"))
+@plane_jit(static_argnames=("flip", "nbits"), stage="sweep")
 def _ingest_tc(raw_tc, flip: bool, nbits: int = 8):
     """Device-side block ingest: [time, chan] native-dtype block ->
     [chan, time] float32, optionally band-flipped. Keeping the transpose,
@@ -343,7 +344,7 @@ class _MaskedSource:
             yield pos, block
 
 
-@functools.partial(jax.jit, static_argnames=("pts",))
+@plane_jit(static_argnames=("pts",), stage="sweep")
 def _masked_block(data, table, base, rem, pts: int):
     """Expand the device-resident [nint, C] zap table to this block's
     [C, L] mask (interval = sample // pts, clamped like
@@ -512,11 +513,10 @@ def _run_step(src, dms, factor: int, nsub: int, group_size: int,
         from pypulsar_tpu.parallel.sweep import choose_group_size
 
         group_size = choose_group_size(dms, src.frequencies, dt_eff, nsub)
-    pad_groups_to = None
-    if mesh is not None:
-        ndm = mesh.shape["dm"]
-        G = -(-len(dms) // group_size)
-        pad_groups_to = -(-G // ndm) * ndm
+    from pypulsar_tpu.parallel.sweep import padded_group_count
+
+    ndm = 1 if mesh is None else mesh.shape["dm"]
+    pad_groups_to = padded_group_count(-(-len(dms) // group_size), ndm)
     plan = make_sweep_plan(dms, src.frequencies, dt_eff, nsub=nsub,
                            group_size=group_size, widths=widths,
                            pad_groups_to=pad_groups_to)
@@ -806,6 +806,7 @@ def sweep_ddplan_2d(
     from pypulsar_tpu.parallel.sweep import (
         finalize_sweep,
         make_sharded_sweep_chunk_2d,
+        padded_group_count,
     )
 
     src = _make_source(source)
@@ -821,8 +822,7 @@ def sweep_ddplan_2d(
         n_ds = src.nsamples // factor
         if n_ds == 0:
             break
-        G = -(-len(dms) // group_size)
-        pad_groups_to = -(-G // nd) * nd
+        pad_groups_to = padded_group_count(-(-len(dms) // group_size), nd)
         plan = make_sweep_plan(dms, src.frequencies, dt_eff, nsub=nsub,
                                group_size=group_size, widths=tuple(widths),
                                pad_groups_to=pad_groups_to)
@@ -994,18 +994,20 @@ def iter_dedispersed_chunks(
                                      chunk_payload=chunk_payload)
     dev_ids = None
     sharded_fn = None
+    from pypulsar_tpu.parallel.sweep import padded_group_count
+
+    ndm = 1 if mesh is None else int(mesh.shape["dm"])
+    padded_groups = padded_group_count(plan.n_groups, ndm)
+    if padded_groups != plan.n_groups:
+        # padded groups replicate the last real trial; group math is
+        # independent, so the real rows below are untouched
+        plan = make_sweep_plan(dms, probe.frequencies,
+                               probe.tsamp * factor, nsub=nsub,
+                               group_size=plan.group_size, widths=(1,),
+                               pad_groups_to=padded_groups)
     if mesh is not None:
         from pypulsar_tpu.parallel.sweep import make_sharded_series_chunk
 
-        ndm = int(mesh.shape["dm"])
-        padded_groups = -(-plan.n_groups // ndm) * ndm
-        if padded_groups != plan.n_groups:
-            # padded groups replicate the last real trial; group math is
-            # independent, so the real rows below are untouched
-            plan = make_sweep_plan(dms, probe.frequencies,
-                                   probe.tsamp * factor, nsub=nsub,
-                                   group_size=plan.group_size, widths=(1,),
-                                   pad_groups_to=padded_groups)
         sharded_fn = make_sharded_series_chunk(
             mesh, plan.nsub, payload, plan.max_shift2, engine)
         dev_ids = [int(getattr(d, "id", -1)) for d in mesh.devices.flat]

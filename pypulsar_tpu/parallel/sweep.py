@@ -45,6 +45,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pypulsar_tpu.compile import (
+    bucket_rows,
+    note_bucket_pad,
+    plane_jit,
+    register_warmer,
+)
 from pypulsar_tpu.core import psrmath
 from pypulsar_tpu.ops import transfer
 from pypulsar_tpu.ops.pallas_kernels import boxcar_stats
@@ -428,8 +434,8 @@ def _sweep_chunk_impl(
     )
 
 
-@partial(jax.jit, static_argnames=("nsub", "out_len", "slack2", "widths",
-                                   "stat_len", "engine"))
+@plane_jit(static_argnames=("nsub", "out_len", "slack2", "widths",
+                            "stat_len", "engine"), stage="sweep")
 def _sweep_chunk_jit(data, stage1_bins, stage2_bins, nsub, out_len, slack2,
                      widths, stat_len, engine="gather"):
     return _sweep_chunk_impl(
@@ -475,7 +481,8 @@ def dedisperse_series_chunk(data, stage1_bins, stage2_bins, nsub,
                                   out_len, slack2, engine)
 
 
-@partial(jax.jit, static_argnames=("nsub", "out_len", "slack2", "engine"))
+@plane_jit(static_argnames=("nsub", "out_len", "slack2", "engine"),
+           stage="sweep")
 def _dedisperse_series_jit(data, stage1_bins, stage2_bins, nsub,
                            out_len: int, slack2: int, engine="gather"):
     engine = resolve_engine(engine)
@@ -539,7 +546,10 @@ def make_sharded_sweep_chunk(mesh: Mesh, nsub, out_len, slack2, widths,
         in_specs=(P(), P("dm"), P("dm")),
         out_specs=P("dm"),
     )
-    return jax.jit(fn)
+    # mesh-closing factory: plane-wrapped for telemetry, aot=False (AOT
+    # keying across meshes is unsound; XLA's persistent cache still hits)
+    return plane_jit(fn, stage="sweep", name="sweep_sharded_chunk",
+                     aot=False)
 
 
 def make_sharded_series_chunk(mesh: Mesh, nsub, out_len, slack2,
@@ -570,7 +580,8 @@ def make_sharded_series_chunk(mesh: Mesh, nsub, out_len, slack2,
         in_specs=(P(), P("dm"), P("dm")),
         out_specs=P("dm"),
     )
-    return jax.jit(fn)
+    return plane_jit(fn, stage="sweep", name="series_sharded_chunk",
+                     aot=False)
 
 
 def make_sharded_sweep_chunk_2d(
@@ -629,7 +640,8 @@ def make_sharded_sweep_chunk_2d(
         out_specs=(P("dm"), P("dm"), P("dm"), P("dm")),
         check_vma=False,  # outputs are replicated over 'time' by construction
     )
-    return jax.jit(fn)
+    return plane_jit(fn, stage="sweep", name="sweep_sharded_chunk_2d",
+                     aot=False)
 
 
 @dataclasses.dataclass
@@ -744,6 +756,17 @@ def merge_accum_parts(parts: Sequence["AccumParts"]) -> "AccumParts":
                       chunk_mb, chunk_ab)
 
 
+def _repad_rows(a: np.ndarray, pad: int) -> np.ndarray:
+    """Extend the trial axis by ``pad`` copies of the last real row —
+    exactly what padded trials (replicated last DM) would have
+    accumulated, so a checkpoint saved at one padded width resumes at
+    another bit-for-bit."""
+    a = np.asarray(a)
+    if pad <= 0:
+        return a
+    return np.concatenate([a, np.repeat(a[-1:], pad, axis=0)], axis=0)
+
+
 class _Accum:
     def __init__(self, D, W, keep_chunk_peaks: bool = False,
                  n_real: Optional[int] = None):
@@ -804,11 +827,15 @@ class SweepCheckpoint:
         numerics — the resolved engine and the mesh layout — so a
         checkpoint can only resume under the exact configuration that
         wrote it (the bit-identity contract; engines agree only to
-        ~1e-4)."""
+        ~1e-4). Only the *real* trials are hashed: padded trials
+        replicate the last real DM, so the padded group count (mesh
+        divisibility, compile-plane bucket ladder) is an execution
+        detail a resume may legally change (round 22)."""
         import hashlib
 
         h = hashlib.sha256()
-        for part in (plan.dms.tobytes(), plan.freqs.tobytes(),
+        nr = plan.n_real_trials
+        for part in (plan.dms[:nr].tobytes(), plan.freqs.tobytes(),
                      np.float64(plan.dt).tobytes(),
                      np.int64([plan.nsub, plan.group_size,
                                plan.n_real_trials, chunk_payload]).tobytes(),
@@ -837,10 +864,16 @@ class SweepCheckpoint:
                              keep_chunk_peaks=keep_chunk_peaks,
                              n_real=plan.n_real_trials)
                 acc.n = int(z["n"])
-                acc.s = z["s"]
-                acc.ss = z["ss"]
-                acc.mb = z["mb"]
-                acc.ab = z["ab"]
+                # checkpoints persist the real rows only; padded trials
+                # replicate the last real DM, so their accumulator state
+                # is bit-identical to the last real row — rebuild it by
+                # replication at whatever padded width THIS run uses
+                # (the bucket ladder may have moved between runs)
+                pad = plan.n_trials - plan.n_real_trials
+                acc.s = _repad_rows(z["s"], pad)
+                acc.ss = _repad_rows(z["ss"], pad)
+                acc.mb = _repad_rows(z["mb"], pad)
+                acc.ab = _repad_rows(z["ab"], pad)
                 if keep_chunk_peaks:
                     acc.chunk_mb = list(z["chunk_mb"])
                     acc.chunk_ab = list(z["chunk_ab"])
@@ -862,9 +895,11 @@ class SweepCheckpoint:
             extra["chunk_ab"] = (np.stack(acc.chunk_ab) if acc.chunk_ab
                                  else np.zeros((0, acc.n_real, W),
                                                np.int64))
+        nr = plan.n_real_trials  # real rows only: see load()
         np.savez(tmp,
                  fingerprint=self._fingerprint(plan, chunk_payload, context),
-                 n=acc.n, s=acc.s, ss=acc.ss, mb=acc.mb, ab=acc.ab,
+                 n=acc.n, s=acc.s[:nr], ss=acc.ss[:nr], mb=acc.mb[:nr],
+                 ab=acc.ab[:nr],
                  cursor=cursor,
                  baseline=np.asarray(baseline, dtype=np.float32),
                  **extra)
@@ -1213,13 +1248,34 @@ def finalize_sweep(plan: SweepPlan, n: int, s, ss, mb, ab,
     )
 
 
+def padded_group_count(n_groups: int, ndm: int = 1) -> int:
+    """Canonical padded trial-group count (round 22): the real group
+    count rounded so groups divide the mesh 'dm' axis (``ndm``) and,
+    when ``PYPULSAR_TPU_COMPILE_BUCKETS`` is on, up the compile plane's
+    bucket ladder. Padded groups replicate the last real trial — the
+    real rows are bit-exact regardless of padding — so bucketing trades
+    a few redundant trials for executable reuse across nearby DM
+    counts. The bucket choice never reaches a checkpoint/journal
+    fingerprint (those hash real trials only), so resumes cross
+    bucket-ladder changes byte-identically."""
+    G = int(n_groups)
+    ndm = max(1, int(ndm))
+    base = -(-G // ndm) * ndm  # mesh-divisibility floor (pre-round-22)
+    padded = bucket_rows(G, multiple=ndm)
+    if padded > base:
+        note_bucket_pad(base, padded)
+    return padded
+
+
 def _mesh_pad_groups(n_dms: int, group_size: int, mesh) -> Optional[int]:
-    """Group padding so trial groups divide the mesh 'dm' axis."""
-    if mesh is None:
-        return None
-    ndm = mesh.shape["dm"]
+    """Group padding so trial groups divide the mesh 'dm' axis and land
+    on the compile plane's bucket ladder (padded_group_count)."""
     G = -(-n_dms // group_size)
-    return -(-G // ndm) * ndm
+    ndm = 1 if mesh is None else mesh.shape["dm"]
+    padded = padded_group_count(G, ndm)
+    if mesh is None and padded == G:
+        return None  # nothing pads: keep the plan's natural shape
+    return padded
 
 
 def _series_baseline(data):
@@ -1364,7 +1420,8 @@ def _make_resident_runner(nsub, out_len, slack2, widths, payload, need,
     # buffer (verified), so donation would invalidate the caller's data on
     # backends that honor it; bench budgeting charges the padded working
     # copy instead
-    @partial(jax.jit, static_argnames=("n_chunks",))
+    @plane_jit(static_argnames=("n_chunks",), stage="sweep",
+               aot=(mesh is None))
     def run(data, s1, s2, baseline, n_chunks):
         data = data - baseline
         # zero tail pad so the final chunk's overlap reads data-shaped zeros
@@ -1379,3 +1436,57 @@ def _make_resident_runner(nsub, out_len, slack2, widths, payload, need,
         return ys
 
     return run
+
+
+# ---------------------------------------------------------------------------
+# warm-pool precompile (round 22)
+
+def _warm_sweep(*, dms, freqs, dt, nsub=64, group_size=0,
+                widths=DEFAULT_WIDTHS, n_samples=None, downsamp=1,
+                chunk_payload=None, engine="auto", **_ignored) -> int:
+    """Warm-pool planner for the sweep stage: rebuild the geometry the
+    streamed sweep will dispatch (plan, bounded chunk payload, padded
+    group tables) and AOT-lower the chunk kernel from abstract arrays —
+    no data read, nothing dispatched. Extra geometry keys are ignored
+    so one scheduler-side dict can feed every stage's warmer."""
+    dms = np.asarray(dms, dtype=np.float64)
+    # the plan wants high-frequency-first channels (the block sources
+    # flip ascending tables; shapes are order-independent anyway)
+    freqs = np.sort(np.asarray(freqs, dtype=np.float64))[::-1].copy()
+    if dms.size == 0 or freqs.size == 0 or not dt or dt <= 0:
+        return 0
+    factor = max(1, int(downsamp))
+    dt = float(dt) * factor  # ``dt`` is the RAW header sample time
+    if group_size <= 0:
+        group_size = choose_group_size(dms, freqs, float(dt), nsub)
+    plan = make_sweep_plan(
+        dms, freqs, float(dt), nsub=nsub, group_size=group_size,
+        widths=tuple(widths),
+        pad_groups_to=_mesh_pad_groups(len(dms), group_size, None))
+    if chunk_payload is None:
+        # the staged CLI's bounded default (tuned=False: detection
+        # chunks are results, the tuner's overlay must not move them)
+        chunk_payload = default_chunk_payload(plan.min_overlap,
+                                              tuned=False)
+    if n_samples:
+        n_ds = int(n_samples) // factor
+        chunk_payload = min(int(chunk_payload), n_ds)
+        if chunk_payload <= plan.min_overlap:
+            chunk_payload = min(n_ds, 2 * plan.min_overlap + 1)
+        if chunk_payload <= 0:
+            return 0
+    W = max(plan.widths)
+    out_len = int(chunk_payload) + W
+    need = out_len + plan.max_shift2 + plan.max_shift1
+    data = jax.ShapeDtypeStruct((len(freqs), need), np.float32)
+    s1 = jax.ShapeDtypeStruct(plan.stage1_bins.shape,
+                              plan.stage1_bins.dtype)
+    s2 = jax.ShapeDtypeStruct(plan.stage2_bins.shape,
+                              plan.stage2_bins.dtype)
+    return int(_sweep_chunk_jit.warm(
+        data, s1, s2, plan.nsub, out_len, plan.max_shift2,
+        tuple(plan.widths), int(chunk_payload),
+        engine=resolve_engine(engine)))
+
+
+register_warmer("sweep", _warm_sweep)

@@ -100,10 +100,11 @@ def _device_scrub(block):
     elementwise pass + two scalar reductions, compiled once per shape."""
     global _scrub_jit
     if _scrub_jit is None:
-        import jax
         import jax.numpy as jnp
 
-        @jax.jit
+        from pypulsar_tpu.compile import plane_jit
+
+        @plane_jit(stage="data")
         def f(b):
             finite = jnp.isfinite(b)
             clean = jnp.where(finite, b, jnp.zeros((), b.dtype))

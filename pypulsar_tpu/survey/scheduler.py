@@ -324,6 +324,7 @@ class FleetScheduler:
         self._host_health = (health_mod.HostHealth()
                              if plane is not None else None)
         self._claim_thread: Optional[threading.Thread] = None
+        self._warm_thread: Optional[threading.Thread] = None
         self._plane_owned_here = False  # register()ed by this run()
 
     # -- manifests ----------------------------------------------------------
@@ -1504,6 +1505,95 @@ class FleetScheduler:
                 self._cv.notify_all()
             raise StopIteration
 
+    # -- warm-pool precompile (round 22) ------------------------------------
+
+    def _obs_geometry(self, i: int) -> Optional[dict]:
+        """One observation's stage geometry for the compile plane's
+        warmers: the raw header (channel table, sample time, length)
+        plus the fleet config's grid — everything a warmer needs to
+        rebuild the shapes its stage will dispatch. None when the
+        header cannot be read (the stage machinery owns that error)."""
+        from pypulsar_tpu.cli.sweep import _open_reader
+
+        import numpy as np
+
+        cfg = self.cfg
+        try:
+            r = _open_reader(self.obs[i].infile)
+            try:
+                freqs = np.asarray(r.frequencies, dtype=np.float64)
+                tsamp = float(r.tsamp)
+                nsamp = int(getattr(r, "number_of_samples", 0)
+                            or getattr(r, "nsamples", 0) or 0)
+            finally:
+                close = getattr(r, "close", None)
+                if close is not None:
+                    close()
+        except Exception:  # noqa: BLE001 - warm pool never fails a fleet
+            return None
+        return dict(
+            dms=cfg.lodm + cfg.dmstep * np.arange(max(1, cfg.numdms)),
+            freqs=freqs, dt=tsamp, n_samples=nsamp,
+            downsamp=max(1, cfg.downsamp), nsub=cfg.nsub,
+            group_size=cfg.group_size, chunk_payload=cfg.chunk,
+            fold_nbins=cfg.fold_nbins, fold_npart=cfg.fold_npart,
+            fold_batch=cfg.fold_batch)
+
+    def _warmpool_loop(self) -> None:
+        """Host-pool precompile daemon: while the devices chew on the
+        current observations, AOT-compile the next ready observation's
+        (stage, geometry) set through the compile plane's registered
+        warmers, so its first dispatch finds a ready executable instead
+        of a trace+compile stall on the critical path. Purely an
+        optimization: every failure is swallowed (counted by the plane
+        as ``compile.warm_error``) and the loop exits once every
+        observation is warmed or already running."""
+        import pypulsar_tpu.fold.engine  # noqa: F401 - registers warmers
+        import pypulsar_tpu.parallel.sweep  # noqa: F401
+        from pypulsar_tpu.compile import warm_stage, warmable_stages
+
+        warmed: set = set()
+        while not self._stop:
+            target = None
+            with self._lock:
+                for i in range(len(self.obs)):
+                    if i in warmed:
+                        continue
+                    states = [self._tasks[(i, s.name)].state
+                              for s in self.stages]
+                    if all(st in (_DONE, _QUARANTINED, _REMOTE)
+                           for st in states):
+                        warmed.add(i)  # nothing left to warm for
+                        continue
+                    if any(st == _RUNNING for st in states):
+                        warmed.add(i)  # too late: already on a device
+                        continue
+                    target = i
+                    break
+            if target is None:
+                return  # every observation warmed or started
+            warmed.add(target)
+            geo = self._obs_geometry(target)
+            if geo is None:
+                continue
+            obs = self.obs[target]
+            t_rel = time.perf_counter() - self._t0
+            t0 = time.perf_counter()
+            n = 0
+            with telemetry.span("survey.precompile", obs=obs.name):
+                for stage in warmable_stages():
+                    if self._stop:
+                        break
+                    n += warm_stage(stage, **geo)
+            dur = time.perf_counter() - t0
+            telemetry.counter("survey.precompiled", n)
+            trace = self._traces[target]
+            if trace is not None:
+                trace.span("survey.precompile", t_rel, dur, compiled=n)
+            if self.verbose and n:
+                print(f"# survey: {obs.name}: warm pool precompiled "
+                      f"{n} executable(s) in {dur:.2f}s")
+
     # -- entry point --------------------------------------------------------
 
     def run(self) -> FleetResult:
@@ -1551,6 +1641,14 @@ class FleetScheduler:
                         self._promote_locked(i)
                     if self._finished_locked():
                         self._stop = True
+            if knobs_mod.env_str("PYPULSAR_TPU_COMPILE_WARMPOOL") \
+                    not in ("0", "off", "none"):
+                # warm-pool precompile rides the host pool's spare
+                # cycles; a daemon so a hung compile cannot block exit
+                self._warm_thread = threading.Thread(
+                    target=self._warmpool_loop, name="survey-warmpool",
+                    daemon=True)
+                self._warm_thread.start()
             workers = (
                 [threading.Thread(target=self._worker,
                                   args=(self._device_q, True),
@@ -1585,6 +1683,9 @@ class FleetScheduler:
             if self._claim_thread is not None:
                 self._claim_thread.join(timeout=5.0)
                 self._claim_thread = None
+            if self._warm_thread is not None:
+                self._warm_thread.join(timeout=5.0)
+                self._warm_thread = None
             self._write_health_json()
             self.result.wall = time.perf_counter() - self._t0
             for m in self._manifests:

@@ -464,11 +464,37 @@ env_knob("PYPULSAR_TPU_OBS_STATUS_PORT", "int", 0, "obs",
 env_knob("PYPULSAR_TPU_OBS_FOLLOW_S", "float", 2.0, "obs",
          invariant=False,
          help="refresh cadence of `survey --status --follow` (seconds)")
+env_knob("PYPULSAR_TPU_OBS_STATUSD_TTL_S", "float", 0.25, "obs",
+         invariant=False,
+         help="live status/metrics endpoint snapshot cache TTL "
+              "(seconds): scrapes within the window reuse one "
+              "snapshot so aggressive pollers cannot stampede the "
+              "scheduler's lock")
 env_knob("PYPULSAR_TPU_OBS_SLO_FRAC", "float", 0.8, "obs",
          invariant=False,
          help="fraction of a stage's deadline budget consumed (without "
               "tripping the watchdog) that emits a survey.slo_burn "
               "event")
+
+# -- compilation plane (round 22) -------------------------------------------
+env_knob("PYPULSAR_TPU_COMPILE_CACHE", "str",
+         "~/.cache/pypulsar_tpu/xla", "compile",
+         invariant=False,
+         help="fleet-shared persistent XLA compilation cache directory "
+              "(jax_compilation_cache_dir); 0/off disables persistence")
+env_knob("PYPULSAR_TPU_COMPILE_AOT", "str", "1", "compile",
+         invariant=False,
+         help="0 disables the plane's in-process AOT executable "
+              "registry (plane_jit degrades to plain jax.jit dispatch)")
+env_knob("PYPULSAR_TPU_COMPILE_BUCKETS", "str", "1", "compile",
+         invariant=False,
+         help="0 disables geometry bucketing of batch axes (DM trial "
+              "groups, accel spectrum batches, fold candidate batches); "
+              "bucket choice never changes artifact bytes")
+env_knob("PYPULSAR_TPU_COMPILE_WARMPOOL", "str", "1", "compile",
+         invariant=False,
+         help="0 disables the fleet scheduler's warm-pool AOT "
+              "precompile of upcoming observations' stage executables")
 
 # -- misc data --------------------------------------------------------------
 env_knob("PYPULSAR_TPU_HASLAM", "str", "", "data",

@@ -12,7 +12,6 @@ loop's per-block host lstsq calls collapse into a single device dispatch
 (cli/autozap.py).
 """
 
-from functools import partial
 
 import numpy as np
 import scipy.linalg
@@ -94,10 +93,11 @@ _DETREND_BLOCKS_JIT = None  # built on first use: keeps `import
 def _detrend_blocks_jit(y, x, keep, order):
     global _DETREND_BLOCKS_JIT
     if _DETREND_BLOCKS_JIT is None:
-        import jax
         import jax.numpy as jnp
 
-        @partial(jax.jit, static_argnames=("order",))
+        from pypulsar_tpu.compile import plane_jit
+
+        @plane_jit(static_argnames=("order",))
         def run(y, x, keep, order):
             # zero-weighting alone is NOT exclusion: 0 * (-inf or NaN)
             # is NaN and would poison the whole block's fit (log10 of a

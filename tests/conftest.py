@@ -59,3 +59,16 @@ def _hermetic_tuning(tmp_path_factory, monkeypatch):
     knobs.clear_tuned()
     yield
     knobs.clear_tuned()
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _hermetic_compile_cache(tmp_path_factory):
+    """Round 22: plane_jit wires the persistent XLA compilation cache
+    once per process (latched on first dispatch). Point it at a
+    throwaway directory before any test dispatches, so the suite never
+    reads — or pollutes — the developer's ~/.cache markers (a stale
+    marker would flip compile.persistent_hit in exact-counter tests).
+    Subprocess children inherit it, keeping them hermetic too."""
+    os.environ["PYPULSAR_TPU_COMPILE_CACHE"] = \
+        str(tmp_path_factory.mktemp("xla"))
+    yield

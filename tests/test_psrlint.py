@@ -705,6 +705,57 @@ def test_pl017_near_misses(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# PL018 raw jax.jit outside the compilation plane
+
+def test_pl018_true_positives(tmp_path):
+    # decorator (bare and parameterized), direct call, and a partial
+    # indirection are all raw-jit escapes from the compilation plane
+    rep = lint(tmp_path, {"pypulsar_tpu/mod.py":
+                          "import functools\n"
+                          "import jax\n"
+                          "@jax.jit\n"
+                          "def f(x):\n"
+                          "    return x\n"
+                          "@jax.jit(static_argnames=('n',))\n"
+                          "def g(x, n):\n"
+                          "    return x\n"
+                          "h = jax.jit(lambda x: x)\n"
+                          "mk = functools.partial(jax.jit, donate_argnums=0)\n"},
+               select="PL018")
+    assert codes(rep) == ["PL018"] * 4
+    assert {f.line for f in rep.findings} == {3, 6, 9, 10}
+
+
+def test_pl018_near_misses(tmp_path):
+    # the plane itself, the registered ops/ leaf kernels, tests, other
+    # modules' .jit attributes, and prose mentions all stay silent
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/compile/plane.py":
+            "import jax\n"
+            "def plane_jit(fn):\n"
+            "    return jax.jit(fn)\n",
+        "pypulsar_tpu/ops/kernels.py":
+            "import jax\n"
+            "@jax.jit\n"
+            "def leaf(x):\n"
+            "    return x\n",
+        "pypulsar_tpu/mod.py":
+            "from pypulsar_tpu.compile import plane_jit\n"
+            "@plane_jit(stage='sweep')\n"
+            "def f(x):\n"
+            "    return x\n"
+            "HELP = 'wraps jax.jit with an AOT registry'\n"
+            "def g(nn, self_like):\n"
+            "    return nn.jit, self_like.jit\n",
+        "tests/test_mod.py":
+            "import jax\n"
+            "def test_f():\n"
+            "    assert jax.jit(lambda x: x)(1) == 1\n",
+    }, select="PL018")
+    assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions / select / ignore / baseline / output
 
 def test_suppression_silences_and_unused_is_flagged(tmp_path):
@@ -823,7 +874,7 @@ def test_report_json_schema(tmp_path):
 def test_rule_catalog_complete():
     got = {r.code for r in all_rules()}
     assert got == ({f"PL00{i}" for i in range(1, 10)}
-                   | {f"PL01{i}" for i in range(1, 8)})
+                   | {f"PL01{i}" for i in range(1, 9)})
     assert all(r.summary and r.name for r in all_rules())
 
 
