@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier test-faults test-fold test-obs test-survey test-corruption test-tune test-multihost test-race lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-obs bench-survey bench-multichip bench-multihost-fleet bench-specfuse bench-telemetry bench-tree bench-tune bench-compile native clean
+.PHONY: test test-fourier test-faults test-fold test-obs test-survey test-corruption test-tune test-multihost test-race test-daemon lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-obs bench-survey bench-multichip bench-multihost-fleet bench-specfuse bench-telemetry bench-tree bench-tune bench-compile native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -49,7 +49,7 @@ test-fourier:
 # survey orchestrator's kill/resume/quarantine and fleet-health
 # (watchdog, device-strike, admission) cases, and the seeded chaos
 # fleet
-test-faults: test-chaos test-corruption test-multihost test-race test-obs
+test-faults: test-chaos test-corruption test-multihost test-race test-obs test-daemon
 	$(CPU_ENV) $(PY) -m pytest tests/test_resilience.py -q
 	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -k "kill or resume or quarantine or retry or stall or deadline or evict or admission or chaos"
 
@@ -92,6 +92,18 @@ test-multihost:
 test-chaos:
 	$(CPU_ENV) $(PY) bench.py --chaos --quick
 	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -m slow -k chaos
+
+# the streaming-daemon suite (round 23): multi-tenant admission +
+# token-bucket quotas, priority/quota-ordered overload shedding with a
+# trace-reconstructible shed trail, guard hysteresis, the daemon fault
+# points, journal replay after kill -9 — then the full soak harness
+# (overload storm + chaos spray + SIGKILL'd subprocess + SIGTERM
+# drain, byte-parity vs a batch reference asserted; the committed
+# record is SOAK_r01.json, the pytest-scale twin is marked `slow`)
+test-daemon:
+	$(CPU_ENV) $(PY) -m pytest tests/test_daemon.py -q
+	$(CPU_ENV) $(PY) bench.py --daemon-soak --quick
+	$(CPU_ENV) $(PY) -m pytest tests/test_daemon.py -q -m slow -k soak
 
 # the data-integrity suite: the checked-in corrupted-fixture corpus
 # against every reader, salvage/scrub/finite-gate contracts, the

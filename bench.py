@@ -134,6 +134,16 @@ def parse_args(argv=None):
                          "completes, and ASSERT byte-parity against a "
                          "clean run — the fleet-health acceptance "
                          "measurement (CHAOS_rXX.json)")
+    ap.add_argument("--daemon-soak", action="store_true",
+                    help="run the round-23 streaming-daemon soak: a "
+                         "multi-tenant overload storm (bulk flood past "
+                         "the accept queue bound, chaos sprayed over "
+                         "the admission edge, a corrupt ingest file, a "
+                         "SIGKILL'd+restarted --daemon subprocess, a "
+                         "SIGTERM drain), with balanced books, bulk-"
+                         "only shedding, a trace-reconstructible shed "
+                         "trail and byte-parity vs a batch reference "
+                         "all ASSERTED (SOAK_rXX.json)")
     ap.add_argument("--multihost", action="store_true",
                     help="run the round-18 multi-host fleet harness: a "
                          "4-obs, 3-process CPU fleet coordinated through "
@@ -2322,6 +2332,436 @@ def run_chaos(args):
     }
 
 
+def run_daemon_soak(args):
+    """Streaming-daemon soak (the round-23 acceptance measurement):
+    the multi-tenant admission plane under sustained overload, measured
+    three ways against ONE batch reference —
+
+    - **reference**: the same 4-observation corpus through a plain
+      batch fleet (the artifacts every later leg must reproduce
+      byte-for-byte);
+    - **overload**: an in-process daemon fed a gold tenant (priority 5,
+      unmetered) plus a bulk tenant (burst-limited) flooding past a
+      2-deep accept queue, with seeded chaos sprayed over the admission
+      storm and one armed fault at each daemon ingest point
+      (``daemon.arrival`` / ``daemon.admit`` / ``daemon.shed``), and a
+      corrupt bulk file exercising the ingest-quarantine edge. Books
+      must balance in-process, shedding must hit ONLY unaccepted bulk
+      work, and the whole shed trail must reconstruct from the trace
+      events alone;
+    - **kill -9**: a real ``survey --daemon --watch`` subprocess
+      SIGKILL'd mid-pipeline after accepting two observations, then
+      restarted — the admission journal must resume the accepted work
+      with ZERO re-runs of manifest-validated stages — and finally
+      SIGTERM'd for a clean (rc 0) drain.
+
+    A final no-chaos in-process resume over every accepted observation
+    must run ZERO stages, and every completed artifact must be
+    byte-identical to the batch reference's."""
+    acquire_backend()
+    import glob as _glob
+    import signal
+    import tempfile
+    import threading
+
+    from pypulsar_tpu.obs import telemetry
+    from pypulsar_tpu.resilience import faultinject
+    from pypulsar_tpu.survey.daemon import (SurveyDaemon, TenantSpec,
+                                            journal_path,
+                                            read_tenant_status)
+    from pypulsar_tpu.survey.dag import SurveyConfig, build_dag
+    from pypulsar_tpu.survey.scheduler import FleetScheduler
+    from pypulsar_tpu.survey.state import MANIFEST_SUFFIX, Observation
+
+    seed = args.chaos_seed
+    rate = args.chaos_rate if args.chaos_rate is not None else 0.05
+    n_gold, n_bulk, queue_bound = 2, 6, 2
+    C, T, dtp = 32, (1 << 13 if (args.quick or args.cpu_fallback)
+                     else 1 << 14), 5e-4
+    rng_freqs = 1500.0 - 4.0 * np.arange(C)
+    cfg = SurveyConfig(
+        mask=True, mask_time=2.0, lodm=0.0, dmstep=10.0, numdms=8,
+        nsub=8, group_size=4, threshold=8.0,
+        accel_zmax=20.0, accel_numharm=2, accel_sigma=3.0, accel_batch=4,
+        sift_sigma=3.0, sift_min_hits=1, fold_nbins=32, fold_npart=8)
+    stages = build_dag(cfg)
+
+    def wait_for(cond, what, timeout=120.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"daemon soak timed out waiting for {what}")
+
+    def accept_records(outdir):
+        """(name, tenant, infile, outbase) per journaled accept, plus
+        the terminal-state map — the restart/resume assertions' input."""
+        accepts, terminal = {}, {}
+        with open(journal_path(outdir)) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail
+                if rec.get("type") == "accept":
+                    accepts[rec["obs"]] = rec
+                elif rec.get("type") == "terminal":
+                    terminal[rec["obs"]] = rec["state"]
+        return accepts, terminal
+
+    def done_units(outdir):
+        """{manifest basename: [unit, ...]} across the outdir — one
+        list entry PER RECORD, so a re-run shows up as a duplicate."""
+        units = {}
+        for mp in sorted(_glob.glob(os.path.join(
+                outdir, "*" + MANIFEST_SUFFIX))):
+            rows = []
+            with open(mp) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("type") == "done":
+                        rows.append(rec.get("unit"))
+            units[os.path.basename(mp)] = rows
+        return units
+
+    def byte_parity(ref_dir, out_dir, stems):
+        ident = tot = 0
+        diverged = []
+        for pattern in ("*_ACCEL_*.cand", "*_ACCEL_*.txtcand",
+                        "*_cand*.pfd", "*.dat"):
+            for fa in sorted(_glob.glob(os.path.join(ref_dir, pattern))):
+                base = os.path.basename(fa)
+                if not any(base.startswith(s) for s in stems):
+                    continue
+                fb = os.path.join(out_dir, base)
+                tot += 1
+                if (os.path.exists(fb) and open(fa, "rb").read()
+                        == open(fb, "rb").read()):
+                    ident += 1
+                else:
+                    diverged.append(base)
+        return ident, tot, diverged
+
+    t_start = time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        # corpus: 2 gold (in-process leg) + 2 kobs (kill -9 leg); the
+        # kobs pair lives in the subprocess's watch dir from the start
+        watch2 = os.path.join(td, "watch2")
+        os.makedirs(watch2)
+        golds = [_synth_survey_fil(os.path.join(td, f"gold{i}.fil"),
+                                   61 + i, C, T, dtp, rng_freqs,
+                                   f"SOAKG{i}",
+                                   period=0.1024 * (1.0 + 0.07 * i))
+                 for i in range(n_gold)]
+        kobs = [_synth_survey_fil(os.path.join(watch2, f"kobs{i}.fil"),
+                                  71 + i, C, T, dtp, rng_freqs,
+                                  f"SOAKK{i}",
+                                  period=0.1024 * (1.0 + 0.09 * i))
+                for i in range(2)]
+
+        # ---- leg A: the batch reference (also warms the jit caches) --
+        faultinject.reset()
+        ref = os.path.join(td, "ref")
+        os.makedirs(ref)
+        obs_ref = ([Observation(f"gold{i}", golds[i],
+                                os.path.join(ref, f"gold{i}"))
+                    for i in range(n_gold)]
+                   + [Observation(f"kobs{i}", kobs[i],
+                                  os.path.join(ref, f"kobs{i}"))
+                      for i in range(2)])
+        batch = FleetScheduler(obs_ref, cfg, max_host_workers=2,
+                               devices=1).run()
+        assert batch.ok and len(batch.ran) == len(obs_ref) * len(stages)
+
+        # ---- leg B: in-process overload soak under chaos spray -------
+        out1 = os.path.join(td, "daemon")
+        bulkdir = os.path.join(td, "bulk_incoming")
+        os.makedirs(bulkdir)
+        trace = os.path.join(td, "soak_trace.jsonl")
+        faultinject.reset()
+        # probabilistic spray over the admission storm (non-fatal kinds
+        # — the kill family gets a REAL SIGKILL in leg C) plus one
+        # armed fault per daemon ingest point so each provably fires
+        faultinject.configure_chaos(f"{seed}:{rate}:oom+io")
+        faultinject.configure("io:daemon.arrival:1,"
+                              "io:daemon.admit:1,"
+                              "io:daemon.shed:1")
+        daemon = SurveyDaemon(
+            out1, cfg, stages=stages,
+            tenants=[TenantSpec("gold", priority=5, rate=0.0),
+                     TenantSpec("bulk", priority=0, rate=1e-6,
+                                burst=2.0)],
+            watch=[(bulkdir, "bulk")],
+            queue_bound=queue_bound, quiesce_s=0.2, poll_s=0.05,
+            idle_exit_s=0.0, min_free_mb=0,
+            max_host_workers=2, devices=1, retries=3)
+        with telemetry.session(trace) as tlm:
+            thread = threading.Thread(target=daemon.run,
+                                      name="soak-daemon", daemon=True)
+            thread.start()
+            # 1. one corrupt bulk file FIRST: it absorbs the armed
+            #    arrival + admit faults (watch rescan / re-pend retry),
+            #    then ingest validation quarantines it — bulk's burst-2
+            #    bucket is now empty, so the later flood can only shed
+            corrupt = os.path.join(td, "corrupt.fil")
+            with open(corrupt, "wb") as f:
+                f.write(b"this is not a filterbank" * 64)
+            os.replace(corrupt, os.path.join(bulkdir, "corrupt.fil"))
+            wait_for(lambda: daemon.stats()["quarantined"] >= 1,
+                     "corrupt bulk file to ingest-quarantine")
+            # 2. gold submissions through the socket-lane API, retrying
+            #    the sprayed transient ingest faults like a client would
+            for fn in golds:
+                for _ in range(200):
+                    v, why = daemon.submit("gold", fn)
+                    if v in ("accepted", "pending") or (
+                            v == "error" and "already submitted" in why):
+                        break
+                    assert v == "error" and "transient" in why, (v, why)
+                    time.sleep(0.05)
+                else:
+                    raise AssertionError(f"gold {fn} never admitted")
+            wait_for(lambda: daemon.tenant_snapshot()["tenants"]
+                     ["gold"]["accepted"] >= n_gold, "gold acceptance")
+            # 3. the bulk flood: over-capacity arrivals with an empty
+            #    token bucket — past the 2-deep bound they shed
+            for i in range(n_bulk):
+                fn = os.path.join(td, f"bulk{i}.fil")
+                with open(fn, "wb") as f:
+                    f.write(b"\x00" * 4096)  # never admitted: content
+                    # is irrelevant, the bucket is already empty
+                os.replace(fn, os.path.join(bulkdir, f"bulk{i}.fil"))
+            wait_for(lambda: daemon.stats()["submitted"]
+                     >= 1 + n_gold + n_bulk, "the bulk flood to arrive")
+            # 4. storm over: chaos off, SIGTERM semantics — accepted
+            #    work finishes, the pending remainder sheds loudly
+            faultinject.configure_chaos(None)
+            daemon.request_drain()
+            thread.join(timeout=600)
+            assert not thread.is_alive(), "daemon failed to drain"
+            counters = {k: int(v) for k, v in
+                        tlm.counter_totals().items()
+                        if k.startswith("daemon.")}
+        fired = faultinject.fired_counts()
+        faultinject.reset()
+        # the fleet verdict: exactly ONE quarantined observation — the
+        # corrupt bulk file, stopped by ingest validation (result.ok is
+        # False by design here: a quarantine IS a loud verdict)
+        assert daemon.result is not None, "fleet never reported"
+        q_names = sorted(daemon.result.quarantined)
+        assert q_names == ["corrupt"], (
+            f"unexpected quarantine set: {daemon.result.quarantined}")
+
+        # books balance, by tenant and in aggregate
+        agg = daemon.stats()
+        snap = daemon.tenant_snapshot()["tenants"]
+        assert agg["pending"] == 0 and agg["accepted_open"] == 0
+        assert agg["submitted"] == agg["accepted"] + agg["shed"], agg
+        assert agg["accepted"] == (agg["completed"]
+                                   + agg["quarantined"]), agg
+        assert agg["submitted"] == 1 + n_gold + n_bulk, agg
+        gold_b, bulk_b = snap["gold"], snap["bulk"]
+        assert (gold_b["completed"] == n_gold and gold_b["shed"] == 0
+                and gold_b["quarantined"] == 0), (
+            f"healthy tenant charged for bulk's overload: {gold_b}")
+        assert (bulk_b["quarantined"] == 1 and bulk_b["shed"] == n_bulk
+                and bulk_b["completed"] == 0), bulk_b
+        # every armed daemon ingest point provably fired and was
+        # absorbed (the arrival was re-seen, the admit re-pended, the
+        # shed still happened)
+        for point in ("arrival", "admit", "shed"):
+            assert counters.get(f"daemon.{point}_faults", 0) >= 1, (
+                f"daemon.{point} fault never fired: {counters}")
+        assert fired.get("io", 0) >= 3, fired
+
+        # the shed trail reconstructs from the trace alone: every
+        # victim, its tenant, the reason and the queue depth at the
+        # decision — and no shed ever names accepted (gold) work
+        shed_evs = []
+        with open(trace) as f:
+            for line in f:
+                rec = json.loads(line)
+                if (rec.get("type") == "event"
+                        and rec.get("name") == "daemon.shed"):
+                    shed_evs.append(rec["attrs"])
+        assert len(shed_evs) == n_bulk, shed_evs
+        assert all(e["tenant"] == "bulk" and e["queue_depth"] >= 1
+                   and e["reason"] for e in shed_evs), shed_evs
+        n_shed_bound = sum(1 for e in shed_evs
+                           if "queue full" in e["reason"])
+        n_shed_drain = sum(1 for e in shed_evs
+                           if "draining" in e["reason"])
+        assert n_shed_bound >= 1 and n_shed_drain >= 1, shed_evs
+        assert n_shed_bound + n_shed_drain == n_bulk, shed_evs
+
+        # ---- leg C: kill -9 a REAL --daemon subprocess, restart ------
+        out2 = os.path.join(td, "killdaemon")
+        argv = [sys.executable, "-m", "pypulsar_tpu.cli", "survey",
+                "--daemon", "-o", out2, "--watch", watch2 + ":gold",
+                "--tenant", "gold:5:0:8", "--queue-bound", "8",
+                "--quiesce", "0.2", "--daemon-poll", "0.05",
+                "--min-free-mb", "0", "--max-host-workers", "2",
+                "--retries", "2",
+                "--mask-time", "2.0", "--lodm", "0.0",
+                "--dmstep", "10.0", "--numdms", "8", "--nsub", "8",
+                "--group-size", "4", "--threshold", "8.0",
+                "--accel-zmax", "20.0", "--accel-numharm", "2",
+                "--accel-sigma", "3.0", "--accel-batch", "4",
+                "--sift-sigma", "3.0", "--sift-min-hits", "1",
+                "--fold-nbins", "32", "--fold-npart", "8"]
+        env = dict(os.environ)
+        for var in ("PYPULSAR_TPU_FAULTS", "PYPULSAR_TPU_CHAOS"):
+            env.pop(var, None)
+
+        def spawn(log_name):
+            log = open(os.path.join(td, log_name), "w")
+            return subprocess.Popen(argv, env=env, stdout=log,
+                                    stderr=subprocess.STDOUT), log
+
+        def poll_subproc(proc, cond, what, timeout=600.0):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"daemon subprocess exited rc={proc.returncode} "
+                        f"while waiting for {what}")
+                if cond():
+                    return
+                time.sleep(0.1)
+            raise AssertionError(f"subprocess soak timed out on {what}")
+
+        def tstat(key, tenant="gold"):
+            st = read_tenant_status(out2)
+            if not st:
+                return 0
+            return st.get("tenants", {}).get(tenant, {}).get(key, 0)
+
+        proc1, log1 = spawn("kill_leg_1.log")
+        try:
+            # accepted + at least one manifest-validated stage, but the
+            # pipeline still in flight: the interesting kill window
+            poll_subproc(
+                proc1,
+                lambda: (tstat("accepted") >= 2
+                         and sum(len(v) for v in
+                                 done_units(out2).values()) >= 1),
+                "2 accepts + 1 validated stage before the SIGKILL")
+        finally:
+            proc1.kill()  # SIGKILL: no drain, no journal close
+            proc1.wait(timeout=60)
+            log1.close()
+        pre_kill = done_units(out2)
+        n_pre = sum(len(v) for v in pre_kill.values())
+
+        proc2, log2 = spawn("kill_leg_2.log")
+        try:
+            poll_subproc(
+                proc2,
+                lambda: (tstat("completed") >= 2
+                         and (read_tenant_status(out2) or {})
+                         .get("accepted_open", 1) == 0),
+                "the restarted daemon to finish the adopted work")
+            proc2.send_signal(signal.SIGTERM)  # the clean-drain contract
+            rc2 = proc2.wait(timeout=120)
+        finally:
+            if proc2.poll() is None:
+                proc2.kill()
+                proc2.wait(timeout=60)
+            log2.close()
+        assert rc2 == 0, f"SIGTERM drain exited rc={rc2}"
+        # zero re-runs of validated stages: every unit recorded done
+        # before the SIGKILL appears EXACTLY once in the final manifest
+        # (a re-run would append a duplicate done record)
+        post = done_units(out2)
+        assert n_pre >= 1
+        for man, units in pre_kill.items():
+            for unit in units:
+                assert post.get(man, []).count(unit) == 1, (
+                    f"{man}:{unit} re-ran after the restart")
+
+        # ---- the cross-leg gates -------------------------------------
+        # a final no-chaos resume over EVERY accepted observation (both
+        # legs) validates the manifests and runs ZERO stages
+        reran = 0
+        for outdir in (out1, out2):
+            accepts, terminal = accept_records(outdir)
+            fleet = [Observation(r["obs"], r["infile"], r["outbase"])
+                     for r in accepts.values()
+                     if terminal.get(r["obs"]) == "done"]
+            assert fleet, f"no completed accepts journaled in {outdir}"
+            final = FleetScheduler(fleet, cfg, max_host_workers=2,
+                                   devices=1, resume=True).run()
+            assert final.ok and len(final.ran) == 0, (
+                f"{outdir}: {len(final.ran)} stages re-ran on the "
+                f"final resume")
+            reran += len(final.ran)
+
+        # completed artifacts byte-identical to the batch reference
+        ident = tot = 0
+        diverged = []
+        for out_dir, stems in ((out1, ("gold",)), (out2, ("kobs",))):
+            i, t, d = byte_parity(ref, out_dir, stems)
+            ident, tot, diverged = ident + i, tot + t, diverged + d
+        assert ident == tot and tot > 0, (
+            f"soak artifacts diverged from the batch reference: "
+            f"{ident}/{tot} ({diverged[:8]})")
+    soak_s = time.perf_counter() - t_start
+
+    n_faults = sum(fired.values())
+    print(f"# daemon-soak: seed {seed} rate {rate}: books balanced over "
+          f"{agg['submitted']} arrivals ({agg['accepted']} accepted, "
+          f"{agg['shed']} shed [{n_shed_bound} bound / {n_shed_drain} "
+          f"drain], {agg['quarantined']} quarantined), {n_faults} "
+          f"injected faults absorbed at the ingest points, kill -9 "
+          f"resumed {n_pre} pre-kill unit(s) with zero re-runs, SIGTERM "
+          f"drained rc 0, {ident}/{tot} artifacts byte-identical to "
+          f"batch ({soak_s:.1f}s)", file=sys.stderr)
+    return {
+        "metric": "daemon_soak_overload_degradation",
+        "value": round(ident / max(tot, 1), 3),
+        "unit": (f"fraction of streaming-daemon artifacts "
+                 f"byte-identical to the batch reference after a "
+                 f"multi-tenant overload soak (bulk flood past a "
+                 f"{queue_bound}-deep accept queue, seeded chaos "
+                 f"{seed}:{rate} over the admission storm + one armed "
+                 f"fault per daemon ingest point, one ingest-"
+                 f"quarantined corrupt file, a SIGKILL'd+restarted "
+                 f"--daemon subprocess and a SIGTERM drain) — asserted "
+                 f"1.0 with balanced books, bulk-only shedding, a "
+                 f"trace-reconstructible shed trail and a final resume "
+                 f"running zero stages"),
+        "vs_baseline": 1.0,
+        "soak_chaos_seed": seed,
+        "soak_chaos_rate": rate,
+        "soak_books": agg,
+        "soak_tenant_books": {n: {k: b[k] for k in
+                                  ("submitted", "accepted", "shed",
+                                   "quarantined", "completed")}
+                              for n, b in snap.items()},
+        "soak_shed_events": len(shed_evs),
+        "soak_shed_at_bound": n_shed_bound,
+        "soak_shed_at_drain": n_shed_drain,
+        "soak_faults_fired": fired,
+        "soak_ingest_fault_counters": {
+            k: v for k, v in counters.items() if k.endswith("_faults")},
+        "soak_kill9_prekill_units": n_pre,
+        "soak_kill9_reruns": 0,
+        "soak_sigterm_rc": rc2,
+        "soak_final_resume_reran": reran,
+        "soak_artifacts_identical": f"{ident}/{tot}",
+        "soak_seconds": round(soak_s, 2),
+        "soak_nsamp": T,
+        "soak_nchan": C,
+    }
+
+
 def run_obs_overhead(args):
     """Observability-plane overhead A/B (round 21's zero-overhead
     contract, measured): the SAME toy sweep->accel chain over a small
@@ -4150,7 +4590,7 @@ def run_child(args, cpu: bool, timeout: float):
     for flag in ("quick", "profile", "ab", "accel", "spectral", "fold",
                  "waterfall", "prepass", "survey", "chaos", "corruption",
                  "dedisp_tree", "tune", "compile", "multihost", "race",
-                 "obs_overhead"):
+                 "obs_overhead", "daemon_soak"):
         if getattr(args, flag):
             argv.append("--" + flag.replace("_", "-"))
     if args.race:
@@ -4163,7 +4603,7 @@ def run_child(args, cpu: bool, timeout: float):
                  if args.trace_out else ""]
     if args.corruption:
         argv += ["--corruption-seed", str(args.corruption_seed)]
-    if args.chaos:
+    if args.chaos or args.daemon_soak:
         argv += ["--chaos-seed", str(args.chaos_seed)]
         if args.chaos_rate is not None:
             argv += ["--chaos-rate", str(args.chaos_rate)]
@@ -4200,7 +4640,7 @@ def main():
                      or args.waterfall or args.prepass or args.survey
                      or args.chaos or args.corruption or args.dedisp_tree or args.tune
                      or args.compile or args.multihost or args.race
-                     or args.obs_overhead
+                     or args.obs_overhead or args.daemon_soak
                      or args.cpu_fallback or args.nsamp or args.nchan)
             and os.path.exists(DEFAULT_STREAM_FIL)):
         # the north-star workload exists on disk: measure THAT (streamed,
@@ -4247,6 +4687,8 @@ def main():
                 record = run_race(args)
             elif args.chaos:
                 record = run_chaos(args)
+            elif args.daemon_soak:
+                record = run_daemon_soak(args)
             elif args.corruption:
                 record = run_corruption(args)
             elif args.prepass:

@@ -128,6 +128,57 @@ def build_parser():
                         "declared dead and its observations adoptable "
                         "(also PYPULSAR_TPU_HOST_LEASE_S; default 10)")
     g = p.add_argument_group(
+        "streaming daemon (round 23: multi-tenant admission + shedding)")
+    g.add_argument("--daemon", action="store_true",
+                   help="run as a long-lived ingest service: watch "
+                        "directories (--watch) and accept socket "
+                        "submissions (--daemon-port), admitting "
+                        "arrivals through per-tenant token-bucket "
+                        "quotas + the resource guard into the running "
+                        "fleet; past --queue-bound the daemon SHEDS "
+                        "lowest-priority unaccepted work (accepted "
+                        "work is journal-manifested and survives "
+                        "kill+restart); SIGTERM drains cleanly")
+    g.add_argument("--watch", action="append", default=[],
+                   metavar="DIR[:TENANT]",
+                   help="watch DIR for arriving .fil/.sf/.raw files "
+                        "(ingested once size-stable for --quiesce "
+                        "seconds) billed to TENANT (default "
+                        "'default'); repeatable")
+    g.add_argument("--daemon-port", type=int, default=None, metavar="N",
+                   help="accept '<tenant> <path>' submissions on "
+                        "127.0.0.1:N, one verdict line back per "
+                        "request (0 picks a free port; default off)")
+    g.add_argument("--tenant", action="append", default=[],
+                   metavar="NAME[:PRIO[:RATE[:BURST]]]",
+                   help="pin one tenant's admission contract: higher "
+                        "PRIO sheds last; RATE admissions/s refill a "
+                        "BURST-deep token bucket (RATE 0 = unmetered). "
+                        "Unlisted tenants get the "
+                        "PYPULSAR_TPU_DAEMON_TENANT_* defaults; "
+                        "repeatable")
+    g.add_argument("--queue-bound", type=int, default=None, metavar="N",
+                   help="bounded accept queue: past N pending "
+                        "(unaccepted) arrivals the daemon sheds lowest "
+                        "priority / thinnest quota first (also "
+                        "PYPULSAR_TPU_DAEMON_QUEUE_BOUND; default 64)")
+    g.add_argument("--quiesce", type=float, default=None, metavar="S",
+                   help="watch-lane quiesce window: a file becomes an "
+                        "arrival only once its size is stable for S "
+                        "seconds (also PYPULSAR_TPU_DAEMON_QUIESCE_S; "
+                        "default 1)")
+    g.add_argument("--daemon-poll", type=float, default=None,
+                   metavar="S",
+                   help="service-loop tick: watch scan + admission "
+                        "pump + status mirror (also "
+                        "PYPULSAR_TPU_DAEMON_POLL_S; default 0.5)")
+    g.add_argument("--daemon-idle-exit", type=float, default=None,
+                   metavar="S",
+                   help="drain after S seconds with no arrivals and "
+                        "nothing in flight (bounded soaks/tests; also "
+                        "PYPULSAR_TPU_DAEMON_IDLE_EXIT_S; default off "
+                        "= run until SIGTERM)")
+    g = p.add_argument_group(
         "fleet health (deadlines, heartbeats, device strikes, admission)")
     g.add_argument("--stall-timeout", type=float, default=None,
                    metavar="S",
@@ -236,8 +287,10 @@ def _status_text(outdir: str, port=None):
             return None
         return format_status(snap["rows"], health=snap.get("health"),
                              plane=snap.get("plane"),
-                             capsules=snap.get("capsules"))
+                             capsules=snap.get("capsules"),
+                             tenants=snap.get("tenants"))
     from pypulsar_tpu.obs.statusd import capsules_by_obs
+    from pypulsar_tpu.survey.daemon import read_tenant_status
     from pypulsar_tpu.survey.fleet import read_plane_status
     from pypulsar_tpu.survey.state import (
         MANIFEST_SUFFIX,
@@ -251,7 +304,8 @@ def _status_text(outdir: str, port=None):
     return format_status(status_rows(paths),
                          health=read_fleet_health(outdir),
                          plane=read_plane_status(outdir),
-                         capsules=capsules_by_obs(outdir))
+                         capsules=capsules_by_obs(outdir),
+                         tenants=read_tenant_status(outdir))
 
 
 def _status(outdir: str, follow: bool = False, port=None) -> int:
@@ -344,13 +398,19 @@ def main(argv=None):
     if args.status:
         return _status(args.outdir, follow=args.follow,
                        port=args.status_port)
-    if not args.infile:
-        p.error("give at least one observation (or --status)")
+    if not args.infile and not (args.daemon and
+                                (args.watch or
+                                 args.daemon_port is not None)):
+        p.error("give at least one observation (or --status, or "
+                "--daemon with --watch/--daemon-port)")
     if args.hosts and args.hosts < 1:
         p.error(f"--hosts must be >= 1, got {args.hosts}")
     if args.hosts and args.host_id:
         p.error("--hosts launches its own named hosts; give one or the "
                 "other")
+    if args.daemon and (args.hosts or args.host_id):
+        p.error("--daemon is a single-host service; run one daemon "
+                "per host, each with its own --outdir")
     if args.hosts:
         os.makedirs(args.outdir, exist_ok=True)
         return _launch_hosts(args, argv)
@@ -388,16 +448,10 @@ def main(argv=None):
         return _run(args)
 
 
-def _run(args) -> int:
+def _survey_config(args):
     from pypulsar_tpu.survey.dag import SurveyConfig
-    from pypulsar_tpu.survey.scheduler import FleetScheduler
 
-    try:
-        obs = _observations(args.infile, args.outdir)
-    except ValueError as e:
-        print(f"survey: {e}", file=sys.stderr)
-        return 2
-    cfg = SurveyConfig(
+    return SurveyConfig(
         mask=args.mask, mask_time=args.mask_time,
         lodm=args.lodm, dmstep=args.dmstep, numdms=args.numdms,
         nsub=args.nsub, group_size=args.group_size,
@@ -410,6 +464,10 @@ def _run(args) -> int:
         sift_min_dm=args.sift_min_dm,
         fold_nbins=args.fold_nbins, fold_npart=args.fold_npart,
         fold_batch=args.fold_batch)
+
+
+def _parse_gang(args):
+    """The --gang flag's value, or None + a printed error."""
     gang = args.gang
     if gang != "auto":
         try:
@@ -417,11 +475,28 @@ def _run(args) -> int:
         except ValueError:
             print(f"survey: --gang must be an integer or 'auto', got "
                   f"{gang!r}", file=sys.stderr)
-            return 2
+            return None
         if gang > args.devices:
             print(f"survey: --gang {gang} exceeds --devices "
                   f"{args.devices}", file=sys.stderr)
-            return 2
+            return None
+    return gang
+
+
+def _run(args) -> int:
+    from pypulsar_tpu.survey.scheduler import FleetScheduler
+
+    if args.daemon:
+        return _run_daemon(args)
+    try:
+        obs = _observations(args.infile, args.outdir)
+    except ValueError as e:
+        print(f"survey: {e}", file=sys.stderr)
+        return 2
+    cfg = _survey_config(args)
+    gang = _parse_gang(args)
+    if gang is None:
+        return 2
     plane = None
     host_id = args.host_id or None
     if host_id is None:
@@ -502,6 +577,85 @@ def _run(args) -> int:
                else "QUARANTINED")
         print(f"#   {tag} {name} at {q['stage']}: {q['error']}")
     if not result.ok:
+        return 1
+    return 0
+
+
+def _parse_watch(spec: str):
+    """``DIR[:TENANT]`` — a bare DIR bills the ``default`` tenant."""
+    d, sep, tenant = spec.rpartition(":")
+    if sep and d and tenant and os.sep not in tenant:
+        return d, tenant
+    return spec, "default"
+
+
+def _run_daemon(args) -> int:
+    """The ``--daemon`` service: a SurveyDaemon around a service-mode
+    fleet, SIGTERM/SIGINT wired to a clean drain, positional infiles
+    fed through the same admission path as every other arrival."""
+    import signal
+
+    from pypulsar_tpu.survey.daemon import SurveyDaemon, parse_tenant_spec
+
+    gang = _parse_gang(args)
+    if gang is None:
+        return 2
+    try:
+        tenants = [parse_tenant_spec(s) for s in args.tenant]
+    except ValueError as e:
+        print(f"survey: {e}", file=sys.stderr)
+        return 2
+    watch = [_parse_watch(s) for s in args.watch]
+    daemon = SurveyDaemon(
+        args.outdir, _survey_config(args),
+        tenants=tenants, watch=watch,
+        initial=[("default", fn) for fn in args.infile],
+        port=args.daemon_port,
+        queue_bound=args.queue_bound, quiesce_s=args.quiesce,
+        poll_s=args.daemon_poll, idle_exit_s=args.daemon_idle_exit,
+        min_free_mb=args.min_free_mb, max_pending=args.max_pending,
+        verbose=True,
+        max_host_workers=args.max_host_workers, devices=args.devices,
+        retries=args.retries, telemetry_dir=args.telemetry_dir,
+        gang=gang, stall_s=args.stall_timeout,
+        stage_deadline=args.stage_deadline,
+        strike_limit=args.strike_limit,
+        max_bad_frac=args.max_bad_frac)
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(signum, lambda *_: daemon.request_drain())
+        except ValueError:
+            pass  # not the main thread (tests drive run() directly)
+    server = None
+    status_port = args.status_port
+    if status_port is None:
+        from pypulsar_tpu.tune import knobs
+
+        port = int(knobs.env_int("PYPULSAR_TPU_OBS_STATUS_PORT"))
+        status_port = port if port > 0 else None
+    if status_port is not None:
+        from pypulsar_tpu.obs.statusd import StatusServer
+
+        try:
+            server = StatusServer(args.outdir, status_port).start()
+            print(f"# survey: live status at {server.url}/status.json "
+                  f"(+ Prometheus {server.url}/metrics)")
+        except OSError as e:
+            print(f"# survey: --status-port {status_port} disabled "
+                  f"({e})", file=sys.stderr)
+    print("# survey: daemon up — SIGTERM drains (accepted work "
+          "finishes; the unaccepted queue is shed with recorded "
+          "reasons)")
+    try:
+        result = daemon.run()
+    finally:
+        if server is not None:
+            server.close()
+    s = daemon.stats()
+    print(f"# survey: daemon drained — {s['submitted']} submitted, "
+          f"{s['accepted']} accepted, {s['shed']} shed, "
+          f"{s['quarantined']} quarantined, {s['completed']} completed")
+    if result is not None and not result.ok:
         return 1
     return 0
 

@@ -96,8 +96,9 @@ def _row_state(row: Dict[str, Any]) -> str:
 
 def fleet_snapshot(outdir: str) -> Dict[str, Any]:
     """The ``--status`` view as one JSON-safe dict (rows + health +
-    plane + capsules) — shared by ``/status.json`` and the process
-    serving it."""
+    plane + capsules + daemon tenants) — shared by ``/status.json``
+    and the process serving it."""
+    from pypulsar_tpu.survey.daemon import read_tenant_status
     from pypulsar_tpu.survey.fleet import read_plane_status
     from pypulsar_tpu.survey.state import (
         MANIFEST_SUFFIX,
@@ -114,7 +115,8 @@ def fleet_snapshot(outdir: str) -> Dict[str, Any]:
             "rows": rows,
             "health": read_fleet_health(outdir),
             "plane": read_plane_status(outdir),
-            "capsules": capsules_by_obs(outdir)}
+            "capsules": capsules_by_obs(outdir),
+            "tenants": read_tenant_status(outdir)}
 
 
 # ---------------------------------------------------------------------------

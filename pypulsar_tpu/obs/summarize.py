@@ -144,6 +144,10 @@ class TraceSummary:
         # stage -> last tune.winner event attrs (config, trials,
         # baseline/best seconds) — the auto-tuning roll-up's payload
         self.tune_winners: Dict[str, dict] = {}
+        # tenant -> {arrivals, accepted, shed, completed, quarantined}
+        # from the streaming daemon's admission events (round 23) —
+        # the per-tenant roll-up daemon traces render
+        self.tenant_stats: Dict[str, Dict[str, int]] = {}
         # log2 latency histograms (round 21): span name -> µs buckets,
         # gauge name -> value buckets, from the periodic counters
         # records (cumulative snapshots — last one wins within a trace,
@@ -248,6 +252,21 @@ class TraceSummary:
                         and host is not None:
                     ent = self.host_events.setdefault(str(src), {})
                     ent["obs_lost"] = ent.get("obs_lost", 0) + 1
+            if name.startswith("daemon."):
+                # the admission plane's per-tenant books, rebuilt from
+                # the trace alone (what the shed-trail acceptance
+                # criterion reads)
+                attrs = rec.get("attrs") or {}
+                tenant = attrs.get("tenant")
+                key = {"daemon.arrival": "arrivals",
+                       "daemon.accept": "accepted",
+                       "daemon.shed": "shed"}.get(name)
+                if name == "daemon.terminal":
+                    key = ("completed" if attrs.get("state") == "done"
+                           else "quarantined")
+                if tenant is not None and key is not None:
+                    ent = self.tenant_stats.setdefault(str(tenant), {})
+                    ent[key] = ent.get(key, 0) + 1
             if name in ("tune.winner", "tune.applied"):
                 # keep the winning config per stage (last wins — a
                 # re-search supersedes); `applied` records cache-served
@@ -342,6 +361,10 @@ def combine_summaries(summaries: List[TraceSummary]) -> TraceSummary:
             o["n"] += ent["n"]
             o["burns"] += ent["burns"]
             o["worst_frac"] = max(o["worst_frac"], ent["worst_frac"])
+        for tn, st in s.tenant_stats.items():
+            ent = out.tenant_stats.setdefault(tn, {})
+            for k, n in st.items():
+                ent[k] = ent.get(k, 0) + n
         out.tune_winners.update(s.tune_winners)
         if s.last_device is not None:
             out.last_device = s.last_device
@@ -498,6 +521,18 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
                 for k, n in sorted(s.host_events.get(h, {}).items())
                 if k != "host_registered")
             p(line + ("  " + evs if evs else ""))
+    # per-tenant roll-up (round 23): the streaming daemon's admission
+    # books rebuilt from its daemon.* events — who submitted, who got
+    # in, who was shed, and how their accepted work ended
+    if s.tenant_stats:
+        p("#\n# per-tenant (daemon admission):")
+        for tn in sorted(s.tenant_stats):
+            st = s.tenant_stats[tn]
+            p(f"#   {tn:<14s} arrivals {st.get('arrivals', 0):>5d}  "
+              f"accepted {st.get('accepted', 0):>5d}  "
+              f"shed {st.get('shed', 0):>5d}  "
+              f"completed {st.get('completed', 0):>5d}  "
+              f"quarantined {st.get('quarantined', 0):>4d}")
     # lock-health roll-up (round 19): the lockdep wrappers' hold-time
     # gauges, contention counters and order-violation events — the view
     # that says WHICH lock a slow fleet is serializing on, and whether

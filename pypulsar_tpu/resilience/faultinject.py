@@ -36,6 +36,15 @@ in the pipeline:
   and it composes with seeded chaos (chaos mode may draw it like any
   other kind).
 
+The streaming daemon (round 23) arms its ingest edges the same way:
+``daemon.arrival`` (an arrival is never seen; the watch lane re-sees it
+next scan), ``daemon.admit`` (an admission attempt fails; the arrival
+goes back to pending and retries next tick) and ``daemon.shed`` (the
+shed still happens — the bounded queue may not stay over its bound —
+but the fault is counted). All three ride the chaos spray like every
+other point, so ``bench.py --daemon-soak`` exercises the admission
+plane with the same seeded machinery.
+
 Spec grammar (``PYPULSAR_TPU_FAULTS`` env var or the CLIs'
 ``--fault-inject``)::
 

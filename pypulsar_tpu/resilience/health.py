@@ -84,6 +84,12 @@ ENV_STALL_S = "PYPULSAR_TPU_STALL_S"
 ENV_MIN_FREE_MB = "PYPULSAR_TPU_MIN_FREE_MB"
 DEFAULT_MIN_FREE_MB = 32.0
 
+# admission hysteresis (round 23): once the gate pauses, it resumes only
+# past the floor/bound by this fractional margin — a fleet hovering AT
+# the threshold must not flap paused/resumed event pairs every poll
+ENV_ADMIT_RESUME_MARGIN = "PYPULSAR_TPU_ADMIT_RESUME_MARGIN"
+DEFAULT_ADMIT_RESUME_MARGIN = 0.25
+
 
 
 
@@ -536,17 +542,35 @@ class ResourceGuard:
       deepens the pile.
 
     The gate pauses *scheduling*; stages already running always
-    continue (they are what frees the resource)."""
+    continue (they are what frees the resource).
+
+    Admission is *hysteretic* (round 23): once paused, the gate demands
+    a ``resume_margin`` of slack past the threshold before admitting
+    again (free disk >= floor * (1 + margin), pending depth <= bound /
+    (1 + margin); ``PYPULSAR_TPU_ADMIT_RESUME_MARGIN``, default 0.25).
+    A gauge hovering exactly at the threshold therefore produces ONE
+    paused/resumed episode, not one pair per oscillation — the
+    flapping the scheduler's per-episode events would otherwise
+    faithfully amplify into the trace."""
 
     def __init__(self, path: str,
                  min_free_bytes: Optional[float] = None,
-                 max_pending: Optional[float] = None):
+                 max_pending: Optional[float] = None,
+                 resume_margin: Optional[float] = None):
         if min_free_bytes is None:
             mb = env_float(ENV_MIN_FREE_MB, DEFAULT_MIN_FREE_MB)
             min_free_bytes = (mb or 0.0) * 1e6
+        if resume_margin is None:
+            resume_margin = env_float(ENV_ADMIT_RESUME_MARGIN,
+                                      DEFAULT_ADMIT_RESUME_MARGIN)
         self.path = path
         self.min_free_bytes = float(min_free_bytes)
         self.max_pending = max_pending
+        self.resume_margin = max(0.0, float(resume_margin or 0.0))
+        # the hysteresis latch; quiet — the guard is consulted on the
+        # scheduler's launch path and must not emit about itself
+        self._lock = locks.TrackedLock("health.guard", quiet=True)
+        self._paused = False
 
     def free_bytes(self) -> Optional[float]:
         try:
@@ -554,20 +578,35 @@ class ResourceGuard:
         except OSError:
             return None  # an unstatable root is not a reason to pause
 
-    def admit(self) -> Optional[str]:
+    def _check(self, paused: bool) -> Optional[str]:
+        """One stateless evaluation at the thresholds the latch state
+        selects: strict (margin-widened) while paused, base otherwise."""
+        widen = 1.0 + (self.resume_margin if paused else 0.0)
         if self.min_free_bytes > 0:
+            floor = self.min_free_bytes * widen
             free = self.free_bytes()
-            if free is not None and free < self.min_free_bytes:
+            if free is not None and free < floor:
                 return (f"low disk: {free / 1e6:.0f} MB free under "
-                        f"{self.path!r} < {self.min_free_bytes / 1e6:.0f}"
-                        f" MB floor")
+                        f"{self.path!r} < {floor / 1e6:.0f}"
+                        f" MB floor"
+                        + (" (resume margin)" if paused else ""))
         if self.max_pending is not None:
+            bound = self.max_pending / widen
             s = telemetry.current()
             if s is not None:
                 for name, g in s.gauge_values().items():
                     if name.endswith(".pending_depth") \
-                            and g.get("last", 0) > self.max_pending:
+                            and g.get("last", 0) > bound:
                         return (f"backpressure: {name} = "
                                 f"{g.get('last', 0):.0f} > "
-                                f"{self.max_pending:.0f}")
+                                f"{bound:.0f}"
+                                + (" (resume margin)" if paused else ""))
         return None
+
+    def admit(self) -> Optional[str]:
+        with self._lock:
+            paused = self._paused
+        reason = self._check(paused)
+        with self._lock:
+            self._paused = reason is not None
+        return reason

@@ -206,7 +206,8 @@ class FleetScheduler:
                  max_bad_frac: Optional[float] = None,
                  jitter_rng=None,
                  plane: Optional["fleet_mod.FleetPlane"] = None,
-                 verbose: bool = False):
+                 verbose: bool = False,
+                 service: bool = False):
         self.cfg = cfg if cfg is not None else SurveyConfig()
         self.stages = list(stages) if stages is not None \
             else build_dag(self.cfg)
@@ -326,6 +327,26 @@ class FleetScheduler:
         self._claim_thread: Optional[threading.Thread] = None
         self._warm_thread: Optional[threading.Thread] = None
         self._plane_owned_here = False  # register()ed by this run()
+
+        # service mode (round 23): the fleet does NOT exit when every
+        # task is terminal — the daemon keeps submit()ing observations
+        # into the running DAG, and only request_drain() restores the
+        # batch run-to-completion exit contract
+        self._service = bool(service)
+        self._draining = False
+        # obs indices whose input file existence is re-verified at every
+        # stage launch (daemon submissions: a source that vanishes
+        # between admit and stage start is a LOUD data-quarantine, not a
+        # crash or a retry loop). Batch obs are exempt — stub-stage
+        # fleets legitimately run against paths that never exist.
+        self._verify_input: set = set()
+        # optional terminal-edge hook (obs_name, state) the daemon uses
+        # for tenant accounting; failures are swallowed (a passenger)
+        self.on_obs_terminal = None
+        # set once run() has opened the initial manifests and promoted
+        # the initial obs: submit() before this point would race the
+        # startup manifest pass (the daemon waits on it)
+        self._ready = locks_mod.TrackedEvent("survey.sched.ready")
 
     # -- manifests ----------------------------------------------------------
 
@@ -459,6 +480,7 @@ class FleetScheduler:
                     t.state = _QUARANTINED
             self.result.quarantined[obs.name] = {
                 "stage": "ingest", "error": error, "reason": "data"}
+            self._maybe_stop_locked()
             self._cv.notify_all()
         self._plane_mark_terminal(obs_i, "quarantined")
 
@@ -485,6 +507,99 @@ class FleetScheduler:
     def _finished_locked(self) -> bool:
         return all(t.state in (_DONE, _QUARANTINED, _REMOTE)
                    for t in self._tasks.values())
+
+    def _maybe_stop_locked(self) -> None:
+        """Stop the fleet when every task is terminal — unless service
+        mode holds it open for future :meth:`submit` calls (only a
+        :meth:`request_drain` restores the batch exit contract). Every
+        terminal edge funnels through here so the service-mode liveness
+        rule lives in exactly one place."""
+        if self._finished_locked() \
+                and not (self._service and not self._draining):
+            self._stop = True
+
+    # -- service mode (round 23) --------------------------------------------
+
+    def submit(self, obs: Observation, *, resume: bool = True,
+               verify_input: bool = True) -> int:
+        """Register ONE new observation with a RUNNING service-mode
+        fleet and promote its ready stages. The daemon's ingest edge:
+        the manifest is opened and planned immediately (the accepted-
+        work durability contract — an accepted observation survives
+        kill+restart exactly like a batch obs), journal-validated
+        stages are skipped (``resume=True``, the default, makes a
+        daemon-restart resubmission idempotent: zero re-runs of
+        validated stages), and ingest validation may data-quarantine
+        the observation before any stage runs. Returns the obs index.
+
+        Thread-safe against the workers: the manifest/trace open runs
+        outside the scheduler lock (it blocks on disk), registration
+        appends under the lock (list appends — existing indices never
+        move), and the tasks become visible to workers only at the
+        final promote."""
+        if not self._service:
+            raise RuntimeError("submit() requires service=True")
+        with self._lock:
+            if any(o.name == obs.name for o in self.obs):
+                raise ValueError(f"duplicate observation name "
+                                 f"{obs.name!r}")
+        snames = stage_names(self.stages)
+        if not resume and os.path.exists(obs.manifest):
+            os.remove(obs.manifest)
+        m = ObsManifest(obs.manifest,
+                        fleet_fingerprint(obs, self.cfg, snames))
+        if m.fresh:
+            self._clean_stale_outputs(obs)
+        m.plan(obs, snames)
+        tid = self._mint_trace(m)
+        trace = None
+        if self.telemetry_dir:
+            trace = ObsTrace(
+                os.path.join(self.telemetry_dir, f"{obs.name}.jsonl"),
+                obs.name, append=resume, trace_id=tid)
+        with self._cv:
+            i = len(self.obs)
+            self.obs.append(obs)
+            self._manifests.append(m)
+            self._trace_ids.append(tid)
+            self._traces.append(trace)
+            for s in self.stages:
+                self._tasks[(i, s.name)] = _Task(i, s)
+            if verify_input:
+                self._verify_input.add(i)
+        if not self._validate_ingest_one(i):
+            return i  # data-quarantined before any stage ran
+        done = m.done_stages() if resume else set()
+        with self._cv:
+            for s in self.stages:
+                if s.name in done:
+                    self._tasks[(i, s.name)].state = _DONE
+                    self.result.skipped.append((obs.name, s.name))
+                    telemetry.counter("survey.stages_skipped")
+            self._promote_locked(i)
+            obs_complete = all(
+                self._tasks[(i, s.name)].state == _DONE
+                for s in self.stages)
+            self._cv.notify_all()
+        if obs_complete:
+            # every stage already journal-validated: terminal on arrival
+            self._plane_mark_terminal(i, "done")
+        return i
+
+    def request_drain(self) -> None:
+        """End service mode: finish everything submitted so far, then
+        exit :meth:`run` with the ordinary batch verdict (the SIGTERM
+        half of the daemon's overload contract)."""
+        with self._cv:
+            self._draining = True
+            self._maybe_stop_locked()
+            self._cv.notify_all()
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until :meth:`run` has finished its startup manifest
+        pass (service mode: the point after which :meth:`submit` is
+        safe)."""
+        return self._ready.wait(timeout)
 
     # -- multi-host claim / adopt loop --------------------------------------
 
@@ -533,7 +648,18 @@ class FleetScheduler:
         """Best-effort claim closeout (done/quarantined). Losing the
         fence here means a survivor adopted the observation while its
         last write was in flight — the adopter revalidates and closes
-        it out itself, so the local verdict simply stands down."""
+        it out itself, so the local verdict simply stands down.
+
+        Every obs-terminal edge (done / quarantined / data-quarantined)
+        funnels through here, which is why the service-mode terminal
+        hook also rides it: the daemon's tenant books settle on the
+        same edges the multi-host plane does."""
+        cb = self.on_obs_terminal
+        if cb is not None:
+            try:
+                cb(self.obs[obs_i].name, state)
+            except Exception:  # noqa: BLE001 - accounting is a passenger
+                pass
         if self.plane is None:
             return
         token = self._obs_tokens.get(obs_i)
@@ -612,8 +738,7 @@ class FleetScheduler:
                     task.state = _PENDING
                     task.attempts = 0  # a fresh owner gets fresh retries
             self._promote_locked(i)
-            if self._finished_locked():
-                self._stop = True
+            self._maybe_stop_locked()
             self._cv.notify_all()
 
     def _claim_failed(self, i: int, token: int, e: Exception) -> None:
@@ -799,8 +924,8 @@ class FleetScheduler:
                 continue
             owned_open += 1
         with self._cv:
-            if self._finished_locked():
-                self._stop = True
+            self._maybe_stop_locked()
+            if self._stop:
                 self._cv.notify_all()
 
     def _plane_loop(self) -> None:
@@ -1033,6 +1158,16 @@ class FleetScheduler:
                  dev_ids: Optional[List[int]] = None) -> None:
         obs = self.obs[task.obs_i]
         stage = task.stage
+        if task.obs_i in self._verify_input \
+                and not os.path.exists(obs.infile):
+            # a daemon-accepted source that vanished between admit and
+            # stage start (mover rolled it back, tenant deleted it): a
+            # LOUD data-quarantine — re-transfer territory, not a crash
+            # and not a retry loop burning attempts on ENOENT
+            self._quarantine_data(
+                task.obs_i,
+                f"input file vanished after admission: {obs.infile}")
+            return
         tid = (self._trace_ids[task.obs_i]
                if task.obs_i < len(self._trace_ids) else None)
         budget = self._deadline_for(stage, obs)
@@ -1135,8 +1270,7 @@ class FleetScheduler:
             obs_complete = all(
                 self._tasks[(task.obs_i, s.name)].state == _DONE
                 for s in self.stages)
-            if self._finished_locked():
-                self._stop = True
+            self._maybe_stop_locked()
             self._cv.notify_all()
         if obs_complete:
             # close the claim out so other hosts read this observation
@@ -1193,8 +1327,7 @@ class FleetScheduler:
                     task.state = _DONE
                     self.result.ran.append((obs.name, stage.name))
                     self._promote_locked(task.obs_i)
-                    if self._finished_locked():
-                        self._stop = True
+                    self._maybe_stop_locked()
                     self._cv.notify_all()
             return
         self._strike_leases(task, err)
@@ -1258,8 +1391,7 @@ class FleetScheduler:
                     t.state = _QUARANTINED
             self.result.quarantined[obs.name] = {"stage": stage.name,
                                                  "error": error}
-            if self._finished_locked():
-                self._stop = True
+            self._maybe_stop_locked()
             self._cv.notify_all()
         self._plane_mark_terminal(task.obs_i, "quarantined")
 
@@ -1639,8 +1771,8 @@ class FleetScheduler:
                                     (self.obs[i].name, s.name))
                                 telemetry.counter("survey.stages_skipped")
                         self._promote_locked(i)
-                    if self._finished_locked():
-                        self._stop = True
+                    self._maybe_stop_locked()
+            self._ready.set()
             if knobs_mod.env_str("PYPULSAR_TPU_COMPILE_WARMPOOL") \
                     not in ("0", "off", "none"):
                 # warm-pool precompile rides the host pool's spare
@@ -1676,6 +1808,7 @@ class FleetScheduler:
             for w in workers:
                 w.join()
         finally:
+            self._ready.set()  # never leave a service waiter hanging
             if self._watchdog is not None:
                 self._watchdog.stop()
                 self._watchdog = None

@@ -329,13 +329,17 @@ def _excerpt(error: str, limit: int = ERROR_EXCERPT_LEN) -> str:
 def format_status(rows: Sequence[Dict],
                   health: Optional[Dict] = None,
                   plane: Optional[Dict] = None,
-                  capsules: Optional[Dict[str, List[str]]] = None) -> str:
+                  capsules: Optional[Dict[str, List[str]]] = None,
+                  tenants: Optional[Dict] = None) -> str:
     """Render the --status progress table (plus, with a fleet-health
     mirror, the per-device strike/quarantine block, and, with a
     multi-host plane snapshot from ``fleet.read_plane_status``, the
     host-liveness block and a per-observation owner column).
     ``capsules`` maps observation name -> postmortem capsule paths
-    (obs/flightrec) so a QUARANTINED row points at its explanation."""
+    (obs/flightrec) so a QUARANTINED row points at its explanation;
+    ``tenants`` is the streaming daemon's admission snapshot
+    (``daemon.read_tenant_status``), rendered as a per-tenant
+    quota/books block when a daemon runs (or ran) here."""
     claims = (plane or {}).get("claims", {})
     capsules = capsules or {}
     host_col = bool(plane)
@@ -439,6 +443,28 @@ def format_status(rows: Sequence[Dict],
                 tail = f" ({_excerpt(err)})" if err else ""
                 lines.append(f"#   {hid}: {h.get('strikes', 0)} "
                              f"strike(s), {verdict}{tail}")
+    if tenants and tenants.get("tenants"):
+        drain = " DRAINING" if tenants.get("draining") else ""
+        lines.append(
+            f"# tenants (accept queue "
+            f"{tenants.get('queue_depth', '?')}/"
+            f"{tenants.get('queue_bound', '?')}, "
+            f"{tenants.get('accepted_open', '?')} accepted in "
+            f"flight{drain}):")
+        for name in sorted(tenants["tenants"]):
+            t = tenants["tenants"][name]
+            rate = t.get("rate", 0) or 0
+            quota = (f"{t.get('tokens', '?')}/{t.get('burst', '?')} "
+                     f"tokens @ {rate:g}/s" if rate
+                     else "unmetered")
+            lines.append(
+                f"#   {name:<14s} prio {t.get('priority', 0):<3d} "
+                f"{quota:<26s} "
+                f"{t.get('submitted', 0)} submitted / "
+                f"{t.get('accepted', 0)} accepted / "
+                f"{t.get('shed', 0)} shed / "
+                f"{t.get('quarantined', 0)} quarantined / "
+                f"{t.get('completed', 0)} completed")
     return "\n".join(lines)
 
 

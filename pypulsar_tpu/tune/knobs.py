@@ -368,6 +368,40 @@ env_knob("PYPULSAR_TPU_MIN_FREE_MB", "float", 32.0, "fleet",
 env_knob("PYPULSAR_TPU_GANG_COST_MIN_FRAC", "float", 0.25, "fleet",
          invariant=False,
          help="--gang auto cost share below which a stage stays 1-chip")
+env_knob("PYPULSAR_TPU_ADMIT_RESUME_MARGIN", "float", 0.25, "fleet",
+         invariant=False,
+         help="admission-gate hysteresis: once paused, resume only with "
+              "this fractional slack past the floor/bound (0 = the old "
+              "flappy threshold-equality behavior)")
+
+# -- streaming daemon (round 23) --------------------------------------------
+env_knob("PYPULSAR_TPU_DAEMON_QUEUE_BOUND", "int", 64, "daemon",
+         invariant=False,
+         help="daemon accept-queue bound: arrivals past this many "
+              "admitted-but-unscheduled observations shed the lowest-"
+              "priority unaccepted entry (daemon.shed)")
+env_knob("PYPULSAR_TPU_DAEMON_QUIESCE_S", "float", 1.0, "daemon",
+         invariant=False,
+         help="watch-dir quiesce window: a file is ingested only after "
+              "its size has been stable this long (a half-written .fil "
+              "is never admitted)")
+env_knob("PYPULSAR_TPU_DAEMON_POLL_S", "float", 0.5, "daemon",
+         invariant=False,
+         help="daemon watch-directory scan cadence (seconds)")
+env_knob("PYPULSAR_TPU_DAEMON_TENANT_RATE", "float", 0.0, "daemon",
+         invariant=False,
+         help="default per-tenant token-bucket refill rate "
+              "(admissions/second) for tenants without an explicit "
+              "--tenant spec; 0 = unmetered")
+env_knob("PYPULSAR_TPU_DAEMON_TENANT_BURST", "float", 8.0, "daemon",
+         invariant=False,
+         help="default per-tenant token-bucket burst capacity (the "
+              "bucket depth an idle tenant accumulates)")
+env_knob("PYPULSAR_TPU_DAEMON_IDLE_EXIT_S", "float", 0.0, "daemon",
+         invariant=False,
+         help="daemon auto-drain after this many seconds with no "
+              "arrivals and an empty fleet (0 = run until SIGTERM; the "
+              "bounded-soak/test hook)")
 
 # -- data integrity ---------------------------------------------------------
 env_knob("PYPULSAR_TPU_MAX_BAD_FRAC", "float", 0.5, "data",
