@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier test-faults test-fold test-obs test-survey test-corruption test-tune test-multihost test-race test-daemon lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-obs bench-survey bench-multichip bench-multihost-fleet bench-specfuse bench-telemetry bench-tree bench-tune bench-compile native clean
+.PHONY: test test-fourier test-faults test-fold test-obs test-survey test-corruption test-tune test-multihost test-race test-daemon test-broker bench-broker lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-obs bench-survey bench-multichip bench-multihost-fleet bench-specfuse bench-telemetry bench-tree bench-tune bench-compile native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -49,7 +49,7 @@ test-fourier:
 # survey orchestrator's kill/resume/quarantine and fleet-health
 # (watchdog, device-strike, admission) cases, and the seeded chaos
 # fleet
-test-faults: test-chaos test-corruption test-multihost test-race test-obs test-daemon
+test-faults: test-chaos test-corruption test-multihost test-race test-obs test-daemon test-broker
 	$(CPU_ENV) $(PY) -m pytest tests/test_resilience.py -q
 	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -k "kill or resume or quarantine or retry or stall or deadline or evict or admission or chaos"
 
@@ -104,6 +104,15 @@ test-daemon:
 	$(CPU_ENV) $(PY) -m pytest tests/test_daemon.py -q
 	$(CPU_ENV) $(PY) bench.py --daemon-soak --quick
 	$(CPU_ENV) $(PY) -m pytest tests/test_daemon.py -q -m slow -k soak
+
+# the batch-broker suite (round 24): cross-observation coalescing
+# semantics (budget close, SLO-pressure window collapse, party early
+# close), the multi-series fold kernel's bitwise parity, brokered-fleet
+# artifacts byte-identical to the PYPULSAR_TPU_BROKER=0 reference with
+# real fusion proven by counters, batchmate fault isolation, and kill +
+# resume mid-coalesce re-running only unvalidated stages
+test-broker:
+	$(CPU_ENV) $(PY) -m pytest tests/test_broker.py -q
 
 # the data-integrity suite: the checked-in corrupted-fixture corpus
 # against every reader, salvage/scrub/finite-gate contracts, the
@@ -238,6 +247,14 @@ bench-tune: test-tune
 bench-compile:
 	$(CPU_ENV) $(PY) -m pytest tests/test_compile.py -q
 	$(CPU_ENV) $(PY) bench.py --compile --out BENCH_r17_compile.json
+
+# the round-24 batch-broker A/B: >=4 small same-geometry observations,
+# brokered (lanes + wide window) vs per-obs dispatch, gated on
+# STRUCTURAL counters — coalesce factor >= 2, fused dispatches <= half
+# the baseline's, zero extra compile misses, artifacts byte-identical
+# (CPU-toy walls are labeled, not gated)
+bench-broker: test-broker
+	$(CPU_ENV) $(PY) bench.py --broker --out BENCH_r19_broker.json
 
 native:
 	$(PY) -c "from pypulsar_tpu import native; assert native.available(); print('native codec OK')"

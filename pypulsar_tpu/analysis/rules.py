@@ -422,12 +422,21 @@ class DeadFaultPointRule(ProjectRule):
                         elif ls:
                             suffixes.add(ls)
             elif isinstance(node, ast.Assign):
-                # FAULT_POINT = "data.block" style registered constants
+                # FAULT_POINT = "data.block" style registered constants,
+                # plus FAULT_POINTS = ("a.b", "c.d") tuple/list registries
+                # (round 24: the broker publishes its points as a tuple)
                 for tgt in node.targets:
-                    if (isinstance(tgt, ast.Name) and "POINT" in tgt.id):
-                        s = _const_str(node.value)
-                        if s:
-                            exact.add(s)
+                    if not (isinstance(tgt, ast.Name)
+                            and "POINT" in tgt.id):
+                        continue
+                    s = _const_str(node.value)
+                    if s:
+                        exact.add(s)
+                    elif isinstance(node.value, (ast.Tuple, ast.List)):
+                        for elt in node.value.elts:
+                            es = _const_str(elt)
+                            if es:
+                                exact.add(es)
         return exact, prefixes, suffixes
 
     # -- references ---------------------------------------------------

@@ -222,12 +222,10 @@ def _leaf_key(x: Any) -> Tuple:
 def _config_digest(stage: str) -> str:
     """Digest of the stage's fully-resolved knob config (trial > env >
     tuned > default) — the round-17 fix: a tuned config change keys a
-    different executable."""
-    if not stage:
-        return ""
-    cfg = knobs.current_config(stage)
-    blob = repr(sorted(cfg.items())).encode()
-    return hashlib.sha1(blob).hexdigest()[:12]
+    different executable. Round 24 hoisted the digest itself into
+    ``knobs.config_digest`` so the batch broker coalesces on the exact
+    key the plane compiles under."""
+    return knobs.config_digest(stage)
 
 
 _WRAPPER_IDS = itertools.count()

@@ -407,6 +407,96 @@ def fold_parts_batch(series, bin_idx, nbins: int, npart: int):
         return _fold_parts_batch_jit(series, bin_idx, nbins, npart)
 
 
+def _onehot_fold_1d_multi(data, bin_idx, nbins: int):
+    """Multi-series twin of :func:`_onehot_fold_1d_batch`: candidate k
+    folds its OWN ``data[k]`` row (``einsum('kt,ktb->kb')``) instead of
+    one shared series. Per candidate the contraction is the identical
+    length-T f32 gemv — same ``_FOLD_BLOCK`` seams, same HIGHEST
+    precision — so on the CPU backend each row is bit-identical to the
+    shared-series kernel fed that row's series (the batch-broker fusion
+    contract, pinned by tests/test_broker.py)."""
+    K, T = bin_idx.shape
+    if T <= _FOLD_BLOCK:
+        onehot = jax.nn.one_hot(bin_idx, nbins, dtype=data.dtype)
+        prof = jnp.einsum("kt,ktb->kb", data, onehot,
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
+        return prof, onehot.sum(axis=1)
+    nblk = -(-T // _FOLD_BLOCK)
+    pad = nblk * _FOLD_BLOCK - T
+    d = jnp.pad(data, ((0, 0), (0, pad))).reshape(
+        K, nblk, _FOLD_BLOCK).transpose(1, 0, 2)
+    b = jnp.pad(bin_idx, ((0, 0), (0, pad)), constant_values=nbins)
+    b = b.reshape(K, nblk, _FOLD_BLOCK).transpose(1, 0, 2)
+
+    def body(acc, xs):
+        dblk, bblk = xs
+        acc_p, acc_c = acc
+        onehot = jax.nn.one_hot(bblk, nbins, dtype=dblk.dtype)
+        prof = jnp.einsum("kt,ktb->kb", dblk, onehot,
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
+        return (acc_p + prof, acc_c + onehot.sum(axis=1)), None
+
+    (prof, cnt), _ = jax.lax.scan(
+        body, (jnp.zeros((K, nbins), jnp.float32),
+               jnp.zeros((K, nbins), jnp.float32)), (d, b))
+    return prof, cnt
+
+
+def _fold_parts_multi_impl(stack, series_idx, bin_idx, nbins: int,
+                           npart: int):
+    stack = jnp.asarray(stack)
+    series_idx = jnp.asarray(series_idx, jnp.int32)
+    bin_idx = jnp.asarray(bin_idx, jnp.int32)
+    K, T = bin_idx.shape
+    part_len = T // npart
+    if part_len >= 1 << 24:
+        raise ValueError(
+            f"part_len={part_len} >= 2^24: f32 one-hot counts would lose "
+            f"exactness; use more partitions")
+    # gather each candidate's series row, then mirror
+    # _fold_parts_batch_impl exactly (same partition cut, same scan)
+    d = stack[series_idx, : npart * part_len].reshape(
+        K, npart, part_len).transpose(1, 0, 2)
+    b = bin_idx[:, : npart * part_len].reshape(
+        K, npart, part_len).transpose(1, 0, 2)
+
+    def body(carry, xs):
+        dpart, bpart = xs
+        prof, cnt = _onehot_fold_1d_multi(dpart, bpart, nbins)
+        return carry, (prof, cnt.astype(jnp.int32))
+
+    _, (profs, counts) = jax.lax.scan(body, 0, (d, b))
+    return profs.transpose(1, 0, 2), counts.transpose(1, 0, 2)
+
+
+_fold_parts_multi_jit = plane_jit(_fold_parts_multi_impl,
+                                  static_argnames=("nbins", "npart"),
+                                  stage="fold")
+
+
+def fold_parts_multi(stack, series_idx, bin_idx, nbins: int, npart: int):
+    """Fold ``K`` candidates against ``G`` DIFFERENT equal-length
+    series in one compiled program: candidate k folds
+    ``stack[series_idx[k]]`` at its own phase model. This is the batch
+    broker's fused fold kernel (round 24) — candidates from several
+    observations, each with its own dedispersed series, fuse into ONE
+    device dispatch. Row k is bit-identical (CPU backend) to
+    ``fold_parts_batch(stack[series_idx[k]], bin_idx[k:k+1], ...)``.
+    Returns (profiles[K, npart, nbins] f32, counts[K, npart, nbins]
+    int32)."""
+    if telemetry.is_active():
+        telemetry.counter("fold.samples",
+                          int(np.shape(bin_idx)[0])
+                          * int(np.shape(stack)[-1]))
+    with telemetry.span("fold_parts_multi", nbins=nbins, npart=npart,
+                        n_cands=int(np.shape(bin_idx)[0]),
+                        n_series=int(np.shape(stack)[0])):
+        return _fold_parts_multi_jit(stack, series_idx, bin_idx, nbins,
+                                     npart)
+
+
 def fold_parts_batch_numpy(series, bin_idx, nbins: int, npart: int):
     """Golden float64 twin of :func:`fold_parts_batch`: per candidate,
     per partition, the EXACT per-candidate :func:`fold_numpy` bincount —

@@ -451,7 +451,14 @@ def _detect_impl(accum, thresh, k: int):
 
 _BANK_CACHE: Dict[tuple, tuple] = {}
 _BANK_CACHE_BYTES = [0]
-_BANK_CACHE_LIMIT = 4e9  # host RAM; jerk banks reach GB scale
+
+
+def _bank_cache_limit() -> float:
+    """Host-RAM bound on cached template banks (jerk banks reach GB
+    scale) — the old inline ``_BANK_CACHE_LIMIT = 4e9`` constant,
+    registered as ``PYPULSAR_TPU_ACCEL_BANK_CACHE`` (round 24) so a
+    RAM-tight host can shrink it without editing source."""
+    return float(knobs.env_float("PYPULSAR_TPU_ACCEL_BANK_CACHE"))
 
 
 def _build_ratio_bank(rho_num: int, rho_den: int, zs: tuple, ws: tuple,
@@ -505,9 +512,10 @@ def _cached_ratio_bank(rho_num, rho_den, zs, ws, segw, min_halfwidth):
         return hit
     bank = _build_ratio_bank(rho_num, rho_den, zs, ws, segw, min_halfwidth)
     size = bank[0].nbytes + bank[3].nbytes
-    if size > _BANK_CACHE_LIMIT:
+    limit = _bank_cache_limit()
+    if size > limit:
         return bank  # uncacheable; evicting everything for it helps nobody
-    while _BANK_CACHE and _BANK_CACHE_BYTES[0] + size > _BANK_CACHE_LIMIT:
+    while _BANK_CACHE and _BANK_CACHE_BYTES[0] + size > limit:
         old_key = next(iter(_BANK_CACHE))
         old = _BANK_CACHE.pop(old_key)
         _BANK_CACHE_BYTES[0] -= old[0].nbytes + old[3].nbytes

@@ -682,6 +682,41 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
             p(f"#   {name.replace('compile.first.', ''):<10s} "
               f"first-compile {sc[0]:.2f}s over {int(sc[1])} "
               f"program(s)")
+    # batch-broker roll-up (round 24): what fleet-level coalescing of
+    # same-geometry dispatches bought — fused dispatch count, units
+    # coalesced per dispatch, rows fused, lane grants, and the latency
+    # the coalesce window cost (the broker.wait span histogram)
+    bb_bits = []
+    n_disp = s.counters.get("broker.dispatches")
+    if n_disp:
+        bb_bits.append(f"fused dispatches={_fmt_count(n_disp)}")
+        n_sub = s.counters.get("broker.submissions", 0)
+        if n_sub:
+            bb_bits.append(f"units={_fmt_count(n_sub)} "
+                           f"(coalesce factor {n_sub / n_disp:.2f})")
+    n_rows = s.counters.get("broker.fused_rows")
+    if n_rows:
+        bb_bits.append(f"rows fused={_fmt_count(n_rows)}")
+    n_lane = s.counters.get("broker.lane_grants")
+    if n_lane:
+        bb_bits.append(f"lane grants={_fmt_count(n_lane)}")
+    for key, label in (("broker.member_faults", "member faults"),
+                       ("broker.fused_faults", "fused faults"),
+                       ("broker.unit_retries", "unit retries")):
+        v = s.counters.get(key)
+        if v:
+            bb_bits.append(f"{label}={_fmt_count(v)}")
+    wait = s.hists.get("broker.wait")
+    if wait and sum(wait):
+        bb_bits.append(
+            f"wait p50/p99="
+            f"{_fmt_us(hist_percentile(wait, 0.50))}/"
+            f"{_fmt_us(hist_percentile(wait, 0.99))}")
+    occ = s.gauges.get("broker.coalesce_factor", {}).get("max")
+    if occ:
+        bb_bits.append(f"peak batch occupancy={int(occ)}")
+    if bb_bits:
+        p("#\n# batch broker: " + "  ".join(bb_bits))
     # data-quality roll-up: what the dataguard scrub and the finite
     # gates did to this run's bytes (round 13)
     data_bits = []

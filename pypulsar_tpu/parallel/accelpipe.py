@@ -46,6 +46,7 @@ import numpy as np
 
 from pypulsar_tpu.compile import bucket_floor, bucket_rows, note_bucket_pad
 from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.parallel import broker as broker_mod
 from pypulsar_tpu.resilience import faultinject, health
 from pypulsar_tpu.tune import knobs
 from pypulsar_tpu.resilience.journal import RunJournal, candfile_complete
@@ -57,6 +58,20 @@ __all__ = [
     "sweep_accel_stream",
     "write_candfiles",
 ]
+
+
+def _broker_concat_rows(payloads):
+    """Fuse same-key accel batch payloads on the spectrum axis — either
+    device-resident (re, im) plane tuples (a device concat, no host
+    round trip) or host-prepped complex arrays. Per-spectrum results
+    are independent (the halving contract), so the fused search demuxes
+    bit-identically."""
+    if isinstance(payloads[0], tuple):
+        import jax.numpy as jnp
+
+        return tuple(jnp.concatenate([pl[i] for pl in payloads])
+                     for i in range(len(payloads[0])))
+    return np.concatenate([np.asarray(pl) for pl in payloads])
 
 
 def accel_out_names(outbase: str, zmax: float, wmax: float = 0.0
@@ -400,6 +415,21 @@ def sweep_accel_stream(
     n_failed = 0
     fallbacks = 0
 
+    # round 24: with the batch broker on, every batched search below
+    # SUBMITS to the fleet coalescing plane instead of dispatching
+    # directly — same-key batches from concurrent observations fuse
+    # into one device dispatch (parallel/broker.py, byte-identical
+    # demux). PYPULSAR_TPU_BROKER=0 leaves bk None and every dispatch
+    # takes exactly the pre-round-24 path.
+    bk = broker_mod.get_broker() if broker_mod.enabled() else None
+    bk_party = ("accel", broker_mod.device_scope(dev_ids))
+    bk_tag = os.path.basename(outbase) or outbase
+    # fused batches stop growing at one full-HBM dispatch (~24 B/sample
+    # per prepped spectrum); accel_search_batch still self-slices, so
+    # the cap bounds host concat cost, not correctness
+    bk_budget = max(int(unit),
+                    ndm * max(1, int(hbm) // (24 * max(int(T), 1))))
+
     for d0 in range(0, D, slice_dms):
         dsl = slice(d0, min(d0 + slice_dms, D))
         sl_todo = [i for i in todo if dsl.start <= i < dsl.stop]
@@ -513,6 +543,45 @@ def sweep_accel_stream(
                                      what="accel.batch")
             return [c for _, _, cands in parts for c in cands]
 
+        def _bk_key(pl):
+            """Exact coalescing key for one submitted batch: per-row
+            plane geometry + the science config + (inside dispatch_key)
+            device scope and the accel knob digest. Two observations
+            fuse only when the fused rows would hit the same compiled
+            executable family as their solo dispatches."""
+            if isinstance(pl, tuple):
+                geom = ("planes",) + tuple(
+                    (tuple(int(s) for s in p.shape[1:]), str(p.dtype))
+                    for p in pl)
+            else:
+                arr = np.asarray(pl)
+                geom = ("hostfft", tuple(int(s) for s in arr.shape[1:]),
+                        str(arr.dtype))
+            return broker_mod.dispatch_key(
+                "accel",
+                (int(T), repr(float(T_sec)), int(ndm)) + geom,
+                (repr(config),), dev_ids)
+
+        def _bk_dispatch(pl, n):
+            """The broker's fused (or solo) dispatch: re-bucket the
+            fused row count (members are bucket-padded individually, so
+            a solo batch is already on the ladder and pads zero rows —
+            byte- and dispatch-identical to the un-brokered call) and
+            run the same OOM-halving search the direct path runs."""
+            m = bucket_rows(n, multiple=ndm)
+            if m > n:
+                note_bucket_pad(n, m)
+                if isinstance(pl, tuple):
+                    import jax.numpy as jnp
+
+                    pl = tuple(jnp.concatenate(
+                        [p, jnp.repeat(p[-1:], m - n, axis=0)])
+                        for p in pl)
+                else:
+                    pl = np.concatenate(
+                        [pl, np.repeat(pl[-1:], m - n, axis=0)])
+            return search_halved(pl, m)[:n]
+
         for idxs, payload, prep_err in source:
             try:
                 if prep_err is not None:
@@ -528,7 +597,16 @@ def sweep_accel_stream(
                     # padded replicas (mesh batches round up to a device
                     # multiple) searched then DROPPED: zip(idxs, ...)
                     # below stops at the real trials
-                    all_cands = search_halved(payload, n_padded)
+                    if bk is None:
+                        all_cands = search_halved(payload, n_padded)
+                    else:
+                        all_cands = bk.submit(
+                            _bk_key(payload), bk_party, payload,
+                            n_padded, tag=bk_tag,
+                            concat=_broker_concat_rows,
+                            dispatch=_bk_dispatch,
+                            demux=lambda out, lo, hi: out[lo:hi],
+                            budget_rows=bk_budget)
             except Exception as e:  # noqa: BLE001 - poison-spectrum
                 if health.no_degrade(e):
                     # watchdog interrupts, chip-indicting and injected

@@ -1,0 +1,464 @@
+"""Cross-observation batch broker (round 24): fleet-level dynamic
+batching of same-geometry device dispatches.
+
+The fleet scheduler gives every observation its own device lease, so a
+fleet of SMALL same-geometry observations (the PALFA-style archival
+regime) dispatches many under-filled accel/fold batches back to back —
+the MXU idles between them while each obs waits on its own host prep.
+This module is the coalescing plane that fixes that: stages *submit*
+typed work units instead of dispatching directly, the broker merges
+same-key units from different observations into ONE fused device
+dispatch, and demuxes the result rows back per submitter.
+
+Dataflow::
+
+    obs A stage ──submit(key, payload_A)──┐
+    obs B stage ──submit(key, payload_B)──┤ coalesce (≤ wait window,
+    obs C stage ──submit(key, payload_C)──┘  ≤ row budget)
+                                  │
+                        leader: concat → ONE device dispatch
+                                  │
+                        demux rows → A, B, C (per-obs results)
+
+Correctness contract — byte identity:
+
+- Units coalesce only under an EXACT key match: (stage, geometry,
+  science config, device scope, ``knobs.config_digest(stage)``) — the
+  same config digest the compile plane keys its AOT executables with,
+  so a fused shape can only ever hit an executable the un-fused shapes
+  would have compiled under identical knobs.
+- The brokered axes are the exact-parity batch axes the repo already
+  pins: per-spectrum accel results and per-candidate fold rows are
+  independent (the ``halving_dispatch`` contract), so
+  ``dispatch(concat(a, b))[i] == dispatch(a)[i]`` bit-for-bit on the
+  CPU backend, and demuxed artifacts are byte-identical to the
+  un-brokered path.
+- A batch that closes with ONE member dispatches that member's payload
+  untouched — identical to the un-brokered call.
+
+Latency contract: a leader holds an open batch at most
+``PYPULSAR_TPU_BROKER_WAIT_MS`` (deadline-aware: an SLO burn or daemon
+shed reported via :func:`note_pressure` collapses the window to zero
+for ``PYPULSAR_TPU_BROKER_SLO_HOLD_S`` — throughput packing must never
+cost a burning deadline another wait window). A batch also closes
+early when every registered party (:meth:`BatchBroker.party`) has a
+member aboard, or when another row would exceed the row budget.
+
+Resilience contract: a batchmate's failure must not poison the fused
+dispatch. Before fusing, each member passes its own
+``broker.member.<tag>`` fault gate — a member poisoned there fails
+ALONE (its obs's retry/quarantine machinery owns the error) and the
+remaining members still fuse. If the fused dispatch itself fails, the
+leader falls back to per-unit dispatches (``broker.unit_retry``), so
+one member's poison batch degrades batchmates to their un-brokered
+dispatch, never to failure. ``BaseException`` (injected kill, watchdog
+interrupt) is delivered to every waiting follower before the leader
+re-raises — a kill never strands a batchmate.
+
+``PYPULSAR_TPU_BROKER=0`` disables the plane entirely: submitters take
+their pre-round-24 dispatch paths untouched (byte- and
+dispatch-identical to the un-brokered tree).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.resilience import health as health_mod
+from pypulsar_tpu.resilience import locks as locks_mod
+from pypulsar_tpu.tune import knobs
+
+__all__ = [
+    "BatchBroker",
+    "device_scope",
+    "dispatch_key",
+    "enabled",
+    "get_broker",
+    "note_pressure",
+    "reset",
+]
+
+# the literal trip() sites this module defines (psrlint PL005 verifies
+# every broker fault point a test arms resolves to one of these, or to
+# the dynamic ``broker.member.<tag>`` prefix below)
+FAULT_POINTS = ("broker.submit", "broker.dispatch", "broker.demux",
+                "broker.unit_retry")
+
+
+def enabled() -> bool:
+    """Whether the coalescing plane is on (``PYPULSAR_TPU_BROKER=0``
+    restores the pre-round-24 per-obs dispatch tree exactly)."""
+    return str(knobs.env_str("PYPULSAR_TPU_BROKER")) not in ("0", "off")
+
+
+def lane_width() -> int:
+    """Scheduler batch-lane width (1 = exclusive leases only)."""
+    if not enabled():
+        return 1
+    return max(1, int(knobs.env_int("PYPULSAR_TPU_BROKER_LANE")))
+
+
+def device_scope(dev_ids=None) -> Tuple:
+    """The device-placement component of a dispatch key: two units may
+    fuse only when they would run on the SAME chips. Batch-lane mates
+    re-enter the leader's ``device_lease`` in their own threads, so
+    their thread-local lease (and hence this scope) matches the
+    leader's; fleet-parallel stages pinned to DIFFERENT chips key
+    apart and never fuse. An unpinned host run keys as ``("host",)``."""
+    if dev_ids:
+        return ("dev",) + tuple(int(i) for i in dev_ids)
+    try:
+        from pypulsar_tpu.parallel.mesh import current_lease
+
+        lease = current_lease()
+        if lease:
+            return ("pin",) + tuple(str(d) for d in lease)
+    except Exception:  # noqa: BLE001 - jax-less runs key as host
+        pass
+    return ("host",)
+
+
+def dispatch_key(stage: str, geometry: Tuple, config: Tuple,
+                 dev_ids=None) -> Tuple:
+    """Build a coalescing key. ``geometry`` carries the exact array
+    shapes/dtypes of the unit, ``config`` the science parameters; the
+    tuned-knob digest (the compile plane's executable key component)
+    and the device scope are appended here so no submitter can forget
+    them."""
+    return (stage, geometry, config, device_scope(dev_ids),
+            knobs.config_digest(stage))
+
+
+class _Member:
+    """One submitted unit riding a batch."""
+
+    __slots__ = ("payload", "n_rows", "tag", "event", "result", "error",
+                 "delivered")
+
+    def __init__(self, payload, n_rows: int, tag: str):
+        self.payload = payload
+        self.n_rows = int(n_rows)
+        self.tag = tag
+        self.event = locks_mod.TrackedEvent("broker.member")
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.delivered = False
+
+
+class _Batch:
+    """An open coalescing window for one key."""
+
+    __slots__ = ("key", "party_key", "members", "budget_rows", "closed")
+
+    def __init__(self, key, party_key, budget_rows: Optional[int]):
+        self.key = key
+        self.party_key = party_key
+        self.members: List[_Member] = []
+        self.budget_rows = budget_rows
+        self.closed = False
+
+    def total_rows(self) -> int:
+        return sum(m.n_rows for m in self.members)
+
+
+class BatchBroker:
+    """Process-global coalescing plane (see module docstring).
+
+    Leader-based: the FIRST submitter of a key opens the batch and
+    becomes its leader — it waits out the coalescing window, fuses,
+    dispatches ONCE, and demuxes; followers park on their member event
+    until the leader delivers a result or an error. All waiting happens
+    with the broker lock released (the lock only guards the open-batch
+    table), and the device dispatch itself runs with no broker state
+    held — the broker adds queueing, never lock scope, around kernels.
+    """
+
+    def __init__(self):
+        self._lock = locks_mod.TrackedLock("parallel.broker")
+        self._cv = locks_mod.TrackedCondition("parallel.broker",
+                                              self._lock)
+        self._open: Dict[Tuple, _Batch] = {}
+        self._parties: Dict[Tuple, int] = {}
+        self._pressure_until = 0.0
+        self._pressure_src = ""
+
+    # -- parties -----------------------------------------------------------
+
+    def party(self, party_key: Tuple):
+        """Context manager registering one ACTIVE participant for
+        ``party_key`` (a coarse stage+scope key). The leader's early
+        close fires when every registered party has a member aboard —
+        a lone party never waits at all, and a party exiting (stage
+        done or crashed) wakes waiting leaders so a finished batchmate
+        cannot stall the fleet for the full window."""
+        return _PartyCtx(self, party_key)
+
+    def _party_enter(self, party_key: Tuple) -> None:
+        with self._cv:
+            self._parties[party_key] = self._parties.get(party_key, 0) + 1
+            self._cv.notify_all()
+
+    def _party_exit(self, party_key: Tuple) -> None:
+        with self._cv:
+            n = self._parties.get(party_key, 1) - 1
+            if n <= 0:
+                self._parties.pop(party_key, None)
+            else:
+                self._parties[party_key] = n
+            self._cv.notify_all()
+
+    def parties(self, party_key: Tuple) -> int:
+        with self._lock:
+            return self._parties.get(party_key, 0)
+
+    # -- SLO pressure ------------------------------------------------------
+
+    def note_pressure(self, source: str = "") -> None:
+        """An SLO burn / daemon shed happened: stop holding batches
+        open for ``PYPULSAR_TPU_BROKER_SLO_HOLD_S`` seconds — under
+        deadline pressure a unit dispatches the moment it arrives
+        (coalescing still happens when mates are ALREADY waiting, the
+        free case)."""
+        hold = float(knobs.env_float("PYPULSAR_TPU_BROKER_SLO_HOLD_S"))
+        if hold <= 0:
+            return
+        with self._cv:
+            self._pressure_until = time.monotonic() + hold
+            self._pressure_src = source
+            self._cv.notify_all()
+        telemetry.counter("broker.pressure_events")
+        telemetry.event("broker.pressure", source=source,
+                        hold_s=round(hold, 3))
+
+    def _window_s(self) -> float:
+        # callers hold self._lock
+        if time.monotonic() < self._pressure_until:
+            return 0.0
+        return max(0.0,
+                   float(knobs.env_float("PYPULSAR_TPU_BROKER_WAIT_MS"))
+                   / 1e3)
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, key: Tuple, party_key: Tuple, payload, n_rows: int,
+               *, tag: str,
+               concat: Callable[[List[Any]], Any],
+               dispatch: Callable[[Any, int], Any],
+               demux: Callable[[Any, int, int], Any],
+               budget_rows: Optional[int] = None):
+        """Submit one work unit; returns this unit's result (what
+        ``demux(fused_result, lo, lo + n_rows)`` yields), or raises the
+        unit's error. ``concat`` fuses member payloads in member order;
+        ``dispatch(fused_payload, total_rows)`` runs the device work
+        ONCE; ``demux`` slices the member's rows back out. All three
+        are stage-provided so the broker stays payload-agnostic."""
+        faultinject.trip("broker.submit")
+        telemetry.counter("broker.submissions")
+        me = _Member(payload, n_rows, tag)
+        with self._cv:
+            batch = self._open.get(key)
+            if batch is not None and not batch.closed:
+                cap = batch.budget_rows
+                if budget_rows is not None:
+                    cap = (budget_rows if cap is None
+                           else min(cap, budget_rows))
+                if (cap is not None
+                        and batch.total_rows() + me.n_rows > cap):
+                    # this unit would bust the fused HBM/RAM budget:
+                    # close the open batch to new members and open a
+                    # fresh one with this unit as leader
+                    batch.closed = True
+                    self._cv.notify_all()
+                    batch = None
+                else:
+                    batch.budget_rows = cap
+                    batch.members.append(me)
+                    self._cv.notify_all()
+                    leader = False
+            if batch is None or batch.closed:
+                batch = _Batch(key, party_key, budget_rows)
+                batch.members.append(me)
+                self._open[key] = batch
+                leader = True
+        if not leader:
+            me.event.wait()
+            if me.error is not None:
+                raise me.error
+            return me.result
+        return self._lead(batch, me, concat, dispatch, demux)
+
+    # -- the leader --------------------------------------------------------
+
+    def _lead(self, batch: _Batch, me: _Member, concat, dispatch, demux):
+        try:
+            with telemetry.span("broker.wait", key=str(batch.key[0])):
+                with self._cv:
+                    deadline = time.monotonic() + self._window_s()
+                    while not batch.closed:
+                        # zero registered parties (standalone CLI, no
+                        # scheduler lane) dispatches immediately: the
+                        # broker only ever WAITS when the scheduler
+                        # declared concurrent batchmates
+                        want = self._parties.get(batch.party_key, 0)
+                        if want <= len(batch.members):
+                            break  # every active party is aboard
+                        # pressure arriving MID-wait collapses the
+                        # window too, not just windows opened after it
+                        now = time.monotonic()
+                        left = min(deadline, now + self._window_s()) - now
+                        if left <= 0:
+                            break
+                        self._cv.wait(timeout=min(left, 0.05))
+                    batch.closed = True
+                    if self._open.get(batch.key) is batch:
+                        del self._open[batch.key]
+                    members = list(batch.members)
+            self._dispatch(batch, members, concat, dispatch, demux)
+        except BaseException as e:  # noqa: BLE001 - kill/interrupt path
+            # the leader is dying (injected kill, watchdog interrupt,
+            # fatal unwind): no follower may be left parked forever
+            with self._cv:
+                batch.closed = True
+                if self._open.get(batch.key) is batch:
+                    del self._open[batch.key]
+            for m in batch.members:
+                if m is not me and not m.delivered:
+                    m.error = e
+                    m.delivered = True
+                    m.event.set()
+            raise
+        if me.error is not None:
+            raise me.error
+        return me.result
+
+    def _dispatch(self, batch: _Batch, members: List[_Member],
+                  concat, dispatch, demux) -> None:
+        # per-member fault gate BEFORE fusing: a poisoned member fails
+        # alone (its obs's retry machinery owns the error) and never
+        # rides the fused dispatch
+        live: List[_Member] = []
+        for m in members:
+            try:
+                faultinject.trip(f"broker.member.{m.tag}")
+            except Exception as e:  # noqa: BLE001 - member-scoped fault
+                telemetry.counter("broker.member_faults")
+                telemetry.event("broker.member_fault", tag=m.tag,
+                                error=type(e).__name__)
+                self._deliver(m, error=e)
+                continue
+            live.append(m)
+        if not live:
+            return
+        total = sum(m.n_rows for m in live)
+        telemetry.counter("broker.dispatches")
+        telemetry.counter("broker.fused_rows", total)
+        telemetry.gauge("broker.coalesce_factor", float(len(live)))
+        if len(live) > 1:
+            telemetry.counter("broker.coalesced_units", len(live))
+        telemetry.event("broker.dispatch", stage=str(batch.key[0]),
+                        members=len(live), rows=total,
+                        tags=[m.tag for m in live])
+        try:
+            faultinject.trip("broker.dispatch")
+            fused = (live[0].payload if len(live) == 1
+                     else concat([m.payload for m in live]))
+            out = dispatch(fused, total)
+        except Exception as e:  # noqa: BLE001 - fused fault isolation
+            if health_mod.must_propagate(e):
+                # a chip-indicting fault (or watchdog verdict) is about
+                # the DEVICE, not any one member: retrying units in
+                # place would hide the strike from device-health
+                # accounting. Every member gets the error; each obs's
+                # scheduler-level retry owns eviction + re-dispatch.
+                telemetry.counter("broker.fused_faults")
+                telemetry.event("broker.fused_fault", members=len(live),
+                                error=type(e).__name__, propagated=True)
+                for m in live:
+                    self._deliver(m, error=e)
+                return
+            # the FUSED dispatch failed: no member may inherit a
+            # batchmate's error — every unit retries alone, exactly the
+            # dispatch it would have run un-brokered, and only units
+            # whose OWN dispatch fails see an error
+            telemetry.counter("broker.fused_faults")
+            telemetry.event("broker.fused_fault", members=len(live),
+                            error=type(e).__name__)
+            for m in live:
+                try:
+                    faultinject.trip("broker.unit_retry")
+                    telemetry.counter("broker.unit_retries")
+                    res = demux(dispatch(m.payload, m.n_rows),
+                                0, m.n_rows)
+                except Exception as e1:  # noqa: BLE001 - unit-scoped
+                    self._deliver(m, error=e1)
+                else:
+                    self._deliver(m, result=res)
+            return
+        lo = 0
+        for m in live:
+            try:
+                # inside the per-member try: an injected demux fault
+                # fails ONE member's delivery, never its batchmates'
+                faultinject.trip("broker.demux")
+                res = demux(out, lo, lo + m.n_rows)
+            except Exception as e:  # noqa: BLE001 - slice error
+                self._deliver(m, error=e)
+            else:
+                self._deliver(m, result=res)
+            lo += m.n_rows
+
+    @staticmethod
+    def _deliver(m: _Member, result=None,
+                 error: Optional[BaseException] = None) -> None:
+        m.result = result
+        m.error = error
+        m.delivered = True
+        m.event.set()
+
+
+class _PartyCtx:
+    def __init__(self, broker: "BatchBroker", party_key: Tuple):
+        self._b = broker
+        self._k = party_key
+
+    def __enter__(self):
+        self._b._party_enter(self._k)
+        return self._b
+
+    def __exit__(self, *exc):
+        self._b._party_exit(self._k)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# process-global plane
+# ---------------------------------------------------------------------------
+
+_GLOBAL: Optional[BatchBroker] = None
+_GLOBAL_LOCK = threading.Lock()  # import-time leaf; adopted by lockdep
+
+
+def get_broker() -> BatchBroker:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = BatchBroker()
+        return _GLOBAL
+
+
+def note_pressure(source: str = "") -> None:
+    """Module-level convenience: scheduler SLO-burn and daemon shed
+    sites report latency pressure here without holding a broker ref."""
+    if enabled():
+        get_broker().note_pressure(source)
+
+
+def reset() -> None:
+    """Drop the global plane (tests; never mid-fleet)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = None

@@ -54,6 +54,21 @@ from pypulsar_tpu.resilience.journal import RunJournal
 from pypulsar_tpu.resilience.retry import halving_dispatch
 from pypulsar_tpu.tune import knobs
 
+
+def _broker_concat_fold(payloads):
+    """Fuse fold payloads ``(series[T], bin_idx[K, T])`` from several
+    observations into the multi-series form ``(stack[G, T],
+    series_idx[sum K], bin_idx[sum K, T])`` — candidate k keeps a
+    pointer to its own observation's series, so the fused kernel folds
+    each candidate against its own data (fold.engine.fold_parts_multi,
+    row-bitwise-identical to the per-obs kernel on CPU)."""
+    stack = np.stack([np.asarray(p[0]) for p in payloads])
+    sidx = np.concatenate(
+        [np.full(np.shape(p[1])[0], g, np.int32)
+         for g, p in enumerate(payloads)])
+    bins = np.concatenate([np.asarray(p[1]) for p in payloads])
+    return stack, sidx, bins
+
 __all__ = [
     "FoldCandidate",
     "cands_from_accelcands",
@@ -409,11 +424,13 @@ def fold_pipeline(
         drift_to_p_pd,
         fold_parts_batch,
         fold_parts_batch_numpy,
+        fold_parts_multi,
         refine_chi2,
         refine_chi2_numpy,
         refine_drift_grid,
     )
     from pypulsar_tpu.io.prestopfd import make_pfd
+    from pypulsar_tpu.parallel import broker as broker_mod
 
     # round-17 auto-tuning consult: install this geometry's cached
     # throughput config (fold stream/binidx budgets) before the DM
@@ -544,6 +561,15 @@ def fold_pipeline(
     dl, dq = refine_drift_grid(ntrial_p, ntrial_pd, max_drift)
     offsets = drift_offsets(dl, dq, npart)
 
+    # round 24: candidate groups submit to the cross-observation batch
+    # broker — same-geometry groups from concurrent observations fuse
+    # into ONE multi-series fold dispatch (parallel/broker.py), demuxed
+    # per obs. PYPULSAR_TPU_BROKER=0 leaves bk None: every group below
+    # dispatches exactly as before round 24.
+    bk = broker_mod.get_broker() if broker_mod.enabled() else None
+    bk_party = ("fold", broker_mod.device_scope())
+    bk_tag = os.path.basename(outbase) or outbase
+
     if prefetch_depth > 0:
         from pypulsar_tpu.parallel.prefetch import prefetch
 
@@ -593,39 +619,106 @@ def fold_pipeline(
 
             with telemetry.span("foldpipe_group", aggregate=False, dm=dm,
                                 n_cands=K):
+                telemetry.counter("fold.group_dispatches")
                 try:
-                    def run(lo, hi):
-                        faultinject.trip("fold.batch_dispatch")
-                        bi = bin_idx[lo:hi]
-                        n = hi - lo
-                        padded = bucket_rows(n)
-                        if padded > n:
-                            # candidate batches land on the compile
-                            # plane's bucket ladder by replicating the
-                            # last candidate's bin indices; the padded
-                            # folds are sliced off below, so archive
-                            # bytes never change
-                            note_bucket_pad(n, padded)
-                            bi = np.concatenate(
-                                [bi, np.repeat(bi[-1:], padded - n,
-                                               axis=0)])
-                        # counts stay on device: stats[...,0] is part_len by
-                        # construction (the serial fold_partitions contract),
-                        # so pulling the [K, npart, nbins] int cube would be
-                        # pure transfer waste
-                        profs_dev, _ = fold_parts_batch(
-                            series, bi, nbins, npart)
-                        outs = ((profs_dev, refine_chi2(profs_dev, offsets))
-                                if refine else (profs_dev,))
-                        from pypulsar_tpu.ops.transfer import pull_host
+                    def run_on(series_m, bin_all):
+                        """The EXACT pre-round-24 halving unit (single
+                        shared series), parameterized on the payload so
+                        the broker's solo and per-unit-retry paths run
+                        the identical dispatch."""
+                        def run(lo, hi):
+                            faultinject.trip("fold.batch_dispatch")
+                            bi = bin_all[lo:hi]
+                            n = hi - lo
+                            padded = bucket_rows(n)
+                            if padded > n:
+                                # candidate batches land on the compile
+                                # plane's bucket ladder by replicating
+                                # the last candidate's bin indices; the
+                                # padded folds are sliced off below, so
+                                # archive bytes never change
+                                note_bucket_pad(n, padded)
+                                bi = np.concatenate(
+                                    [bi, np.repeat(bi[-1:], padded - n,
+                                                   axis=0)])
+                            # counts stay on device: stats[...,0] is
+                            # part_len by construction (the serial
+                            # fold_partitions contract), so pulling the
+                            # [K, npart, nbins] int cube would be pure
+                            # transfer waste
+                            profs_dev, _ = fold_parts_batch(
+                                series_m, bi, nbins, npart)
+                            outs = ((profs_dev,
+                                     refine_chi2(profs_dev, offsets))
+                                    if refine else (profs_dev,))
+                            from pypulsar_tpu.ops.transfer import pull_host
 
-                        return tuple(np.asarray(x)[:n]
-                                     for x in pull_host(*outs))
+                            return tuple(np.asarray(x)[:n]
+                                         for x in pull_host(*outs))
+                        return run
 
-                    parts = halving_dispatch(run, K, what="fold.batch")
-                    profs = np.concatenate([p[2][0] for p in parts])
-                    chi2 = (np.concatenate([p[2][1] for p in parts])
-                            if refine else None)
+                    def run_multi(stack, sidx, bin_all):
+                        """Fused cross-observation unit: candidate k
+                        folds its OWN ``stack[sidx[k]]`` series via the
+                        multi-series kernel (row-bitwise-identical to
+                        run_on, tests/test_broker.py)."""
+                        def run(lo, hi):
+                            faultinject.trip("fold.batch_dispatch")
+                            bi = bin_all[lo:hi]
+                            si = sidx[lo:hi]
+                            n = hi - lo
+                            padded = bucket_rows(n)
+                            if padded > n:
+                                note_bucket_pad(n, padded)
+                                bi = np.concatenate(
+                                    [bi, np.repeat(bi[-1:], padded - n,
+                                                   axis=0)])
+                                si = np.concatenate(
+                                    [si, np.repeat(si[-1:], padded - n)])
+                            profs_dev, _ = fold_parts_multi(
+                                stack, si, bi, nbins, npart)
+                            outs = ((profs_dev,
+                                     refine_chi2(profs_dev, offsets))
+                                    if refine else (profs_dev,))
+                            from pypulsar_tpu.ops.transfer import pull_host
+
+                            return tuple(np.asarray(x)[:n]
+                                         for x in pull_host(*outs))
+                        return run
+
+                    def _join(parts):
+                        p = np.concatenate([x[2][0] for x in parts])
+                        c = (np.concatenate([x[2][1] for x in parts])
+                             if refine else None)
+                        return p, c
+
+                    if bk is None:
+                        profs, chi2 = _join(halving_dispatch(
+                            run_on(series, bin_idx), K,
+                            what="fold.batch"))
+                    else:
+                        def _bk_dispatch(pl, n):
+                            run = (run_on(pl[0], pl[1]) if len(pl) == 2
+                                   else run_multi(*pl))
+                            return _join(halving_dispatch(
+                                run, n, what="fold.batch"))
+
+                        key = broker_mod.dispatch_key(
+                            "fold",
+                            (int(T), int(nbins), int(npart),
+                             bool(refine), int(ntrial_p),
+                             int(ntrial_pd), repr(float(max_drift)),
+                             str(np.asarray(series).dtype)),
+                            ())
+                        profs, chi2 = bk.submit(
+                            key, bk_party, (series, bin_idx), K,
+                            tag=bk_tag, concat=_broker_concat_fold,
+                            dispatch=_bk_dispatch,
+                            demux=lambda out, lo, hi: (
+                                out[0][lo:hi],
+                                out[1][lo:hi] if refine else None),
+                            budget_rows=max(K, binidx_budget
+                                            // (4 * max(T, 1))))
                 except Exception as e:  # noqa: BLE001 - degrade, don't die
                     if health.no_degrade(e):
                         # a watchdog interrupt, chip-indicting or

@@ -67,6 +67,7 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.parallel import broker as broker_mod
 from pypulsar_tpu.resilience import faultinject
 from pypulsar_tpu.resilience import health as health_mod
 from pypulsar_tpu.resilience import locks as locks_mod
@@ -517,6 +518,10 @@ class SurveyDaemon:
                 # this point may not leave the queue over its bound
                 telemetry.counter("daemon.shed_faults")
             self._book(victim.tenant).shed += 1
+            # overload shedding means the fleet is behind: collapse the
+            # batch broker's coalesce window so in-flight work stops
+            # trading latency for batch width (round 24)
+            broker_mod.note_pressure("daemon.shed")
             telemetry.counter("daemon.shed_total")
             telemetry.event("daemon.shed", tenant=victim.tenant,
                             reason=reason, queue_depth=depth,

@@ -103,6 +103,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from pypulsar_tpu.obs import flightrec, telemetry, tracing
+from pypulsar_tpu.parallel import broker as broker_mod
 from pypulsar_tpu.resilience import faultinject
 from pypulsar_tpu.resilience import health as health_mod
 from pypulsar_tpu.resilience import locks as locks_mod
@@ -140,6 +141,11 @@ _UNSET = object()  # _n_jax_devices cache sentinel (None = no backend)
 
 _PENDING, _QUEUED, _RUNNING, _DONE, _QUARANTINED, _REMOTE = range(6)
 
+# Stages whose device work submits typed units to the batch broker
+# (round 24), and the broker party kind each stage registers as.  Only
+# these stages are eligible for batch-lane claims.
+_BROKER_UNITS = {"sweep": "accel", "fold": "fold"}
+
 
 @dataclass
 class FleetResult:
@@ -170,7 +176,7 @@ class FleetResult:
 class _Task:
     __slots__ = ("obs_i", "stage", "state", "attempts", "seq",
                  "last_dev_ids", "last_real_dev_ids", "last_error",
-                 "done_recorded")
+                 "done_recorded", "lane_seq")
 
     def __init__(self, obs_i: int, stage: StageSpec):
         self.obs_i = obs_i
@@ -185,6 +191,11 @@ class _Task:
         # watchdog interrupt landing after that point must finish the
         # task, not retry it
         self.done_recorded = False
+        # queue seq this task was batch-lane-claimed at (round 24): the
+        # lane runs the task out-of-band, so its original queue entry
+        # goes stale; a worker popping THAT seq consumes it silently. A
+        # retry re-enqueue gets a new seq and runs normally.
+        self.lane_seq: Optional[int] = None
 
 
 class FleetScheduler:
@@ -1234,6 +1245,10 @@ class FleetScheduler:
                             stage=stage.name,
                             budget_s=round(float(budget), 3),
                             frac=round(dur / float(budget), 3))
+            # SLO burn gates batching: collapse the broker's coalesce
+            # window so latency-critical work dispatches immediately
+            # instead of widening batches (round 24)
+            broker_mod.note_pressure(f"slo_burn:{stage.name}")
         trace = self._traces[task.obs_i]
         if trace is not None:
             tr_attrs = {"outputs": len(outputs)}
@@ -1571,6 +1586,7 @@ class FleetScheduler:
                 task.last_real_dev_ids = [
                     int(getattr(d, "id", i))
                     for i, d in zip(ids, gang_devs)]
+            mates = self._claim_lane_mates(task, k)
             if gang_devs is not None:
                 import jax
 
@@ -1578,11 +1594,116 @@ class FleetScheduler:
 
                 with jax.default_device(gang_devs[0]), \
                         device_lease(gang_devs):
-                    self._execute(task, gang=k, dev_ids=ids)
+                    self._run_lane(task, mates, k, ids, pinned=True)
             else:
-                self._execute(task, gang=k)
+                self._run_lane(task, mates, k, ids, pinned=False)
         finally:
             self._release_devices(ids)
+
+    def _claim_lane_mates(self, task: _Task, k: int) -> List[_Task]:
+        """Round 24 batch lanes.  A single-chip lease taken for a
+        broker-submitting stage widens into a *batch lane*: it claims up
+        to ``PYPULSAR_TPU_BROKER_LANE - 1`` queued same-stage tasks and
+        runs them concurrently UNDER THIS LEASE, so their device
+        dispatches meet in the batch broker and fuse instead of
+        serializing on separate exclusive leases.  Claims are skipped
+        for gangs (k > 1), non-broker stages, when the broker/lanes are
+        off, and whenever the resource guard is refusing launches."""
+        if k != 1 or task.stage.name not in _BROKER_UNITS:
+            return []
+        if not broker_mod.enabled() or broker_mod.lane_width() <= 1:
+            return []
+        if self._guard.admit() is not None:
+            return []  # under resource pressure: no extra tenants
+        width = broker_mod.lane_width()
+        mates: List[_Task] = []
+        with self._lock:
+            if self._stop:
+                return []
+            for t in self._tasks.values():
+                if len(mates) >= width - 1:
+                    break
+                if t is task or t.state != _QUEUED:
+                    continue
+                if t.stage.name != task.stage.name:
+                    continue
+                if self.plane is not None and t.obs_i not in self._owned:
+                    continue
+                # claim: run out of band, leave a stale queue entry
+                # that _worker_step consumes by seq match
+                t.state = _RUNNING
+                t.lane_seq = t.seq
+                mates.append(t)
+        return mates
+
+    def _run_lane(self, task: _Task, mates: List[_Task], k: int,
+                  ids: List[int], *, pinned: bool) -> None:
+        """Execute the leader task, plus any lane mates in sibling
+        threads that re-enter the leader's device pin + lease.  All
+        lane members register as broker parties for the stage's unit
+        kind *before* any of them runs, so the first submitter's batch
+        window knows how many peers to wait for; each member withdraws
+        its party as it finishes so trailing uneven batches never stall
+        on departed peers."""
+        dev_ids = ids if pinned else None
+        if not mates:
+            self._execute(task, gang=k, dev_ids=dev_ids)
+            return
+        # scope must be computed inside the pinned context so leader
+        # and mates (which re-enter the same lease) key identically
+        party = (_BROKER_UNITS[task.stage.name], broker_mod.device_scope())
+        bk = broker_mod.get_broker()
+        names = [self.obs[t.obs_i].name for t in mates]
+        telemetry.counter("broker.lane_grants", len(mates))
+        telemetry.event("survey.lane_decision", stage=task.stage.name,
+                        leader=self.obs[task.obs_i].name, mates=names,
+                        width=1 + len(mates), chips=ids)
+        # pre-register every member (leader included) before anything
+        # executes: closes the race where the leader submits before a
+        # mate thread has spun up and the batch dispatches solo
+        for _ in range(1 + len(mates)):
+            bk._party_enter(party)
+
+        def _mate_body(t: _Task) -> None:
+            try:
+                try:
+                    if pinned:
+                        import jax
+
+                        from pypulsar_tpu.parallel.mesh import device_lease
+
+                        gang_devs = self._jax_gang(ids)
+                        with jax.default_device(gang_devs[0]), \
+                                device_lease(gang_devs):
+                            self._execute(t, gang=k, dev_ids=dev_ids)
+                    else:
+                        self._execute(t, gang=k)
+                finally:
+                    bk._party_exit(party)
+            except Exception as e:  # stage failure: normal retry path
+                self._handle_failure(t, e)
+            except BaseException as e:  # injected kill etc: fleet-fatal
+                with self._cv:
+                    if self._fatal is None:
+                        self._fatal = e
+                    self._stop = True
+                    self._cv.notify_all()
+
+        threads = []
+        for t in mates:
+            th = threading.Thread(
+                target=_mate_body, args=(t,), daemon=True,
+                name=f"lane-{self.obs[t.obs_i].name}-{t.stage.name}")
+            th.start()
+            threads.append(th)
+        try:
+            try:
+                self._execute(task, gang=k, dev_ids=dev_ids)
+            finally:
+                bk._party_exit(party)
+        finally:
+            for th in threads:
+                th.join()
 
     def _worker(self, q: "queue.PriorityQueue",
                 device_lane: bool = False) -> None:
@@ -1604,7 +1725,7 @@ class FleetScheduler:
         """One take-a-task-and-run-it iteration; raises StopIteration
         to shut the worker down."""
         try:
-            _, _, task = q.get(timeout=0.05)
+            _, seq, task = q.get(timeout=0.05)
         except queue.Empty:
             if self._stop:
                 raise StopIteration
@@ -1616,6 +1737,15 @@ class FleetScheduler:
         with self._lock:
             if self._stop and self._fatal is not None:
                 return  # fleet is unwinding: drop queued work
+            if task.seq != seq:
+                # the task was re-enqueued since this entry was put
+                # (lane-claimed then retried): a younger entry owns it
+                return
+            if task.lane_seq == seq:
+                # a batch lane ran (or is running) this task out of
+                # band: this is its stale queue entry — consume it
+                task.lane_seq = None
+                return
             if task.state in (_QUARANTINED, _REMOTE):
                 return  # cancelled / finished remotely while queued
             if self.plane is not None \

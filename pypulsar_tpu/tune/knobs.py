@@ -50,6 +50,7 @@ registration idioms PL004 recognizes).
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 from dataclasses import dataclass
@@ -60,6 +61,7 @@ __all__ = [
     "all_knobs",
     "apply_tuned",
     "clear_tuned",
+    "config_digest",
     "current_config",
     "env_float",
     "env_int",
@@ -291,6 +293,19 @@ def current_config(stage: Optional[str] = None) -> Dict[str, Any]:
     return {k.env: env_value(k.env) for k in all_knobs(stage)}
 
 
+def config_digest(stage: str) -> str:
+    """Digest of a stage's fully-resolved knob config (trial > env >
+    tuned > default). This is THE config component of every dispatch
+    key: the compile plane keys its AOT executables with it (round 17)
+    and the batch broker keys its coalescing queues with it (round 24),
+    so two observations coalesce only when they would have compiled the
+    very same executable."""
+    if not stage:
+        return ""
+    blob = repr(sorted(current_config(stage).items())).encode()
+    return hashlib.sha1(blob).hexdigest()[:12]
+
+
 # ---------------------------------------------------------------------------
 # declarations — one row per knob, same defaults the inline reads carried
 # ---------------------------------------------------------------------------
@@ -331,6 +346,10 @@ env_knob("PYPULSAR_TPU_ACCEL_HBM", "float", 5e9, "accel",
          help="per-device HBM bytes the batched accel search plans for")
 env_knob("PYPULSAR_TPU_ACCEL_STREAM_RAM", "float", 12e9, "accel",
          help="host RAM for the in-RAM sweep->accel handoff")
+env_knob("PYPULSAR_TPU_ACCEL_BANK_CACHE", "float", 4e9, "accel",
+         help="host RAM bytes for the cached accel template-bank "
+              "arrays (the round-4 _BANK_CACHE_LIMIT constant); a "
+              "single bank larger than this bypasses the cache")
 
 # -- specfuse ---------------------------------------------------------------
 env_knob("PYPULSAR_TPU_SPECFUSE_HBM", "float", 8e9, "specfuse",
@@ -402,6 +421,28 @@ env_knob("PYPULSAR_TPU_DAEMON_IDLE_EXIT_S", "float", 0.0, "daemon",
          help="daemon auto-drain after this many seconds with no "
               "arrivals and an empty fleet (0 = run until SIGTERM; the "
               "bounded-soak/test hook)")
+
+# -- batch broker (round 24) ------------------------------------------------
+env_knob("PYPULSAR_TPU_BROKER", "str", "1", "broker",
+         invariant=False,
+         help="0 disables the cross-observation batch broker entirely: "
+              "every stage dispatches per-obs exactly as before round "
+              "24 (byte- and dispatch-identical)")
+env_knob("PYPULSAR_TPU_BROKER_WAIT_MS", "float", 100.0, "broker",
+         domain=(25.0, 100.0, 400.0),
+         help="bounded latency window a broker leader holds an open "
+              "batch for same-key batchmates before dispatching "
+              "under-full; SLO burn collapses it to zero")
+env_knob("PYPULSAR_TPU_BROKER_LANE", "int", 4, "broker",
+         invariant=False,
+         help="batch-lane width: max same-stage observations the "
+              "scheduler co-schedules on one device lease so their "
+              "dispatches can coalesce (1 = exclusive leases only)")
+env_knob("PYPULSAR_TPU_BROKER_SLO_HOLD_S", "float", 30.0, "broker",
+         invariant=False,
+         help="seconds after an SLO burn or daemon shed during which "
+              "the broker stops waiting for batchmates (latency "
+              "pressure gates coalescing width)")
 
 # -- data integrity ---------------------------------------------------------
 env_knob("PYPULSAR_TPU_MAX_BAD_FRAC", "float", 0.5, "data",

@@ -1,0 +1,535 @@
+"""Batch-broker tests (round 24): the fleet-level coalescing plane
+must fuse same-key dispatches from concurrent observations into single
+device calls and demux rows back BYTE-IDENTICALLY to the un-brokered
+path; a batchmate's failure or injected fault must never poison its
+peers; a kill mid-coalesce must resume re-running only unvalidated
+stages; and ``PYPULSAR_TPU_BROKER=0`` must restore the pre-round-24
+dispatch tree exactly."""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.parallel import broker as broker_mod
+from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.survey.dag import SurveyConfig, build_dag
+from pypulsar_tpu.survey.scheduler import FleetScheduler
+from pypulsar_tpu.survey.state import status_rows
+
+from tests.test_accel_pipeline import _pulsar_fil
+from tests.test_survey import (
+    ARTIFACT_PATTERNS,
+    CFG_KW,
+    _artifact_bytes,
+    _fleet_obs,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    faultinject.reset()
+    broker_mod.reset()
+    yield
+    faultinject.reset()
+    broker_mod.reset()
+
+
+# ---------------------------------------------------------------------------
+# broker unit semantics (no device, numpy payloads)
+# ---------------------------------------------------------------------------
+
+
+def _np_hooks():
+    """Stage hooks for a toy 'multiply rows by 2' dispatch."""
+    calls = []
+
+    def concat(payloads):
+        return np.concatenate(payloads)
+
+    def dispatch(fused, n):
+        calls.append(int(n))
+        return np.asarray(fused) * 2.0
+
+    def demux(out, lo, hi):
+        return out[lo:hi]
+
+    return calls, concat, dispatch, demux
+
+
+KEY = ("accel", (64,), ("cfg",), ("host",), "digest")
+PARTY = ("accel", ("host",))
+
+
+def test_solo_submit_dispatches_immediately_no_wait():
+    """Zero registered parties (standalone CLI): a submission must
+    dispatch at once — the broker never adds latency outside lanes."""
+    bk = broker_mod.BatchBroker()
+    calls, concat, dispatch, demux = _np_hooks()
+    t0 = time.monotonic()
+    out = bk.submit(KEY, PARTY, np.arange(4.0), 4, tag="a",
+                    concat=concat, dispatch=dispatch, demux=demux)
+    assert time.monotonic() - t0 < 1.0
+    assert calls == [4]
+    np.testing.assert_array_equal(out, np.arange(4.0) * 2)
+
+
+def test_two_parties_fuse_one_dispatch_rows_demuxed(monkeypatch):
+    """Two registered parties submitting the same key fuse into ONE
+    dispatch; each gets exactly its own rows back, in order."""
+    monkeypatch.setenv("PYPULSAR_TPU_BROKER_WAIT_MS", "30000")
+    bk = broker_mod.BatchBroker()
+    calls, concat, dispatch, demux = _np_hooks()
+    results = {}
+
+    def worker(name, payload):
+        results[name] = bk.submit(
+            KEY, PARTY, payload, len(payload), tag=name,
+            concat=concat, dispatch=dispatch, demux=demux)
+
+    a, b = np.arange(3.0), np.arange(10.0, 15.0)
+    t0 = time.monotonic()
+    # parties registered BEFORE any submit, as the scheduler's lane
+    # does — the leader's early close waits for full attendance
+    with bk.party(PARTY), bk.party(PARTY):
+        ts = [threading.Thread(target=worker, args=("a", a)),
+              threading.Thread(target=worker, args=("b", b))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+    # early close on full party attendance: nobody waited out 30s
+    assert time.monotonic() - t0 < 10.0
+    assert calls == [8], "expected ONE fused dispatch of 3+5 rows"
+    np.testing.assert_array_equal(results["a"], a * 2)
+    np.testing.assert_array_equal(results["b"], b * 2)
+
+
+def test_row_budget_closes_batch_and_opens_fresh_one(monkeypatch):
+    """A unit that would bust the fused row budget must not ride the
+    open batch: the batch closes and the unit leads a fresh one."""
+    monkeypatch.setenv("PYPULSAR_TPU_BROKER_WAIT_MS", "200")
+    bk = broker_mod.BatchBroker()
+    calls, concat, dispatch, demux = _np_hooks()
+    results = {}
+
+    def worker(name, payload):
+        results[name] = bk.submit(
+            KEY, PARTY, payload, len(payload), tag=name,
+            concat=concat, dispatch=dispatch, demux=demux,
+            budget_rows=6)
+
+    with bk.party(PARTY), bk.party(PARTY), bk.party(PARTY):
+        ts = [threading.Thread(target=worker,
+                               args=(f"m{i}", np.arange(4.0) + 10 * i))
+              for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+    assert sorted(calls) == [4, 4, 4], calls  # 4+4 rows bust budget 6
+    for i in range(3):
+        np.testing.assert_array_equal(results[f"m{i}"],
+                                      (np.arange(4.0) + 10 * i) * 2)
+
+
+def test_slo_pressure_collapses_coalesce_window(monkeypatch):
+    """After note_pressure() a lone-member batch dispatches immediately
+    even though a second party is registered but absent — SLO burn
+    gates window widening."""
+    monkeypatch.setenv("PYPULSAR_TPU_BROKER_WAIT_MS", "30000")
+    bk = broker_mod.BatchBroker()
+    calls, concat, dispatch, demux = _np_hooks()
+    bk.note_pressure("test")
+    with bk.party(PARTY), bk.party(PARTY):  # 2 parties, 1 shows up
+        t0 = time.monotonic()
+        out = bk.submit(KEY, PARTY, np.arange(4.0), 4, tag="a",
+                        concat=concat, dispatch=dispatch, demux=demux)
+    assert time.monotonic() - t0 < 5.0, "pressure did not collapse wait"
+    assert calls == [4]
+    np.testing.assert_array_equal(out, np.arange(4.0) * 2)
+
+
+def test_departed_party_never_stalls_the_leader(monkeypatch):
+    """A party that exits (stage finished) while a leader waits must
+    wake the leader: trailing uneven batches dispatch without the
+    departed peer."""
+    monkeypatch.setenv("PYPULSAR_TPU_BROKER_WAIT_MS", "30000")
+    bk = broker_mod.BatchBroker()
+    calls, concat, dispatch, demux = _np_hooks()
+    bk._party_enter(PARTY)
+    bk._party_enter(PARTY)
+    out = {}
+
+    def leader():
+        out["r"] = bk.submit(KEY, PARTY, np.arange(2.0), 2, tag="a",
+                             concat=concat, dispatch=dispatch,
+                             demux=demux)
+        bk._party_exit(PARTY)
+
+    t = threading.Thread(target=leader)
+    t0 = time.monotonic()
+    t.start()
+    time.sleep(0.3)
+    bk._party_exit(PARTY)  # the absent peer departs
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert time.monotonic() - t0 < 10.0
+    np.testing.assert_array_equal(out["r"], np.arange(2.0) * 2)
+
+
+def test_member_fault_isolated_from_batchmates(monkeypatch):
+    """An injected per-member fault fails ONLY that member; its
+    batchmate still rides a (now solo) dispatch and gets bytes
+    identical to an unfused run."""
+    monkeypatch.setenv("PYPULSAR_TPU_BROKER_WAIT_MS", "30000")
+    faultinject.configure("io:broker.member.bad:1")
+    bk = broker_mod.BatchBroker()
+    calls, concat, dispatch, demux = _np_hooks()
+    results, errors = {}, {}
+
+    def worker(name, payload):
+        try:
+            results[name] = bk.submit(
+                KEY, PARTY, payload, len(payload), tag=name,
+                concat=concat, dispatch=dispatch, demux=demux)
+        except Exception as e:  # noqa: BLE001
+            errors[name] = e
+
+    good = np.arange(5.0)
+    with bk.party(PARTY), bk.party(PARTY):
+        ts = [threading.Thread(target=worker,
+                               args=("bad", np.arange(3.0))),
+              threading.Thread(target=worker, args=("good", good))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+    assert isinstance(errors["bad"], faultinject.InjectedIOError)
+    assert "good" not in errors
+    np.testing.assert_array_equal(results["good"], good * 2)
+
+
+def test_fused_fault_retries_each_unit_alone(monkeypatch):
+    """A transient failure of the FUSED dispatch retries every unit
+    solo: no member inherits a batchmate's error, and each solo retry
+    is the exact dispatch it would have run un-brokered."""
+    monkeypatch.setenv("PYPULSAR_TPU_BROKER_WAIT_MS", "30000")
+    bk = broker_mod.BatchBroker()
+    calls = []
+
+    def concat(payloads):
+        return np.concatenate(payloads)
+
+    def dispatch(fused, n):
+        calls.append(int(n))
+        if n > 4:  # the fused call fails; solo retries succeed
+            raise RuntimeError("transient fused failure")
+        return np.asarray(fused) * 2.0
+
+    results = {}
+
+    def worker(name, payload):
+        results[name] = bk.submit(
+            KEY, PARTY, payload, len(payload), tag=name,
+            concat=concat, dispatch=dispatch,
+            demux=lambda out, lo, hi: out[lo:hi])
+
+    a, b = np.arange(3.0), np.arange(10.0, 14.0)
+    with bk.party(PARTY), bk.party(PARTY):
+        ts = [threading.Thread(target=worker, args=("a", a)),
+              threading.Thread(target=worker, args=("b", b))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+    assert calls[0] == 7 and sorted(calls[1:]) == [3, 4]
+    np.testing.assert_array_equal(results["a"], a * 2)
+    np.testing.assert_array_equal(results["b"], b * 2)
+
+
+def test_device_fault_in_fused_dispatch_propagates_to_all(monkeypatch):
+    """A chip-indicting fault is about the DEVICE, not a member: the
+    broker must NOT absorb it with per-unit retries (that would hide
+    the strike from device-health accounting) — every member sees it."""
+    monkeypatch.setenv("PYPULSAR_TPU_BROKER_WAIT_MS", "30000")
+    bk = broker_mod.BatchBroker()
+
+    def dispatch(fused, n):
+        raise faultinject.InjectedDeviceFault("injected: chip down")
+
+    errors = {}
+
+    def worker(name, payload):
+        try:
+            bk.submit(KEY, PARTY, payload, len(payload), tag=name,
+                      concat=lambda ps: np.concatenate(ps),
+                      dispatch=dispatch,
+                      demux=lambda out, lo, hi: out[lo:hi])
+        except Exception as e:  # noqa: BLE001
+            errors[name] = e
+
+    with bk.party(PARTY), bk.party(PARTY):
+        ts = [threading.Thread(target=worker, args=(n, np.arange(2.0)))
+              for n in ("a", "b")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+    assert all(isinstance(errors[n], faultinject.InjectedDeviceFault)
+               for n in ("a", "b"))
+
+
+def test_different_keys_never_fuse(monkeypatch):
+    """Units whose geometry/config/scope keys differ must dispatch
+    separately even when submitted concurrently."""
+    monkeypatch.setenv("PYPULSAR_TPU_BROKER_WAIT_MS", "200")
+    bk = broker_mod.BatchBroker()
+    calls, concat, dispatch, demux = _np_hooks()
+    other_key = ("accel", (128,), ("cfg",), ("host",), "digest")
+    results = {}
+
+    def worker(name, key, payload):
+        results[name] = bk.submit(key, PARTY, payload, len(payload),
+                                  tag=name, concat=concat,
+                                  dispatch=dispatch, demux=demux)
+
+    ts = [threading.Thread(target=worker, args=("a", KEY, np.arange(3.0))),
+          threading.Thread(target=worker,
+                           args=("b", other_key, np.arange(4.0)))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert sorted(calls) == [3, 4]
+
+
+# ---------------------------------------------------------------------------
+# multi-series fold kernel parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("T", [100, None])
+def test_fold_parts_multi_matches_per_series_fold(T):
+    """The fused fold kernel (a stack of series + per-candidate series
+    index) is bitwise-equal to folding each candidate against its own
+    series with the round-7 batch kernel — blocked and short paths."""
+    from pypulsar_tpu.fold import engine
+
+    if T is None:
+        T = int(engine._FOLD_BLOCK * 2.5)  # exercise the blocked path
+    rng = np.random.default_rng(7)
+    nbins, npart = 16, 4
+    stack = rng.standard_normal((3, T)).astype(np.float32)
+    ks = [2, 1, 3]  # candidates per series
+    sidx = np.concatenate([np.full(k, g, np.int32)
+                           for g, k in enumerate(ks)])
+    K = int(sidx.size)
+    bins = rng.integers(0, nbins, size=(K, T)).astype(np.int32)
+    profs, counts = engine.fold_parts_multi(stack, sidx, bins,
+                                            nbins, npart)
+    profs, counts = np.asarray(profs), np.asarray(counts)
+    lo = 0
+    for g, k in enumerate(ks):
+        rp, rc = engine.fold_parts_batch(stack[g], bins[lo:lo + k],
+                                         nbins, npart)
+        np.testing.assert_array_equal(profs[lo:lo + k], np.asarray(rp),
+                                      err_msg=f"series {g} profiles")
+        np.testing.assert_array_equal(counts[lo:lo + k], np.asarray(rc),
+                                      err_msg=f"series {g} counts")
+        lo += k
+
+
+# ---------------------------------------------------------------------------
+# full-chain parity, fault isolation, kill+resume (slow: real fleets)
+# ---------------------------------------------------------------------------
+
+OBS = dict(C=16, T=8192)
+NOMASK_KW = dict(CFG_KW, mask=False)
+
+
+def _run_fleet(fils, outdir, cfg_kw, trace=None, **sched_kw):
+    obs = _fleet_obs(fils, outdir)
+    cfg = SurveyConfig(**cfg_kw)
+    if trace is not None:
+        with telemetry.session(trace):
+            result = FleetScheduler(obs, cfg, max_host_workers=2,
+                                    **sched_kw).run()
+    else:
+        result = FleetScheduler(obs, cfg, max_host_workers=2,
+                                **sched_kw).run()
+    return obs, result
+
+
+@pytest.fixture(scope="module")
+def duo(tmp_path_factory):
+    """Two same-geometry toy observations plus the BROKER=0 reference
+    artifacts (the pre-round-24 dispatch tree, pinned byte-identical
+    to the serial chain by test_survey)."""
+    root = tmp_path_factory.mktemp("broker")
+    fils = [_pulsar_fil(root, name=f"psr{i}.fil", seed=5 + i, **OBS)
+            for i in range(2)]
+    refdir = str(root / "ref")
+    os.environ["PYPULSAR_TPU_BROKER"] = "0"
+    try:
+        _, result = _run_fleet(fils, refdir, NOMASK_KW)
+    finally:
+        os.environ.pop("PYPULSAR_TPU_BROKER", None)
+    assert result.ok
+    ref = {f"psr{i}": _artifact_bytes(refdir, f"psr{i}")
+           for i in range(2)}
+    assert all(ref.values())
+    return {"root": root, "fils": fils, "ref": ref}
+
+
+def _assert_ref_parity(duo_dict, outdir):
+    for stem, want in duo_dict["ref"].items():
+        got = _artifact_bytes(outdir, stem)
+        assert got.keys() == want.keys(), stem
+        for name, data in want.items():
+            assert got[name] == data, f"{stem}: {name} diverged"
+
+
+def test_brokered_fleet_byte_identical_and_actually_coalesces(duo):
+    """Acceptance: with the broker ON and batch lanes enabled, a
+    2-observation fleet really fuses cross-obs dispatches (coalesced
+    units > 0, fused dispatches < total submissions) and every final
+    artifact is byte-identical to the BROKER=0 reference."""
+    outdir = str(duo["root"] / "brokered")
+    trace = str(duo["root"] / "brokered.jsonl")
+    _, result = _run_fleet(duo["fils"], outdir, NOMASK_KW, trace=trace)
+    assert result.ok
+    _assert_ref_parity(duo, outdir)
+    from pypulsar_tpu.obs.summarize import load_records, summarize
+
+    s = summarize(load_records(trace))
+    subs = s.counters.get("broker.submissions", 0)
+    disp = s.counters.get("broker.dispatches", 0)
+    assert disp > 0 and subs > disp, (subs, disp)
+    assert s.counters.get("broker.coalesced_units", 0) >= 2
+    assert s.counters.get("broker.lane_grants", 0) >= 1
+    assert s.events.get("survey.lane_decision", 0) >= 1
+    # and tlmsum renders the roll-up
+    import io
+
+    from pypulsar_tpu.obs.summarize import render
+
+    buf = io.StringIO()
+    render(s, buf)
+    assert "# batch broker:" in buf.getvalue()
+
+
+def test_broker_off_restores_pre_broker_dispatch_tree(duo, monkeypatch):
+    """PYPULSAR_TPU_BROKER=0 must be byte-identical AND
+    dispatch-identical to the pre-round-24 path: zero broker traffic,
+    zero lane grants, and the same per-stage dispatch counters as the
+    reference leg."""
+    monkeypatch.setenv("PYPULSAR_TPU_BROKER", "0")
+    outdir = str(duo["root"] / "off")
+    trace = str(duo["root"] / "off.jsonl")
+    _, result = _run_fleet(duo["fils"], outdir, NOMASK_KW, trace=trace)
+    assert result.ok
+    _assert_ref_parity(duo, outdir)
+    from pypulsar_tpu.obs.summarize import load_records, summarize
+
+    s = summarize(load_records(trace))
+    for key in ("broker.submissions", "broker.dispatches",
+                "broker.lane_grants", "broker.coalesced_units"):
+        assert not s.counters.get(key), key
+    assert not s.events.get("survey.lane_decision")
+
+
+def test_batchmate_fault_leaves_peer_artifacts_byte_identical(duo):
+    """One observation's injected broker-member fault must cost ONLY
+    that observation a stage retry: its batchmate's artifacts stay
+    byte-identical and the fleet completes."""
+    outdir = str(duo["root"] / "memfault")
+    trace = str(duo["root"] / "memfault.jsonl")
+    faultinject.configure("io:broker.member.psr0:1")
+    _, result = _run_fleet(duo["fils"], outdir, NOMASK_KW, trace=trace)
+    assert result.ok and result.retried >= 1
+    _assert_ref_parity(duo, outdir)
+    from pypulsar_tpu.obs.summarize import load_records, summarize
+
+    s = summarize(load_records(trace))
+    assert s.counters.get("broker.member_faults", 0) >= 1
+
+
+def test_kill_mid_coalesce_resume_reruns_only_unvalidated(duo):
+    """kill -9 semantics at the fused-dispatch boundary: resume must
+    re-run exactly the stages the manifests do not validate, and the
+    artifacts still match the BROKER=0 reference."""
+    outdir = str(duo["root"] / "kill")
+    cfg = SurveyConfig(**NOMASK_KW)
+    all_stages = {s.name for s in build_dag(cfg)}
+    obs = _fleet_obs(duo["fils"], outdir)
+    faultinject.configure("kill:broker.dispatch:3")
+    with pytest.raises(faultinject.InjectedKill):
+        FleetScheduler(obs, cfg, max_host_workers=2).run()
+    faultinject.reset()
+    broker_mod.reset()
+    recorded = {(r["obs"], s)
+                for r in status_rows([o.manifest for o in obs])
+                for s in r["done"]}
+    result = FleetScheduler(obs, cfg, max_host_workers=2,
+                            resume=True).run()
+    assert result.ok
+    assert set(result.skipped) == recorded
+    assert set(result.ran) == (
+        {(o.name, s) for o in obs for s in all_stages} - recorded)
+    _assert_ref_parity(duo, outdir)
+    # a fully validated fleet resumes to zero stages re-run
+    result2 = FleetScheduler(_fleet_obs(duo["fils"], outdir), cfg,
+                             max_host_workers=2, resume=True).run()
+    assert result2.ok and not result2.ran
+
+
+# ---------------------------------------------------------------------------
+# observability: tlmsum roll-up + statusd exposition
+# ---------------------------------------------------------------------------
+
+
+def test_tlmsum_renders_batch_broker_rollup(tmp_path):
+    import io
+
+    from pypulsar_tpu.obs.summarize import load_records, render, summarize
+
+    path = str(tmp_path / "t.jsonl")
+    with telemetry.session(path):
+        telemetry.counter("broker.submissions", 12)
+        telemetry.counter("broker.dispatches", 4)
+        telemetry.counter("broker.fused_rows", 4096)
+        telemetry.counter("broker.lane_grants", 3)
+        telemetry.counter("broker.unit_retries", 2)
+        telemetry.gauge("broker.coalesce_factor", 3.0)
+        with telemetry.span("broker.wait", key="accel"):
+            pass
+    buf = io.StringIO()
+    render(summarize(load_records(path)), buf)
+    out = buf.getvalue()
+    assert "# batch broker:" in out
+    for bit in ("fused dispatches=4", "units=12 (coalesce factor 3.00)",
+                "rows fused=4096", "lane grants=3", "unit retries=2",
+                "wait p50/p99=", "peak batch occupancy=3"):
+        assert bit in out, bit
+
+
+def test_statusd_metrics_exposes_broker_counters(tmp_path):
+    import urllib.request
+
+    from pypulsar_tpu.obs import statusd
+
+    with telemetry.session():
+        telemetry.counter("broker.dispatches", 7)
+        telemetry.gauge("broker.coalesce_factor", 2.0)
+        with statusd.StatusServer(str(tmp_path), 0) as srv:
+            with urllib.request.urlopen(srv.url + "/metrics",
+                                        timeout=5) as resp:
+                text = resp.read().decode()
+    assert 'pypulsar_counter{name="broker.dispatches"} 7' in text
+    assert 'pypulsar_gauge{name="broker.coalesce_factor"' in text

@@ -207,6 +207,29 @@ def test_pl005_near_miss(tmp_path):
     assert codes(rep) == []
 
 
+def test_pl005_tuple_point_registry_defines(tmp_path):
+    # round 24: FAULT_POINTS = ("a", "b") tuple/list registries in
+    # production count as definitions (the broker publishes its points
+    # that way) — but a point absent from the tuple is still dead
+    rep = lint(tmp_path, {
+        "pypulsar_tpu/prod.py":
+            "from pypulsar_tpu.resilience import faultinject\n"
+            "FAULT_POINTS = ('broker.submit', 'broker.dispatch')\n"
+            "def work():\n"
+            "    for p in FAULT_POINTS:\n"
+            "        faultinject.trip(p)\n",
+        "tests/test_faults.py":
+            "from pypulsar_tpu.resilience import faultinject\n"
+            "def test_real():\n"
+            "    faultinject.configure(\n"
+            "        'io:broker.submit:1, kill:broker.dispatch:1')\n"
+            "def test_ghost():\n"
+            "    faultinject.configure('io:broker.ghost:1')\n",
+    }, select="PL005")
+    assert codes(rep) == ["PL005"]
+    assert "broker.ghost" in rep.findings[0].message
+
+
 # ---------------------------------------------------------------------------
 # PL006 raw header read in io/
 
