@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fourier test-faults test-fold test-obs test-survey test-corruption test-tune test-multihost test-race test-daemon test-broker bench-broker lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-obs bench-survey bench-multichip bench-multihost-fleet bench-specfuse bench-telemetry bench-tree bench-tune bench-compile native clean
+.PHONY: test test-fourier test-faults test-fold test-obs test-survey test-corruption test-tune test-multihost test-race test-daemon test-broker test-candstore bench-broker bench-candplane lint dryrun smoke probe bench bench-quick bench-ab bench-accel bench-accel-pipeline bench-fold bench-obs bench-survey bench-multichip bench-multihost-fleet bench-specfuse bench-telemetry bench-tree bench-tune bench-compile native clean
 
 # every device engine on the live TPU, one PASS/FAIL line each (~1 min)
 smoke:
@@ -14,7 +14,7 @@ smoke:
 probe:
 	$(PY) tools/tpu_component_probe.py
 
-test: lint test-obs
+test: lint test-obs test-candstore
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
 
 # the static-analysis gate (docs/ARCHITECTURE.md "Static analysis"):
@@ -49,7 +49,7 @@ test-fourier:
 # survey orchestrator's kill/resume/quarantine and fleet-health
 # (watchdog, device-strike, admission) cases, and the seeded chaos
 # fleet
-test-faults: test-chaos test-corruption test-multihost test-race test-obs test-daemon test-broker
+test-faults: test-chaos test-corruption test-multihost test-race test-obs test-daemon test-broker test-candstore
 	$(CPU_ENV) $(PY) -m pytest tests/test_resilience.py -q
 	$(CPU_ENV) $(PY) -m pytest tests/test_survey.py -q -k "kill or resume or quarantine or retry or stall or deadline or evict or admission or chaos"
 
@@ -113,6 +113,16 @@ test-daemon:
 # resume mid-coalesce re-running only unvalidated stages
 test-broker:
 	$(CPU_ENV) $(PY) -m pytest tests/test_broker.py -q
+
+# the candidate data plane suite (round 25): fenced store appends
+# (stale-token writers rejected before touching the file), kill -9
+# mid-append + re-publish yielding exactly-once records, torn-tail
+# tolerance, pre/post-compaction query identity, two racing hosts over
+# one store, the cross-obs candsift's harmonic clustering +
+# known-source veto, the cands CLI, the /candidates endpoint, and the
+# scheduler's terminal-edge ingest
+test-candstore:
+	$(CPU_ENV) $(PY) -m pytest tests/test_candstore.py -q
 
 # the data-integrity suite: the checked-in corrupted-fixture corpus
 # against every reader, salvage/scrub/finite-gate contracts, the
@@ -255,6 +265,15 @@ bench-compile:
 # (CPU-toy walls are labeled, not gated)
 bench-broker: test-broker
 	$(CPU_ENV) $(PY) bench.py --broker --out BENCH_r19_broker.json
+
+# the round-25 candidate-plane A/B: the same pulsar injected at 3
+# epochs + per-epoch noise through the real fleet ingest — store-on vs
+# PYPULSAR_TPU_CANDSTORE=0 with per-obs artifacts byte-identical,
+# cross-obs dedup factor asserted > 1 (the pulsar's epochs collapse to
+# one cluster), kill -9 mid-append + resume leaving exactly-once
+# books, and query results identical pre/post compaction
+bench-candplane: test-candstore
+	$(CPU_ENV) $(PY) bench.py --candplane --out BENCH_r20_candplane.json
 
 native:
 	$(PY) -c "from pypulsar_tpu import native; assert native.available(); print('native codec OK')"

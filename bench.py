@@ -127,6 +127,15 @@ def parse_args(argv=None):
                          "per-obs dispatch, gated on structural counters\n"
                          "(coalesce factor, dispatch collapse, compile misses)\n"
                          "+ byte parity + validated-resume-zero")
+    ap.add_argument("--candplane", action="store_true",
+                    help="A/B the round-25 candidate data plane: the same\n"
+                         "synthetic pulsar observed at 3 epochs (plus per-\n"
+                         "epoch noise) run through the fleet scheduler with\n"
+                         "the candidate store ON vs PYPULSAR_TPU_CANDSTORE=0,\n"
+                         "byte-parity on per-obs artifacts, cross-epoch sift\n"
+                         "duplicate reduction measured, kill -9 + resume\n"
+                         "exactly-once and pre/post-compaction query identity\n"
+                         "asserted (BENCH_r20_candplane.json)")
     ap.add_argument("--obs-overhead", action="store_true",
                     help="A/B the round-21 observability plane on a toy "
                          "sweep->accel fleet: instrumentation-off vs "
@@ -1847,9 +1856,12 @@ def _fold_pipeline_ab(args):
 
 
 def _synth_survey_fil(fn, seed, C, T, dtp, freqs, src_name,
-                      dm=40.0, period=0.1024, amp=10.0):
+                      dm=40.0, period=0.1024, amp=10.0,
+                      tstart=55000.0):
     """One synthetic pulsar filterbank for the survey/chaos harnesses
-    (shared so the two A/Bs can never drift apart on the recipe)."""
+    (shared so the two A/Bs can never drift apart on the recipe).
+    ``tstart`` lets the candplane A/B re-observe the same pulsar at
+    several epochs; every other harness keeps the 55000.0 default."""
     import numpy as np
 
     from pypulsar_tpu.io import filterbank
@@ -1866,7 +1878,7 @@ def _synth_survey_fil(fn, seed, C, T, dtp, freqs, src_name,
                 data[idx, c] += amp
     filterbank.write_filterbank(
         fn, dict(nchans=C, tsamp=dtp, fch1=float(freqs[0]),
-                 foff=-4.0, tstart=55000.0, nbits=32, nifs=1,
+                 foff=-4.0, tstart=float(tstart), nbits=32, nifs=1,
                  source_name=src_name), data)
     return fn
 
@@ -2345,6 +2357,256 @@ def run_broker(args):
             "record's claims are the structural counters (dispatch "
             "collapse, coalesce factor, zero extra compile misses) "
             "and byte parity; wall-clock scaling needs real chips")
+    if args.cpu_fallback:
+        record["unit"] += " [CPU FALLBACK: accelerator backend unavailable]"
+    return record
+
+
+def run_candplane(args):
+    """Candidate-data-plane A/B (the round-25 tentpole's acceptance
+    measurement): the SAME synthetic pulsar observed at 3 epochs
+    (identical P, DM; fresh noise and a fresh MJD per epoch) through
+    the fleet scheduler two ways —
+
+    - **plain** (``PYPULSAR_TPU_CANDSTORE=0``): the pre-round-25
+      fleet, per-obs artifacts only, no candidate store;
+    - **store**: the candidate data plane on, every terminal ``done``
+      observation publishing its normalized candidates into the
+      fenced append-only store under ``<outdir>/_fleet/candstore/``.
+
+    The record is gated on structure, not wall-clock: per-obs
+    artifacts byte-identical across legs (the store is a pure
+    passenger), the plain leg leaves NO store directory behind, the
+    cross-epoch candsift finds the pulsar in all 3 epochs and folds
+    the store's records into strictly fewer clusters (the measured
+    duplicate reduction), a kill -9 mid-append + re-publish leaves
+    exactly-once live records (raw log keeps the torn rows; the query
+    surface and the ``cands`` CLI both hide them), and every query is
+    identical before and after compaction."""
+    acquire_backend()
+    import contextlib
+    import glob as _glob
+    import io
+    import tempfile
+
+    from pypulsar_tpu import candstore as candstore_mod
+    from pypulsar_tpu.candstore.store import CandStore, store_dir
+    from pypulsar_tpu.cli import cands as cands_cli
+    from pypulsar_tpu.obs import telemetry
+    from pypulsar_tpu.resilience import faultinject
+    from pypulsar_tpu.survey.dag import SurveyConfig, build_dag
+    from pypulsar_tpu.survey.scheduler import FleetScheduler
+    from pypulsar_tpu.survey.state import Observation
+
+    n_epochs = 3
+    C, T, dtp = 16, (1 << 13 if (args.quick or args.cpu_fallback)
+                     else 1 << 14), 5e-4
+    rng_freqs = 1500.0 - 4.0 * np.arange(C)
+    period, dm = 0.1024, 40.0
+    # sift gate LOW (unlike --broker): the fold + snr stages must run
+    # so the terminal edge has real pfd_snr rows to publish
+    cfg = SurveyConfig(
+        mask=False, lodm=0.0, dmstep=10.0, numdms=16, nsub=8,
+        group_size=4, threshold=8.0,
+        accel_zmax=20.0, accel_numharm=2, accel_sigma=3.0, accel_batch=4,
+        sift_sigma=3.0, sift_min_hits=1, fold_nbins=32, fold_npart=8)
+    stages = build_dag(cfg)
+
+    with tempfile.TemporaryDirectory() as td:
+        fils = [_synth_survey_fil(os.path.join(td, f"ep{i}.fil"),
+                                  31 + i, C, T, dtp, rng_freqs,
+                                  "CANDAB", dm=dm, period=period,
+                                  tstart=55000.0 + 10.0 * i)
+                for i in range(n_epochs)]
+
+        def fleet(dirname):
+            out = os.path.join(td, dirname)
+            os.makedirs(out, exist_ok=True)
+            return [Observation(f"ep{i}", fils[i],
+                                os.path.join(out, f"ep{i}"))
+                    for i in range(n_epochs)]
+
+        def leg(dirname, env):
+            old = {k: os.environ.get(k) for k in env}
+            os.environ.update(env)
+            try:
+                with telemetry.session() as tlm:
+                    t0 = time.perf_counter()
+                    result = FleetScheduler(fleet(dirname), cfg,
+                                            max_host_workers=1,
+                                            devices=1).run()
+                    wall = time.perf_counter() - t0
+                assert result.ok \
+                    and len(result.ran) == n_epochs * len(stages), \
+                    f"{dirname} leg failed"
+                return wall, tlm.counter_totals()
+            finally:
+                for k, v in old.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+
+        plain_s, _plain_c = leg("plain", {"PYPULSAR_TPU_CANDSTORE": "0"})
+        store_s, store_c = leg("store", {"PYPULSAR_TPU_CANDSTORE": "1"})
+
+        # parity: the store is a passenger on the terminal edge —
+        # per-obs artifacts must be byte-identical to the store-less run
+        ident = tot = 0
+        for pattern in ("*_ACCEL_*.cand", "*_ACCEL_*.txtcand",
+                        "*_cand*.pfd"):
+            for fa in sorted(_glob.glob(os.path.join(td, "plain",
+                                                     pattern))):
+                fb = os.path.join(td, "store", os.path.basename(fa))
+                tot += 1
+                if (os.path.exists(fb) and open(fa, "rb").read()
+                        == open(fb, "rb").read()):
+                    ident += 1
+        assert ident == tot and tot > 0, \
+            f"store leg artifacts diverged: {ident}/{tot}"
+        # the snr fleet summaries embed each pfd's path (which contains
+        # the leg dirname), so parity there is structural: identical
+        # rows once the path field is reduced to its basename
+        for i in range(n_epochs):
+            legs = []
+            for dirname in ("plain", "store"):
+                with open(os.path.join(td, dirname,
+                                       f"ep{i}_snr.json")) as f:
+                    rows = json.load(f)
+                legs.append([dict(r, pfd=os.path.basename(r["pfd"]))
+                             for r in rows])
+            assert legs[0] == legs[1], f"ep{i} snr summaries diverged"
+            tot += 1
+            ident += 1
+        assert not os.path.exists(store_dir(os.path.join(td, "plain"))), \
+            "disabled store still left a candstore directory behind"
+
+        # the data-plane claims: 3 epochs of one pulsar fold into one
+        # cluster — the duplicate reduction per-obs files cannot give
+        store = CandStore(os.path.join(td, "store"))
+        recs = store.records()
+        n_records = len(recs)
+        assert n_records >= n_epochs, \
+            f"store holds {n_records} records from {n_epochs} epochs"
+        clusters = candstore_mod.cross_sift(recs)
+        # the cluster seeds on its strongest member, which for a bright
+        # pulsar is often a harmonic — identify it harmonically, not by
+        # the fundamental alone
+        pulsar = [c for c in clusters
+                  if candstore_mod.harmonic_ratio(c["p_s"], period,
+                                                  5e-3) is not None]
+        assert pulsar and pulsar[0]["n_epochs"] == n_epochs, (
+            f"pulsar cluster missing or incomplete: "
+            f"{[ (c['p_s'], c['n_epochs']) for c in clusters[:5] ]}")
+        reduction = n_records / len(clusters)
+        assert reduction > 1.0, \
+            f"no duplicate reduction: {n_records} recs / {len(clusters)}"
+
+        # queries are identical before and after compaction (the
+        # snapshot is an equivalent-by-construction rewrite)
+        q_near = dict(near=(period, dm), top=50)
+        pre_near = store.query(**q_near)
+        pre_all = store.query()
+        pre_ep = store.query(epoch_range=(55005.0, 55025.0))
+        store.compact()
+        assert store.query(**q_near) == pre_near \
+            and store.query() == pre_all \
+            and store.query(epoch_range=(55005.0, 55025.0)) == pre_ep, \
+            "query changed across compaction"
+        assert store.status()["segments"] == 0, \
+            "compaction left segments behind"
+
+        # kill -9 mid-append + resume: the round-25 exactly-once claim.
+        # Re-publish the SAME (obs, fingerprint) after an injected kill
+        # tore the first attempt — the raw log keeps the torn rows, the
+        # query surface shows each candidate once.
+        obs_name, outbase = "ep0", os.path.join(td, "store", "ep0")
+        recs0, fp = candstore_mod.normalize_obs(obs_name, outbase,
+                                                fils[0])
+        assert len(recs0) >= 2, "need >=2 rows for a mid-append kill"
+        kdir = os.path.join(td, "killres")
+        os.makedirs(kdir, exist_ok=True)
+        faultinject.reset()
+        faultinject.configure("kill:candstore.append:2")
+        killed = False
+        try:
+            CandStore(kdir).publish(obs_name, recs0, fp)
+        except faultinject.InjectedKill:
+            killed = True
+        finally:
+            faultinject.reset()
+        assert killed, "armed candstore.append kill never fired"
+        ks = CandStore(kdir)  # the resumed host
+        ks.publish(obs_name, recs0, fp)
+        kstat = ks.status()
+        assert kstat["records"] == len(recs0), (
+            f"kill+resume not exactly-once: {kstat['records']} live "
+            f"vs {len(recs0)} published")
+        assert kstat["raw_records"] > kstat["records"], \
+            "torn first attempt left no raw rows — kill leg proved nothing"
+        # the per-obs sift keeps only the strongest harmonic, so query
+        # near the strongest published row rather than the fundamental
+        strongest = max((r for r in recs0
+                         if isinstance(r.get("p_s"), float)
+                         and isinstance(r.get("dm"), float)),
+                        key=lambda r: r.get("snr") or 0.0)
+        assert ks.query(near=(strongest["p_s"], strongest["dm"])), \
+            "resumed store lost the pulsar"
+        # ...and the same exactly-once view through the cands CLI
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cands_cli.main([kdir, "--json"])
+        cli_rows = json.loads(buf.getvalue())
+        assert rc == 0 and len(cli_rows) == len(recs0), \
+            f"cands CLI disagrees: {len(cli_rows)} vs {len(recs0)}"
+
+    print(f"# candplane A/B: {n_epochs} epochs -> {n_records} store "
+          f"records -> {len(clusters)} clusters ({reduction:.2f}x dup "
+          f"reduction, pulsar seen {pulsar[0]['n_epochs']}/{n_epochs} "
+          f"epochs); {ident}/{tot} artifacts byte-identical; "
+          f"kill+resume exactly-once ({kstat['raw_records']} raw -> "
+          f"{kstat['records']} live); plain {plain_s:.2f}s vs store "
+          f"{store_s:.2f}s", file=sys.stderr)
+    record = {
+        "metric": "candplane_dup_reduction",
+        "value": round(reduction, 3),
+        "unit": (f"cross-epoch duplicate reduction from the round-25 "
+                 f"candidate data plane ({n_epochs} epochs of one "
+                 f"synthetic pulsar + per-epoch noise, {C}-chan x "
+                 f"{T}-sample each, full sweep->accel->sift->fold->snr "
+                 f"DAG — live store records divided by candsift "
+                 f"clusters; per-obs artifacts byte-checked identical "
+                 f"to a PYPULSAR_TPU_CANDSTORE=0 run, kill -9 "
+                 f"mid-append + re-publish asserted exactly-once, "
+                 f"queries asserted identical pre/post compaction)"),
+        "vs_baseline": round(reduction, 3),
+        "candplane_n_epochs": n_epochs,
+        "candplane_n_records": n_records,
+        "candplane_n_clusters": len(clusters),
+        "candplane_pulsar_epochs": int(pulsar[0]["n_epochs"]),
+        "candplane_artifacts_identical": f"{ident}/{tot}",
+        "candplane_publishes": int(store_c.get("candstore.publishes", 0)),
+        "candplane_appended": int(store_c.get("candstore.appended", 0)),
+        "candplane_killres_raw_records": int(kstat["raw_records"]),
+        "candplane_killres_live_records": int(kstat["records"]),
+        "candplane_query_stable_across_compaction": True,
+        "candplane_plain_seconds": round(plain_s, 3),
+        "candplane_store_seconds": round(store_s, 3),
+        "candplane_nsamp": T,
+        "candplane_nchan": C,
+    }
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform  # psrlint: ignore[PL002] -- record annotation, runs after the fleet (no lease)
+    except Exception:  # noqa: BLE001 - note is best-effort
+        platform = "?"
+    if platform == "cpu":
+        record["candplane_wall_note"] = (
+            "toy CPU fleet: the claim is structural (dup reduction, "
+            "byte parity, exactly-once after kill, compaction-stable "
+            "queries), not wall-clock — store overhead on the "
+            "terminal edge is file appends, noise next to the DAG")
     if args.cpu_fallback:
         record["unit"] += " [CPU FALLBACK: accelerator backend unavailable]"
     return record
@@ -4808,8 +5070,8 @@ def run_child(args, cpu: bool, timeout: float):
     if args.tune and args.tune_trials is not None:
         argv += ["--tune-trials", str(args.tune_trials)]
     for flag in ("quick", "profile", "ab", "accel", "spectral", "fold",
-                 "waterfall", "prepass", "survey", "broker", "chaos",
-                 "corruption", "dedisp_tree", "tune", "compile",
+                 "waterfall", "prepass", "survey", "broker", "candplane",
+                 "chaos", "corruption", "dedisp_tree", "tune", "compile",
                  "multihost", "race", "obs_overhead", "daemon_soak"):
         if getattr(args, flag):
             argv.append("--" + flag.replace("_", "-"))
@@ -4858,7 +5120,7 @@ def main():
     if (args.stream is None and not args.child
             and not (args.quick or args.ab or args.accel or args.fold
                      or args.waterfall or args.prepass or args.survey
-                     or args.broker
+                     or args.broker or args.candplane
                      or args.chaos or args.corruption or args.dedisp_tree or args.tune
                      or args.compile or args.multihost or args.race
                      or args.obs_overhead or args.daemon_soak
@@ -4904,6 +5166,8 @@ def main():
                 record = run_survey(args)
             elif args.broker:
                 record = run_broker(args)
+            elif args.candplane:
+                record = run_candplane(args)
             elif args.multihost:
                 record = run_multihost(args)
             elif args.race:

@@ -1,0 +1,28 @@
+"""Candidate data plane (round 25): the survey's system of record for
+pulsar candidates.
+
+- ``store``   — fenced append-only segment log + compacted indexed
+  snapshot under ``<outdir>/_fleet/candstore/``
+- ``records`` — normalizing per-obs terminal artifacts into
+  CandidateRecords (the scheduler's ingest edge)
+- ``sift``    — cross-observation harmonic clustering + known-source
+  veto (``candsift``)
+- ``match``   — the ONE (P, DM) matching implementation shared with
+  ``cli/sift.py --known-sources``
+"""
+
+from pypulsar_tpu.candstore.match import (CatalogError, KnownSource,
+                                          catalog_digest, format_ratio,
+                                          harmonic_ratio, load_catalog,
+                                          match_known)
+from pypulsar_tpu.candstore.records import normalize_obs, publish_obs
+from pypulsar_tpu.candstore.sift import cross_sift
+from pypulsar_tpu.candstore.store import CandStore, enabled, store_dir
+
+__all__ = [
+    "CandStore", "store_dir", "enabled",
+    "normalize_obs", "publish_obs",
+    "cross_sift",
+    "KnownSource", "CatalogError", "load_catalog", "match_known",
+    "harmonic_ratio", "format_ratio", "catalog_digest",
+]

@@ -1,0 +1,562 @@
+"""The survey's candidate store: fenced append-only segments + compacted
+indexed snapshot under ``<outdir>/_fleet/candstore/`` (round 25).
+
+Layout::
+
+    <outdir>/_fleet/candstore/
+        books.jsonl       exactly-once publish ledger (shared RunJournal)
+        seg-<NNNNNNNN>.jsonl   append-only record segments (shared RunJournal)
+        snapshot.json     compacted, (DM, P)-sorted, range-indexed snapshot
+        compact.lock      best-effort compaction mutex (O_EXCL, staleness-aged)
+
+Write discipline is ``resilience.journal`` shared-append mode end to
+end: every segment append goes through an ``O_APPEND`` handle with
+leading-newline framing and an fsync, so a predecessor's kill -9 leaves
+at most one torn fragment that readers skip as a blank line.  Appends
+are *fenced* exactly like survey manifest writes: the caller passes the
+claim-bound fence callable and the store invokes it **before touching
+any file** and again before every append — a dead host's late publish
+raises :class:`~pypulsar_tpu.survey.fleet.StaleLeaseError` without
+leaving a byte behind.
+
+Exactly-once semantics (the kill -9 + ``--resume`` contract): a publish
+is a batch of records for one observation stamped with the artifact
+fingerprint it was derived from.  Records land in the segment log
+first; only then does ``books.jsonl`` record the ``publish:<obs>`` unit
+with that fingerprint.  A kill between the two leaves orphan records
+that the resume's re-publish duplicates — readers collapse them by
+record ``uid``, and only records whose fingerprint matches the LATEST
+booked publish for their observation (or an unbooked in-flight one) are
+live, so the queryable view is exactly-once even though the log is
+at-least-once.  Compaction folds the live view into ``snapshot.json``
+(atomic tmp+replace) sorted by (DM, period) with a coarse B-range index
+over DM, so ``--near`` queries bisect buckets instead of scanning the
+log; consumed segments are unlinked only after the replace lands.
+"""
+
+from __future__ import annotations
+
+import errno
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from pypulsar_tpu.obs import telemetry
+from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.resilience.journal import (JOURNAL_VERSION, RunJournal,
+                                             atomic_write_text)
+
+__all__ = ["CandStore", "store_dir", "enabled"]
+
+TOOL = "candstore"
+STORE_DIR = "candstore"
+BOOKS = "books.jsonl"
+SNAPSHOT = "snapshot.json"
+SEG_PREFIX = "seg-"
+SEG_SUFFIX = ".jsonl"
+SNAPSHOT_VERSION = 1
+# coarse B-range index granularity: at most this many buckets over the
+# (DM, P)-sorted snapshot — each bucket stores its DM span + rank range
+_INDEX_BUCKETS = 64
+# a compact.lock older than this is debris from a dead compactor and
+# may be broken (compaction is idempotent; the lock only serializes)
+_COMPACT_LOCK_STALE_S = 60.0
+# per-call uniqueness for journal-header tmp files (see _ensure_journal)
+_HDR_SEQ = itertools.count()
+
+ENV_CANDSTORE = "PYPULSAR_TPU_CANDSTORE"
+ENV_SEGMENT_BYTES = "PYPULSAR_TPU_CANDSTORE_SEGMENT_BYTES"
+ENV_COMPACT_RECORDS = "PYPULSAR_TPU_CANDSTORE_COMPACT_RECORDS"
+
+
+def store_dir(outdir: str) -> str:
+    """The candidate store's directory under the coordination plane."""
+    from pypulsar_tpu.survey.fleet import plane_dir
+
+    return os.path.join(plane_dir(outdir), STORE_DIR)
+
+
+def enabled() -> bool:
+    """Is the candidate data plane on?  ``PYPULSAR_TPU_CANDSTORE=0``
+    restores the store-less fleet exactly (the A/B's baseline leg)."""
+    from pypulsar_tpu.tune import knobs
+
+    return (knobs.env_str(ENV_CANDSTORE) or "1").lower() \
+        not in ("0", "off", "no")
+
+
+def _read_jsonl_dicts(path: str) -> List[dict]:
+    """All parseable JSON-object lines of a shared-append JSONL file,
+    skipping blanks and torn fragments (the read-only twin of the
+    shared RunJournal loader — queries must not open append handles on
+    segments another host is writing)."""
+    out: List[dict] = []
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return out
+    for line in raw.decode(errors="replace").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn fragment from a killed writer
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def _sort_key(rec: dict) -> Tuple[float, float, str]:
+    dm = rec.get("dm")
+    p = rec.get("p_s")
+    return (float(dm) if isinstance(dm, (int, float)) else float("inf"),
+            float(p) if isinstance(p, (int, float)) else float("inf"),
+            str(rec.get("uid", "")))
+
+
+def _rank_key(rec: dict) -> Tuple[float, str]:
+    """Query ordering: strongest SNR first, uid as the deterministic
+    tiebreak (pre/post-compaction results must be IDENTICAL)."""
+    snr = rec.get("snr")
+    return (-(float(snr) if isinstance(snr, (int, float)) else -1e30),
+            str(rec.get("uid", "")))
+
+
+class CandStore:
+    """One survey outdir's candidate store (see module doc).
+
+    ``fence`` is the multi-host write guard: a zero-arg callable that
+    raises :class:`StaleLeaseError` when the caller's claim token is no
+    longer current.  It runs before the store touches ANY file and
+    again before every record append — the same per-append discipline
+    as :class:`~pypulsar_tpu.survey.state.ObsManifest`.  Read paths
+    never fence (queries are safe from any host, live or dead).
+    """
+
+    def __init__(self, outdir: str,
+                 fence: Optional[Callable[[], None]] = None):
+        self.outdir = outdir
+        self.dir = store_dir(outdir)
+        self.fence = fence
+
+    # -- paths ---------------------------------------------------------------
+
+    @property
+    def books_path(self) -> str:
+        return os.path.join(self.dir, BOOKS)
+
+    @property
+    def snapshot_path(self) -> str:
+        return os.path.join(self.dir, SNAPSHOT)
+
+    def _segments(self) -> List[str]:
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        return [os.path.join(self.dir, n) for n in sorted(names)
+                if n.startswith(SEG_PREFIX) and n.endswith(SEG_SUFFIX)
+                and not n.endswith(".tmp")]
+
+    def _active_segment(self) -> str:
+        """The segment new records append to: the highest-numbered one
+        while it is under the rotation bound, else the next number.
+        Two hosts racing the rotation converge on the same name —
+        O_APPEND keeps their interleaved records intact."""
+        from pypulsar_tpu.tune import knobs
+
+        bound = float(knobs.env_float(ENV_SEGMENT_BYTES))
+        segs = self._segments()
+        if segs:
+            last = segs[-1]
+            try:
+                if os.path.getsize(last) < bound:
+                    return last
+            except OSError:
+                pass
+            n = int(os.path.basename(last)[len(SEG_PREFIX):-len(
+                SEG_SUFFIX)]) + 1
+        else:
+            n = 1
+        return os.path.join(self.dir, f"{SEG_PREFIX}{n:08d}{SEG_SUFFIX}")
+
+    def _ensure_journal(self, path: str) -> None:
+        """Atomically create a shared journal file WITH its header.
+
+        RunJournal restarts a file it loaded as fresh with ``open(path,
+        "w")`` — correct for a single-writer manifest, but two hosts
+        racing the creation of one segment would truncate each other's
+        first records.  Creating the header via tmp-write + ``os.link``
+        makes file-exists-with-valid-header atomic: every RunJournal
+        handle after this loads a non-fresh journal and opens
+        ``O_APPEND``.  The tmp name carries pid + thread id + a counter
+        — two in-process writers racing one segment's creation with a
+        SHARED tmp name would truncate the very inode the winner just
+        linked (``open(tmp, "w")`` empties it in place), exposing an
+        empty journal whose next loader would restart-with-truncate."""
+        if os.path.exists(path):
+            return
+        header = json.dumps({"type": "journal",
+                             "version": JOURNAL_VERSION,
+                             "tool": TOOL, "fingerprint": ""}) + "\n"
+        tmp = (f"{path}.{os.getpid()}.{threading.get_ident()}."
+               f"{next(_HDR_SEQ)}.hdr.tmp")
+        with open(tmp, "w") as f:
+            f.write(header)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(tmp, path)
+        except FileExistsError:
+            pass  # the racing creator won; its header is identical
+        except OSError:
+            # no hard links on this fs: fall back to O_EXCL create
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.write(fd, header.encode())
+                os.fsync(fd)
+                os.close(fd)
+            except OSError:
+                pass  # exists now: someone's header is in place
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    # -- books (exactly-once ledger) -----------------------------------------
+
+    def published(self) -> Dict[str, str]:
+        """obs name -> fingerprint of its LATEST booked publish."""
+        out: Dict[str, str] = {}
+        for rec in _read_jsonl_dicts(self.books_path):
+            if rec.get("type") == "done" \
+                    and str(rec.get("unit", "")).startswith("publish:"):
+                out[rec["unit"][len("publish:"):]] = \
+                    str(rec.get("fingerprint", ""))
+        return out
+
+    # -- write side ----------------------------------------------------------
+
+    def publish(self, obs: str, records: Iterable[dict],
+                fingerprint: str, token: Optional[int] = None) -> int:
+        """Append one observation's normalized CandidateRecords.
+
+        Idempotent on the (obs, fingerprint) pair: a resume that
+        re-derives the same records from the same artifacts is a no-op
+        (``candstore.dup_publishes``); changed artifacts re-publish and
+        the old fingerprint's records go dead.  Returns the number of
+        records appended (0 on the duplicate-skip path)."""
+        records = list(records)
+        if self.fence is not None:
+            # stale writers are rejected BEFORE the store is touched —
+            # not even the directory is created under a lost claim
+            self.fence()
+        if self.published().get(obs) == fingerprint:
+            telemetry.counter("candstore.dup_publishes")
+            return 0
+        os.makedirs(self.dir, exist_ok=True)
+        seg_path = self._active_segment()
+        self._ensure_journal(seg_path)
+        seg = RunJournal(seg_path, "", tool=TOOL, shared=True)
+        try:
+            for i, rec in enumerate(records):
+                if self.fence is not None:
+                    self.fence()
+                faultinject.trip("candstore.append")
+                body = {k: v for k, v in rec.items()
+                        if k not in ("uid", "obs", "pub_fp")}
+                seg.note(event="cand", uid=f"{obs}:{i}", obs=obs,
+                         pub_fp=fingerprint, **body)
+                telemetry.counter("candstore.appended")
+        finally:
+            seg.close()
+        if self.fence is not None:
+            self.fence()
+        self._ensure_journal(self.books_path)
+        books = RunJournal(self.books_path, "", tool=TOOL, shared=True)
+        try:
+            extra = {"fingerprint": fingerprint, "n": len(records)}
+            if token is not None:
+                extra["token"] = token
+            books.done(f"publish:{obs}", [], **extra)
+        finally:
+            books.close()
+        telemetry.counter("candstore.publishes")
+        telemetry.gauge("candstore.store_bytes", float(self.size_bytes()))
+        telemetry.event("candstore.publish", obs=obs, n=len(records),
+                        fingerprint=fingerprint[:12])
+        self.maybe_compact()
+        return len(records)
+
+    # -- compaction ----------------------------------------------------------
+
+    def _segment_records(self) -> List[dict]:
+        out: List[dict] = []
+        for seg in self._segments():
+            for rec in _read_jsonl_dicts(seg):
+                if rec.get("type") == "note" \
+                        and rec.get("event") == "cand":
+                    out.append({k: v for k, v in rec.items()
+                                if k not in ("type", "event")})
+        return out
+
+    def _read_snapshot(self) -> dict:
+        try:
+            with open(self.snapshot_path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            return {"version": SNAPSHOT_VERSION, "compactions": 0,
+                    "records": [], "index": []}
+        if not isinstance(snap, dict) \
+                or not isinstance(snap.get("records"), list):
+            return {"version": SNAPSHOT_VERSION, "compactions": 0,
+                    "records": [], "index": []}
+        return snap
+
+    def _live(self, recs: Iterable[dict],
+              seen: Optional[set] = None) -> List[dict]:
+        """Collapse the at-least-once log into the exactly-once view:
+        keep one record per uid, and only records whose publish
+        fingerprint matches their observation's latest booked publish
+        (an UNBOOKED observation's records stay live — they are a
+        publish in flight, real candidates either way)."""
+        booked = self.published()
+        seen = set() if seen is None else seen
+        out: List[dict] = []
+        for rec in recs:
+            uid = rec.get("uid")
+            if uid is None or uid in seen:
+                continue
+            fp = booked.get(str(rec.get("obs", "")))
+            if fp is not None and rec.get("pub_fp") != fp:
+                continue  # superseded publish: dead record
+            seen.add(uid)
+            out.append(rec)
+        return out
+
+    def maybe_compact(self) -> bool:
+        """Compact when the un-compacted segment record count crosses
+        the ``PYPULSAR_TPU_CANDSTORE_COMPACT_RECORDS`` threshold."""
+        from pypulsar_tpu.tune import knobs
+
+        bound = int(knobs.env_int(ENV_COMPACT_RECORDS))
+        if bound <= 0:
+            return False
+        n = sum(1 for _ in self._segment_records())
+        if n < bound:
+            return False
+        return self.compact()
+
+    def _take_compact_lock(self) -> bool:
+        lock = os.path.join(self.dir, "compact.lock")
+        for _attempt in range(2):
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.write(fd, str(os.getpid()).encode())
+                os.close(fd)
+                return True
+            except OSError as e:
+                if e.errno != errno.EEXIST:
+                    return False
+                try:
+                    age = time.time() - os.path.getmtime(lock)
+                except OSError:
+                    continue  # holder just released: retry the O_EXCL
+                if age < _COMPACT_LOCK_STALE_S:
+                    return False  # live compactor elsewhere: skip
+                try:
+                    os.remove(lock)  # debris from a dead compactor
+                except OSError:
+                    pass
+        return False
+
+    def _release_compact_lock(self) -> None:
+        try:
+            os.remove(os.path.join(self.dir, "compact.lock"))
+        except OSError:
+            pass
+
+    def compact(self) -> bool:
+        """Fold snapshot + segments into a fresh (DM, P)-sorted indexed
+        snapshot (atomic tmp+replace), then unlink the consumed
+        segments.  A kill anywhere in between is safe: records are
+        never only in an unlinked segment (the replace landed first),
+        and duplicate copies left in un-unlinked segments collapse by
+        uid on the next read.  Returns True when a compaction ran."""
+        if self.fence is not None:
+            self.fence()
+        if not os.path.isdir(self.dir):
+            return False
+        if not self._take_compact_lock():
+            return False
+        try:
+            faultinject.trip("candstore.compact")
+            snap = self._read_snapshot()
+            segs = self._segments()
+            seen: set = set()
+            recs = self._live(list(snap.get("records", []))
+                              + self._segment_records(), seen)
+            recs.sort(key=_sort_key)
+            index = _build_index(recs)
+            if self.fence is not None:
+                self.fence()
+            atomic_write_text(self.snapshot_path, json.dumps({
+                "type": "candstore.snapshot",
+                "version": SNAPSHOT_VERSION,
+                "compactions": int(snap.get("compactions", 0)) + 1,
+                "n": len(recs),
+                "records": recs,
+                "index": index,
+            }))
+            for seg in segs:
+                try:
+                    os.remove(seg)
+                except OSError:
+                    pass
+            telemetry.counter("candstore.compactions")
+            telemetry.gauge("candstore.store_bytes",
+                            float(self.size_bytes()))
+            telemetry.event("candstore.compact", n=len(recs),
+                            segments=len(segs))
+            return True
+        finally:
+            self._release_compact_lock()
+
+    # -- read side -----------------------------------------------------------
+
+    def records(self) -> List[dict]:
+        """Every live record (snapshot first, then segments), deduped."""
+        snap = self._read_snapshot()
+        seen: set = set()
+        out = self._live(snap.get("records", []), seen)
+        out += self._live(self._segment_records(), seen)
+        return out
+
+    def _snapshot_scan(self, snap: dict, dm_lo: float,
+                       dm_hi: float) -> List[dict]:
+        """Snapshot records possibly inside [dm_lo, dm_hi], via the
+        in-file B-range index (bucketed rank ranges over the DM-sorted
+        array) — the reason --near queries do not scan the log."""
+        recs = snap.get("records", [])
+        index = snap.get("index") or []
+        if not index:
+            return list(recs)
+        out: List[dict] = []
+        for bucket in index:
+            if bucket.get("dm_hi", float("inf")) < dm_lo:
+                continue
+            if bucket.get("dm_lo", float("-inf")) > dm_hi:
+                break  # buckets are DM-ordered
+            out.extend(recs[int(bucket["start"]):int(bucket["stop"])])
+        return out
+
+    def query(self, near: Optional[Tuple[float, float]] = None,
+              tol_p: Optional[float] = None,
+              tol_dm: Optional[float] = None,
+              tenant: Optional[str] = None,
+              epoch_range: Optional[Tuple[float, float]] = None,
+              top: Optional[int] = None) -> List[dict]:
+        """Live records filtered by proximity/tenant/epoch, ranked by
+        SNR (uid tiebreak).  ``near`` is (P seconds, DM); ``tol_p`` is
+        FRACTIONAL on period, ``tol_dm`` absolute — both default to the
+        ``PYPULSAR_TPU_CANDSTORE_TOL_*`` knobs.  Results are identical
+        before and after compaction (the acceptance contract)."""
+        from pypulsar_tpu.tune import knobs
+
+        if tol_p is None:
+            tol_p = float(knobs.env_float("PYPULSAR_TPU_CANDSTORE_TOL_P"))
+        if tol_dm is None:
+            tol_dm = float(knobs.env_float(
+                "PYPULSAR_TPU_CANDSTORE_TOL_DM"))
+        snap = self._read_snapshot()
+        seen: set = set()
+        if near is not None:
+            p0, dm0 = float(near[0]), float(near[1])
+            pool = self._live(self._snapshot_scan(
+                snap, dm0 - tol_dm, dm0 + tol_dm), seen)
+        else:
+            pool = self._live(snap.get("records", []), seen)
+        pool += self._live(self._segment_records(), seen)
+        out: List[dict] = []
+        for rec in pool:
+            if near is not None:
+                dm = rec.get("dm")
+                p = rec.get("p_s")
+                if not isinstance(dm, (int, float)) \
+                        or not isinstance(p, (int, float)):
+                    continue
+                if abs(dm - dm0) > tol_dm:
+                    continue
+                if abs(p - p0) > tol_p * p0:
+                    continue
+            if tenant is not None and rec.get("tenant") != tenant:
+                continue
+            if epoch_range is not None:
+                e = rec.get("epoch_mjd")
+                if not isinstance(e, (int, float)) \
+                        or not (epoch_range[0] <= e <= epoch_range[1]):
+                    continue
+            out.append(rec)
+        out.sort(key=_rank_key)
+        if top is not None and top >= 0:
+            out = out[:top]
+        return out
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def size_bytes(self) -> int:
+        total = 0
+        try:
+            for name in os.listdir(self.dir):
+                try:
+                    total += os.path.getsize(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        return total
+
+    def status(self) -> Dict[str, Any]:
+        """One dict for the status/tlmsum surfaces: live record count,
+        raw log record count (the at-least-once excess is the dedup the
+        store performs), segment/snapshot shape and byte size."""
+        snap = self._read_snapshot()
+        seg_recs = self._segment_records()
+        live = self.records()
+        return {
+            "records": len(live),
+            "raw_records": len(snap.get("records", [])) + len(seg_recs),
+            "segments": len(self._segments()),
+            "segment_records": len(seg_recs),
+            "snapshot_records": len(snap.get("records", [])),
+            "compactions": int(snap.get("compactions", 0)),
+            "publishes": len(self.published()),
+            "bytes": self.size_bytes(),
+        }
+
+
+def _build_index(recs: List[dict]) -> List[dict]:
+    """Coarse B-range index over the (DM, P)-sorted record array: up to
+    ``_INDEX_BUCKETS`` contiguous rank ranges, each with its DM span."""
+    n = len(recs)
+    if n == 0:
+        return []
+    per = max(1, (n + _INDEX_BUCKETS - 1) // _INDEX_BUCKETS)
+    index: List[dict] = []
+    for start in range(0, n, per):
+        stop = min(start + per, n)
+        dms = [r.get("dm") for r in recs[start:stop]
+               if isinstance(r.get("dm"), (int, float))]
+        index.append({
+            "start": start, "stop": stop,
+            "dm_lo": min(dms) if dms else float("inf"),
+            "dm_hi": max(dms) if dms else float("inf"),
+        })
+    return index

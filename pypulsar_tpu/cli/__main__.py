@@ -14,7 +14,7 @@ TOOLS = [
     "mockspecfil2subbands", "demodulate", "pfd_snr", "pfdinfo",
     "gridding", "fitkepler", "shapiro", "pbdot", "massfunc",
     "pyppdot", "pyplotres", "coordconv", "tlmsum", "tlmtrace", "psrlint",
-    "tune",
+    "tune", "cands",
 ]
 
 

@@ -205,7 +205,7 @@ def main(argv=None):
                   % (type(e).__name__, e))
             rows.append({"pfd": pfdfn, "name": None, "best_dm": None,
                          "period": None, "snr": None, "weq_bins": None,
-                         "smean_mjy": None,
+                         "smean_mjy": None, "ra": None, "dec": None,
                          "error": f"unreadable: {type(e).__name__}"})
             continue
         try:
@@ -224,6 +224,7 @@ def main(argv=None):
                          "best_dm": float(pfd.bestdm),
                          "period": float(pfd.curr_p1), "snr": None,
                          "weq_bins": None, "smean_mjy": None,
+                         **_radec(pfd),
                          "error": f"failed: {type(e).__name__}"})
     if args.json:
         from pypulsar_tpu.resilience.journal import atomic_write_text
@@ -285,6 +286,7 @@ def _append_archive_row(args, pfd, pfdfn: str, rows: list) -> None:
                      "best_dm": float(pfd.bestdm),
                      "period": float(pfd.curr_p1), "snr": None,
                      "weq_bins": None, "smean_mjy": None,
+                     **_radec(pfd),
                      "error": "no on-pulse region"})
         return
     print("SNR: %.3f" % result["snr"])
@@ -301,6 +303,7 @@ def _append_archive_row(args, pfd, pfdfn: str, rows: list) -> None:
                      "best_dm": float(pfd.bestdm),
                      "period": float(pfd.curr_p1), "snr": None,
                      "weq_bins": None, "smean_mjy": None,
+                     **_radec(pfd),
                      "error": "non-finite SNR"})
         return
     rows.append({
@@ -312,7 +315,19 @@ def _append_archive_row(args, pfd, pfdfn: str, rows: list) -> None:
         "weq_bins": float(result["weq"]),
         "smean_mjy": (None if result["smean"] is None
                       else float(result["smean"])),
+        **_radec(pfd),
     })
+
+
+def _radec(pfd) -> dict:
+    """Sky position from the archive header (round 25): positional
+    queries and known-source vetoes need coordinates on every row, not
+    just in the binary archive the row summarizes."""
+    def clean(v):
+        return v if isinstance(v, str) and v and v != "Unknown" else None
+
+    return {"ra": clean(getattr(pfd, "rastr", None)),
+            "dec": clean(getattr(pfd, "decstr", None))}
 
 
 if __name__ == "__main__":

@@ -164,6 +164,12 @@ def build_parser():
                    help="min DM trials a cluster must appear in (default 2)")
     p.add_argument("--min-dm", type=float, default=None,
                    help="drop clusters whose best DM is below this")
+    p.add_argument("--known-sources", default=None, metavar="FILE",
+                   help="veto candidates matching this known-source "
+                        "catalog (text 'name period_s dm [tol_p_frac] "
+                        "[tol_dm]' lines or a JSON list) — "
+                        "harmonic-aware, the SAME matcher the "
+                        "cross-obs candsift uses (candstore.match)")
     p.add_argument("--journal", default=None, metavar="PATH.jsonl",
                    help="record the sifted .accelcands artifact in this "
                         "work-unit journal (resilience.RunJournal; with "
@@ -230,6 +236,11 @@ def _run(args):
                              else -1.0]).tobytes())
         h.update(np.int64([args.min_hits]).tobytes())
         h.update(args.outfile.encode())
+        if args.known_sources:
+            # a changed catalog must re-sift, not no-op on stale output
+            from pypulsar_tpu.candstore.match import catalog_digest
+
+            h.update(catalog_digest(args.known_sources).encode())
         # tool="sift": pointing this flag at the sweep->accel chain's
         # journal raises instead of silently truncating that manifest
         journal = RunJournal(args.journal, h.hexdigest(), tool="sift")
@@ -247,6 +258,8 @@ def _run(args):
     cands = sift(files, min_sigma=args.min_sigma, min_hits=args.min_hits)
     if args.min_dm is not None:
         cands = [c for c in cands if c.dm >= args.min_dm]
+    if args.known_sources:
+        cands = _veto_known(cands, args.known_sources)
     write_candlist(cands, args.outfile)
     if args.outfile:
         print(f"# {len(cands)} sifted candidates -> {args.outfile}",
@@ -257,6 +270,30 @@ def _run(args):
     if args.fold and cands:
         return _fold_sifted(args, files)
     return 0
+
+
+def _veto_known(cands, catalog_path):
+    """--known-sources: drop candidates matching the catalog, through
+    the ONE shared matcher (``candstore.match``) so this within-obs
+    veto can never drift from the cross-obs candsift's."""
+    from pypulsar_tpu.candstore.match import (format_ratio, load_catalog,
+                                              match_known)
+
+    catalog = load_catalog(catalog_path)
+    kept = []
+    for c in cands:
+        hit = match_known(c.period, c.dm, catalog)
+        if hit is None:
+            kept.append(c)
+        else:
+            src, ratio = hit
+            print(f"# known-source veto: {c.accelfile}:{c.candnum} "
+                  f"P={c.period:.6f}s DM={c.dm:.2f} matches {src.name} "
+                  f"({format_ratio(ratio)})", file=sys.stderr)
+    if len(kept) != len(cands):
+        print(f"# known-source veto dropped {len(cands) - len(kept)} "
+              f"of {len(cands)} candidates", file=sys.stderr)
+    return kept
 
 
 def _fold_sifted(args, files) -> int:

@@ -15,6 +15,9 @@ survey process:
   live telemetry collector: counters, gauges, and the round-21 log2
   latency histograms re-expressed as cumulative ``_bucket``/``_count``
   series, plus observation-state gauges from the manifests.
+- ``GET /candidates`` — the candidate store's query surface (round
+  25): live CandidateRecords under ``_fleet/candstore/``, filterable
+  by ``?p=&dm=`` proximity, tenant, and epoch range.
 
 Binding is loopback by default; ``port=0`` picks a free port (the
 multi-host harness uses that to run one endpoint per host). The server
@@ -208,7 +211,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 - http.server API
         try:
-            path = self.path.split("?", 1)[0]
+            path, _, query = self.path.partition("?")
             if path in ("/", "/status.json", "/status"):
                 body = json.dumps(
                     self.server.snapshot(), default=str).encode()
@@ -216,9 +219,13 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/metrics":
                 body = self.server.metrics().encode()
                 ctype = "text/plain; version=0.0.4"
+            elif path == "/candidates":
+                body = json.dumps(
+                    self.server.candidates(query), default=str).encode()
+                ctype = "application/json"
             else:
-                self.send_error(404, "unknown path (serve /status.json "
-                                     "and /metrics)")
+                self.send_error(404, "unknown path (serve /status.json, "
+                                     "/metrics and /candidates)")
                 return
             self.send_response(200)
             self.send_header("Content-Type", ctype)
@@ -260,6 +267,40 @@ class _Server(ThreadingHTTPServer):
 
     def metrics(self) -> str:
         return prometheus_text(self.outdir)
+
+    def candidates(self, query: str) -> Dict[str, Any]:
+        """``GET /candidates`` (round 25): the candidate store's query
+        surface over HTTP.  Parameterized like the ``cands`` CLI —
+        ``?p=..&dm=..`` (both, for a --near query), ``tol_p``,
+        ``tol_dm``, ``tenant``, ``epoch_lo``/``epoch_hi``, ``top``
+        (default 100).  No TTL cache: queries are parameterized and the
+        store read path is already cheap (indexed snapshot)."""
+        from urllib.parse import parse_qs
+
+        from pypulsar_tpu.candstore import CandStore
+
+        q = parse_qs(query or "")
+
+        def one(key, cast=str):
+            vals = q.get(key)
+            return cast(vals[0]) if vals else None
+
+        p = one("p", float)
+        dm = one("dm", float)
+        near = (p, dm) if p is not None and dm is not None else None
+        lo, hi = one("epoch_lo", float), one("epoch_hi", float)
+        erange = (lo, hi) if lo is not None and hi is not None else None
+        top = one("top", int)
+        store = CandStore(self.outdir)
+        records = store.query(
+            near=near, tol_p=one("tol_p", float),
+            tol_dm=one("tol_dm", float), tenant=one("tenant"),
+            epoch_range=erange, top=100 if top is None else top)
+        return {"outdir": self.outdir,
+                "t_unix": time.time(),
+                "n": len(records),
+                "store": store.status(),
+                "records": records}
 
 
 class StatusServer:

@@ -717,6 +717,31 @@ def render(s: TraceSummary, file: TextIO, top: int = 20) -> None:
         bb_bits.append(f"peak batch occupancy={int(occ)}")
     if bb_bits:
         p("#\n# batch broker: " + "  ".join(bb_bits))
+    # candidate-plane roll-up (round 25): what the candidate store
+    # ingested — records appended, publishes (and the exactly-once
+    # dup skips), compactions, store footprint, and the cross-obs
+    # sift's measured dedup factor
+    cs_bits = []
+    n_app = s.counters.get("candstore.appended")
+    if n_app:
+        cs_bits.append(f"records appended={_fmt_count(n_app)}")
+    n_pub = s.counters.get("candstore.publishes")
+    if n_pub:
+        cs_bits.append(f"publishes={_fmt_count(n_pub)}")
+    n_dup = s.counters.get("candstore.dup_publishes")
+    if n_dup:
+        cs_bits.append(f"dup publishes skipped={_fmt_count(n_dup)}")
+    n_cpt = s.counters.get("candstore.compactions")
+    if n_cpt:
+        cs_bits.append(f"compactions={_fmt_count(n_cpt)}")
+    sb = s.gauges.get("candstore.store_bytes", {}).get("last")
+    if sb:
+        cs_bits.append(f"store bytes={_fmt_count(sb)}")
+    df = s.gauges.get("candstore.dedup_factor", {}).get("last")
+    if df:
+        cs_bits.append(f"cross-obs dedup factor={df:.2f}")
+    if cs_bits:
+        p("#\n# candidate plane: " + "  ".join(cs_bits))
     # data-quality roll-up: what the dataguard scrub and the finite
     # gates did to this run's bytes (round 13)
     data_bits = []

@@ -298,6 +298,10 @@ class SurveyDaemon:
             min_free_mb=min_free_mb, max_pending=max_pending,
             verbose=verbose, **scheduler_kw)
         self._sched.on_obs_terminal = self._on_obs_terminal
+        # candidate-store ingest (round 25) stamps records with the
+        # admitting tenant, so /candidates?tenant= queries are real
+        self._sched.tenant_of = lambda name: self._obs_tenant.get(
+            name, "default")
 
         # reentrant: scheduler.submit() fires _on_obs_terminal
         # synchronously when ingest validation quarantines the arrival,

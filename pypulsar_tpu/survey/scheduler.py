@@ -354,6 +354,9 @@ class FleetScheduler:
         # optional terminal-edge hook (obs_name, state) the daemon uses
         # for tenant accounting; failures are swallowed (a passenger)
         self.on_obs_terminal = None
+        # optional obs_name -> tenant resolver for the candidate-store
+        # ingest edge (the daemon points this at its admission books)
+        self.tenant_of = None
         # set once run() has opened the initial manifests and promoted
         # the initial obs: submit() before this point would race the
         # startup manifest pass (the daemon waits on it)
@@ -671,6 +674,11 @@ class FleetScheduler:
                 cb(self.obs[obs_i].name, state)
             except Exception:  # noqa: BLE001 - accounting is a passenger
                 pass
+        if state == "done":
+            # publish to the candidate store UNDER the still-held claim
+            # (round 25) — the fenced append is what makes a dead
+            # host's late publish a no-op
+            self._publish_candidates(obs_i)
         if self.plane is None:
             return
         token = self._obs_tokens.get(obs_i)
@@ -682,6 +690,42 @@ class FleetScheduler:
                 trace_id=self._trace_ids[obs_i])
         except fleet_mod.StaleLeaseError:
             self._cede_obs(obs_i, already_terminal=True)
+
+    def _publish_candidates(self, obs_i: int) -> None:
+        """Candidate-store ingest (round 25): normalize this done
+        observation's terminal artifacts and publish them, fenced under
+        the obs claim when a plane is live.  A passenger like the
+        terminal hook — it only READS stage outputs and writes only
+        under ``_fleet/candstore/``, so per-obs artifacts stay
+        byte-identical and a store failure never fails the obs.
+        ``PYPULSAR_TPU_CANDSTORE=0`` restores the store-less fleet."""
+        from pypulsar_tpu import candstore as candstore_mod
+
+        if not candstore_mod.enabled():
+            return
+        obs = self.obs[obs_i]
+        outdir = os.path.dirname(obs.outbase) or "."
+        token = self._obs_tokens.get(obs_i)
+        fence = None
+        if self.plane is not None and token is not None:
+            fence = (lambda o=obs.name, t=token:
+                     self.plane.fence(o, t))
+        tenant = "default"
+        resolver = self.tenant_of
+        if resolver is not None:
+            try:
+                tenant = str(resolver(obs.name) or "default")
+            except Exception:  # noqa: BLE001 - accounting passenger
+                tenant = "default"
+        try:
+            candstore_mod.publish_obs(
+                outdir, obs.name, obs.outbase, obs.infile,
+                tenant=tenant, trace_id=self._trace_ids[obs_i],
+                fence=fence, token=token)
+        except fleet_mod.StaleLeaseError:
+            pass  # adopter owns the obs now; it will publish
+        except Exception:  # noqa: BLE001 - the store is a passenger
+            pass
 
     def _claim_obs(self, i: int, token: int, adopted_from=None) -> None:
         """Take ownership of one claimed observation: open its manifest

@@ -444,6 +444,31 @@ env_knob("PYPULSAR_TPU_BROKER_SLO_HOLD_S", "float", 30.0, "broker",
               "the broker stops waiting for batchmates (latency "
               "pressure gates coalescing width)")
 
+# -- candidate data plane (round 25) ----------------------------------------
+env_knob("PYPULSAR_TPU_CANDSTORE", "str", "1", "candstore",
+         invariant=False,
+         help="0 disables the candidate store entirely: the fleet runs "
+              "store-less exactly as before round 25 (per-obs "
+              "artifacts are byte-identical either way; this only "
+              "gates the _fleet/candstore/ ingest edge)")
+env_knob("PYPULSAR_TPU_CANDSTORE_SEGMENT_BYTES", "float", 4e6,
+         "candstore", invariant=False,
+         help="segment-log rotation bound: appends roll to a new "
+              "seg-*.jsonl once the active segment reaches this size")
+env_knob("PYPULSAR_TPU_CANDSTORE_COMPACT_RECORDS", "int", 2048,
+         "candstore", invariant=False,
+         help="compact the segment log into the indexed snapshot once "
+              "it holds this many records (0 disables auto-compaction; "
+              "cands --compact still forces one)")
+env_knob("PYPULSAR_TPU_CANDSTORE_TOL_P", "float", 1e-3, "candstore",
+         invariant=False,
+         help="default FRACTIONAL period tolerance for store queries "
+              "(--near) and cross-obs harmonic clustering")
+env_knob("PYPULSAR_TPU_CANDSTORE_TOL_DM", "float", 0.5, "candstore",
+         invariant=False,
+         help="default absolute DM tolerance for store queries (--near) "
+              "and cross-obs harmonic clustering")
+
 # -- data integrity ---------------------------------------------------------
 env_knob("PYPULSAR_TPU_MAX_BAD_FRAC", "float", 0.5, "data",
          invariant=False,

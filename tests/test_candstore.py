@@ -1,0 +1,491 @@
+"""Candidate data plane tests (round 25): the store's safety contracts
+(fenced appends rejected BEFORE touching the file, kill -9 mid-append +
+re-publish yielding exactly-once records, torn tails tolerated,
+pre/post-compaction query identity), multi-host racing publishes, the
+cross-observation candsift (harmonic clustering, known-source veto),
+the shared matcher, the ``cands`` CLI, the statusd ``/candidates``
+endpoint, and the scheduler's terminal-edge ingest — extending the
+``tests/test_multihost.py`` pattern (in-process FleetPlane handles over
+one shared directory; the plane is plain files, so the coordination
+machinery is identical to the M-process case)."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from pypulsar_tpu.candstore import (CandStore, cross_sift, load_catalog,
+                                    match_known, normalize_obs,
+                                    store_dir)
+from pypulsar_tpu.candstore.match import (CatalogError, format_ratio,
+                                          harmonic_ratio)
+from pypulsar_tpu.resilience import faultinject
+from pypulsar_tpu.survey.dag import StageSpec, SurveyConfig
+from pypulsar_tpu.survey.fleet import FleetPlane, StaleLeaseError
+from pypulsar_tpu.survey.scheduler import FleetScheduler
+from pypulsar_tpu.survey.state import Observation
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+def _plane(td, host, lease_s=1.0, settle_s=0.02, heartbeat_s=None):
+    return FleetPlane(str(td), host_id=host, lease_s=lease_s,
+                      settle_s=settle_s, heartbeat_s=heartbeat_s)
+
+
+def _rec(p_s, dm, snr, epoch=55000.0, tenant="default", **extra):
+    rec = {"p_s": p_s, "dm": dm, "snr": snr, "epoch_mjd": epoch,
+           "tenant": tenant}
+    rec.update(extra)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# the shared (P, DM) matcher
+# ---------------------------------------------------------------------------
+
+
+def test_harmonic_ratio_fundamental_harmonic_subharmonic():
+    assert harmonic_ratio(0.1024, 0.1024, 1e-3) == (1, 1)
+    assert harmonic_ratio(0.0512, 0.1024, 1e-3) == (1, 2)  # harmonic
+    assert harmonic_ratio(0.2048, 0.1024, 1e-3) == (2, 1)  # subharm
+    assert harmonic_ratio(0.1024 * 2 / 3, 0.1024, 1e-3) == (2, 3)
+    assert harmonic_ratio(0.0777, 0.1024, 1e-4) is None
+    assert format_ratio((1, 1)) == "fundamental"
+    assert format_ratio((1, 2)) == "1/2 harmonic"
+
+
+def test_catalog_text_and_json_roundtrip(tmp_path):
+    txt = tmp_path / "cat.txt"
+    txt.write_text("# comment\nB0531+21 0.0333924 56.77\n"
+                   "J0437-47 0.00575745 2.64 0.0005 0.3\n")
+    cat = load_catalog(str(txt))
+    assert [s.name for s in cat] == ["B0531+21", "J0437-47"]
+    assert cat[1].tol_p == 0.0005 and cat[1].tol_dm == 0.3
+    js = tmp_path / "cat.json"
+    js.write_text(json.dumps([{"name": "X", "p_s": 0.1, "dm": 10.0}]))
+    assert load_catalog(str(js))[0].p_s == 0.1
+    bad = tmp_path / "bad.txt"
+    bad.write_text("onlytwo 0.1\n")
+    with pytest.raises(CatalogError):
+        load_catalog(str(bad))
+
+
+def test_match_known_harmonic_aware_with_dm_gate(tmp_path):
+    cat = load_catalog(str(_write_cat(tmp_path)))
+    hit = match_known(0.0333924 / 2, 56.8, cat)  # detected at 2nd harm
+    assert hit is not None and hit[0].name == "B0531+21"
+    assert hit[1] == (1, 2)
+    assert match_known(0.0333924, 99.0, cat) is None  # DM gate
+
+
+def _write_cat(tmp_path):
+    cat = tmp_path / "known.txt"
+    cat.write_text("B0531+21 0.0333924 56.77\n")
+    return cat
+
+
+# ---------------------------------------------------------------------------
+# store: publish / query / books
+# ---------------------------------------------------------------------------
+
+
+def test_publish_query_roundtrip_with_filters(tmp_path):
+    st = CandStore(str(tmp_path))
+    st.publish("o0", [_rec(0.1024, 40.0, 12.0, epoch=55000.0),
+                      _rec(0.5, 10.0, 6.0, epoch=55000.0)], "fp0")
+    st.publish("o1", [_rec(0.1024, 40.05, 9.0, epoch=55010.0,
+                           tenant="lofar")], "fp1")
+    assert len(st.query()) == 3
+    near = st.query(near=(0.1024, 40.0), tol_p=1e-3, tol_dm=0.5)
+    assert [r["obs"] for r in near] == ["o0", "o1"]  # SNR-ranked
+    assert [r["obs"] for r in st.query(tenant="lofar")] == ["o1"]
+    assert [r["obs"] for r in
+            st.query(epoch_range=(55005.0, 55015.0))] == ["o1"]
+    assert len(st.query(top=1)) == 1
+    assert st.query(top=1)[0]["snr"] == 12.0
+
+
+def test_duplicate_publish_same_fingerprint_is_noop(tmp_path):
+    st = CandStore(str(tmp_path))
+    assert st.publish("o0", [_rec(0.1, 20.0, 5.0)], "fpA") == 1
+    assert st.publish("o0", [_rec(0.1, 20.0, 5.0)], "fpA") == 0
+    assert len(st.query()) == 1
+    assert st.published() == {"o0": "fpA"}
+
+
+def test_changed_fingerprint_supersedes_old_records(tmp_path):
+    st = CandStore(str(tmp_path))
+    st.publish("o0", [_rec(0.1, 20.0, 5.0)], "fpA")
+    st.publish("o0", [_rec(0.2, 21.0, 7.0)], "fpB")
+    recs = st.query()
+    assert len(recs) == 1 and recs[0]["p_s"] == 0.2
+    st.compact()
+    recs2 = st.query()
+    assert len(recs2) == 1 and recs2[0]["p_s"] == 0.2
+
+
+def test_torn_tail_tolerated(tmp_path):
+    st = CandStore(str(tmp_path))
+    st.publish("o0", [_rec(0.1, 20.0, 5.0)], "fpA")
+    seg = st._segments()[0]
+    with open(seg, "a") as f:
+        f.write('\n{"type": "note", "event": "cand", "uid": "torn')
+    assert len(st.query()) == 1  # fragment skipped, not fatal
+    st.publish("o1", [_rec(0.3, 30.0, 6.0)], "fpB")
+    assert len(st.query()) == 2  # appends after the tear still land
+    assert st.compact()
+    assert len(st.query()) == 2
+
+
+def test_kill_mid_append_then_republish_exactly_once(tmp_path):
+    """The acceptance contract: a kill -9 mid-append leaves orphan
+    records in the segment log (no books entry); the resume re-publish
+    appends a full fresh copy and the query surface dedups by uid to
+    exactly-once records."""
+    st = CandStore(str(tmp_path))
+    recs = [_rec(0.1 + 0.01 * i, 20.0 + i, 5.0 + i) for i in range(4)]
+    faultinject.configure("kill:candstore.append:3")
+    with pytest.raises(faultinject.InjectedKill):
+        st.publish("o0", recs, "fpA")
+    faultinject.reset()
+    assert st.published() == {}  # books never saw the torn publish
+    assert st.publish("o0", recs, "fpA") == 4  # resume re-publishes
+    got = st.query()
+    assert len(got) == 4  # exactly-once, not 6
+    # the raw log really does hold duplicates — dedup did the work
+    raw = sum(1 for seg in st._segments()
+              for line in open(seg) if '"event": "cand"' in line)
+    assert raw == 6
+    st.compact()
+    assert len(st.query()) == 4
+
+
+def test_kill_during_compaction_loses_nothing(tmp_path):
+    st = CandStore(str(tmp_path))
+    st.publish("o0", [_rec(0.1, 20.0, 5.0)], "fpA")
+    faultinject.configure("kill:candstore.compact:1")
+    with pytest.raises(faultinject.InjectedKill):
+        st.compact()
+    faultinject.reset()
+    assert len(st.query()) == 1  # segments untouched
+    assert st.compact()
+    assert len(st.query()) == 1
+
+
+# ---------------------------------------------------------------------------
+# store: compaction + snapshot index
+# ---------------------------------------------------------------------------
+
+
+def test_query_identical_pre_and_post_compaction(tmp_path):
+    st = CandStore(str(tmp_path))
+    for i in range(5):
+        st.publish(f"o{i}", [_rec(0.05 + 0.03 * j, 5.0 * j + i, 4.0 + j,
+                                  epoch=55000.0 + i)
+                             for j in range(6)], f"fp{i}")
+    queries = [dict(), dict(near=(0.08, 5.0)), dict(top=7),
+               dict(epoch_range=(55001.0, 55003.0)),
+               dict(near=(0.11, 10.0), tol_dm=3.0)]
+    pre = [st.query(**q) for q in queries]
+    assert st.compact()
+    post = [st.query(**q) for q in queries]
+    assert pre == post
+    assert st._segments() == []  # consumed segments unlinked
+    snap = st._read_snapshot()
+    dms = [r["dm"] for r in snap["records"]]
+    assert dms == sorted(dms)  # (DM, P)-sorted
+    assert snap["index"], "snapshot must carry the B-range index"
+    starts = [b["start"] for b in snap["index"]]
+    assert starts == sorted(starts)
+
+
+def test_auto_compaction_threshold(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYPULSAR_TPU_CANDSTORE_COMPACT_RECORDS", "3")
+    st = CandStore(str(tmp_path))
+    st.publish("o0", [_rec(0.1, 20.0, 5.0)], "fpA")
+    assert st._segments()  # below threshold: log retained
+    st.publish("o1", [_rec(0.2, 21.0, 6.0),
+                      _rec(0.3, 22.0, 7.0)], "fpB")
+    assert st._segments() == []  # threshold crossed: auto-compacted
+    assert st.status()["compactions"] == 1
+    assert len(st.query()) == 3
+
+
+def test_segment_rotation_bound(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYPULSAR_TPU_CANDSTORE_SEGMENT_BYTES", "200")
+    st = CandStore(str(tmp_path))
+    for i in range(4):
+        st.publish(f"o{i}", [_rec(0.1 + i, 20.0, 5.0)], f"fp{i}")
+    assert len(st._segments()) > 1  # tiny bound: the log rolled
+    assert len(st.query()) == 4
+
+
+# ---------------------------------------------------------------------------
+# multi-host fencing
+# ---------------------------------------------------------------------------
+
+
+def test_stale_token_writer_rejected_before_touching_store(tmp_path):
+    """A dead host's late publish must be a no-op: the fence fires
+    before the store directory even exists."""
+    pa = _plane(tmp_path, "hA", settle_s=0.0)
+    ta = pa.claim("o0")
+    assert ta is not None
+    # hA never registered a lease, so hB adopts o0 immediately with a
+    # strictly higher token — hA is now the dead host waking up
+    pb = _plane(tmp_path, "hB", settle_s=0.0)
+    tb = pb.claim("o0")
+    assert tb is not None and tb > ta
+    st = CandStore(str(tmp_path),
+                   fence=lambda: pa.fence("o0", ta))
+    with pytest.raises(StaleLeaseError):
+        st.publish("o0", [_rec(0.1, 20.0, 5.0)], "fpA", token=ta)
+    assert not os.path.exists(store_dir(str(tmp_path)))
+    # the adopter's publish (current token) lands fine
+    st2 = CandStore(str(tmp_path),
+                    fence=lambda: pb.fence("o0", tb))
+    assert st2.publish("o0", [_rec(0.1, 20.0, 5.0)], "fpA",
+                       token=tb) == 1
+    assert len(st2.query()) == 1
+
+
+def test_two_racing_hosts_publish_to_one_store(tmp_path):
+    """Two hosts publishing different observations concurrently into
+    one store: every record lands exactly once, no torn lines."""
+    pa = _plane(tmp_path, "hA", settle_s=0.0)
+    pb = _plane(tmp_path, "hB", settle_s=0.0)
+    errors = []
+
+    def go(plane, host, lo):
+        try:
+            for i in range(lo, lo + 4):
+                obs = f"o{i}"
+                tok = plane.claim(obs)
+                assert tok is not None, (host, obs)
+                st = CandStore(str(tmp_path),
+                               fence=lambda o=obs, t=tok:
+                               plane.fence(o, t))
+                st.publish(obs, [_rec(0.05 * (i + 1), 10.0 + i,
+                                      5.0 + i)], f"fp{i}", token=tok)
+                plane.mark_terminal(obs, tok, "done")
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append((host, e))
+
+    ts = [threading.Thread(target=go, args=(pa, "hA", 0)),
+          threading.Thread(target=go, args=(pb, "hB", 4))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errors, errors
+    st = CandStore(str(tmp_path))
+    got = st.query()
+    assert sorted(r["obs"] for r in got) == [f"o{i}" for i in range(8)]
+    assert st.compact()
+    assert sorted(r["obs"] for r in st.query()) \
+        == [f"o{i}" for i in range(8)]
+
+
+# ---------------------------------------------------------------------------
+# cross-observation candsift
+# ---------------------------------------------------------------------------
+
+
+def test_cross_sift_clusters_epochs_and_harmonics(tmp_path):
+    """The same pulsar at three epochs — once at its 2nd harmonic —
+    collapses to ONE multi-epoch cluster; per-epoch noise stays in
+    singletons below it."""
+    recs = [
+        _rec(0.1024, 40.0, 12.0, epoch=55000.0, uid="a", obs="o0"),
+        _rec(0.10241, 40.1, 10.0, epoch=55010.0, uid="b", obs="o1"),
+        _rec(0.0512, 39.9, 8.0, epoch=55020.0, uid="c", obs="o2"),
+        _rec(0.777, 12.0, 6.0, epoch=55000.0, uid="d", obs="o0"),
+        _rec(0.333, 77.0, 5.5, epoch=55010.0, uid="e", obs="o1"),
+    ]
+    clusters = cross_sift(recs, tol_p=1e-3, tol_dm=0.5)
+    assert len(clusters) == 3
+    top = clusters[0]
+    assert top["n_epochs"] == 3 and top["n_hits"] == 3
+    assert top["p_s"] == 0.1024  # strongest record seeds the cluster
+    assert "1/2 harmonic" in top["harmonics"]
+    assert sorted(top["obs"]) == ["o0", "o1", "o2"]
+    assert all(c["n_epochs"] == 1 for c in clusters[1:])
+
+
+def test_cross_sift_known_source_veto(tmp_path):
+    cat = load_catalog(str(_write_cat(tmp_path)))
+    recs = [_rec(0.0333924, 56.8, 20.0, uid="crab"),
+            _rec(0.4, 12.0, 6.0, uid="new")]
+    clusters = cross_sift(recs, tol_p=1e-3, tol_dm=0.5, known=cat)
+    by_known = {c["known_source"]: c for c in clusters}
+    assert "B0531+21" in by_known
+    assert by_known["B0531+21"]["known_ratio"] == "fundamental"
+    assert by_known[None]["p_s"] == 0.4
+
+
+# ---------------------------------------------------------------------------
+# query surfaces: cands CLI + statusd /candidates
+# ---------------------------------------------------------------------------
+
+
+def test_cands_cli_json_and_sift(tmp_path, capsys):
+    from pypulsar_tpu.cli import cands as cands_cli
+
+    st = CandStore(str(tmp_path))
+    st.publish("o0", [_rec(0.1024, 40.0, 12.0, epoch=55000.0)], "fp0")
+    st.publish("o1", [_rec(0.1024, 40.0, 9.0, epoch=55010.0)], "fp1")
+    assert cands_cli.main([str(tmp_path), "--near", "0.1024", "40.0",
+                           "--json"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert [r["obs"] for r in rows] == ["o0", "o1"]
+    assert cands_cli.main([str(tmp_path), "--sift", "--json"]) == 0
+    clusters = json.loads(capsys.readouterr().out)
+    assert len(clusters) == 1 and clusters[0]["n_epochs"] == 2
+    # --compact forces compaction and answers identically
+    assert cands_cli.main([str(tmp_path), "--compact", "--json"]) == 0
+    assert len(json.loads(capsys.readouterr().out)) == 2
+    assert CandStore(str(tmp_path))._segments() == []
+
+
+def test_statusd_candidates_endpoint(tmp_path):
+    from pypulsar_tpu.obs.statusd import StatusServer
+
+    st = CandStore(str(tmp_path))
+    st.publish("o0", [_rec(0.1024, 40.0, 12.0, tenant="lofar"),
+                      _rec(0.7, 10.0, 5.0)], "fp0")
+    with StatusServer(str(tmp_path), port=0) as srv:
+        doc = json.loads(urllib.request.urlopen(
+            srv.url + "/candidates", timeout=10).read())
+        assert doc["n"] == 2
+        assert doc["store"]["publishes"] == 1
+        doc2 = json.loads(urllib.request.urlopen(
+            srv.url + "/candidates?p=0.1024&dm=40.0&tenant=lofar",
+            timeout=10).read())
+        assert doc2["n"] == 1
+        assert doc2["records"][0]["snr"] == 12.0
+
+
+# ---------------------------------------------------------------------------
+# ingest: normalize + the scheduler's terminal edge
+# ---------------------------------------------------------------------------
+
+
+def _snr_stage():
+    """Stub DAG stage that writes a pfd_snr-shaped summary, so the
+    terminal-edge ingest has something real to normalize."""
+    def run(o, c):
+        rows = [{"pfd": f"{o.outbase}.pfd", "name": o.name,
+                 "best_dm": 40.0, "period": 0.1024, "snr": 11.0,
+                 "weq_bins": 4.0, "smean_mjy": None,
+                 "ra": "05:34:21.0", "dec": "22:00:57.0"}]
+        with open(f"{o.outbase}_snr.json", "w") as f:
+            json.dump(rows, f)
+        return 0
+
+    return StageSpec("snr", "stub", False, (), lambda o, c: [],
+                     lambda o, c: [f"{o.outbase}_snr.json"], run=run)
+
+
+def _mk_obs(td, n):
+    obs = []
+    for i in range(n):
+        raw = os.path.join(str(td), f"o{i}.raw")
+        with open(raw, "wb") as f:
+            f.write(b"x" * 64)
+        obs.append(Observation(f"o{i}", raw,
+                               os.path.join(str(td), f"o{i}")))
+    return obs
+
+
+def test_normalize_obs_prefers_row_radec(tmp_path):
+    outbase = str(tmp_path / "o0")
+    rows = [{"pfd": "x.pfd", "best_dm": 40.0, "period": 0.1024,
+             "snr": 11.0, "ra": "05:34:21.0", "dec": "22:00:57.0"}]
+    with open(outbase + "_snr.json", "w") as f:
+        json.dump(rows, f)
+    recs, fp = normalize_obs("o0", outbase, str(tmp_path / "o0.raw"))
+    assert len(recs) == 1
+    assert recs[0]["ra"] == "05:34:21.0"
+    assert recs[0]["dm"] == 40.0 and recs[0]["p_s"] == 0.1024
+    # fingerprint tracks artifact content
+    with open(outbase + "_snr.json", "a") as f:
+        f.write(" ")
+    _, fp2 = normalize_obs("o0", outbase, str(tmp_path / "o0.raw"))
+    assert fp2 != fp
+
+
+def test_scheduler_terminal_edge_publishes(tmp_path):
+    obs = _mk_obs(tmp_path, 2)
+    res = FleetScheduler(obs, SurveyConfig(),
+                         stages=[_snr_stage()]).run()
+    assert res.ok
+    st = CandStore(str(tmp_path))
+    got = st.query()
+    assert sorted(r["obs"] for r in got) == ["o0", "o1"]
+    assert got[0]["ra"] == "05:34:21.0"
+    assert st.published().keys() == {"o0", "o1"}
+    # a --resume over the same artifacts is an exactly-once no-op
+    res2 = FleetScheduler(obs, SurveyConfig(),
+                          stages=[_snr_stage()]).run()
+    assert res2.ok
+    assert len(CandStore(str(tmp_path)).query()) == 2
+
+
+def test_scheduler_store_disabled_leaves_no_trace(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYPULSAR_TPU_CANDSTORE", "0")
+    obs = _mk_obs(tmp_path, 1)
+    res = FleetScheduler(obs, SurveyConfig(),
+                         stages=[_snr_stage()]).run()
+    assert res.ok
+    assert not os.path.exists(store_dir(str(tmp_path)))
+
+
+# ---------------------------------------------------------------------------
+# sift --known-sources (the within-obs half of the shared matcher)
+# ---------------------------------------------------------------------------
+
+
+def test_sift_cli_known_sources_veto(tmp_path):
+    from pypulsar_tpu.cli import sift as sift_cli
+    from pypulsar_tpu.io.accelcands import parse_candlist
+    from pypulsar_tpu.io.infodata import InfoData
+    from pypulsar_tpu.io.prestocand import write_rzwcands
+
+    N, dt = 32768, 1e-3
+    T = N * dt
+    base = str(tmp_path / "x_DM56.77")
+    inf = InfoData()
+    inf.epoch = 55000.0
+    inf.dt = dt
+    inf.N = N
+    inf.DM = 56.77
+    inf.telescope = "Fake"
+    inf.lofreq = 1400.0
+    inf.BW = 100.0
+    inf.numchan = 1
+    inf.chan_width = 100.0
+    inf.object = "FAKE"
+    inf.to_file(base + ".inf")
+    # one candidate at the Crab period, one at an unknown 0.25 s
+    write_rzwcands(base + "_ACCEL_50.cand",
+                   [dict(r=T / 0.0333924, rerr=0.1, z=0.0, zerr=0.1,
+                         sig=12.0, pow=50.0),
+                    dict(r=T / 0.25, rerr=0.1, z=0.0, zerr=0.1,
+                         sig=9.0, pow=30.0)])
+    out = str(tmp_path / "sifted.accelcands")
+    rc = sift_cli.main([base + "_ACCEL_50.cand", "-o", out,
+                        "--min-hits", "1",
+                        "--known-sources", str(_write_cat(tmp_path))])
+    assert rc == 0
+    kept = parse_candlist(out)
+    assert len(kept) == 1
+    assert abs(kept[0].period - 0.25) < 1e-3  # Crab vetoed, new kept

@@ -718,6 +718,7 @@ def test_pfd_snr_gates_nonfinite_row(monkeypatch):
     assert rows == [{"pfd": "fake.pfd", "name": "FAKE",
                      "best_dm": 10.0, "period": 0.1, "snr": None,
                      "weq_bins": None, "smean_mjy": None,
+                     "ra": None, "dec": None,
                      "error": "non-finite SNR"}]
     assert totals["data.nonfinite_cands_dropped"] == 1
     assert json.dumps(rows)  # the summary stays serializable
