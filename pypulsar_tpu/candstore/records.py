@@ -156,6 +156,15 @@ def normalize_obs(obs_name: str, outbase: str, infile: str,
 
     h = hashlib.sha256()
     h.update(obs_name.encode())
+    # metadata that rides on every record but is NOT derivable from
+    # the artifact files: if the tenant mapping or the filterbank
+    # header's position/epoch changes between runs while the artifacts
+    # do not, the fingerprint must still change, so the re-publish
+    # supersedes the stale records instead of being dup-skipped and
+    # leaving e.g. /candidates?tenant= filtering wrong forever
+    # (trace_id stays OUT — it differs every run and would defeat the
+    # exactly-once resume no-op)
+    h.update(f"\x00{tenant}\x00{ra}\x00{dec}\x00{epoch}\x00".encode())
     h.update(_digest_or_missing(snr_path).encode())
     h.update(_digest_or_missing(acc_path).encode())
     return records, h.hexdigest()

@@ -31,7 +31,29 @@ live, so the queryable view is exactly-once even though the log is
 at-least-once.  Compaction folds the live view into ``snapshot.json``
 (atomic tmp+replace) sorted by (DM, period) with a coarse B-range index
 over DM, so ``--near`` queries bisect buckets instead of scanning the
-log; consumed segments are unlinked only after the replace lands.
+log.
+
+Compaction vs concurrent publishers (the retire-then-read discipline):
+a segment is never read-then-unlinked in place — a publisher on
+another host could append between the read and the unlink, and those
+records would vanish while its books entry suppresses the re-publish
+forever.  Instead the compactor atomically renames every segment
+aside to a unique ``*.retired-*`` name BEFORE reading it, and
+only ever unlinks retired files (after the snapshot replace lands).
+The publisher closes the other half of the handshake: after its last
+append it compares the segment path's inode against the handle it
+wrote through, and only a still-linked segment gets booked — a
+renamed-away segment is re-published into a fresh one (duplicates
+collapse by uid).  Because rename happens-before the compactor's read
+and append happens-before the publisher's inode check, every booked
+record is either in a live segment or was captured by the snapshot:
+records are never only in an unlinked file.  Readers scan retired
+files too, so a compactor killed between rename and replace hides
+nothing.  ``compact.lock`` serializes compactors: stale locks are
+stolen via ``os.rename`` (exactly one stealer can win), the holder
+refreshes the lock mtime while it works, and re-checks ownership
+before the snapshot replace so a stolen lock aborts instead of
+clobbering the thief's newer snapshot.
 """
 
 from __future__ import annotations
@@ -57,6 +79,9 @@ BOOKS = "books.jsonl"
 SNAPSHOT = "snapshot.json"
 SEG_PREFIX = "seg-"
 SEG_SUFFIX = ".jsonl"
+# a compacting segment is renamed aside to <seg>.retired-<unique>
+# before it is read; only retired files are ever unlinked
+RETIRED_MARK = ".retired-"
 SNAPSHOT_VERSION = 1
 # coarse B-range index granularity: at most this many buckets over the
 # (DM, P)-sorted snapshot — each bucket stores its DM span + rank range
@@ -66,6 +91,12 @@ _INDEX_BUCKETS = 64
 _COMPACT_LOCK_STALE_S = 60.0
 # per-call uniqueness for journal-header tmp files (see _ensure_journal)
 _HDR_SEQ = itertools.count()
+# books.jsonl parse cache keyed on (size, mtime_ns): every publish
+# consults the ledger, and re-parsing the whole survey's publish
+# history per observation is O(store) work that an append-only file's
+# stat signature makes unnecessary
+_BOOKS_CACHE: Dict[str, Tuple[Tuple[int, int], Dict[str, str]]] = {}
+_BOOKS_CACHE_LOCK = threading.Lock()
 
 ENV_CANDSTORE = "PYPULSAR_TPU_CANDSTORE"
 ENV_SEGMENT_BYTES = "PYPULSAR_TPU_CANDSTORE_SEGMENT_BYTES"
@@ -156,6 +187,7 @@ class CandStore:
         return os.path.join(self.dir, SNAPSHOT)
 
     def _segments(self) -> List[str]:
+        """Appendable segments — what publishers rotate over."""
         try:
             names = os.listdir(self.dir)
         except OSError:
@@ -163,6 +195,21 @@ class CandStore:
         return [os.path.join(self.dir, n) for n in sorted(names)
                 if n.startswith(SEG_PREFIX) and n.endswith(SEG_SUFFIX)
                 and not n.endswith(".tmp")]
+
+    def _retired_segments(self) -> List[str]:
+        """Segments a compactor renamed aside but has not yet folded
+        into the snapshot (it died, or is mid-compaction right now).
+        Readers must include them — their records may exist nowhere
+        else until the snapshot replace lands."""
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        return [os.path.join(self.dir, n) for n in sorted(names)
+                if n.startswith(SEG_PREFIX) and RETIRED_MARK in n]
+
+    def _all_segments(self) -> List[str]:
+        return self._retired_segments() + self._segments()
 
     def _active_segment(self) -> str:
         """The segment new records append to: the highest-numbered one
@@ -230,16 +277,46 @@ class CandStore:
             except OSError:
                 pass
 
+    @staticmethod
+    def _still_linked(path: str,
+                      ino: Optional[Tuple[int, int]]) -> bool:
+        """Does ``path`` still name the inode we appended through?
+        ``ino`` is None when nothing was written (trivially linked)."""
+        if ino is None:
+            return True
+        try:
+            st = os.stat(path)
+        except OSError:
+            return False
+        return (st.st_dev, st.st_ino) == ino
+
     # -- books (exactly-once ledger) -----------------------------------------
 
     def published(self) -> Dict[str, str]:
-        """obs name -> fingerprint of its LATEST booked publish."""
+        """obs name -> fingerprint of its LATEST booked publish.
+
+        Cached on the ledger's (size, mtime_ns) stat signature: the
+        file is append-only, so an unchanged signature means an
+        unchanged parse — another host's append bumps both and misses
+        the cache.  Keeps publish() O(new bytes), not O(survey)."""
+        path = self.books_path
+        try:
+            st = os.stat(path)
+        except OSError:
+            return {}
+        sig = (int(st.st_size), int(st.st_mtime_ns))
+        with _BOOKS_CACHE_LOCK:
+            hit = _BOOKS_CACHE.get(path)
+            if hit is not None and hit[0] == sig:
+                return dict(hit[1])
         out: Dict[str, str] = {}
-        for rec in _read_jsonl_dicts(self.books_path):
+        for rec in _read_jsonl_dicts(path):
             if rec.get("type") == "done" \
                     and str(rec.get("unit", "")).startswith("publish:"):
                 out[rec["unit"][len("publish:"):]] = \
                     str(rec.get("fingerprint", ""))
+        with _BOOKS_CACHE_LOCK:
+            _BOOKS_CACHE[path] = (sig, dict(out))
         return out
 
     # -- write side ----------------------------------------------------------
@@ -262,21 +339,40 @@ class CandStore:
             telemetry.counter("candstore.dup_publishes")
             return 0
         os.makedirs(self.dir, exist_ok=True)
-        seg_path = self._active_segment()
-        self._ensure_journal(seg_path)
-        seg = RunJournal(seg_path, "", tool=TOOL, shared=True)
-        try:
-            for i, rec in enumerate(records):
-                if self.fence is not None:
-                    self.fence()
-                faultinject.trip("candstore.append")
-                body = {k: v for k, v in rec.items()
-                        if k not in ("uid", "obs", "pub_fp")}
-                seg.note(event="cand", uid=f"{obs}:{i}", obs=obs,
-                         pub_fp=fingerprint, **body)
-                telemetry.counter("candstore.appended")
-        finally:
-            seg.close()
+        for _attempt in range(8):
+            seg_path = self._active_segment()
+            self._ensure_journal(seg_path)
+            seg = RunJournal(seg_path, "", tool=TOOL, shared=True)
+            ino = None
+            try:
+                for i, rec in enumerate(records):
+                    if self.fence is not None:
+                        self.fence()
+                    faultinject.trip("candstore.append")
+                    body = {k: v for k, v in rec.items()
+                            if k not in ("uid", "obs", "pub_fp")}
+                    seg.note(event="cand", uid=f"{obs}:{i}", obs=obs,
+                             pub_fp=fingerprint, **body)
+                    telemetry.counter("candstore.appended")
+                ino = seg.inode()
+            finally:
+                seg.close()
+            # The compactor's half of the retire-then-read handshake
+            # guarantees every record appended BEFORE a segment's
+            # retirement rename is captured by the compaction read.
+            # Verify ours were: the segment path must still be the
+            # inode we appended through.  If a racing compaction
+            # renamed it away (and may unlink it), re-publish into a
+            # fresh segment — the retired copies collapse by uid.
+            # Booking is gated on this check, so books never assert
+            # records that live only in an unlinked file.
+            if self._still_linked(seg_path, ino):
+                break
+            telemetry.counter("candstore.republishes")
+        else:
+            raise RuntimeError(
+                f"candstore: segment kept retiring under publish of "
+                f"{obs!r}; giving up rather than booking lost records")
         if self.fence is not None:
             self.fence()
         self._ensure_journal(self.books_path)
@@ -297,15 +393,36 @@ class CandStore:
 
     # -- compaction ----------------------------------------------------------
 
-    def _segment_records(self) -> List[dict]:
+    @staticmethod
+    def _records_of(paths: Iterable[str]) -> List[dict]:
         out: List[dict] = []
-        for seg in self._segments():
+        for seg in paths:
             for rec in _read_jsonl_dicts(seg):
                 if rec.get("type") == "note" \
                         and rec.get("event") == "cand":
                     out.append({k: v for k, v in rec.items()
                                 if k not in ("type", "event")})
         return out
+
+    def _segment_records(self) -> List[dict]:
+        return self._records_of(self._all_segments())
+
+    def _segment_line_count(self) -> int:
+        """Upper bound on the log's record count WITHOUT parsing a
+        byte of JSON — non-blank lines minus each file's header line
+        (a torn fragment counts, which only trips compaction one
+        record early).  The auto-compaction gate runs on every
+        publish; materializing every record just to count would make
+        publishing O(store)."""
+        n = 0
+        for seg in self._all_segments():
+            try:
+                with open(seg, "rb") as f:
+                    lines = sum(1 for ln in f if ln.strip())
+            except OSError:
+                continue
+            n += max(0, lines - 1)
+        return n
 
     def _read_snapshot(self) -> dict:
         try:
@@ -349,73 +466,144 @@ class CandStore:
         bound = int(knobs.env_int(ENV_COMPACT_RECORDS))
         if bound <= 0:
             return False
-        n = sum(1 for _ in self._segment_records())
-        if n < bound:
+        if self._segment_line_count() < bound:
             return False
         return self.compact()
 
-    def _take_compact_lock(self) -> bool:
-        lock = os.path.join(self.dir, "compact.lock")
+    @property
+    def _lock_path(self) -> str:
+        return os.path.join(self.dir, "compact.lock")
+
+    def _take_compact_lock(self) -> Optional[str]:
+        """O_EXCL-create ``compact.lock`` carrying a unique owner
+        token; returns the token, or None when a live compactor holds
+        the lock.  A stale lock (older than the staleness age) is
+        stolen by ``os.rename``-ing it aside — a rename of one inode
+        can succeed for exactly ONE stealer, so two processes that
+        both see the same stale lock cannot both 'clean it up' (a
+        racing ``os.remove`` pair would let the second remove delete
+        the winner's fresh lock and run two compactors concurrently —
+        a data-loss path; see compact())."""
+        lock = self._lock_path
+        owner = f"{os.getpid()}-{threading.get_ident()}-{next(_HDR_SEQ)}"
         for _attempt in range(2):
             try:
                 fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                os.write(fd, str(os.getpid()).encode())
+                os.write(fd, owner.encode())
                 os.close(fd)
-                return True
+                return owner
             except OSError as e:
                 if e.errno != errno.EEXIST:
-                    return False
+                    return None
                 try:
                     age = time.time() - os.path.getmtime(lock)
                 except OSError:
                     continue  # holder just released: retry the O_EXCL
                 if age < _COMPACT_LOCK_STALE_S:
-                    return False  # live compactor elsewhere: skip
+                    return None  # live compactor elsewhere: skip
+                grave = f"{lock}.{owner}.stale"
                 try:
-                    os.remove(lock)  # debris from a dead compactor
+                    os.rename(lock, grave)  # exactly one stealer wins
+                except OSError:
+                    return None  # the other stealer got this inode
+                try:
+                    os.remove(grave)
                 except OSError:
                     pass
-        return False
+        return None
 
-    def _release_compact_lock(self) -> None:
+    def _lock_owned(self, owner: str) -> bool:
         try:
-            os.remove(os.path.join(self.dir, "compact.lock"))
+            with open(self._lock_path) as f:
+                return f.read().strip() == owner
         except OSError:
-            pass
+            return False
+
+    def _touch_lock(self, owner: str) -> None:
+        """Refresh the lock mtime mid-compaction so a legitimately
+        long run (snapshot scale is the whole survey) is not mistaken
+        for a dead holder and stolen out from under us."""
+        if self._lock_owned(owner):
+            try:
+                os.utime(self._lock_path, None)
+            except OSError:
+                pass
+
+    def _release_compact_lock(self, owner: str) -> None:
+        # only remove OUR lock: if a stealer decided we were dead, the
+        # file at the lock path is the thief's, not ours to delete
+        if self._lock_owned(owner):
+            try:
+                os.remove(self._lock_path)
+            except OSError:
+                pass
 
     def compact(self) -> bool:
         """Fold snapshot + segments into a fresh (DM, P)-sorted indexed
-        snapshot (atomic tmp+replace), then unlink the consumed
-        segments.  A kill anywhere in between is safe: records are
-        never only in an unlinked segment (the replace landed first),
-        and duplicate copies left in un-unlinked segments collapse by
-        uid on the next read.  Returns True when a compaction ran."""
+        snapshot (atomic tmp+replace), then unlink the consumed files.
+        Returns True when a compaction ran.
+
+        The retire-then-read discipline (module doc): every segment is
+        atomically renamed aside BEFORE it is read, so the read is a
+        superset of anything a publisher appended-then-booked (its
+        inode check happens after its appends; rename < read means
+        append < rename implies the record is in what we read, and
+        append > rename fails the publisher's check and re-publishes).
+        Only retired files are ever unlinked, and only after the
+        snapshot replace lands — a kill anywhere in between leaves
+        records readable (readers scan retired files), and duplicate
+        copies collapse by uid on the next read."""
         if self.fence is not None:
             self.fence()
         if not os.path.isdir(self.dir):
             return False
-        if not self._take_compact_lock():
+        owner = self._take_compact_lock()
+        if owner is None:
             return False
         try:
             faultinject.trip("candstore.compact")
+            # adopt a dead compactor's leftovers, then retire the
+            # current segments; publishers converge on fresh ones
+            retired = self._retired_segments()
+            for seg in self._segments():
+                if self.fence is not None:
+                    self.fence()
+                dest = f"{seg}{RETIRED_MARK}{os.getpid()}-" \
+                       f"{next(_HDR_SEQ)}"
+                try:
+                    os.rename(seg, dest)
+                except OSError:
+                    continue  # vanished under us: nothing to consume
+                retired.append(dest)
             snap = self._read_snapshot()
-            segs = self._segments()
+            recs_in = list(snap.get("records", []))
+            for seg in retired:
+                self._touch_lock(owner)
+                recs_in += self._records_of([seg])
             seen: set = set()
-            recs = self._live(list(snap.get("records", []))
-                              + self._segment_records(), seen)
+            recs = self._live(recs_in, seen)
             recs.sort(key=_sort_key)
             index = _build_index(recs)
             if self.fence is not None:
                 self.fence()
-            atomic_write_text(self.snapshot_path, json.dumps({
+            payload = json.dumps({
                 "type": "candstore.snapshot",
                 "version": SNAPSHOT_VERSION,
                 "compactions": int(snap.get("compactions", 0)) + 1,
                 "n": len(recs),
                 "records": recs,
                 "index": index,
-            }))
-            for seg in segs:
+            })
+            if not self._lock_owned(owner):
+                # we overran the staleness age and a thief took over:
+                # its view may already supersede ours, so replacing
+                # the snapshot now could erase records it compacted
+                # and unlinked.  Abort untouched — our retired files
+                # stay readable and the thief folds them in.
+                telemetry.counter("candstore.compact_lock_lost")
+                return False
+            atomic_write_text(self.snapshot_path, payload)
+            for seg in retired:
                 try:
                     os.remove(seg)
                 except OSError:
@@ -424,10 +612,10 @@ class CandStore:
             telemetry.gauge("candstore.store_bytes",
                             float(self.size_bytes()))
             telemetry.event("candstore.compact", n=len(recs),
-                            segments=len(segs))
+                            segments=len(retired))
             return True
         finally:
-            self._release_compact_lock()
+            self._release_compact_lock(owner)
 
     # -- read side -----------------------------------------------------------
 

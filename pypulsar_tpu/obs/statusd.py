@@ -206,6 +206,12 @@ def prometheus_text(outdir: Optional[str] = None) -> str:
 # ---------------------------------------------------------------------------
 # the server
 
+class _BadQuery(ValueError):
+    """A /candidates query parameter failed to parse — the client's
+    fault, reported as a 400 with the offending parameter named (NOT
+    the generic 500 the handler uses for real snapshot failures)."""
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "pypulsar-statusd/1"
 
@@ -220,8 +226,13 @@ class _Handler(BaseHTTPRequestHandler):
                 body = self.server.metrics().encode()
                 ctype = "text/plain; version=0.0.4"
             elif path == "/candidates":
-                body = json.dumps(
-                    self.server.candidates(query), default=str).encode()
+                try:
+                    body = json.dumps(
+                        self.server.candidates(query),
+                        default=str).encode()
+                except _BadQuery as e:
+                    self.send_error(400, str(e))
+                    return
                 ctype = "application/json"
             else:
                 self.send_error(404, "unknown path (serve /status.json, "
@@ -283,7 +294,14 @@ class _Server(ThreadingHTTPServer):
 
         def one(key, cast=str):
             vals = q.get(key)
-            return cast(vals[0]) if vals else None
+            if not vals:
+                return None
+            try:
+                return cast(vals[0])
+            except (TypeError, ValueError):
+                raise _BadQuery(
+                    f"query parameter {key}={vals[0]!r} is not a "
+                    f"valid {cast.__name__}")
 
         p = one("p", float)
         dm = one("dm", float)

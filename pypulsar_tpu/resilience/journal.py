@@ -400,6 +400,18 @@ class RunJournal:
             out = [r for r in out if r.get("event") == event]
         return out
 
+    def inode(self) -> Optional[Tuple[int, int]]:
+        """``(st_dev, st_ino)`` of the open append handle, or None when
+        nothing has been written yet.  A shared journal's file can be
+        renamed or unlinked under a live writer by another host (e.g. a
+        candstore compaction retiring a segment); comparing this
+        against ``os.stat(path)`` tells the writer whether its records
+        still live at the path it thinks they do."""
+        if self._fh is None:
+            return None
+        st = os.fstat(self._fh.fileno())
+        return (st.st_dev, st.st_ino)
+
     def close(self) -> None:
         if self._fh is not None:
             self._fh.close()

@@ -13,6 +13,7 @@ import json
 import os
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -296,6 +297,111 @@ def test_two_racing_hosts_publish_to_one_store(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# compaction vs concurrent publishers (the retire-then-read discipline)
+# ---------------------------------------------------------------------------
+
+
+def test_retired_segments_stay_readable_and_next_compact_adopts(tmp_path):
+    """A compactor killed between retiring a segment and replacing the
+    snapshot leaves ``*.retired-*`` files: queries must still see
+    their records, and the next compaction folds and unlinks them."""
+    st = CandStore(str(tmp_path))
+    st.publish("o0", [_rec(0.1, 20.0, 5.0)], "fpA")
+    seg = st._segments()[0]
+    os.rename(seg, seg + ".retired-dead-1")
+    assert st._segments() == []
+    assert len(st.query()) == 1  # retired file still read
+    assert st.compact()
+    assert len(st.query()) == 1
+    assert st._retired_segments() == []  # adopted + unlinked
+
+
+def test_publisher_republishes_when_segment_retired_midflight(tmp_path):
+    """The writer half of the handshake: a racing compactor renames
+    the publisher's segment away between appends; the publish must
+    notice (inode check) and re-append into a fresh segment BEFORE
+    booking — books must never assert records that live only in a
+    file a compactor may unlink."""
+    outdir = str(tmp_path)
+    calls = {"n": 0, "renamed": False}
+
+    def fence():
+        calls["n"] += 1
+        # after the first record lands, play the concurrent
+        # compactor: retire the active segment out from under us
+        if calls["n"] == 3 and not calls["renamed"]:
+            segs = CandStore(outdir)._segments()
+            if segs:
+                os.rename(segs[0], segs[0] + ".retired-race-1")
+                calls["renamed"] = True
+
+    st = CandStore(outdir, fence=fence)
+    recs = [_rec(0.1 + 0.01 * i, 20.0 + i, 5.0 + i) for i in range(3)]
+    assert st.publish("o0", recs, "fpA") == 3
+    ro = CandStore(outdir)
+    assert ro.published() == {"o0": "fpA"}
+    assert len(ro.query()) == 3  # exactly-once despite the race
+    assert ro._segments(), "records must live in a LINKED segment"
+    # the fresh segment alone holds a full copy: unlinking the retired
+    # file (what the racing compactor goes on to do) loses nothing
+    for seg in ro._retired_segments():
+        os.remove(seg)
+    assert len(CandStore(outdir).query()) == 3
+
+
+def test_compact_lock_steal_exactly_once_and_owned_release(tmp_path):
+    st = CandStore(str(tmp_path))
+    st.publish("o0", [_rec(0.1, 20.0, 5.0)], "fpA")
+    lock = st._lock_path
+    with open(lock, "w") as f:
+        f.write("dead-compactor")
+    old = time.time() - 3600
+    os.utime(lock, (old, old))
+    tok = st._take_compact_lock()
+    assert tok is not None  # stale lock stolen
+    # a second contender sees the winner's FRESH lock and backs off
+    # (two racing os.remove stealers could both "win" — the bug class)
+    assert st._take_compact_lock() is None
+    # a thief that decided we were dead replaced the lock: release
+    # must not delete the thief's lock out from under it
+    with open(lock, "w") as f:
+        f.write("thief")
+    st._release_compact_lock(tok)
+    assert os.path.exists(lock)
+
+
+def test_compact_aborts_when_lock_stolen_midrun(tmp_path):
+    """A compaction that overruns the staleness age and loses its lock
+    must NOT replace the snapshot or unlink anything — its stale view
+    could erase records the thief already folded in."""
+    outdir = str(tmp_path)
+    CandStore(outdir).publish("o0", [_rec(0.1, 20.0, 5.0)], "fpA")
+    probe = CandStore(outdir)
+    calls = {"n": 0}
+
+    def fence():
+        calls["n"] += 1
+        if calls["n"] >= 2:  # after the lock is held: play the thief
+            with open(probe._lock_path, "w") as f:
+                f.write("thief")
+
+    assert CandStore(outdir, fence=fence).compact() is False
+    assert not os.path.exists(probe.snapshot_path)  # replace aborted
+    assert len(CandStore(outdir).query()) == 1  # retired rows readable
+
+
+def test_published_cache_sees_other_writers(tmp_path):
+    st = CandStore(str(tmp_path))
+    st.publish("o0", [_rec(0.1, 20.0, 5.0)], "fpA")
+    assert st.published() == {"o0": "fpA"}
+    # another handle (another host) books o1: the cached parse must be
+    # invalidated by the ledger's stat signature, not trusted stale
+    CandStore(str(tmp_path)).publish("o1", [_rec(0.2, 21.0, 6.0)],
+                                     "fpB")
+    assert st.published() == {"o0": "fpA", "o1": "fpB"}
+
+
+# ---------------------------------------------------------------------------
 # cross-observation candsift
 # ---------------------------------------------------------------------------
 
@@ -372,6 +478,13 @@ def test_statusd_candidates_endpoint(tmp_path):
             timeout=10).read())
         assert doc2["n"] == 1
         assert doc2["records"][0]["snr"] == 12.0
+        # malformed query params are the CLIENT's fault: 400 naming
+        # the parameter, not a generic 500 "snapshot failed"
+        for bad in ("?top=abc", "?p=x&dm=40.0", "?epoch_lo=5&epoch_hi=z"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + "/candidates" + bad,
+                                       timeout=10)
+            assert ei.value.code == 400
 
 
 # ---------------------------------------------------------------------------
@@ -404,6 +517,27 @@ def _mk_obs(td, n):
         obs.append(Observation(f"o{i}", raw,
                                os.path.join(str(td), f"o{i}")))
     return obs
+
+
+def test_fingerprint_tracks_tenant_not_trace_id(tmp_path):
+    """Metadata that rides on the records but is not in the artifact
+    files (tenant, header position/epoch) must move the fingerprint —
+    a tenant remap over unchanged artifacts has to supersede the old
+    rows, not dup-skip and leave /candidates?tenant= wrong forever.
+    trace_id differs every run and must NOT move it."""
+    outbase = str(tmp_path / "o0")
+    rows = [{"pfd": "x.pfd", "best_dm": 40.0, "period": 0.1024,
+             "snr": 11.0}]
+    with open(outbase + "_snr.json", "w") as f:
+        json.dump(rows, f)
+    raw = str(tmp_path / "o0.raw")
+    _, fp_a = normalize_obs("o0", outbase, raw)
+    _, fp_b = normalize_obs("o0", outbase, raw, tenant="lofar")
+    assert fp_a != fp_b
+    _, fp_c = normalize_obs("o0", outbase, raw)
+    assert fp_c == fp_a  # deterministic
+    _, fp_d = normalize_obs("o0", outbase, raw, trace_id="t-123")
+    assert fp_d == fp_a  # resume keeps its exactly-once no-op
 
 
 def test_normalize_obs_prefers_row_radec(tmp_path):
